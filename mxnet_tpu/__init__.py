@@ -1,0 +1,30 @@
+"""mxnet_tpu — a TPU-native framework with the capabilities of MXNet v0.9.4.
+
+Not a port: the compute substrate is JAX/XLA (jit, vjp, sharding, Pallas),
+the API surface is MXNet's (nd/sym/mod/kv/io) so reference user code maps
+1:1.  See SURVEY.md at the repo root for the blueprint and per-module
+docstrings for reference citations.
+"""
+from .base import MXNetError, AttrScope, NameManager, __version__
+from .context import Context, cpu, cpu_pinned, gpu, tpu, current_context, num_devices
+from . import engine
+from . import random
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = [
+    "MXNetError",
+    "AttrScope",
+    "NameManager",
+    "Context",
+    "cpu",
+    "gpu",
+    "tpu",
+    "current_context",
+    "nd",
+    "NDArray",
+    "engine",
+    "random",
+]

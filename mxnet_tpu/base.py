@@ -1,0 +1,166 @@
+"""Common utilities for mxnet_tpu.
+
+TPU-native re-imagining of MXNet's dmlc-core utility surface
+(reference: include/mxnet/base.h, dmlc logging/parameter).  There is no C
+ABI boundary here: the "C API" layer of the reference (src/c_api/) is
+collapsed into the Python package because the compute substrate is
+JAX/XLA, reached directly through jaxlib.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any
+
+__version__ = "0.1.0"
+
+
+class MXNetError(RuntimeError):
+    """Error raised by mxnet_tpu (parity: dmlc::Error / MXGetLastError)."""
+
+
+def get_env(name: str, default, dtype=None):
+    """Read an env var with a typed default (parity: dmlc::GetEnv).
+
+    Environment variables keep their reference names (MXNET_*) so existing
+    user configs carry over; see docs/how_to/env_var.md in the reference.
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    ty = dtype or type(default)
+    if ty is bool:
+        return val.lower() not in ("0", "false", "")
+    return ty(val)
+
+
+def parse_attr(value: Any):
+    """Normalize an op attribute that may arrive as a string.
+
+    The reference parses all op kwargs from strings via dmlc::Parameter
+    (include/mxnet/base.h + dmlc parameter.h); frontends send everything
+    as str through the C API.  We accept native Python values but also
+    literal-parse strings so string-typed configs behave identically.
+    """
+    if not isinstance(value, str):
+        return value
+    s = value.strip()
+    low = s.lower()
+    if low in ("true",):
+        return True
+    if low in ("false",):
+        return False
+    if low in ("none", "null"):
+        return None
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return value
+
+
+def normalize_tuple(value, ndim: int, name: str = "value"):
+    """Broadcast an int (or 1-tuple) to an ndim-tuple (kernel/stride/pad)."""
+    value = parse_attr(value)
+    if isinstance(value, int):
+        return (value,) * ndim
+    value = tuple(value)
+    if len(value) == 1:
+        return value * ndim
+    if len(value) != ndim:
+        raise ValueError(f"{name} must have {ndim} elements, got {value}")
+    return value
+
+
+_BOOL_STRS = {"true": True, "false": False, "1": True, "0": False}
+
+
+def parse_bool(value) -> bool:
+    if isinstance(value, str):
+        return _BOOL_STRS.get(value.lower(), bool(value))
+    return bool(value)
+
+
+def frozen_attrs(attrs: dict) -> tuple:
+    """Hashable view of an attr dict, for jit-dispatch cache keys."""
+
+    def freeze(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(freeze(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((k, freeze(x)) for k, x in v.items()))
+        return v
+
+    return tuple(sorted((k, freeze(v)) for k, v in attrs.items()))
+
+
+class _NameManager:
+    """Auto-namer for symbols (parity: python/mxnet/name.py NameManager)."""
+
+    _current = None
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        hint = hint.lower()
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        self._old = _NameManager._current
+        _NameManager._current = self
+        return self
+
+    def __exit__(self, *exc):
+        _NameManager._current = self._old
+
+
+def current_name_manager() -> _NameManager:
+    if _NameManager._current is None:
+        _NameManager._current = _NameManager()
+    return _NameManager._current
+
+
+NameManager = _NameManager
+
+
+class AttrScope:
+    """Scoped symbol attributes (parity: python/mxnet/attribute.py).
+
+    Used for model parallelism: ``with mx.AttrScope(ctx_group='dev1'):``
+    tags symbols; the executor maps groups to mesh shardings
+    (reference: graph_executor.cc:225-314 PlaceDevice pass).
+    """
+
+    _current = None
+
+    def __init__(self, **kwargs):
+        self._attr = {k: str(v) for k, v in kwargs.items()}
+
+    def get(self, attr):
+        merged = dict(self._attr)
+        if attr:
+            merged.update(attr)
+        return merged
+
+    def __enter__(self):
+        self._old = AttrScope._current
+        if self._old is not None:
+            merged = dict(self._old._attr)
+            merged.update(self._attr)
+            scope = AttrScope()
+            scope._attr = merged
+            AttrScope._current = scope
+        else:
+            AttrScope._current = self
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._current = self._old
+
+
+def current_attr_scope():
+    return AttrScope._current

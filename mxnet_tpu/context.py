@@ -1,0 +1,112 @@
+"""Device contexts.
+
+Parity with python/mxnet/context.py (reference), re-targeted at TPU.
+``mx.tpu(i)`` is first-class; ``mx.gpu(i)`` aliases the i-th accelerator so
+reference scripts run unchanged.  A Context resolves lazily to a concrete
+``jax.Device`` — on a CPU-only host (tests force JAX_PLATFORMS=cpu with 8
+virtual devices) every context maps into the virtual device list, which is
+how the reference's "multi-device on CPU-only machines" tests work
+(tests/python/unittest/test_multi_device_exec.py).
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Context:
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+    _default_ctx = None
+
+    def __init__(self, device_type, device_id: int = 0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+
+    @property
+    def device_type(self) -> str:
+        return Context.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __enter__(self):
+        self._old_ctx = Context._default_ctx
+        Context._default_ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        Context._default_ctx = self._old_ctx
+
+    # --- TPU-native resolution -------------------------------------------------
+    @property
+    def jax_device(self) -> "jax.Device":
+        """Concrete jax.Device for this context.
+
+        Accelerator contexts (tpu/gpu) prefer the default backend's devices;
+        cpu contexts use the CPU backend.  device_id indexes modulo the
+        available device count so reference scripts with gpu(0..3) still run
+        on smaller topologies.
+        """
+        devs = _device_list(self.device_type)
+        return devs[self.device_id % len(devs)]
+
+    def empty_cache(self):  # parity: MXStorageEmptyCache; XLA manages pools
+        return None
+
+
+def _device_list(device_type: str):
+    if device_type in ("gpu", "tpu"):
+        default = jax.devices()
+        if default and default[0].platform != "cpu":
+            return default
+        # CPU-only host: accelerator contexts fold onto virtual CPU devices.
+        return jax.devices("cpu")
+    return jax.devices("cpu")
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def num_devices(device_type: str = "tpu") -> int:
+    return len(_device_list(device_type))
+
+
+def current_context() -> Context:
+    if Context._default_ctx is None:
+        Context._default_ctx = Context("cpu", 0)
+    return Context._default_ctx
+
+
+def default_accelerator_context() -> Context:
+    """tpu(0) when an accelerator backend exists, else cpu(0)."""
+    devs = jax.devices()
+    if devs and devs[0].platform != "cpu":
+        return tpu(0)
+    return cpu(0)

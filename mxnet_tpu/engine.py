@@ -1,0 +1,89 @@
+"""Engine-lite: ordering and synchronization over PjRt's async dispatch.
+
+The reference's dependency engine (src/engine/threaded_engine.{h,cc};
+include/mxnet/engine.h:75-229) exists to (a) run ops asynchronously off the
+Python thread, (b) serialize writers / parallelize readers per variable, and
+(c) expose WaitForVar/WaitForAll sync points.  On TPU, (a) and (b) are
+native properties of the substrate: every jitted call dispatches
+asynchronously on the PjRt stream, and XLA's buffer ordering serializes
+access per buffer.  What remains host-side is a *thin* layer:
+
+- per-NDArray version counters (parity: ThreadedVar versioning,
+  src/engine/threaded_engine.h:44-227) so views/mutation interact sanely,
+- wait_to_read/wait_to_write -> jax block_until_ready,
+- WaitForAll -> block on all live arrays,
+- the profiler hook points that the reference wraps around op execution
+  (src/engine/profiler.h:20-137).
+
+There are deliberately no worker threads: XLA owns scheduling.  The
+"NaiveEngine" debugging fallback (src/engine/naive_engine.cc) maps to
+MXNET_ENGINE_TYPE=NaiveEngine, which makes every imperative invoke block —
+the same bisection tool for ruling out async effects.
+"""
+from __future__ import annotations
+
+import weakref
+
+import jax
+
+from .base import get_env
+
+_live_arrays: "weakref.WeakValueDictionary[int, object]" = weakref.WeakValueDictionary()
+_counter = 0
+
+
+def _engine_is_naive() -> bool:
+    return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") == "NaiveEngine"
+
+
+def track(arr) -> int:
+    """Register a live device array so wait_for_all can reach it."""
+    global _counter
+    _counter += 1
+    try:
+        _live_arrays[_counter] = arr
+    except TypeError:
+        pass
+    return _counter
+
+
+def on_push(result):
+    """Called after every imperative op dispatch.
+
+    Under NaiveEngine semantics every push synchronizes immediately —
+    parity with src/engine/naive_engine.cc:16-198 where exec happens on
+    the pushing thread.
+    """
+    if _engine_is_naive():
+        jax.block_until_ready(result)
+    return result
+
+
+def wait_for_var(arr):
+    """Parity: Engine::WaitForVar (include/mxnet/engine.h:180)."""
+    jax.block_until_ready(arr)
+
+
+def wait_for_all():
+    """Parity: Engine::WaitForAll (include/mxnet/engine.h:184)."""
+    for arr in list(_live_arrays.values()):
+        try:
+            jax.block_until_ready(arr)
+        except Exception:
+            pass
+
+
+class _Variable:
+    """Host-side var handle (parity: Engine::NewVariable).
+
+    Only bookkeeping: version bumps on write let callers detect staleness;
+    actual read/write ordering is enforced by XLA buffer semantics.
+    """
+
+    __slots__ = ("version",)
+
+    def __init__(self):
+        self.version = 0
+
+    def on_write(self):
+        self.version += 1

@@ -1,0 +1,29 @@
+"""Operator library — importing this package registers the full op surface.
+
+Layout mirrors the functional grouping of the reference's src/operator/
+(SURVEY.md §2.1 rows 'Neural-net operators' / 'Tensor ops'):
+
+- elemwise.py      unary/binary/scalar/logic/broadcast + ElementWiseSum
+- reduce.py        reductions + arg-reductions
+- matrix.py        dot/batch_dot, reshape family, slicing, ordering
+- indexing.py      Embedding/take/one_hot
+- init_sample.py   zeros/ones/arange + uniform/normal sampling
+- nn.py            Conv/Deconv/FC/BN/Pool/Act/Dropout/LRN/Concat/...
+- loss.py          *Output ops (custom_vjp backward), MakeLoss, CE
+- sequence.py      SequenceLast/Mask/Reverse
+- optimizer_ops.py fused sgd/adam/rmsprop update kernels
+- spatial.py       GridGenerator/BilinearSampler/SpatialTransformer/ROI/...
+- rnn_op.py        fused RNN op (lax.scan)
+"""
+from . import registry
+from .registry import OpCtx, OpDef, get, exists, invoke, list_ops, register
+
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import indexing  # noqa: F401
+from . import init_sample  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import sequence  # noqa: F401
+from . import optimizer_ops  # noqa: F401

@@ -1,0 +1,260 @@
+"""Elementwise ops: unary math, binary (+scalar, +broadcast), logic.
+
+Parity surface: src/operator/tensor/elemwise_unary_op.cc,
+elemwise_binary_op_basic.cc, elemwise_binary_scalar_op_*.cc,
+elemwise_binary_broadcast_op_*.cc, elemwise_sum.cc (reference, SURVEY.md
+Appendix A).  All ops are thin jnp lambdas — XLA fuses chains of these into
+single kernels, which *is* the TPU-native replacement for mshadow's
+expression templates (reference mshadow expression engine).
+
+MXNet semantics preserved:
+- ``elemwise_*`` requires same-shape operands (no silent broadcast);
+  ``broadcast_*`` are the broadcasting variants.
+- logic ops return float arrays of 0/1 (reference mshadow_op.h comparisons).
+- ``smooth_l1`` takes scalar sigma via attr.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, parse_attr
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# unary math ops (reference: elemwise_unary_op.cc list, SURVEY.md:535-540)
+# ---------------------------------------------------------------------------
+_GAMMA = lambda x: jnp.exp(jax.scipy.special.gammaln(x))
+
+_UNARY = {
+    "abs": jnp.abs,
+    "arccos": jnp.arccos,
+    "arccosh": jnp.arccosh,
+    "arcsin": jnp.arcsin,
+    "arcsinh": jnp.arcsinh,
+    "arctan": jnp.arctan,
+    "arctanh": jnp.arctanh,
+    "ceil": jnp.ceil,
+    "cos": jnp.cos,
+    "cosh": jnp.cosh,
+    "degrees": jnp.degrees,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "fix": jnp.trunc,
+    "floor": jnp.floor,
+    "gamma": _GAMMA,
+    "gammaln": jax.scipy.special.gammaln,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "log2": jnp.log2,
+    "negative": jnp.negative,
+    "radians": jnp.radians,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "sinh": jnp.sinh,
+    "sqrt": jnp.sqrt,
+    "square": jnp.square,
+    "tan": jnp.tan,
+    "tanh": jnp.tanh,
+    # not standalone in the reference (mshadow_op.h functors) but exposed for
+    # convenience; Activation provides the parity path.
+    "sigmoid": jax.nn.sigmoid,
+    "relu": jax.nn.relu,
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name)(lambda ctx, data, _fn=_fn, **attrs: _fn(data))
+
+register("_copy", aliases=("identity",))(lambda ctx, data, **a: data + 0)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def _block_grad(ctx, data, **attrs):
+    """Identity forward, zero gradient (reference: block_grad in
+    elemwise_unary_op.cc; SURVEY.md:538)."""
+    return jax.lax.stop_gradient(data)
+
+
+@register("Cast", aliases=("cast",))
+def _cast(ctx, data, **attrs):
+    """Parity: Cast op (elemwise_unary_op.cc)."""
+    return data.astype(jnp.dtype(attrs["dtype"]))
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (same-shape contract, reference elemwise_binary_op_basic)
+# ---------------------------------------------------------------------------
+def _same_shape(lhs, rhs, name):
+    if lhs.shape != rhs.shape:
+        raise MXNetError(
+            f"{name}: shapes {lhs.shape} and {rhs.shape} differ; use broadcast_{name.strip('_')}"
+        )
+
+
+def _binary(fn, name, check=True):
+    def impl(ctx, lhs, rhs, **attrs):
+        if check:
+            _same_shape(lhs, rhs, name)
+        return fn(lhs, rhs)
+
+    return impl
+
+
+_BINARY = {
+    "elemwise_add": jnp.add,
+    "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply,
+    "elemwise_div": jnp.divide,
+    "_power": jnp.power,
+    "_maximum": jnp.maximum,
+    "_minimum": jnp.minimum,
+    "_hypot": jnp.hypot,
+}
+_BINARY_ALIASES = {
+    "elemwise_add": ("_plus", "_add", "_Plus"),
+    "elemwise_sub": ("_minus", "_sub", "_Minus"),
+    "elemwise_mul": ("_mul", "_Mul"),
+    "elemwise_div": ("_div", "_Div"),
+    "_power": ("_Power",),
+    "_maximum": ("_Maximum",),
+    "_minimum": ("_Minimum",),
+    "_hypot": (),
+}
+for _name, _fn in _BINARY.items():
+    register(_name, arg_names=("lhs", "rhs"), aliases=_BINARY_ALIASES[_name])(
+        _binary(_fn, _name)
+    )
+
+# _grad_add: same as add; used by grad aggregation (elemwise_binary_op_basic.cc)
+register("_grad_add", arg_names=("lhs", "rhs"))(_binary(jnp.add, "_grad_add"))
+
+
+@register("smooth_l1")
+def _smooth_l1(ctx, data, **attrs):
+    """Parity: smooth_l1 (elemwise_binary_op_trig/extended); scalar sigma."""
+    sigma = float(parse_attr(attrs.get("scalar", attrs.get("sigma", 1.0))))
+    s2 = sigma * sigma
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * jnp.square(data), a - 0.5 / s2)
+
+
+_LOGIC = {
+    "_equal": jnp.equal,
+    "_not_equal": jnp.not_equal,
+    "_greater": jnp.greater,
+    "_greater_equal": jnp.greater_equal,
+    "_lesser": jnp.less,
+    "_lesser_equal": jnp.less_equal,
+}
+for _name, _fn in _LOGIC.items():
+    register(_name, arg_names=("lhs", "rhs"))(
+        _binary(lambda l, r, _fn=_fn: _fn(l, r).astype(l.dtype), _name)
+    )
+
+# ---------------------------------------------------------------------------
+# scalar variants (reference elemwise_binary_scalar_op_*.cc)
+# ---------------------------------------------------------------------------
+def _scalar_op(fn, reverse=False):
+    def impl(ctx, data, **attrs):
+        s = jnp.asarray(parse_attr(attrs["scalar"]), dtype=data.dtype)
+        return fn(s, data) if reverse else fn(data, s)
+
+    return impl
+
+
+_SCALAR = {
+    "_plus_scalar": (jnp.add, False),
+    "_minus_scalar": (jnp.subtract, False),
+    "_rminus_scalar": (jnp.subtract, True),
+    "_mul_scalar": (jnp.multiply, False),
+    "_div_scalar": (jnp.divide, False),
+    "_rdiv_scalar": (jnp.divide, True),
+    "_power_scalar": (jnp.power, False),
+    "_rpower_scalar": (jnp.power, True),
+    "_maximum_scalar": (jnp.maximum, False),
+    "_minimum_scalar": (jnp.minimum, False),
+    "_hypot_scalar": (jnp.hypot, False),
+    "_equal_scalar": (lambda a, b: jnp.equal(a, b).astype(a.dtype), False),
+    "_not_equal_scalar": (lambda a, b: jnp.not_equal(a, b).astype(a.dtype), False),
+    "_greater_scalar": (lambda a, b: jnp.greater(a, b).astype(a.dtype), False),
+    "_greater_equal_scalar": (lambda a, b: jnp.greater_equal(a, b).astype(a.dtype), False),
+    "_lesser_scalar": (lambda a, b: jnp.less(a, b).astype(a.dtype), False),
+    "_lesser_equal_scalar": (lambda a, b: jnp.less_equal(a, b).astype(a.dtype), False),
+}
+for _name, (_fn, _rev) in _SCALAR.items():
+    register(_name, aliases=(_name.replace("_", "_Plus", 1),) if False else ())(
+        _scalar_op(_fn, _rev)
+    )
+
+# ---------------------------------------------------------------------------
+# broadcast variants (reference elemwise_binary_broadcast_op_*.cc)
+# ---------------------------------------------------------------------------
+_BROADCAST = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_equal": lambda a, b: jnp.equal(a, b).astype(a.dtype),
+    "broadcast_not_equal": lambda a, b: jnp.not_equal(a, b).astype(a.dtype),
+    "broadcast_greater": lambda a, b: jnp.greater(a, b).astype(a.dtype),
+    "broadcast_greater_equal": lambda a, b: jnp.greater_equal(a, b).astype(a.dtype),
+    "broadcast_lesser": lambda a, b: jnp.less(a, b).astype(a.dtype),
+    "broadcast_lesser_equal": lambda a, b: jnp.less_equal(a, b).astype(a.dtype),
+    "broadcast_plus": jnp.add,
+    "broadcast_minus": jnp.subtract,
+}
+for _name, _fn in _BROADCAST.items():
+    register(_name, arg_names=("lhs", "rhs"))(_binary(_fn, _name, check=False))
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(ctx, data, **attrs):
+    """Parity: broadcast_axis (broadcast_reduce_op_value.cc)."""
+    axes = parse_attr(attrs.get("axis", ()))
+    sizes = parse_attr(attrs.get("size", ()))
+    if isinstance(axes, int):
+        axes = (axes,)
+    if isinstance(sizes, int):
+        sizes = (sizes,)
+    shape = list(data.shape)
+    for ax, sz in zip(axes, sizes):
+        if shape[ax] != 1:
+            raise MXNetError("broadcast_axis: source axis must have size 1")
+        shape[ax] = sz
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("broadcast_to")
+def _broadcast_to(ctx, data, **attrs):
+    shape = tuple(parse_attr(attrs["shape"]))
+    # MXNet allows 0 meaning "keep source dim"
+    shape = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register("ElementWiseSum", varargs=True, aliases=("add_n", "_sum"))
+def _element_wise_sum(ctx, *args, **attrs):
+    """Parity: ElementWiseSum (src/operator/tensor/elemwise_sum.cc); the
+    gradient-aggregation workhorse (NDArray ElementwiseSum,
+    src/ndarray/ndarray.cc:302)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("clip")
+def _clip(ctx, data, **attrs):
+    """Parity: clip (matrix_op.cc)."""
+    return jnp.clip(
+        data, float(parse_attr(attrs["a_min"])), float(parse_attr(attrs["a_max"]))
+    )

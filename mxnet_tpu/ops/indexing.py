@@ -1,0 +1,70 @@
+"""Indexing ops (parity: src/operator/tensor/indexing_op.{h,cc}).
+
+Embedding / take lower to XLA gather — the TPU path for what the reference
+does with hand-written CUDA gather kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import parse_attr
+from .registry import register
+
+
+def _embedding_params(attrs, *in_shapes):
+    inp = int(parse_attr(attrs["input_dim"]))
+    out = int(parse_attr(attrs["output_dim"]))
+    return {"weight": (inp, out)}
+
+
+@register(
+    "Embedding",
+    arg_names=("data", "weight"),
+    param_names=("weight",),
+    infer_params=_embedding_params,
+)
+def _embedding(ctx, data, weight, **attrs):
+    """Parity: Embedding (indexing_op.h).  data holds float indices (MXNet
+    convention); output shape = data.shape + (output_dim,)."""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("take", arg_names=("a", "indices"))
+def _take(ctx, a, indices, **attrs):
+    """Parity: take (indexing_op.cc); axis=0 only in v0.9.4, clip mode."""
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[0] - 1)
+    return jnp.take(a, idx, axis=0)
+
+
+@register("batch_take", arg_names=("a", "indices"))
+def _batch_take(ctx, a, indices, **attrs):
+    """Parity: batch_take — per-row element pick (indexing_op.cc)."""
+    idx = indices.astype(jnp.int32).reshape(-1)
+    return a[jnp.arange(a.shape[0]), idx]
+
+
+@register("one_hot", aliases=("_onehot_encode",))
+def _one_hot(ctx, data, **attrs):
+    """Parity: _onehot_encode NDArray function (src/ndarray/ndarray.cc:752)."""
+    depth = int(parse_attr(attrs["depth"]))
+    on = float(parse_attr(attrs.get("on_value", 1.0)))
+    off = float(parse_attr(attrs.get("off_value", 0.0)))
+    oh = jax.nn.one_hot(data.astype(jnp.int32), depth, dtype=jnp.float32)
+    return oh * (on - off) + off
+
+
+@register("choose_element_0index", arg_names=("lhs", "rhs"))
+def _choose_element_0index(ctx, lhs, rhs, **attrs):
+    """Parity: choose_element_0index (src/ndarray/ndarray.cc:755) — pick
+    lhs[i, rhs[i]] per row."""
+    idx = rhs.astype(jnp.int32).reshape(-1)
+    return lhs[jnp.arange(lhs.shape[0]), idx]
+
+
+@register("fill_element_0index", arg_names=("lhs", "mhs", "rhs"))
+def _fill_element_0index(ctx, lhs, mhs, rhs, **attrs):
+    """Parity: fill_element_0index (ndarray.cc:761) — lhs[i, rhs[i]] = mhs[i]."""
+    idx = rhs.astype(jnp.int32).reshape(-1)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs.reshape(-1))

@@ -1,0 +1,81 @@
+"""Creation + sampling ops.
+
+Parity: src/operator/tensor/init_op.cc (_zeros/_ones/_arange) and
+sample_op.cc (uniform/normal).  Sampling ops draw from explicit JAX PRNG
+keys via OpCtx.rng() — the pure replacement for mshadow's stateful
+per-device random resource (include/mxnet/resource.h kRandom).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import parse_attr
+from .registry import register
+
+
+def _dtype_of(attrs, default=jnp.float32):
+    dt = attrs.get("dtype", None)
+    return jnp.dtype(dt) if dt is not None else jnp.dtype(default)
+
+
+@register("_zeros", arg_names=())
+def _zeros(ctx, **attrs):
+    return jnp.zeros(tuple(parse_attr(attrs["shape"])), dtype=_dtype_of(attrs))
+
+
+@register("_ones", arg_names=())
+def _ones(ctx, **attrs):
+    return jnp.ones(tuple(parse_attr(attrs["shape"])), dtype=_dtype_of(attrs))
+
+
+@register("_full", arg_names=())
+def _full(ctx, **attrs):
+    return jnp.full(
+        tuple(parse_attr(attrs["shape"])),
+        parse_attr(attrs["value"]),
+        dtype=_dtype_of(attrs),
+    )
+
+
+@register("_arange", arg_names=())
+def _arange(ctx, **attrs):
+    """Parity: _arange (init_op.cc); supports repeat like the reference."""
+    start = parse_attr(attrs.get("start", 0))
+    stop = parse_attr(attrs.get("stop", None))
+    step = parse_attr(attrs.get("step", 1.0))
+    repeat = int(parse_attr(attrs.get("repeat", 1)))
+    if stop in (None, "None"):
+        start, stop = 0, start
+    out = jnp.arange(start, stop, step, dtype=_dtype_of(attrs))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("uniform", arg_names=(), needs_rng=True, aliases=("_sample_uniform", "random_uniform"))
+def _uniform(ctx, **attrs):
+    """Parity: uniform (sample_op.cc); low/high bounds."""
+    low = float(parse_attr(attrs.get("low", 0.0)))
+    high = float(parse_attr(attrs.get("high", 1.0)))
+    shape = tuple(parse_attr(attrs["shape"]))
+    return jax.random.uniform(
+        ctx.rng(), shape, dtype=_dtype_of(attrs), minval=low, maxval=high
+    )
+
+
+@register("normal", arg_names=(), needs_rng=True, aliases=("_sample_normal", "random_normal"))
+def _normal(ctx, **attrs):
+    """Parity: normal (sample_op.cc); loc/scale."""
+    loc = float(parse_attr(attrs.get("loc", 0.0)))
+    scale = float(parse_attr(attrs.get("scale", 1.0)))
+    shape = tuple(parse_attr(attrs["shape"]))
+    return loc + scale * jax.random.normal(ctx.rng(), shape, dtype=_dtype_of(attrs))
+
+
+@register("_set_value", arg_names=())
+def _set_value(ctx, **attrs):
+    """Parity: _set_value NDArray function (src/ndarray/ndarray.cc:748)."""
+    return jnp.full(
+        tuple(parse_attr(attrs["shape"])), parse_attr(attrs["src"]), dtype=_dtype_of(attrs)
+    )

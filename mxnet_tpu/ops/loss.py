@@ -1,0 +1,254 @@
+"""Output/loss ops with MXNet backward semantics.
+
+The reference's *Output ops are identity-ish in forward and source their
+own gradient in backward, ignoring the incoming head gradient (reference:
+src/operator/softmax_output-inl.h:136 ``grad = (out - label) * grad_scale``;
+src/operator/regression_output-inl.h:70-79 ``grad = grad_scale/num_output *
+BackwardOp(out, label)``).  We reproduce this exactly with jax.custom_vjp:
+the vjp discards the cotangent and emits the op-defined gradient, so
+``executor.backward()`` with default ones head-grads matches the reference
+bit-for-bit in structure.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, parse_attr, parse_bool
+from .registry import register
+
+
+def _norm_enum(attrs):
+    n = attrs.get("normalization", "null")
+    return n if n in ("null", "batch", "valid") else "null"
+
+
+@register("SoftmaxOutput", arg_names=("data", "label"), aliases=("Softmax",))
+def _softmax_output(ctx, data, label, **attrs):
+    """Parity: SoftmaxOutput (src/operator/softmax_output-inl.h).
+
+    Forward: softmax over axis 1 (multi_output softmaxes channel axis for
+    (N,C,d...) inputs).  Backward: (p - onehot(label)) * grad_scale with
+    null/batch/valid normalization and use_ignore masking — head gradient
+    ignored (reference :136,:156-176,:203-224).  ``Softmax`` is the
+    deprecated alias the reference keeps (softmax_output.cc registration).
+    """
+    grad_scale = float(parse_attr(attrs.get("grad_scale", 1.0)))
+    ignore_label = float(parse_attr(attrs.get("ignore_label", -1.0)))
+    use_ignore = parse_bool(attrs.get("use_ignore", False))
+    multi_output = parse_bool(attrs.get("multi_output", False))
+    normalization = _norm_enum(attrs)
+    preserve_shape = parse_bool(attrs.get("preserve_shape", False))
+
+    @jax.custom_vjp
+    def fwd(data, label):
+        return _softmax_fwd(data)
+
+    def _softmax_fwd(data):
+        if multi_output or preserve_shape or data.ndim <= 2:
+            return jax.nn.softmax(data, axis=1 if data.ndim > 1 else 0)
+        # default: flatten to (N, C)
+        return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=1).reshape(data.shape)
+
+    def fwd_fwd(data, label):
+        out = _softmax_fwd(data)
+        return out, (out, label)
+
+    def fwd_bwd(res, g):
+        out, label = res
+        if multi_output and out.ndim > 2:
+            # label (N, d...) indexes channel axis 1
+            lab = label.astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, out.shape[1], dtype=out.dtype, axis=1)
+            grad = out - onehot
+            mask = (label != ignore_label) if use_ignore else None
+        else:
+            lab = label.reshape(-1).astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, out.shape[-1], dtype=out.dtype)
+            grad = out.reshape(lab.shape[0], -1) - onehot
+            mask = (label.reshape(-1) != ignore_label) if use_ignore else None
+            if mask is not None:
+                grad = grad * mask[:, None].astype(grad.dtype)
+            grad = grad.reshape(out.shape)
+        if multi_output and mask is not None:
+            grad = grad * jnp.expand_dims(mask, 1).astype(grad.dtype)
+        scale = grad_scale
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid" and mask is not None:
+            valid = jnp.maximum(jnp.sum(mask.astype(grad.dtype)), 1.0)
+            grad = grad / valid
+        elif normalization == "valid":
+            grad = grad / out.shape[0]
+        return (scale * grad, jnp.zeros_like(label))
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    return fwd(data, label)
+
+
+def _regression_output(name, fwd_fn, bwd_fn, doc):
+    @register(name, arg_names=("data", "label"))
+    def _impl(ctx, data, label, **attrs):
+        grad_scale = float(parse_attr(attrs.get("grad_scale", 1.0)))
+
+        @jax.custom_vjp
+        def fwd(data, label):
+            return fwd_fn(data)
+
+        def f(data, label):
+            return fwd_fn(data), (fwd_fn(data), label)
+
+        def b(res, g):
+            out, label = res
+            # reference: grad_scale / num_output where num_output =
+            # label.size/batch (regression_output-inl.h:70-79)
+            num_output = max(int(jnp.size(label)) // label.shape[0], 1) \
+                if hasattr(label, "shape") and label.ndim > 0 else 1
+            lab = label.reshape(out.shape)
+            grad = bwd_fn(out, lab) * (grad_scale / num_output)
+            return (grad, jnp.zeros_like(label))
+
+        fwd.defvjp(f, b)
+        return fwd(data, label)
+
+    _impl.__doc__ = doc
+    return _impl
+
+
+_regression_output(
+    "LinearRegressionOutput",
+    lambda d: d,
+    lambda o, l: o - l,
+    "Parity: LinearRegressionOutput (regression_output-inl.h, kLinear).",
+)
+_regression_output(
+    "LogisticRegressionOutput",
+    jax.nn.sigmoid,
+    lambda o, l: o - l,
+    "Parity: LogisticRegressionOutput (regression_output-inl.h, kLogistic).",
+)
+_regression_output(
+    "MAERegressionOutput",
+    lambda d: d,
+    lambda o, l: jnp.sign(o - l),
+    "Parity: MAERegressionOutput (regression_output-inl.h, kMAE).",
+)
+
+
+@register("SVMOutput", arg_names=("data", "label"))
+def _svm_output(ctx, data, label, **attrs):
+    """Parity: SVMOutput (src/operator/svm_output-inl.h); hinge-loss
+    gradient (L1 or squared) with margin + regularization_coefficient."""
+    margin = float(parse_attr(attrs.get("margin", 1.0)))
+    reg = float(parse_attr(attrs.get("regularization_coefficient", 1.0)))
+    use_linear = parse_bool(attrs.get("use_linear", False))
+
+    @jax.custom_vjp
+    def fwd(data, label):
+        return data
+
+    def f(data, label):
+        return data, (data, label)
+
+    def b(res, g):
+        data, label = res
+        lab = label.reshape(-1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, data.shape[1], dtype=data.dtype)
+        sign = 2.0 * onehot - 1.0  # +1 at true class, -1 elsewhere
+        viol = (margin - sign * data) > 0
+        if use_linear:  # L1-SVM: grad = -sign where margin violated
+            grad = jnp.where(viol, -sign * reg, 0.0)
+        else:  # L2-SVM: grad = -2*(margin - sign*x)*sign where violated
+            grad = jnp.where(viol, -2.0 * (margin - sign * data) * sign * reg, 0.0)
+        return (grad.astype(data.dtype), jnp.zeros_like(label))
+
+    fwd.defvjp(f, b)
+    return fwd(data, label)
+
+
+@register("MakeLoss")
+def _make_loss(ctx, data, **attrs):
+    """Parity: MakeLoss (src/operator/make_loss-inl.h): identity forward,
+    backward = grad_scale (normalized) regardless of head gradient."""
+    grad_scale = float(parse_attr(attrs.get("grad_scale", 1.0)))
+    normalization = _norm_enum(attrs)
+
+    @jax.custom_vjp
+    def fwd(data):
+        return data
+
+    def f(data):
+        return data, data.shape
+
+    def b(shape, g):
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / shape[0]
+        elif normalization == "valid":
+            scale = scale / max(int(jnp.prod(jnp.array(shape))), 1)
+        return (jnp.full(shape, scale, dtype=jnp.float32),)
+
+    fwd.defvjp(f, b)
+    return fwd(data)
+
+
+@register("softmax_cross_entropy", arg_names=("data", "label"))
+def _softmax_cross_entropy(ctx, data, label, **attrs):
+    """Parity: softmax_cross_entropy (src/operator/loss_binary_op.cc) —
+    scalar summed CE between softmax(data) and integer labels."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.reshape(-1).astype(jnp.int32)
+    ce = -logp[jnp.arange(data.shape[0]), lab]
+    return jnp.sum(ce).reshape((1,))
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(ctx, data, **attrs):
+    """Parity: SoftmaxActivation (src/operator/softmax_activation-inl.h);
+    mode instance (softmax over trailing dims flattened) or channel."""
+    mode = attrs.get("mode", "instance")
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=1).reshape(data.shape)
+
+
+def _kl_params(attrs, data_shape, *rest):
+    return {"moving_avg": (data_shape[1],)}
+
+
+@register(
+    "IdentityAttachKLSparseReg",
+    arg_names=("data",),
+    aux_names=("moving_avg",),
+    infer_params=_kl_params,
+)
+def _identity_attach_kl(ctx, data, moving_avg, **attrs):
+    """Parity: IdentityAttachKLSparseReg
+    (src/operator/identity_attach_KL_sparse_reg-inl.h): identity forward;
+    backward adds KL-divergence sparsity penalty gradient computed from the
+    moving average activation."""
+    penalty = float(parse_attr(attrs.get("penalty", 0.001)))
+    sparseness_target = float(parse_attr(attrs.get("sparseness_target", 0.1)))
+    momentum = float(parse_attr(attrs.get("momentum", 0.9)))
+
+    avg = jnp.mean(data, axis=tuple(i for i in range(data.ndim) if i != 1))
+    new_avg = moving_avg * momentum + avg * (1 - momentum) if ctx.is_train else moving_avg
+
+    @jax.custom_vjp
+    def fwd(data, mavg):
+        return data
+
+    def f(data, mavg):
+        return data, (data.shape, mavg)
+
+    def b(res, g):
+        shape, mavg = res
+        rho = jnp.clip(mavg, 1e-6, 1 - 1e-6)
+        kl_grad = penalty * (
+            -sparseness_target / rho + (1.0 - sparseness_target) / (1.0 - rho)
+        )
+        bshape = (1, -1) + (1,) * (len(shape) - 2)
+        return (g + kl_grad.reshape(bshape), jnp.zeros_like(mavg))
+
+    fwd.defvjp(f, b)
+    return fwd(data, moving_avg), (jax.lax.stop_gradient(new_avg),)

@@ -1,0 +1,204 @@
+"""Matrix / shape-manipulation ops.
+
+Parity: src/operator/tensor/matrix_op.cc + matrix_op-inl.h (1589 LoC in the
+reference), ordering_op-inl.h (sort/topk/argsort — reference uses CUB; here
+jax.lax.sort/top_k lower straight to XLA, SURVEY.md §2.2 'cub' row).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, parse_attr, parse_bool
+from .registry import register
+
+
+@register("dot", arg_names=("lhs", "rhs"))
+def _dot(ctx, lhs, rhs, **attrs):
+    """Parity: dot (matrix_op.cc). transpose_a/transpose_b attrs.
+
+    1-D x 1-D -> scalar-as-(1,) like the reference; 2-D matmul hits the MXU.
+    """
+    ta = parse_bool(attrs.get("transpose_a", False))
+    tb = parse_bool(attrs.get("transpose_b", False))
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs).reshape((1,))
+    a = lhs.T if ta else lhs
+    b = rhs.T if tb else rhs
+    return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(lhs.dtype)
+
+
+@register("batch_dot", arg_names=("lhs", "rhs"))
+def _batch_dot(ctx, lhs, rhs, **attrs):
+    """Parity: batch_dot (matrix_op.cc) — (B,M,K)x(B,K,N)."""
+    ta = parse_bool(attrs.get("transpose_a", False))
+    tb = parse_bool(attrs.get("transpose_b", False))
+    a = jnp.swapaxes(lhs, -1, -2) if ta else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if tb else rhs
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(lhs.dtype)
+
+
+@register("transpose")
+def _transpose(ctx, data, **attrs):
+    axes = parse_attr(attrs.get("axes", None))
+    if axes is None or axes == ():
+        return jnp.transpose(data)
+    return jnp.transpose(data, tuple(axes))
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def _swapaxis(ctx, data, **attrs):
+    """Parity: SwapAxis (src/operator/swapaxis-inl.h)."""
+    return jnp.swapaxes(
+        data, int(parse_attr(attrs.get("dim1", 0))), int(parse_attr(attrs.get("dim2", 0)))
+    )
+
+
+def _infer_reshape(shape, target):
+    """MXNet v0.9 reshape codes: 0 copies the input dim, -1 infers."""
+    target = list(target)
+    for i, t in enumerate(target):
+        if t == 0:
+            target[i] = shape[i]
+    if -1 in target:
+        known = int(np.prod([t for t in target if t != -1]))
+        total = int(np.prod(shape))
+        target[target.index(-1)] = total // max(known, 1)
+    return tuple(int(t) for t in target)
+
+
+@register("Reshape", aliases=("reshape",))
+def _reshape(ctx, data, **attrs):
+    """Parity: Reshape (matrix_op.cc); supports 0 / -1 shape codes."""
+    shape = parse_attr(attrs.get("shape", attrs.get("target_shape", None)))
+    return jnp.reshape(data, _infer_reshape(data.shape, tuple(shape)))
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(ctx, data, **attrs):
+    """Parity: Flatten — collapse all but axis 0 (matrix_op.cc)."""
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("expand_dims")
+def _expand_dims(ctx, data, **attrs):
+    return jnp.expand_dims(data, int(parse_attr(attrs["axis"])))
+
+
+@register("crop", aliases=("slice",))
+def _slice(ctx, data, **attrs):
+    """Parity: crop/slice (matrix_op.cc) — begin/end per-axis slice."""
+    begin = tuple(parse_attr(attrs["begin"]))
+    end = tuple(parse_attr(attrs["end"]))
+    idx = tuple(
+        slice(b, e) for b, e in zip(begin, end)
+    ) + (Ellipsis,)
+    return data[idx]
+
+
+@register("slice_axis")
+def _slice_axis(ctx, data, **attrs):
+    """Parity: slice_axis (matrix_op.cc); end may be None for 'to the end'."""
+    axis = int(parse_attr(attrs["axis"]))
+    begin = int(parse_attr(attrs["begin"]))
+    end = parse_attr(attrs.get("end", None))
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, None if end in (None, "None") else int(end))
+    return data[tuple(idx)]
+
+
+@register("flip")
+def _flip(ctx, data, **attrs):
+    axis = parse_attr(attrs["axis"])
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(data, axis=tuple(axis))
+
+
+@register("repeat")
+def _repeat(ctx, data, **attrs):
+    reps = int(parse_attr(attrs["repeats"]))
+    axis = parse_attr(attrs.get("axis", None))
+    return jnp.repeat(data, reps, axis=None if axis is None else int(axis))
+
+
+@register("tile")
+def _tile(ctx, data, **attrs):
+    return jnp.tile(data, tuple(parse_attr(attrs["reps"])))
+
+
+# --- ordering (reference ordering_op-inl.h; CUB -> lax.sort/top_k) ---------
+@register("sort")
+def _sort(ctx, data, **attrs):
+    axis = parse_attr(attrs.get("axis", -1))
+    is_ascend = parse_bool(attrs.get("is_ascend", True))
+    axis = None if axis in (None, "None") else int(axis)
+    if axis is None:
+        data = data.reshape(-1)
+        axis = 0
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort")
+def _argsort(ctx, data, **attrs):
+    axis = parse_attr(attrs.get("axis", -1))
+    is_ascend = parse_bool(attrs.get("is_ascend", True))
+    axis = None if axis in (None, "None") else int(axis)
+    if axis is None:
+        data = data.reshape(-1)
+        axis = 0
+    idx = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(data.dtype)
+
+
+@register("topk", num_outputs=2, output_names=("output", "indices"))
+def _topk(ctx, data, **attrs):
+    """Parity: topk (ordering_op-inl.h:478).  ret_typ selects outputs:
+    'indices' (default) | 'value' | 'both' | 'mask'."""
+    axis = parse_attr(attrs.get("axis", -1))
+    k = int(parse_attr(attrs.get("k", 1)))
+    ret_typ = attrs.get("ret_typ", "indices")
+    is_ascend = parse_bool(attrs.get("is_ascend", False))
+    axis = data.ndim - 1 if axis in (None, "None") else int(axis) % data.ndim
+    moved = jnp.moveaxis(data, axis, -1)
+    vals, idxs = jax.lax.top_k(-moved if is_ascend else moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(data.dtype)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idxs)
+    if ret_typ == "mask":
+        onehot = jax.nn.one_hot(jnp.moveaxis(idxs, axis, -1).astype(jnp.int32),
+                                data.shape[axis], dtype=data.dtype)
+        mask = jnp.moveaxis(onehot.sum(axis=-2), -1, axis)
+        return mask
+    return idxs
+
+
+@register("_identity_with_attr_like_rhs", arg_names=("lhs", "rhs"))
+def _identity_like_rhs(ctx, lhs, rhs, **attrs):
+    return lhs + jnp.zeros_like(rhs)
+
+
+@register("_crop_assign", arg_names=("lhs", "rhs"))
+def _crop_assign(ctx, lhs, rhs, **attrs):
+    begin = tuple(parse_attr(attrs["begin"]))
+    end = tuple(parse_attr(attrs["end"]))
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return lhs.at[idx].set(rhs)
+
+
+@register("_crop_assign_scalar")
+def _crop_assign_scalar(ctx, data, **attrs):
+    begin = tuple(parse_attr(attrs["begin"]))
+    end = tuple(parse_attr(attrs["end"]))
+    scalar = parse_attr(attrs.get("scalar", 0.0))
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return data.at[idx].set(scalar)

@@ -1,0 +1,518 @@
+"""Neural-network layer ops.
+
+Parity: the reference's layer-op families in src/operator/*-inl.h
+(Convolution, FullyConnected, BatchNorm, Pooling, Activation, LeakyReLU,
+Dropout, LRN, Concat, SliceChannel, InstanceNorm, L2Normalization,
+UpSampling, Pad, Crop — SURVEY.md Appendix A).  TPU-first mapping:
+
+- Convolution  -> lax.conv_general_dilated (MXU); user-facing layout stays
+  NCHW for API parity, XLA picks physical tiling (SURVEY.md §7 layout note).
+- Pooling      -> lax.reduce_window.
+- cuDNN autotune (cudnn_*-inl.h) has no analogue: XLA autotunes.
+- All kernels fuse with surrounding elementwise ops at XLA level, replacing
+  the reference's hand-fused mshadow expressions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, normalize_tuple, parse_attr, parse_bool
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+def _conv_dims(kernel):
+    kernel = parse_attr(kernel)
+    return len(tuple(kernel) if not isinstance(kernel, int) else (kernel,))
+
+
+def _conv_dim_numbers(nd):
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    if spatial is None:
+        raise MXNetError("Convolution supports 1/2/3 spatial dims")
+    return ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+
+
+def _conv_attrs(attrs):
+    nd = _conv_dims(attrs["kernel"])
+    kernel = normalize_tuple(attrs["kernel"], nd, "kernel")
+    stride = normalize_tuple(attrs.get("stride", (1,) * nd), nd, "stride")
+    pad = normalize_tuple(attrs.get("pad", (0,) * nd), nd, "pad")
+    dilate = normalize_tuple(attrs.get("dilate", (1,) * nd), nd, "dilate")
+    num_filter = int(parse_attr(attrs["num_filter"]))
+    num_group = int(parse_attr(attrs.get("num_group", 1)))
+    no_bias = parse_bool(attrs.get("no_bias", False))
+    return nd, kernel, stride, pad, dilate, num_filter, num_group, no_bias
+
+
+def _conv_params(attrs, data_shape, *rest):
+    nd, kernel, _, _, _, num_filter, num_group, no_bias = _conv_attrs(attrs)
+    in_ch = data_shape[1]
+    shapes = {"weight": (num_filter, in_ch // num_group) + kernel}
+    if not no_bias:
+        shapes["bias"] = (num_filter,)
+    return shapes
+
+
+def _no_bias_drop(attrs):
+    return {"bias"} if parse_bool(attrs.get("no_bias", False)) else set()
+
+
+@register(
+    "Convolution",
+    arg_names=("data", "weight", "bias"),
+    param_names=("weight", "bias"),
+    infer_params=_conv_params,
+    optional_args=_no_bias_drop,
+)
+def _convolution(ctx, data, weight, bias=None, **attrs):
+    """Parity: Convolution (src/operator/convolution-inl.h).
+
+    weight layout (num_filter, C/group, *kernel) == reference OIHW.
+    """
+    nd, kernel, stride, pad, dilate, num_filter, num_group, no_bias = _conv_attrs(attrs)
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dim_numbers(nd))
+    out = jax.lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _deconv_params(attrs, data_shape, *rest):
+    nd, kernel, _, _, _, num_filter, num_group, no_bias = _conv_attrs(attrs)
+    in_ch = data_shape[1]
+    shapes = {"weight": (in_ch, num_filter // num_group) + kernel}
+    if not no_bias:
+        shapes["bias"] = (num_filter,)
+    return shapes
+
+
+@register(
+    "Deconvolution",
+    arg_names=("data", "weight", "bias"),
+    param_names=("weight", "bias"),
+    infer_params=_deconv_params,
+    optional_args=_no_bias_drop,
+    attr_defaults={"no_bias": True},
+)
+def _deconvolution(ctx, data, weight, bias=None, **attrs):
+    """Parity: Deconvolution (src/operator/deconvolution-inl.h) — transposed
+    conv; adj/target_shape attrs for output sizing."""
+    nd, kernel, stride, pad, dilate, num_filter, num_group, no_bias = _conv_attrs(attrs)
+    adj = normalize_tuple(attrs.get("adj", (0,) * nd), nd, "adj")
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, (data.shape[1], num_filter // num_group) + kernel, _conv_dim_numbers(nd)
+    )
+    # Transposed convolution as gradient-of-conv: lhs dilation by stride.
+    out = jax.lax.conv_general_dilated(
+        data,
+        jnp.flip(weight, axis=tuple(range(2, 2 + nd))).swapaxes(0, 1)
+        if num_group == 1
+        else _grouped_flip(weight, nd, num_group),
+        window_strides=(1,) * nd,
+        padding=[
+            (d * (k - 1) - p, d * (k - 1) - p + a + s - 1)
+            for k, p, s, d, a in zip(kernel, pad, stride, dilate, adj)
+        ],
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _grouped_flip(weight, nd, groups):
+    # weight (C_in, num_filter//g, *k) -> grouped transpose per group
+    cin, fpg = weight.shape[0], weight.shape[1]
+    w = weight.reshape((groups, cin // groups) + weight.shape[1:])
+    w = jnp.flip(w, axis=tuple(range(3, 3 + nd)))
+    w = w.swapaxes(1, 2).reshape((groups * fpg, cin // groups) + weight.shape[2:])
+    return w
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+def _fc_params(attrs, data_shape, *rest):
+    num_hidden = int(parse_attr(attrs["num_hidden"]))
+    in_dim = int(np.prod(data_shape[1:]))
+    shapes = {"weight": (num_hidden, in_dim)}
+    if not parse_bool(attrs.get("no_bias", False)):
+        shapes["bias"] = (num_hidden,)
+    return shapes
+
+
+@register(
+    "FullyConnected",
+    arg_names=("data", "weight", "bias"),
+    param_names=("weight", "bias"),
+    infer_params=_fc_params,
+    optional_args=_no_bias_drop,
+)
+def _fully_connected(ctx, data, weight, bias=None, **attrs):
+    """Parity: FullyConnected (src/operator/fully_connected-inl.h); always
+    flattens trailing dims like the reference v0.9 op."""
+    x = data.reshape((data.shape[0], -1))
+    out = jnp.dot(x, weight.T, preferred_element_type=jnp.float32).astype(data.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (aux: moving stats)
+# ---------------------------------------------------------------------------
+def _bn_params(attrs, data_shape, *rest):
+    c = data_shape[1]
+    return {
+        "gamma": (c,),
+        "beta": (c,),
+        "moving_mean": (c,),
+        "moving_var": (c,),
+    }
+
+
+@register(
+    "BatchNorm",
+    arg_names=("data", "gamma", "beta"),
+    param_names=("gamma", "beta"),
+    aux_names=("moving_mean", "moving_var"),
+    infer_params=_bn_params,
+)
+def _batch_norm(ctx, data, gamma, beta, moving_mean, moving_var, **attrs):
+    """Parity: BatchNorm (src/operator/batch_norm-inl.h).
+
+    Defaults mirror the reference: eps=1e-3, momentum=0.9, fix_gamma=True.
+    Returns (out, (new_moving_mean, new_moving_var)); in eval mode (or
+    use_global_stats) the moving stats are used and passed through.
+    """
+    eps = float(parse_attr(attrs.get("eps", 1e-3)))
+    momentum = float(parse_attr(attrs.get("momentum", 0.9)))
+    fix_gamma = parse_bool(attrs.get("fix_gamma", True))
+    use_global = parse_bool(attrs.get("use_global_stats", False))
+
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    axes = (0,) + tuple(range(2, data.ndim))
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+
+    if ctx.is_train and not use_global:
+        mean = jnp.mean(data, axis=axes)
+        var = jnp.var(data, axis=axes)
+        new_mean = moving_mean * momentum + mean * (1 - momentum)
+        new_var = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * inv.reshape(bshape) * gamma.reshape(
+        bshape
+    ) + beta.reshape(bshape)
+    return out, (jax.lax.stop_gradient(new_mean), jax.lax.stop_gradient(new_var))
+
+
+def _in_params(attrs, data_shape, *rest):
+    c = data_shape[1]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+@register(
+    "InstanceNorm",
+    arg_names=("data", "gamma", "beta"),
+    param_names=("gamma", "beta"),
+    infer_params=_in_params,
+)
+def _instance_norm(ctx, data, gamma, beta, **attrs):
+    """Parity: InstanceNorm (src/operator/instance_norm-inl.h)."""
+    eps = float(parse_attr(attrs.get("eps", 1e-3)))
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (data - mean) * jax.lax.rsqrt(var + eps)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization")
+def _l2_normalization(ctx, data, **attrs):
+    """Parity: L2Normalization (src/operator/l2_normalization-inl.h);
+    mode instance (default) / channel / spatial."""
+    eps = float(parse_attr(attrs.get("eps", 1e-10)))
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise MXNetError(f"L2Normalization: unknown mode {mode}")
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+@register("Pooling")
+def _pooling(ctx, data, **attrs):
+    """Parity: Pooling (src/operator/pooling-inl.h).
+
+    pool_type max/avg/sum; global_pool; pooling_convention valid (floor,
+    default) or full (ceil, reference kFull).  avg counts padding like the
+    reference's mshadow pool (count-include-pad).
+    """
+    nd = data.ndim - 2
+    if parse_bool(attrs.get("global_pool", False)):
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = normalize_tuple(attrs["kernel"], nd, "kernel")
+        stride = normalize_tuple(attrs.get("stride", (1,) * nd), nd, "stride")
+        pad = normalize_tuple(attrs.get("pad", (0,) * nd), nd, "pad")
+    pool_type = attrs.get("pool_type", "max")
+    convention = attrs.get("pooling_convention", "valid")
+
+    padding = [(0, 0), (0, 0)]
+    for i in range(nd):
+        lo = pad[i]
+        hi = pad[i]
+        if convention == "full":
+            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            rem = size % stride[i]
+            if rem != 0:
+                hi += stride[i] - rem  # ceil-mode: extend right edge
+        padding.append((lo, hi))
+
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    if pool_type == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(data, init, jax.lax.max, window, strides, padding)
+    elif pool_type in ("avg", "sum"):
+        out = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, padding)
+        if pool_type == "avg":
+            out = out / float(np.prod(kernel))
+    else:
+        raise MXNetError(f"Pooling: unknown pool_type {pool_type}")
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+@register("Activation")
+def _activation(ctx, data, **attrs):
+    """Parity: Activation (src/operator/activation-inl.h); act_type in
+    relu/sigmoid/tanh/softrelu."""
+    act = attrs.get("act_type", "relu")
+    if act == "relu":
+        return jax.nn.relu(data)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act == "tanh":
+        return jnp.tanh(data)
+    if act == "softrelu":
+        return jax.nn.softplus(data)
+    raise MXNetError(f"Activation: unknown act_type {act}")
+
+
+def _prelu_params(attrs, data_shape, *rest):
+    if attrs.get("act_type", "leaky") == "prelu":
+        return {"gamma": (data_shape[1],)}
+    return {}
+
+
+def _leaky_optional(attrs):
+    return set() if attrs.get("act_type", "leaky") == "prelu" else {"gamma"}
+
+
+@register(
+    "LeakyReLU",
+    arg_names=("data", "gamma"),
+    param_names=("gamma",),
+    infer_params=_prelu_params,
+    optional_args=_leaky_optional,
+    needs_rng=True,
+)
+def _leaky_relu(ctx, data, gamma=None, **attrs):
+    """Parity: LeakyReLU (src/operator/leaky_relu-inl.h); act_type in
+    leaky/prelu/elu/rrelu."""
+    act = attrs.get("act_type", "leaky")
+    slope = float(parse_attr(attrs.get("slope", 0.25)))
+    if act == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, g * data)
+    if act == "rrelu":
+        lo = float(parse_attr(attrs.get("lower_bound", 0.125)))
+        hi = float(parse_attr(attrs.get("upper_bound", 0.334)))
+        if ctx.is_train:
+            s = jax.random.uniform(
+                ctx.rng(), (1, data.shape[1]) + (1,) * (data.ndim - 2), minval=lo, maxval=hi
+            )
+        else:
+            s = (lo + hi) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise MXNetError(f"LeakyReLU: unknown act_type {act}")
+
+
+@register("Dropout", needs_rng=True)
+def _dropout(ctx, data, **attrs):
+    """Parity: Dropout (src/operator/dropout-inl.h); inverted dropout with
+    keep-prob scaling at train time, identity at eval."""
+    p = float(parse_attr(attrs.get("p", 0.5)))
+    if not ctx.is_train or p <= 0.0:
+        return data
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(ctx.rng(), keep, data.shape)
+    return jnp.where(mask, data / keep, 0.0).astype(data.dtype)
+
+
+@register("LRN")
+def _lrn(ctx, data, **attrs):
+    """Parity: LRN (src/operator/lrn-inl.h) cross-channel normalization:
+    out = data / (knorm + alpha/nsize * sum_sq_window)^beta."""
+    alpha = float(parse_attr(attrs.get("alpha", 1e-4)))
+    beta = float(parse_attr(attrs.get("beta", 0.75)))
+    knorm = float(parse_attr(attrs.get("knorm", 2.0)))
+    nsize = int(parse_attr(attrs["nsize"]))
+    half = nsize // 2
+    sq = jnp.square(data)
+    window = (1, nsize) + (1,) * (data.ndim - 2)
+    strides = (1,) * data.ndim
+    padding = [(0, 0), (half, nsize - 1 - half)] + [(0, 0)] * (data.ndim - 2)
+    ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window, strides, padding)
+    return data * jnp.power(knorm + alpha / nsize * ssum, -beta)
+
+
+# ---------------------------------------------------------------------------
+# Concat / SliceChannel
+# ---------------------------------------------------------------------------
+@register("Concat", varargs=True, aliases=("concat",))
+def _concat(ctx, *args, **attrs):
+    """Parity: Concat (src/operator/concat-inl.h); attr dim (default 1)."""
+    dim = int(parse_attr(attrs.get("dim", 1)))
+    return jnp.concatenate(args, axis=dim)
+
+
+def _slice_channel_outputs(attrs):
+    return int(parse_attr(attrs.get("num_outputs", 1)))
+
+
+@register("SliceChannel", num_outputs=-1, aliases=("split",))
+def _slice_channel(ctx, data, **attrs):
+    """Parity: SliceChannel/split (src/operator/slice_channel-inl.h)."""
+    num = int(parse_attr(attrs["num_outputs"]))
+    axis = int(parse_attr(attrs.get("axis", 1)))
+    squeeze = parse_bool(attrs.get("squeeze_axis", False))
+    parts = jnp.split(data, num, axis=axis)
+    if squeeze:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# Pad / UpSampling / Crop (layer variant)
+# ---------------------------------------------------------------------------
+@register("Pad", aliases=("pad",))
+def _pad(ctx, data, **attrs):
+    """Parity: Pad (src/operator/pad-inl.h); pad_width in MXNet's flat
+    (before,after)-per-axis order; modes constant/edge/reflect."""
+    pw = tuple(parse_attr(attrs["pad_width"]))
+    mode = attrs.get("mode", "constant")
+    value = float(parse_attr(attrs.get("constant_value", 0.0)))
+    pads = [(pw[2 * i], pw[2 * i + 1]) for i in range(data.ndim)]
+    if mode == "constant":
+        return jnp.pad(data, pads, mode="constant", constant_values=value)
+    if mode == "edge":
+        return jnp.pad(data, pads, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pads, mode="reflect")
+    raise MXNetError(f"Pad: unknown mode {mode}")
+
+
+def _upsampling_params(attrs, data_shape, *rest):
+    if attrs.get("sample_type", "nearest") == "bilinear":
+        scale = int(parse_attr(attrs["scale"]))
+        num_filter = int(parse_attr(attrs.get("num_filter", data_shape[1])))
+        k = 2 * scale - scale % 2
+        return {"weight": (num_filter, 1, k, k)}
+    return {}
+
+
+def _upsampling_optional(attrs):
+    return set() if attrs.get("sample_type", "nearest") == "bilinear" else {"weight"}
+
+
+@register(
+    "UpSampling",
+    arg_names=("data", "weight"),
+    param_names=("weight",),
+    varargs=False,
+    infer_params=_upsampling_params,
+    optional_args=_upsampling_optional,
+)
+def _upsampling(ctx, data, weight=None, **attrs):
+    """Parity: UpSampling (src/operator/upsampling-inl.h); nearest repeats,
+    bilinear is a deconvolution with a (learnable) bilinear kernel."""
+    scale = int(parse_attr(attrs["scale"]))
+    sample_type = attrs.get("sample_type", "nearest")
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+        return out
+    # bilinear: transposed conv with stride=scale, groups=C
+    k = 2 * scale - scale % 2
+    p = int(np.ceil((scale - 1) / 2.0))
+    c = data.shape[1]
+    dn = jax.lax.conv_dimension_numbers(data.shape, (c, 1, k, k), ("NCHW", "OIHW", "NCHW"))
+    out = jax.lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=(1, 1),
+        padding=[(k - 1 - p, k - 1 - p + scale - 1), (k - 1 - p, k - 1 - p + scale - 1)],
+        lhs_dilation=(scale, scale),
+        dimension_numbers=dn,
+        feature_group_count=c,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    return out
+
+
+@register("Crop", arg_names=("data", "crop_like"), optional_args=lambda a: set()
+          if int(parse_attr(a.get("num_args", 1))) > 1 else {"crop_like"})
+def _crop_layer(ctx, data, crop_like=None, **attrs):
+    """Parity: Crop layer (src/operator/crop-inl.h) — crop spatial dims to
+    crop_like's (or h_w attr), with offset or center crop."""
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = tuple(parse_attr(attrs["h_w"]))
+    offset = parse_attr(attrs.get("offset", (0, 0)))
+    center = parse_bool(attrs.get("center_crop", False))
+    h, w = data.shape[2], data.shape[3]
+    if center:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return data[:, :, oy : oy + th, ox : ox + tw]

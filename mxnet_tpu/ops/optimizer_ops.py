@@ -1,0 +1,84 @@
+"""Fused optimizer-update kernels.
+
+Parity: src/operator/optimizer_op.cc (sgd_update, sgd_mom_update,
+adam_update, rmsprop_update) — the reference's fused CUDA kernels called by
+python/mxnet/optimizer.py.  Here each is one jitted XLA computation, so the
+clip+decay+update chain fuses exactly as the hand-written kernels do.
+Semantics (rescale_grad, clip_gradient, wd applied to weight) follow
+optimizer_op-inl.h.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import parse_attr
+from .registry import register
+
+
+def _prep_grad(grad, weight, attrs):
+    rescale = float(parse_attr(attrs.get("rescale_grad", 1.0)))
+    clip = parse_attr(attrs.get("clip_gradient", None))
+    wd = float(parse_attr(attrs.get("wd", 0.0)))
+    g = grad * rescale
+    if clip is not None and float(clip) > 0:
+        g = jnp.clip(g, -float(clip), float(clip))
+    return g + wd * weight
+
+
+@register("sgd_update", arg_names=("weight", "grad"))
+def _sgd_update(ctx, weight, grad, **attrs):
+    lr = float(parse_attr(attrs["lr"]))
+    return weight - lr * _prep_grad(grad, weight, attrs)
+
+
+@register(
+    "sgd_mom_update",
+    arg_names=("weight", "grad", "mom"),
+    num_outputs=2,
+    output_names=("weight", "mom"),
+)
+def _sgd_mom_update(ctx, weight, grad, mom, **attrs):
+    """mom = momentum*mom - lr*grad';  weight += mom (optimizer_op-inl.h)."""
+    lr = float(parse_attr(attrs["lr"]))
+    momentum = float(parse_attr(attrs.get("momentum", 0.0)))
+    g = _prep_grad(grad, weight, attrs)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register(
+    "adam_update",
+    arg_names=("weight", "grad", "mean", "var"),
+    num_outputs=3,
+    output_names=("weight", "mean", "var"),
+)
+def _adam_update(ctx, weight, grad, mean, var, **attrs):
+    lr = float(parse_attr(attrs["lr"]))
+    beta1 = float(parse_attr(attrs.get("beta1", 0.9)))
+    beta2 = float(parse_attr(attrs.get("beta2", 0.999)))
+    eps = float(parse_attr(attrs.get("epsilon", 1e-8)))
+    g = _prep_grad(grad, weight, attrs)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_weight = weight - lr * new_mean / (jnp.sqrt(new_var) + eps)
+    return new_weight, new_mean, new_var
+
+
+@register(
+    "rmsprop_update",
+    arg_names=("weight", "grad", "n"),
+    num_outputs=2,
+    output_names=("weight", "n"),
+)
+def _rmsprop_update(ctx, weight, grad, n, **attrs):
+    lr = float(parse_attr(attrs["lr"]))
+    gamma1 = float(parse_attr(attrs.get("gamma1", 0.95)))
+    eps = float(parse_attr(attrs.get("epsilon", 1e-8)))
+    clip_weights = parse_attr(attrs.get("clip_weights", None))
+    g = _prep_grad(grad, weight, attrs)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_weight = weight - lr * g / jnp.sqrt(new_n + eps)
+    if clip_weights is not None and float(clip_weights) > 0:
+        cw = float(clip_weights)
+        new_weight = jnp.clip(new_weight, -cw, cw)
+    return new_weight, new_n

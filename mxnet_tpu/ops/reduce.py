@@ -1,0 +1,75 @@
+"""Reduction ops (parity: src/operator/tensor/broadcast_reduce_op_*.cc).
+
+Axis semantics follow the reference: ``axis`` may be int/tuple/empty (empty
+= reduce all), ``keepdims`` bool; argmax/argmin/argmax_channel return float
+indices (MXNet convention: outputs are float arrays holding indices).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import parse_attr, parse_bool
+from .registry import register
+
+
+def _axis_of(attrs, data):
+    axis = parse_attr(attrs.get("axis", None))
+    if axis is None or axis == () or axis == []:
+        return None
+    if isinstance(axis, int):
+        return (axis,)
+    return tuple(axis)
+
+
+def _reduce(fn, name):
+    def impl(ctx, data, **attrs):
+        axis = _axis_of(attrs, data)
+        keepdims = parse_bool(attrs.get("keepdims", False))
+        return fn(data, axis=axis, keepdims=keepdims)
+
+    return impl
+
+
+_REDUCE = {
+    "sum": jnp.sum,
+    "mean": jnp.mean,
+    "prod": jnp.prod,
+    "nansum": jnp.nansum,
+    "nanprod": jnp.nanprod,
+    "max": jnp.max,
+    "min": jnp.min,
+}
+_ALIASES = {"sum": ("sum_axis",), "max": ("max_axis",), "min": ("min_axis",)}
+for _name, _fn in _REDUCE.items():
+    register(_name, aliases=_ALIASES.get(_name, ()))(_reduce(_fn, _name))
+
+
+@register("norm")
+def _norm(ctx, data, **attrs):
+    """Parity: norm — L2 over the whole array (broadcast_reduce_op_value.cc)."""
+    return jnp.sqrt(jnp.sum(jnp.square(data))).reshape((1,))
+
+
+def _arg_reduce(fn):
+    def impl(ctx, data, **attrs):
+        axis = parse_attr(attrs.get("axis", None))
+        keepdims = parse_bool(attrs.get("keepdims", False))
+        if axis is None:
+            out = fn(data.reshape(-1), axis=0)
+            return out.astype(data.dtype)
+        out = fn(data, axis=axis)
+        if keepdims:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(data.dtype)
+
+    return impl
+
+
+register("argmax")(_arg_reduce(jnp.argmax))
+register("argmin")(_arg_reduce(jnp.argmin))
+
+
+@register("argmax_channel")
+def _argmax_channel(ctx, data, **attrs):
+    """Parity: argmax_channel — argmax over axis 1 (channel), returns float."""
+    return jnp.argmax(data, axis=1).astype(data.dtype)

@@ -1,0 +1,65 @@
+"""Sequence ops (parity: src/operator/sequence_{last,mask,reverse}-inl.h).
+
+Time-major (T, N, ...) layout like the reference; optional
+``sequence_length`` input gated by use_sequence_length.  These lower to
+gathers/selects — no scalar loops, jit-safe.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import parse_attr, parse_bool
+from .registry import register
+
+
+def _seq_optional(attrs):
+    if parse_bool(attrs.get("use_sequence_length", False)):
+        return set()
+    return {"sequence_length"}
+
+
+@register(
+    "SequenceLast",
+    arg_names=("data", "sequence_length"),
+    optional_args=_seq_optional,
+)
+def _sequence_last(ctx, data, sequence_length=None, **attrs):
+    if sequence_length is None:
+        return data[-1]
+    idx = sequence_length.astype(jnp.int32) - 1
+    batch = jnp.arange(data.shape[1])
+    return data[idx, batch]
+
+
+@register(
+    "SequenceMask",
+    arg_names=("data", "sequence_length"),
+    optional_args=_seq_optional,
+)
+def _sequence_mask(ctx, data, sequence_length=None, **attrs):
+    value = float(parse_attr(attrs.get("value", 0.0)))
+    if sequence_length is None:
+        return data + 0
+    t = data.shape[0]
+    steps = jnp.arange(t)[:, None]  # (T, 1)
+    mask = steps < sequence_length.astype(jnp.int32)[None, :]  # (T, N)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value).astype(data.dtype)
+
+
+@register(
+    "SequenceReverse",
+    arg_names=("data", "sequence_length"),
+    optional_args=_seq_optional,
+)
+def _sequence_reverse(ctx, data, sequence_length=None, **attrs):
+    if sequence_length is None:
+        return jnp.flip(data, axis=0)
+    t = data.shape[0]
+    lengths = sequence_length.astype(jnp.int32)  # (N,)
+    steps = jnp.arange(t)[:, None]  # (T,1)
+    # index of the element to read for output position t: len-1-t inside the
+    # sequence, t itself beyond it.
+    rev_idx = jnp.where(steps < lengths[None, :], lengths[None, :] - 1 - steps, steps)
+    batch = jnp.arange(data.shape[1])[None, :]
+    return data[rev_idx, batch]

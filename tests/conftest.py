@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of testing multi-device topologies on
+CPU-only machines (tests/python/unittest/test_multi_device_exec.py uses
+mx.cpu(0..3)); here XLA's host-platform device-count flag provides 8
+virtual devices so mesh/sharding/collective paths are exercised without
+TPU hardware (SURVEY.md §4.3).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,236 @@
+"""NDArray unit tests (parity model: tests/python/unittest/test_ndarray.py
+in the reference — numpy is the oracle)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.asnumpy().sum() == 0
+    b = nd.ones((2, 2))
+    np.testing.assert_allclose(b.asnumpy(), np.ones((2, 2)))
+    c = nd.full((2, 3), 7.5)
+    assert c.asnumpy()[1, 2] == 7.5
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_elementwise_arith():
+    a_np = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    b_np = np.random.RandomState(1).rand(3, 4).astype(np.float32) + 0.1
+    a, b = nd.array(a_np), nd.array(b_np)
+    np.testing.assert_allclose((a + b).asnumpy(), a_np + b_np, rtol=1e-6)
+    np.testing.assert_allclose((a - b).asnumpy(), a_np - b_np, rtol=1e-6)
+    np.testing.assert_allclose((a * b).asnumpy(), a_np * b_np, rtol=1e-6)
+    np.testing.assert_allclose((a / b).asnumpy(), a_np / b_np, rtol=1e-5)
+    np.testing.assert_allclose((a + 1.5).asnumpy(), a_np + 1.5, rtol=1e-6)
+    np.testing.assert_allclose((2.0 - a).asnumpy(), 2.0 - a_np, rtol=1e-6)
+    np.testing.assert_allclose((1.0 / b).asnumpy(), 1.0 / b_np, rtol=1e-5)
+    np.testing.assert_allclose((a ** 2).asnumpy(), a_np ** 2, rtol=1e-5)
+    np.testing.assert_allclose((-a).asnumpy(), -a_np)
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 2))
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose((a <= 2).asnumpy(), [1, 1, 0])
+
+
+def test_view_write_through():
+    # parity: NDArray::Slice shares the chunk (include/mxnet/ndarray.h)
+    x = nd.zeros((4, 3))
+    v = x[2]
+    v[:] = 7.0
+    assert (x.asnumpy()[2] == 7.0).all()
+    s = x.slice(0, 2)
+    s[:] = 1.0
+    assert (x.asnumpy()[:2] == 1.0).all()
+    r = x.reshape((3, 4))
+    r[:] = 2.0
+    assert (x.asnumpy() == 2.0).all()
+
+
+def test_setitem_getitem():
+    x = nd.zeros((4, 3))
+    x[1] = 5.0
+    assert (x.asnumpy()[1] == 5.0).all()
+    x[0] = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    np.testing.assert_allclose(x[0].asnumpy(), [1, 2, 3])
+
+
+def test_reductions():
+    a_np = np.random.RandomState(2).rand(3, 4, 5).astype(np.float32)
+    a = nd.array(a_np)
+    np.testing.assert_allclose(nd.sum(a).asnumpy(), a_np.sum(), rtol=1e-5)
+    np.testing.assert_allclose(nd.sum(a, axis=1).asnumpy(), a_np.sum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(nd.mean(a, axis=(0, 2)).asnumpy(), a_np.mean(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(nd.max(a, axis=2).asnumpy(), a_np.max(axis=2), rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.argmax(a, axis=1).asnumpy(), a_np.argmax(axis=1).astype(np.float32)
+    )
+    np.testing.assert_allclose(
+        nd.norm(a).asnumpy(), [np.sqrt((a_np ** 2).sum())], rtol=1e-5
+    )
+
+
+def test_broadcast_ops():
+    a_np = np.random.RandomState(3).rand(3, 1).astype(np.float32)
+    b_np = np.random.RandomState(4).rand(1, 4).astype(np.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    np.testing.assert_allclose(nd.broadcast_add(a, b).asnumpy(), a_np + b_np, rtol=1e-6)
+    np.testing.assert_allclose(nd.broadcast_mul(a, b).asnumpy(), a_np * b_np, rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.broadcast_to(nd.array(a_np), shape=(3, 4)).asnumpy(), np.broadcast_to(a_np, (3, 4))
+    )
+
+
+def test_elemwise_shape_check():
+    a = nd.ones((2, 3))
+    b = nd.ones((3, 2))
+    with pytest.raises(mx.MXNetError):
+        nd.elemwise_add(a, b)
+
+
+def test_matrix_ops():
+    a_np = np.random.RandomState(5).rand(3, 4).astype(np.float32)
+    b_np = np.random.RandomState(6).rand(4, 5).astype(np.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(), a_np @ b_np, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(a, nd.array(b_np.T), transpose_b=True).asnumpy(), a_np @ b_np, rtol=1e-5
+    )
+    bd_a = np.random.RandomState(7).rand(2, 3, 4).astype(np.float32)
+    bd_b = np.random.RandomState(8).rand(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.batch_dot(nd.array(bd_a), nd.array(bd_b)).asnumpy(), bd_a @ bd_b, rtol=1e-5
+    )
+    np.testing.assert_allclose(nd.transpose(a).asnumpy(), a_np.T)
+    np.testing.assert_allclose(
+        nd.Reshape(a, shape=(2, 6)).asnumpy(), a_np.reshape(2, 6)
+    )
+    np.testing.assert_allclose(
+        nd.Reshape(a, shape=(0, -1)).asnumpy(), a_np.reshape(3, 4)
+    )
+    np.testing.assert_allclose(nd.Flatten(nd.array(bd_a)).asnumpy(), bd_a.reshape(2, -1))
+
+
+def test_slicing_ops():
+    a_np = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = nd.array(a_np)
+    np.testing.assert_allclose(
+        nd.slice_axis(a, axis=1, begin=1, end=3).asnumpy(), a_np[:, 1:3]
+    )
+    np.testing.assert_allclose(
+        nd.crop(a, begin=(0, 0, 1), end=(2, 2, 3)).asnumpy(), a_np[:2, :2, 1:3]
+    )
+    np.testing.assert_allclose(nd.flip(a, axis=2).asnumpy(), a_np[:, :, ::-1])
+    np.testing.assert_allclose(
+        nd.repeat(a, repeats=2, axis=1).asnumpy(), np.repeat(a_np, 2, axis=1)
+    )
+    np.testing.assert_allclose(nd.tile(a, reps=(1, 2, 1)).asnumpy(), np.tile(a_np, (1, 2, 1)))
+
+
+def test_ordering_ops():
+    a_np = np.random.RandomState(9).rand(4, 6).astype(np.float32)
+    a = nd.array(a_np)
+    np.testing.assert_allclose(nd.sort(a, axis=1).asnumpy(), np.sort(a_np, axis=1))
+    np.testing.assert_allclose(
+        nd.sort(a, axis=1, is_ascend=False).asnumpy(), -np.sort(-a_np, axis=1)
+    )
+    vals, idxs = nd.topk(a, k=2, ret_typ="both")
+    expect = -np.sort(-a_np, axis=1)[:, :2]
+    np.testing.assert_allclose(vals.asnumpy(), expect, rtol=1e-6)
+
+
+def test_unary_math():
+    a_np = np.random.RandomState(10).rand(3, 3).astype(np.float32) + 0.5
+    a = nd.array(a_np)
+    for name, ref in [
+        ("exp", np.exp),
+        ("log", np.log),
+        ("sqrt", np.sqrt),
+        ("square", np.square),
+        ("abs", np.abs),
+        ("sign", np.sign),
+        ("tanh", np.tanh),
+        ("floor", np.floor),
+        ("ceil", np.ceil),
+    ]:
+        fn = getattr(nd, name)
+        np.testing.assert_allclose(fn(a).asnumpy(), ref(a_np), rtol=1e-5, atol=1e-6)
+
+
+def test_indexing_ops():
+    w_np = np.random.RandomState(11).rand(10, 4).astype(np.float32)
+    idx = nd.array([1.0, 3.0, 5.0])
+    out = nd.Embedding(idx, nd.array(w_np), input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), w_np[[1, 3, 5]])
+    a_np = np.random.RandomState(12).rand(4, 5).astype(np.float32)
+    picked = nd.batch_take(nd.array(a_np), nd.array([0.0, 2.0, 4.0, 1.0]))
+    np.testing.assert_allclose(picked.asnumpy(), a_np[np.arange(4), [0, 2, 4, 1]])
+    oh = nd.one_hot(nd.array([0.0, 2.0]), depth=3)
+    np.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_random_reproducible():
+    mx.random.seed(42)
+    a = nd.uniform(low=0, high=1, shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.uniform(low=0, high=1, shape=(5,)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    assert (a >= 0).all() and (a < 1).all()
+    n = nd.normal(loc=0, scale=1, shape=(1000,)).asnumpy()
+    assert abs(n.mean()) < 0.2
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs.params")
+    data = {"w": nd.ones((2, 3)), "b": nd.zeros((4,))}
+    nd.save(fname, data)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), np.ones((2, 3)))
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_copyto_context():
+    a = nd.ones((2, 2))
+    b = a.copyto(mx.cpu(0))
+    np.testing.assert_allclose(b.asnumpy(), a.asnumpy())
+    c = a.as_in_context(mx.cpu(1))
+    assert c.context == mx.cpu(1)
+
+
+def test_astype_cast():
+    a = nd.array([1.5, 2.5])
+    b = a.astype(np.int32)
+    assert b.dtype == np.int32
+    c = nd.Cast(a, dtype="int32")
+    assert c.dtype == np.int32
+
+
+def test_waitall():
+    a = nd.ones((10, 10))
+    for _ in range(5):
+        a = a * 1.0001
+    nd.waitall()
+    assert a.asnumpy().shape == (10, 10)
