@@ -5,7 +5,18 @@ the API surface is MXNet's (nd/sym/mod/kv/io) so reference user code maps
 1:1.  See SURVEY.md at the repo root for the blueprint and per-module
 docstrings for reference citations.
 """
-from .base import MXNetError, AttrScope, NameManager, __version__
+import jax as _jax
+
+from .base import MXNetError, AttrScope, NameManager, __version__, get_env as _get_env
+
+# float32 arrays get true-fp32 matmuls (parity with the reference's fp32
+# math); the fast path on TPU is explicit bfloat16 dtypes, which this
+# setting does not affect.  Override with MXNET_TPU_MATMUL_PRECISION
+# (e.g. "bfloat16" to trade accuracy for speed on fp32 data).
+_jax.config.update(
+    "jax_default_matmul_precision",
+    _get_env("MXNET_TPU_MATMUL_PRECISION", "float32", str),
+)
 from .context import Context, cpu, cpu_pinned, gpu, tpu, current_context, num_devices
 from . import engine
 from . import random
@@ -13,6 +24,11 @@ from . import ops
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol, Variable, Group
+from . import executor
+from .executor import Executor
 
 __all__ = [
     "MXNetError",

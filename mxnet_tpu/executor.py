@@ -1,0 +1,345 @@
+"""Graph executor.
+
+Parity: src/executor/graph_executor.cc + python/mxnet/executor.py
+(reference).  The reference compiles a Symbol into a static plan (gradient
+graph, memory plan, cached engine ops — GraphExecutor::Init,
+graph_executor.cc:316-351) and runs it by pushing ops to the dependency
+engine.  TPU-natively the *whole plan is one XLA computation*:
+
+- bind traces the graph into a pure function f(args, aux, key) ->
+  (outputs, new_aux) and jits it — XLA buffer assignment replaces
+  PlanMemory, XLA fusion replaces per-node kernels,
+- the gradient graph (nnvm::pass::Gradient, graph_executor.cc:167-223) is
+  jax.vjp over f, compiled together with the forward into one fused
+  fwd+bwd executable — outputs and gradients materialize from a single
+  device dispatch,
+- forward(is_train=True) is *lazy*: it records inputs; if backward() is
+  called before outputs are read, only the fused fwd+bwd computation runs
+  (the reference gets the same effect from engine asynchrony: Python never
+  blocks, SURVEY.md §3.1),
+- grad_req write/add/null follow include/mxnet/op_attr_types.h OpReqType.
+
+Executors created with ``shared_exec`` reuse the donor's compiled cache —
+the TPU analogue of bucketing's shared memory pool
+(GraphExecutor::Init(shared_exec), graph_executor.cc:330-334): what's
+shared on TPU is compilation + params, while XLA reuses buffers per-call.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ops
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray
+from .symbol import Symbol, _topo_order
+
+_GRAD_REQ = ("write", "add", "null")
+
+
+def _build_graph_fn(symbol: Symbol):
+    """Build f(arg_dict, aux_dict, key, is_train) -> (outputs, new_aux_dict).
+
+    This is the tracing equivalent of GraphExecutor::InitCachedOps
+    (graph_executor.cc:518-648): one closure per graph, evaluated under
+    jax.jit so every node fuses into a single XLA program.
+    """
+    out_entries = list(symbol._outputs)
+    topo = _topo_order([n for n, _ in out_entries])
+
+    def fn(arg_vals: Dict, aux_vals: Dict, key, is_train: bool):
+        env = {}
+        new_aux = dict(aux_vals)
+        for i, node in enumerate(topo):
+            if node.is_variable:
+                if node.is_aux:
+                    env[id(node)] = (aux_vals[node.name],)
+                else:
+                    env[id(node)] = (arg_vals[node.name],)
+                continue
+            od = ops.get(node.op)
+            ins = [env[id(src)][oidx] for src, oidx in node.inputs]
+            octx = ops.OpCtx(
+                is_train=is_train,
+                key=jax.random.fold_in(key, i) if od.needs_rng else None,
+            )
+            res = od.fn(octx, *ins, **node.attrs)
+            if od.aux_names:
+                res, aux_updates = res
+                aux_arg_names = node.inputs[-len(od.aux_names):]
+                for (aux_node, _), val in zip(aux_arg_names, aux_updates):
+                    new_aux[aux_node.name] = val
+            if not isinstance(res, tuple):
+                res = (res,)
+            env[id(node)] = res
+        outputs = [env[id(n)][i] for n, i in out_entries]
+        return outputs, new_aux
+
+    return fn
+
+
+class Executor:
+    """Parity: include/mxnet/executor.h Executor + python/mxnet/executor.py."""
+
+    def __init__(self, symbol: Symbol, ctx: Optional[Context], args, args_grad,
+                 grad_req="write", aux_states=None, group2ctx=None,
+                 shared_exec: "Executor" = None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self._group2ctx = group2ctx or {}
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        # --- normalize arg containers (parity: executor bind signature) ----
+        if isinstance(args, dict):
+            self.arg_dict = {k: args[k] for k in arg_names if k in args}
+            missing = [k for k in arg_names if k not in args]
+            if missing:
+                raise MXNetError(f"bind: missing arguments {missing}")
+            self.arg_arrays = [self.arg_dict[k] for k in arg_names]
+        else:
+            args = list(args or [])
+            if len(args) != len(arg_names):
+                raise MXNetError(
+                    f"bind: expected {len(arg_names)} args ({arg_names}), got {len(args)}"
+                )
+            self.arg_arrays = args
+            self.arg_dict = dict(zip(arg_names, args))
+
+        if isinstance(args_grad, dict):
+            self.grad_dict = dict(args_grad)
+        elif args_grad is None:
+            self.grad_dict = {}
+        else:
+            self.grad_dict = dict(zip(arg_names, args_grad))
+        self.grad_arrays = [self.grad_dict.get(k) for k in arg_names]
+
+        if isinstance(grad_req, str):
+            self.grad_req = {k: grad_req for k in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self.grad_req = {k: grad_req.get(k, "null") for k in arg_names}
+        for k, v in self.grad_req.items():
+            if v not in _GRAD_REQ:
+                raise MXNetError(f"invalid grad_req {v} for {k}")
+        # args without a grad array can't be written
+        for k in arg_names:
+            if k not in self.grad_dict:
+                self.grad_req[k] = "null"
+
+        if isinstance(aux_states, dict):
+            self.aux_dict = dict(aux_states)
+        else:
+            self.aux_dict = dict(zip(aux_names, aux_states or []))
+        missing_aux = [k for k in aux_names if k not in self.aux_dict]
+        if missing_aux:
+            raise MXNetError(f"bind: missing aux states {missing_aux}")
+        self.aux_arrays = [self.aux_dict[k] for k in aux_names]
+
+        self._graph_fn = _build_graph_fn(symbol)
+        self._grad_names = [k for k in arg_names if self.grad_req.get(k) != "null"]
+        if shared_exec is not None and shared_exec._symbol is symbol:
+            self._jit_fwd = shared_exec._jit_fwd
+            self._jit_fwdbwd = shared_exec._jit_fwdbwd
+        else:
+            self._jit_fwd = jax.jit(
+                lambda a, x, k, t: self._graph_fn(a, x, k, t), static_argnums=(3,)
+            )
+            self._jit_fwdbwd = jax.jit(self._make_fwdbwd(), static_argnames=("gnames",))
+        self._step = 0
+        self._pending = None  # (args_raw, aux_raw, key) of last train forward
+        self._outputs_cache: Optional[List] = None
+        self._monitor_callback = None
+
+    # ------------------------------------------------------------------ build
+    def _make_fwdbwd(self):
+        graph_fn = self._graph_fn
+
+        def fwdbwd(arg_vals, aux_vals, key, head_grads, gnames: tuple):
+            def fwd_for_grad(grad_args):
+                merged = dict(arg_vals)
+                merged.update(grad_args)
+                outs, new_aux = graph_fn(merged, aux_vals, key, True)
+                return outs, new_aux
+
+            grad_args = {k: arg_vals[k] for k in gnames}
+            (outs, new_aux), vjp_fn = jax.vjp(
+                lambda ga: fwd_for_grad(ga), grad_args, has_aux=False
+            )
+            # cotangent: (outputs_cot, aux_cot=zeros)
+            aux_cot = jax.tree_util.tree_map(jnp.zeros_like, new_aux)
+            (grads,) = vjp_fn((head_grads, aux_cot))
+            return outs, new_aux, grads
+
+        return fwdbwd
+
+    # ---------------------------------------------------------------- running
+    def _gather_inputs(self):
+        args = {k: v._read() for k, v in self.arg_dict.items()}
+        aux = {k: v._read() for k, v in self.aux_dict.items()}
+        from . import random as _random
+
+        key = jax.random.fold_in(_random.current_key(), self._step)
+        self._step += 1
+        return args, aux, key
+
+    def forward(self, is_train=False, **kwargs):
+        """Parity: Executor.forward (python/mxnet/executor.py:84 ->
+        GraphExecutor::Forward)."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"unknown input {k}")
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._set(v._read())
+            else:
+                self.arg_dict[k]._set(jnp.asarray(np.asarray(v, dtype=np.float32)))
+        args, aux, key = self._gather_inputs()
+        if is_train:
+            # lazy: defer compute so backward() can run the fused fwd+bwd
+            self._pending = (args, aux, key)
+            self._outputs_cache = None
+        else:
+            outs, new_aux = self._jit_fwd(args, aux, key, False)
+            self._pending = None
+            self._outputs_cache = [NDArray(o) for o in outs]
+            if self._monitor_callback is not None:
+                self._run_monitor(args, aux, key)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Parity: Executor.backward (executor.py:123 ->
+        GraphExecutor::Backward); grads land in grad_arrays per grad_req."""
+        if self._pending is None:
+            raise MXNetError("backward() requires forward(is_train=True) first")
+        args, aux, key = self._pending
+        outs_shapes = None
+        if out_grads is None:
+            # loss-output graphs: ops define their own grads (custom_vjp) and
+            # ignore this; plain graphs get ones like sum-of-outputs loss
+            outs, new_aux, grads = self._run_fwdbwd_with_ones(args, aux, key)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head = [g._read() if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads]
+            outs, new_aux, grads = self._jit_fwdbwd(
+                args, aux, key, head, gnames=tuple(self._grad_names)
+            )
+        self._outputs_cache = [NDArray(o) for o in outs]
+        self._write_aux(new_aux)
+        for k, g in grads.items():
+            req = self.grad_req.get(k, "null")
+            tgt = self.grad_dict.get(k)
+            if tgt is None or req == "null":
+                continue
+            if req == "add":
+                tgt._set(tgt._read() + g)
+            else:
+                tgt._set(g)
+        if self._monitor_callback is not None:
+            self._run_monitor(args, aux, key)
+
+    def _run_fwdbwd_with_ones(self, args, aux, key):
+        # head grads of ones — custom_vjp loss ops discard them (parity with
+        # reference loss-op backward semantics)
+        outs_aval, _ = jax.eval_shape(
+            lambda a, x, k: self._graph_fn(a, x, k, True), args, aux, key
+        )
+        head = [jnp.ones(o.shape, o.dtype) for o in outs_aval]
+        return self._jit_fwdbwd(args, aux, key, head, gnames=tuple(self._grad_names))
+
+    def _write_aux(self, new_aux):
+        for k, v in new_aux.items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._set(v)
+
+    @property
+    def outputs(self) -> List[NDArray]:
+        if self._outputs_cache is None:
+            if self._pending is None:
+                raise MXNetError("no forward has been run")
+            args, aux, key = self._pending
+            outs, new_aux = self._jit_fwd(args, aux, key, True)
+            self._outputs_cache = [NDArray(o) for o in outs]
+            self._write_aux(new_aux)
+        return self._outputs_cache
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # ------------------------------------------------------------- monitoring
+    def set_monitor_callback(self, callback):
+        """Parity: GraphExecutor::SetMonitorCallback (graph_executor.cc:63) —
+        taps every internal output (used by mx.mon.Monitor)."""
+        self._monitor_callback = callback
+
+    def _run_monitor(self, args, aux, key):
+        internals = self._symbol.get_internals()
+        fn = _build_graph_fn(internals)
+        outs, _ = fn(args, aux, key, False)
+        for name, val in zip(internals.list_outputs(), outs):
+            self._monitor_callback(name, NDArray(val))
+
+    # ------------------------------------------------------------------- misc
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set(v._read())
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown param {k}")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._set(v._read())
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux {k}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Parity: Executor.reshape — rebind with new shapes; on TPU this is
+        just a fresh simple_bind (jit handles per-shape compilation cache)."""
+        shapes = {k: v.shape for k, v in self.arg_dict.items()}
+        shapes.update(kwargs)
+        return simple_bind(self._symbol, self._ctx, grad_req=self.grad_req,
+                           shared_exec=self, **shapes)
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+
+def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
+                group2ctx=None, shared_exec=None, **kwargs) -> Executor:
+    """Parity: Symbol.simple_bind (python/mxnet/symbol.py:726): infer
+    shapes, allocate arrays (+grads per grad_req), bind."""
+    ctx = ctx or current_context()
+    arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+    if arg_shapes is None:
+        raise MXNetError(f"simple_bind: cannot infer shapes from {kwargs}")
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    args = {}
+    for name, shape in zip(arg_names, arg_shapes):
+        args[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32), ctx=ctx)
+    aux = {}
+    for name, shape in zip(aux_names, aux_shapes):
+        aux[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32), ctx=ctx)
+
+    if isinstance(grad_req, str):
+        req = {k: grad_req for k in arg_names}
+    elif isinstance(grad_req, (list, tuple)):
+        req = dict(zip(arg_names, grad_req))
+    else:
+        req = {k: grad_req.get(k, "null") for k in arg_names}
+    grads = {
+        k: NDArray(jnp.zeros(dict(zip(arg_names, arg_shapes))[k], dtype=jnp.float32), ctx=ctx)
+        for k in arg_names
+        if req.get(k, "null") != "null"
+    }
+    return Executor(symbol, ctx, args, grads, req, aux, group2ctx=group2ctx,
+                    shared_exec=shared_exec)

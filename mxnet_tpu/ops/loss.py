@@ -23,7 +23,28 @@ def _norm_enum(attrs):
     return n if n in ("null", "batch", "valid") else "null"
 
 
-@register("SoftmaxOutput", arg_names=("data", "label"), aliases=("Softmax",))
+def _softmax_label_shape(attrs, data_shape, *rest):
+    """Label shape inference (parity: SoftmaxOutputProp::InferShape):
+    (N,) normally, (N, d...) for multi_output."""
+    if parse_bool(attrs.get("multi_output", False)):
+        return {"label": (data_shape[0],) + tuple(data_shape[2:])}
+    return {"label": (data_shape[0],)}
+
+
+def _regression_label_shape(attrs, data_shape, *rest):
+    """Parity: RegressionOutputProp::InferShape — label matches data, with
+    the 1-D special case for (N,1) outputs (regression_output-inl.h:108)."""
+    if len(data_shape) == 2 and data_shape[1] == 1:
+        return {"label": (data_shape[0],)}
+    return {"label": tuple(data_shape)}
+
+
+@register(
+    "SoftmaxOutput",
+    arg_names=("data", "label"),
+    aliases=("Softmax",),
+    infer_params=_softmax_label_shape,
+)
 def _softmax_output(ctx, data, label, **attrs):
     """Parity: SoftmaxOutput (src/operator/softmax_output-inl.h).
 
@@ -87,7 +108,8 @@ def _softmax_output(ctx, data, label, **attrs):
 
 
 def _regression_output(name, fwd_fn, bwd_fn, doc):
-    @register(name, arg_names=("data", "label"))
+    @register(name, arg_names=("data", "label"),
+              infer_params=_regression_label_shape)
     def _impl(ctx, data, label, **attrs):
         grad_scale = float(parse_attr(attrs.get("grad_scale", 1.0)))
 
@@ -135,7 +157,8 @@ _regression_output(
 )
 
 
-@register("SVMOutput", arg_names=("data", "label"))
+@register("SVMOutput", arg_names=("data", "label"),
+          infer_params=_softmax_label_shape)
 def _svm_output(ctx, data, label, **attrs):
     """Parity: SVMOutput (src/operator/svm_output-inl.h); hinge-loss
     gradient (L1 or squared) with margin + regularization_coefficient."""
@@ -192,7 +215,8 @@ def _make_loss(ctx, data, **attrs):
     return fwd(data)
 
 
-@register("softmax_cross_entropy", arg_names=("data", "label"))
+@register("softmax_cross_entropy", arg_names=("data", "label"),
+          infer_params=_softmax_label_shape)
 def _softmax_cross_entropy(ctx, data, label, **attrs):
     """Parity: softmax_cross_entropy (src/operator/loss_binary_op.cc) —
     scalar summed CE between softmax(data) and integer labels."""

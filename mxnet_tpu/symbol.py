@@ -1,0 +1,565 @@
+"""Symbolic graph layer.
+
+Parity: python/mxnet/symbol.py + the NNVM symbol/graph IR the reference
+imports (include/mxnet/base.h:14-17, src/nnvm/ bridges; SURVEY.md §1 layer
+3).  The Symbol here is a lightweight DAG whose nodes reference ops in
+mxnet_tpu.ops.registry.  There is no separate pass pipeline: the NNVM
+passes map onto JAX machinery at bind time (SURVEY.md §7):
+
+- Gradient        -> jax.vjp in the executor
+- InferShape/Type -> graph walk with jax.eval_shape + per-op param hooks
+- PlanMemory      -> XLA buffer assignment (+ donation in fused paths)
+- PlaceDevice     -> ctx_group attrs consumed as sharding hints by the
+                     executor/mesh layer (parallel/)
+
+JSON round-trip keeps the reference's nodes/arg_nodes/heads structure
+(nnvm::Graph save format) so checkpoints are portable in spirit.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ops
+from .base import MXNetError, current_attr_scope, current_name_manager
+
+_py_slice = slice
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "extra_attrs", "is_aux")
+
+    def __init__(self, op, name, attrs=None, inputs=None, extra_attrs=None, is_aux=False):
+        self.op = op  # None for variables
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs: List[Tuple[_Node, int]] = list(inputs or [])
+        self.extra_attrs = dict(extra_attrs or {})
+        self.is_aux = is_aux
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def num_outputs(self):
+        if self.op is None:
+            return 1
+        od = ops.get(self.op)
+        if od.num_outputs == -1:  # attr-dependent (SliceChannel)
+            return int(self.attrs.get("num_outputs", 1))
+        return od.num_outputs
+
+
+def _topo_order(out_nodes: Sequence[_Node]) -> List[_Node]:
+    """Stable DFS topological order — matches the reference's IndexedGraph
+    ordering so list_arguments() agrees with MXNet's."""
+    seen = {}
+    order: List[_Node] = []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen[id(node)] = True
+        for inp, _ in node.inputs:
+            visit(inp)
+        order.append(node)
+
+    for n in out_nodes:
+        visit(n)
+    return order
+
+
+class Symbol:
+    """A list of output entries of a graph node (parity: nnvm::Symbol)."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs: Sequence[Tuple[_Node, int]]):
+        self._outputs = list(outputs)
+
+    # ------------------------------------------------------------- structure
+    @property
+    def nodes(self) -> List[_Node]:
+        return _topo_order([n for n, _ in self._outputs])
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self.nodes if n.is_variable and not n.is_aux]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self.nodes if n.is_variable and n.is_aux]
+
+    def list_outputs(self) -> List[str]:
+        names = []
+        for node, idx in self._outputs:
+            if node.is_variable:
+                names.append(node.name)
+                continue
+            od = ops.get(node.op)
+            if od.num_outputs == -1:  # attr-dependent (SliceChannel)
+                names.append(f"{node.name}_output{idx}")
+            elif od.num_outputs == 1:
+                names.append(f"{node.name}_output")
+            else:
+                names.append(f"{node.name}_{od.output_names[idx]}")
+        return names
+
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError(f"no output named {index}; outputs: {names}")
+            index = names.index(index)
+        if isinstance(index, int):
+            return Symbol([self._outputs[index]])
+        raise TypeError(index)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield self[i]
+
+    def get_internals(self) -> "Symbol":
+        """Parity: Symbol.get_internals — every node's outputs, topo order."""
+        outs = []
+        for node in self.nodes:
+            if node.is_variable:
+                outs.append((node, 0))
+            else:
+                for i in range(node.num_outputs()):
+                    outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node, _ = self._outputs[0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # ------------------------------------------------------------------ attrs
+    def attr(self, key):
+        node, _ = self._outputs[0]
+        return node.extra_attrs.get(key)
+
+    def list_attr(self):
+        node, _ = self._outputs[0]
+        return dict(node.extra_attrs)
+
+    def attr_dict(self):
+        out = {}
+        for node in self.nodes:
+            if node.extra_attrs:
+                out[node.name] = dict(node.extra_attrs)
+        return out
+
+    def _set_attr(self, **kwargs):
+        node, _ = self._outputs[0]
+        node.extra_attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    # -------------------------------------------------------------- operators
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _create(op, [a, b], {})
+        if np.isscalar(other):
+            return _create(scalar_op, [self], {"scalar": float(other)})
+        raise TypeError(type(other))
+
+    def __add__(self, o):
+        return self._binop(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if np.isscalar(o):
+            return _create("_rminus_scalar", [self], {"scalar": float(o)})
+        return self._binop(o, "elemwise_sub", None, reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        if np.isscalar(o):
+            return _create("_rdiv_scalar", [self], {"scalar": float(o)})
+        return self._binop(o, "elemwise_div", None, reverse=True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, o):
+        return self._binop(o, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    def __repr__(self):
+        name = self.name or "grouped"
+        return f"<Symbol {name}>"
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # --------------------------------------------------------- shape inference
+    def infer_shape(self, *args, **kwargs):
+        """Parity: Symbol.infer_shape -> (arg_shapes, out_shapes, aux_shapes).
+
+        Reference pipeline: nnvm InferShape pass (graph_executor.cc:404).
+        Here: forward walk with per-op param hooks + jax.eval_shape.
+        """
+        known = dict(kwargs)
+        if args:
+            for name, shape in zip(self.list_arguments(), args):
+                if shape is not None:
+                    known[name] = shape
+        try:
+            shapes, _ = self._infer(known, {})
+        except _InferIncomplete:
+            n = len(self.list_arguments())
+            return None, None, None
+        arg_shapes = [shapes.get((a, "var")) for a in self.list_arguments()]
+        aux_shapes = [shapes.get((a, "var")) for a in self.list_auxiliary_states()]
+        out_shapes = [shapes.get((id(n), i)) for n, i in self._outputs]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        known = dict(kwargs)
+        if args:
+            for name, ty in zip(self.list_arguments(), args):
+                if ty is not None:
+                    known[name] = ty
+        shape_known = {}
+        # infer_type alone (no shapes) falls back to float32 everywhere
+        arg_types = [np.dtype(known.get(a, np.float32)).type for a in self.list_arguments()]
+        aux_types = [np.float32 for _ in self.list_auxiliary_states()]
+        out_types = [np.float32 for _ in self._outputs]
+        return arg_types, out_types, aux_types
+
+    def _infer(self, known_shapes: Dict[str, tuple], known_types: Dict[str, type]):
+        """Walk the graph computing avals; returns ({key: shape}, {key: dtype})
+        with keys (arg_name,'var') for variables and (id(node), out_idx)."""
+        shapes: Dict = {}
+        dtypes: Dict = {}
+        avals: Dict = {}  # id(node) -> tuple of ShapeDtypeStruct
+
+        def var_aval(node):
+            name = node.name
+            if name in known_shapes:
+                shape = tuple(known_shapes[name])
+            elif "__shape__" in node.extra_attrs:
+                shape = tuple(json.loads(node.extra_attrs["__shape__"]))
+            else:
+                return None
+            dt = np.dtype(known_types.get(name, np.float32))
+            return jax.ShapeDtypeStruct(shape, dt)
+
+        for node in self.nodes:
+            if node.is_variable:
+                av = var_aval(node)
+                if av is not None:
+                    avals[id(node)] = (av,)
+                    shapes[(node.name, "var")] = av.shape
+                    dtypes[(node.name, "var")] = av.dtype
+                continue
+            od = ops.get(node.op)
+            in_avals = []
+            unknown_vars = []
+            for inp, oidx in node.inputs:
+                got = avals.get(id(inp))
+                if got is None:
+                    if inp.is_variable:
+                        unknown_vars.append(inp)
+                        in_avals.append(None)
+                    else:
+                        raise _InferIncomplete(node.name)
+                else:
+                    in_avals.append(got[oidx])
+            if unknown_vars:
+                if od.infer_params is None:
+                    raise _InferIncomplete(node.name)
+                known_in = [a.shape if a is not None else None for a in in_avals]
+                try:
+                    param_shapes = od.infer_params(node.attrs, *known_in)
+                except (TypeError, IndexError, KeyError):
+                    # hook needs shapes we don't have yet (e.g. data unknown)
+                    raise _InferIncomplete(node.name) from None
+                arg_names = od.resolve_arg_names(node.attrs) + list(od.aux_names)
+                for j, (inp, _) in enumerate(node.inputs):
+                    if in_avals[j] is None:
+                        pname = arg_names[j] if j < len(arg_names) else None
+                        if pname not in param_shapes:
+                            raise _InferIncomplete(f"{node.name}:{pname}")
+                        av = jax.ShapeDtypeStruct(tuple(param_shapes[pname]), np.float32)
+                        avals[id(inp)] = (av,)
+                        shapes[(inp.name, "var")] = av.shape
+                        dtypes[(inp.name, "var")] = av.dtype
+                        in_avals[j] = av
+            out_avals = _abstract_eval(od, node.attrs, in_avals)
+            avals[id(node)] = out_avals
+            for i, av in enumerate(out_avals):
+                shapes[(id(node), i)] = av.shape
+                dtypes[(id(node), i)] = av.dtype
+        return shapes, dtypes
+
+    # -------------------------------------------------------------- save/load
+    def tojson(self) -> str:
+        """Parity: nnvm JSON (save format of MXSymbolSaveToJSON)."""
+        nodes = self.nodes
+        index = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append(
+                {
+                    "op": n.op or "null",
+                    "name": n.name,
+                    "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
+                              for k, v in n.attrs.items()},
+                    "extra_attrs": n.extra_attrs,
+                    "is_aux": n.is_aux,
+                    "inputs": [[index[id(src)], oidx, 0] for src, oidx in n.inputs],
+                }
+            )
+        heads = [[index[id(n)], i, 0] for n, i in self._outputs]
+        arg_nodes = [i for i, n in enumerate(nodes) if n.is_variable]
+        return json.dumps(
+            {"nodes": jnodes, "arg_nodes": arg_nodes, "heads": heads,
+             "attrs": {"mxnet_tpu_version": 1}},
+            indent=2,
+        )
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        from .executor import simple_bind as _simple_bind
+
+        return _simple_bind(self, ctx, grad_req=grad_req, type_dict=type_dict,
+                            group2ctx=group2ctx, shared_exec=shared_exec, **kwargs)
+
+    # convenience evaluation (imperative-style) used by tests
+    def eval(self, ctx=None, **kwargs):
+        ex = self.simple_bind(ctx, grad_req="null",
+                              **{k: v.shape for k, v in kwargs.items()})
+        for k, v in kwargs.items():
+            ex.arg_dict[k][:] = v
+        return ex.forward(is_train=False)
+
+
+class _InferIncomplete(Exception):
+    pass
+
+
+def _abstract_eval(od, attrs, in_avals):
+    """Output avals of one op via jax.eval_shape."""
+
+    def fn(*ins):
+        ctx = ops.OpCtx(is_train=True, key=jax.random.PRNGKey(0))
+        res = od.fn(ctx, *ins, **attrs)
+        if od.aux_names:
+            res = res[0]
+        return res
+
+    out = jax.eval_shape(fn, *in_avals)
+    if isinstance(out, (tuple, list)):
+        return tuple(out)
+    return (out,)
+
+
+# ---------------------------------------------------------------------------
+# composition
+# ---------------------------------------------------------------------------
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, **kwargs) -> Symbol:
+    """Parity: mx.sym.Variable (symbol.py in reference)."""
+    scope = current_attr_scope()
+    extra = scope.get(attr) if scope else dict(attr or {})
+    if shape is not None:
+        extra["__shape__"] = json.dumps(list(shape))
+    if lr_mult is not None:
+        extra["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        extra["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        extra["__dtype__"] = np.dtype(dtype).name
+    if init is not None:
+        extra["__init__"] = init if isinstance(init, str) else init.dumps()
+    node = _Node(None, name, extra_attrs=extra)
+    return Symbol([(node, 0)])
+
+
+def Group(symbols) -> Symbol:
+    """Parity: mx.sym.Group."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def _create(opname, sym_inputs, attrs, name=None, extra_attr=None) -> Symbol:
+    """Create an op node (parity: the C API symbol creation path
+    MXSymbolCreateAtomicSymbol + Compose)."""
+    od = ops.get(opname)
+    name = current_name_manager().get(name, od.name)
+    scope = current_attr_scope()
+    extra = scope.get(extra_attr) if scope else dict(extra_attr or {})
+
+    inputs: List[Tuple[_Node, int]] = []
+    for s in sym_inputs:
+        if not isinstance(s, Symbol):
+            raise TypeError(f"{opname}: expected Symbol input, got {type(s)}")
+        if len(s._outputs) != 1:
+            raise MXNetError(f"{opname}: cannot use a grouped symbol as input")
+        inputs.append(s._outputs[0])
+
+    node = _Node(od.name, name, attrs=attrs, inputs=inputs, extra_attrs=extra)
+    n_out = node.num_outputs()
+    return Symbol([(node, i) for i in range(n_out)]) if n_out > 1 else Symbol([(node, 0)])
+
+
+def _make_symbol_fn(opname: str):
+    od = ops.get(opname)
+
+    def creator(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        od_local = ops.get(opname)
+        sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+        name = current_name_manager().get(name, od_local.name)
+
+        if od_local.varargs:
+            inputs = [a for a in args if isinstance(a, Symbol)]
+            attrs.setdefault("num_args", len(inputs))
+            sym = _create_named(od_local, inputs, attrs, name, attr)
+            return sym
+
+        arg_names = od_local.resolve_arg_names(attrs)
+        inputs = []
+        pos = list(args)
+        for an in arg_names:
+            if an in sym_kwargs:
+                inputs.append(sym_kwargs.pop(an))
+            elif pos:
+                inputs.append(pos.pop(0))
+            else:
+                # auto-create variable (param or missing data/label input) —
+                # parity: symbol composition creates e.g. conv0_weight,
+                # softmax_label (reference symbol.py Compose behavior)
+                inputs.append(Variable(f"{name}_{an}"))
+        if sym_kwargs:
+            raise MXNetError(f"{opname}: unexpected symbol kwargs {list(sym_kwargs)}")
+        for aux in od_local.aux_names:
+            v = Variable(f"{name}_{aux}")
+            v._outputs[0][0].is_aux = True
+            inputs.append(v)
+        return _create_named(od_local, inputs, attrs, name, attr)
+
+    creator.__name__ = opname
+    creator.__doc__ = od.doc
+    return creator
+
+
+def _create_named(od, sym_inputs, attrs, name, extra_attr):
+    scope = current_attr_scope()
+    extra = scope.get(extra_attr) if scope else dict(extra_attr or {})
+    inputs = []
+    for s in sym_inputs:
+        if len(s._outputs) != 1:
+            raise MXNetError(f"{od.name}: cannot use grouped symbol as input")
+        inputs.append(s._outputs[0])
+    node = _Node(od.name, name, attrs=attrs, inputs=inputs, extra_attrs=extra)
+    n_out = node.num_outputs()
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str: str) -> Symbol:
+    """Parity: MXSymbolCreateFromJSON."""
+    data = json.loads(json_str)
+    nodes: List[_Node] = []
+    for jn in data["nodes"]:
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"], extra_attrs=jn.get("extra_attrs", {}),
+                         is_aux=jn.get("is_aux", False))
+        else:
+            attrs = {}
+            for k, v in jn.get("attrs", {}).items():
+                try:
+                    attrs[k] = json.loads(v)
+                except (json.JSONDecodeError, TypeError):
+                    attrs[k] = v
+            node = _Node(jn["op"], jn["name"], attrs=attrs,
+                         extra_attrs=jn.get("extra_attrs", {}))
+            node.inputs = [(nodes[i], oidx) for i, oidx, _ in jn["inputs"]]
+        nodes.append(node)
+    heads = [(nodes[i], oidx) for i, oidx, _ in data["heads"]]
+    return Symbol(heads)
+
+
+def _init_symbol_functions():
+    mod = sys.modules[__name__]
+    all_ops = ops.list_ops()
+    registered = set(all_ops)
+    for opname in all_ops:
+        if not hasattr(mod, opname):
+            setattr(mod, opname, _make_symbol_fn(opname))
+    for opname in all_ops:
+        low = opname.lower()
+        if low != opname and low not in registered and not hasattr(mod, low):
+            setattr(mod, low, _make_symbol_fn(opname))
+
+
+def zeros(shape, dtype=np.float32, **kwargs):
+    return _create("_zeros", [], {"shape": tuple(shape), "dtype": np.dtype(dtype).name}, **kwargs)
+
+
+def ones(shape, dtype=np.float32, **kwargs):
+    return _create("_ones", [], {"shape": tuple(shape), "dtype": np.dtype(dtype).name}, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=np.float32, **kwargs):
+    return _create(
+        "_arange",
+        [],
+        {"start": start, "stop": stop, "step": step, "repeat": repeat,
+         "dtype": np.dtype(dtype).name},
+        **kwargs,
+    )
+
+
+_init_symbol_functions()

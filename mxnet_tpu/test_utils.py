@@ -1,0 +1,206 @@
+"""Verification utilities.
+
+Parity: python/mxnet/test_utils.py (reference): check_numeric_gradient
+(finite differences, :308), check_symbolic_forward/backward vs numpy
+(:430,:491), check_consistency across contexts (:650 — reference checks
+cpu-vs-gpu; here cpu(XLA-CPU)-vs-tpu, SURVEY.md §4.4), check_speed (:576).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from . import ndarray as nd
+from . import random as _random
+from .context import Context, cpu, current_context
+from .ndarray import NDArray
+
+
+def default_context():
+    return current_context()
+
+
+def _as_numpy_dict(symbol, location):
+    args = symbol.list_arguments()
+    if isinstance(location, dict):
+        return {k: np.asarray(v, dtype=np.float32) for k, v in location.items()}
+    return {k: np.asarray(v, dtype=np.float32) for k, v in zip(args, location)}
+
+
+def _bind_with(symbol, location, aux=None, grad_req="write", ctx=None):
+    ctx = ctx or default_context()
+    ex = symbol.simple_bind(ctx, grad_req=grad_req,
+                            **{k: v.shape for k, v in location.items()})
+    for k, v in location.items():
+        ex.arg_dict[k][:] = v
+    for k, v in (aux or {}).items():
+        ex.aux_dict[k][:] = v
+    return ex
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-5,
+                           aux_states=None, ctx=None):
+    """Parity: test_utils.check_symbolic_forward (:430)."""
+    location = _as_numpy_dict(sym, location)
+    ex = _bind_with(sym, location, aux_states, grad_req="null", ctx=ctx)
+    outputs = ex.forward(is_train=False)
+    if isinstance(expected, (list, tuple)):
+        pairs = zip(outputs, expected)
+    else:
+        pairs = [(outputs[0], expected)]
+    for out, exp in pairs:
+        np.testing.assert_allclose(out.asnumpy(), exp, rtol=rtol, atol=atol)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-5, aux_states=None, grad_req="write", ctx=None):
+    """Parity: test_utils.check_symbolic_backward (:491)."""
+    location = _as_numpy_dict(sym, location)
+    ex = _bind_with(sym, location, aux_states, grad_req=grad_req, ctx=ctx)
+    ex.forward(is_train=True)
+    og = None
+    if out_grads is not None:
+        og = [nd.array(np.asarray(g, dtype=np.float32)) for g in out_grads]
+    ex.backward(og)
+    if isinstance(expected, dict):
+        for name, exp in expected.items():
+            np.testing.assert_allclose(ex.grad_dict[name].asnumpy(), exp,
+                                       rtol=rtol, atol=atol, err_msg=name)
+    else:
+        for name, exp in zip(sym.list_arguments(), expected):
+            if exp is None:
+                continue
+            np.testing.assert_allclose(ex.grad_dict[name].asnumpy(), exp,
+                                       rtol=rtol, atol=atol, err_msg=name)
+    return {k: v.asnumpy() for k, v in ex.grad_dict.items()}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, ctx=None):
+    """Finite-difference gradient check (parity: test_utils.py:308).
+
+    Uses sum-of-outputs as the implicit scalar loss: backward() is called
+    with all-ones head gradients matching the reference helper's behavior.
+    """
+    location = _as_numpy_dict(sym, location)
+    grad_nodes = grad_nodes or list(location.keys())
+    ex = _bind_with(sym, location, aux_states, grad_req="write", ctx=ctx)
+    ex.forward(is_train=True)
+    out_shapes = [o.shape for o in ex.outputs]
+    ex.backward([nd.ones(s) for s in out_shapes])
+    analytic = {k: ex.grad_dict[k].asnumpy().copy() for k in grad_nodes
+                if k in ex.grad_dict}
+
+    def loss_at(loc):
+        ex2 = _bind_with(sym, loc, aux_states, grad_req="null", ctx=ctx)
+        outs = ex2.forward(is_train=True)
+        return sum(float(o.asnumpy().sum()) for o in outs)
+
+    for name in grad_nodes:
+        if name not in analytic:
+            continue
+        base = location[name]
+        num_grad = np.zeros_like(base)
+        flat = base.reshape(-1)
+        ng = num_grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps
+            lp = loss_at(location)
+            flat[i] = orig - numeric_eps
+            lm = loss_at(location)
+            flat[i] = orig
+            ng[i] = (lp - lm) / (2 * numeric_eps)
+        np.testing.assert_allclose(analytic[name], num_grad, rtol=rtol,
+                                   atol=atol or 1e-2, err_msg=name)
+
+
+def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4):
+    """Run the same symbol on several contexts and cross-check outputs+grads
+    (parity: test_utils.check_consistency :650 — the cpu/gpu harness that
+    becomes cpu/tpu on this stack)."""
+    results = []
+    for spec in ctx_list:
+        ctx = spec["ctx"]
+        shapes = {k: v for k, v in spec.items() if k != "ctx" and k != "type_dict"}
+        _random.seed(0)
+        ex = sym.simple_bind(ctx, grad_req="write", **shapes)
+        rs = np.random.RandomState(0)
+        for k in sorted(ex.arg_dict):
+            ex.arg_dict[k][:] = (rs.standard_normal(ex.arg_dict[k].shape) * scale).astype(np.float32)
+        ex.forward(is_train=True)
+        ex.backward([nd.ones(o.shape) for o in ex.outputs])
+        results.append((
+            [o.asnumpy() for o in ex.outputs],
+            {k: v.asnumpy() for k, v in ex.grad_dict.items()},
+        ))
+    ref_outs, ref_grads = results[0]
+    for outs, grads in results[1:]:
+        for a, b in zip(ref_outs, outs):
+            np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+        for k in ref_grads:
+            np.testing.assert_allclose(ref_grads[k], grads[k], rtol=rtol,
+                                       atol=atol, err_msg=k)
+    return results
+
+
+def check_speed(sym, location=None, ctx=None, n=20, grad_req="write", **shapes):
+    """Parity: test_utils.check_speed (:576) — seconds per fwd+bwd."""
+    ctx = ctx or default_context()
+    if location is None:
+        ex = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+        rs = np.random.RandomState(0)
+        for k in ex.arg_dict:
+            ex.arg_dict[k][:] = rs.standard_normal(ex.arg_dict[k].shape).astype(np.float32)
+    else:
+        location = _as_numpy_dict(sym, location)
+        ex = _bind_with(sym, location, grad_req=grad_req, ctx=ctx)
+    # warmup (compile)
+    ex.forward(is_train=True)
+    ex.backward()
+    [o.wait_to_read() for o in ex.outputs]
+    tic = time.time()
+    for _ in range(n):
+        ex.forward(is_train=True)
+        ex.backward()
+    [o.wait_to_read() for o in ex.outputs]
+    for g in ex.grad_dict.values():
+        g.wait_to_read()
+    return (time.time() - tic) / n
+
+
+def rand_ndarray(shape, ctx=None):
+    return nd.array(np.random.uniform(-1, 1, shape).astype(np.float32), ctx=ctx)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8):
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+def get_synthetic_mnist(num_train=512, num_test=128, seed=7):
+    """Deterministic MNIST-like dataset (no network egress in this image;
+    the reference's tests download real MNIST via get_data.py).  Classes are
+    linearly separable blobs rendered into 1x28x28 images so small models
+    reach high accuracy within a few epochs."""
+    rs = np.random.RandomState(seed)
+    n = num_train + num_test
+    labels = rs.randint(0, 10, size=n)
+    images = np.zeros((n, 1, 28, 28), dtype=np.float32)
+    # each class lights a distinct 6x6 block (plus noise)
+    for i, lab in enumerate(labels):
+        r, c = divmod(int(lab), 5)
+        images[i, 0, 2 + r * 13 : 8 + r * 13, 1 + c * 5 : 7 + c * 5] = 1.0
+    images += rs.uniform(0, 0.3, images.shape).astype(np.float32)
+    x_train, x_test = images[:num_train], images[num_train:]
+    y_train, y_test = labels[:num_train].astype(np.float32), labels[num_train:].astype(np.float32)
+    return (x_train, y_train), (x_test, y_test)

@@ -1,0 +1,209 @@
+"""Executor tests (parity model: tests/python/unittest/test_executor.py +
+operator gradient checks from test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import (
+    check_numeric_gradient,
+    check_symbolic_backward,
+    check_symbolic_forward,
+    check_consistency,
+)
+
+
+def test_bind_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b
+    a_nd = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b_nd = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    ga = nd.zeros((2, 2))
+    gb = nd.zeros((2, 2))
+    ex = c.bind(mx.cpu(), args=[a_nd, b_nd], args_grad=[ga, gb])
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), a_nd.asnumpy() * b_nd.asnumpy())
+    ex.backward([nd.ones((2, 2))])
+    np.testing.assert_allclose(ga.asnumpy(), b_nd.asnumpy())
+    np.testing.assert_allclose(gb.asnumpy(), a_nd.asnumpy())
+
+
+def test_grad_req_add():
+    a = sym.Variable("a")
+    c = a * 2.0
+    a_nd = nd.ones((3,))
+    ga = nd.zeros((3,))
+    ex = c.bind(mx.cpu(), args=[a_nd], args_grad=[ga], grad_req="add")
+    for i in range(3):
+        ex.forward(is_train=True)
+        ex.backward([nd.ones((3,))])
+    np.testing.assert_allclose(ga.asnumpy(), 6.0 * np.ones(3))
+
+
+def test_grad_req_null():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    ex = c.simple_bind(mx.cpu(), grad_req={"a": "write", "b": "null"}, a=(2,), b=(2,))
+    ex.forward(is_train=True)
+    ex.backward([nd.ones((2,))])
+    assert "b" not in ex.grad_dict
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [1, 1])
+
+
+def test_softmax_output_grad():
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(data, name="softmax")
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    labels = np.array([0, 1, 2, 3], dtype=np.float32)
+    ex = net.simple_bind(mx.cpu(), data=(4, 5))
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["softmax_label"][:] = labels
+    ex.forward(is_train=True)
+    ex.backward()
+    p = ex.outputs[0].asnumpy()
+    onehot = np.eye(5, dtype=np.float32)[labels.astype(int)]
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), p - onehot, rtol=1e-5)
+
+
+def test_linear_regression_grad():
+    data = sym.Variable("data")
+    net = sym.LinearRegressionOutput(data, name="lro")
+    x = np.random.RandomState(1).randn(6, 3).astype(np.float32)
+    y = np.random.RandomState(2).randn(6, 3).astype(np.float32)
+    ex = net.simple_bind(mx.cpu(), data=(6, 3))
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["lro_label"][:] = y
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(
+        ex.grad_dict["data"].asnumpy(), (x - y) / 3.0, rtol=1e-5
+    )
+
+
+def test_check_numeric_gradient_fc():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    rs = np.random.RandomState(3)
+    loc = {
+        "data": rs.randn(3, 5).astype(np.float32),
+        "fc_weight": rs.randn(4, 5).astype(np.float32),
+        "fc_bias": rs.randn(4).astype(np.float32),
+    }
+    check_numeric_gradient(fc, loc, numeric_eps=1e-2, rtol=5e-2)
+
+
+def test_check_numeric_gradient_tanh():
+    data = sym.Variable("data")
+    net = sym.Activation(data, act_type="tanh")
+    loc = {"data": np.random.RandomState(4).randn(4, 4).astype(np.float32)}
+    check_numeric_gradient(net, loc, numeric_eps=1e-2, rtol=5e-2)
+
+
+def test_symbolic_forward_backward_helpers():
+    a = sym.Variable("a")
+    net = sym.exp(a)
+    x = np.random.RandomState(5).rand(3, 3).astype(np.float32)
+    check_symbolic_forward(net, {"a": x}, np.exp(x), rtol=1e-5)
+    check_symbolic_backward(net, {"a": x}, [np.ones_like(x)], {"a": np.exp(x)}, rtol=1e-5)
+
+
+def test_conv_forward_matches_numpy():
+    # 1x1 conv == per-pixel matmul
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, name="c", kernel=(1, 1), num_filter=4, no_bias=True)
+    rs = np.random.RandomState(6)
+    x = rs.randn(2, 3, 5, 5).astype(np.float32)
+    w = rs.randn(4, 3, 1, 1).astype(np.float32)
+    expect = np.einsum("nchw,fc->nfhw", x, w[:, :, 0, 0])
+    check_symbolic_forward(conv, {"data": x, "c_weight": w}, expect, rtol=1e-4)
+
+
+def test_pooling_forward():
+    data = sym.Variable("data")
+    pool = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    expect = np.array([[[[5, 7], [13, 15]]]], dtype=np.float32)
+    check_symbolic_forward(pool, {"data": x}, expect)
+    avg = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expect_avg = np.array([[[[2.5, 4.5], [10.5, 12.5]]]], dtype=np.float32)
+    check_symbolic_forward(avg, {"data": x}, expect_avg)
+
+
+def test_batchnorm_train_stats():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn", fix_gamma=True, eps=1e-5)
+    x = np.random.RandomState(7).randn(8, 3, 4, 4).astype(np.float32) * 3 + 1
+    ex = bn.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = x
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    # normalized output: per-channel mean ~0, var ~1
+    assert abs(out.mean(axis=(0, 2, 3))).max() < 1e-4
+    np.testing.assert_allclose(out.var(axis=(0, 2, 3)), np.ones(3), rtol=1e-2)
+
+
+def test_dropout_train_vs_eval():
+    data = sym.Variable("data")
+    net = sym.Dropout(data, p=0.5)
+    x = np.ones((100, 100), dtype=np.float32)
+    ex = net.simple_bind(mx.cpu(), grad_req="null", data=x.shape)
+    ex.arg_dict["data"][:] = x
+    eval_out = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(eval_out, x)
+    train_out = ex.forward(is_train=True)
+    train_np = ex.outputs[0].asnumpy()
+    frac_zero = (train_np == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+    # kept entries scaled by 1/keep
+    assert np.allclose(train_np[train_np > 0], 2.0)
+
+
+def test_executor_reshape():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    ex = fc.simple_bind(mx.cpu(), data=(8, 6))
+    ex2 = ex.reshape(data=(2, 6))
+    ex2.arg_dict["data"][:] = np.ones((2, 6), dtype=np.float32)
+    out = ex2.forward()[0]
+    assert out.shape == (2, 4)
+
+
+def test_shared_exec_bucketing_cache():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    ex1 = fc.simple_bind(mx.cpu(), data=(8, 6))
+    ex2 = fc.simple_bind(mx.cpu(), shared_exec=ex1, data=(4, 6))
+    assert ex2._jit_fwd is ex1._jit_fwd  # compilation cache shared
+
+
+def test_check_consistency_multi_ctx():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    net = sym.Activation(fc, act_type="relu")
+    check_consistency(net, [{"ctx": mx.cpu(0), "data": (4, 7)},
+                            {"ctx": mx.cpu(1), "data": (4, 7)}])
+
+
+def test_multi_output_executor():
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=2, axis=1, name="sl")
+    ex = parts.simple_bind(mx.cpu(), grad_req="null", data=(2, 4, 3))
+    x = np.random.RandomState(8).randn(2, 4, 3).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    outs = ex.forward()
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0].asnumpy(), x[:, :2])
+    np.testing.assert_allclose(outs[1].asnumpy(), x[:, 2:])
+
+
+def test_monitor_callback():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=2)
+    ex = fc.simple_bind(mx.cpu(), grad_req="null", data=(2, 3))
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward(is_train=False)
+    assert any("fc_output" in s for s in seen)

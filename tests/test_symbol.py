@@ -1,0 +1,126 @@
+"""Symbol composition/inference tests (parity model:
+tests/python/unittest/test_symbol.py + test_infer_shape.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=10)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_list_arguments_order():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias", "softmax_label",
+    ]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape_mlp():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 100)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (10, 16)
+    assert d["softmax_label"] == (32,)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, name="conv", kernel=(3, 3), num_filter=8, pad=(1, 1))
+    bn = sym.BatchNorm(conv, name="bn")
+    pool = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(pool.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (8, 3, 3, 3)
+    assert d["conv_bias"] == (8,)
+    assert d["bn_gamma"] == (8,)
+    assert out_shapes == [(2, 8, 4, 4)]
+    x = dict(zip(pool.list_auxiliary_states(), aux_shapes))
+    assert x["bn_moving_mean"] == (8,)
+
+
+def test_infer_shape_partial_fails_gracefully():
+    net = _mlp()
+    a, o, x = net.infer_shape()
+    assert a is None and o is None
+
+
+def test_symbol_compose_explicit_weight():
+    data = sym.Variable("data")
+    w = sym.Variable("myweight")
+    fc = sym.FullyConnected(data=data, weight=w, name="fc", num_hidden=4, no_bias=True)
+    assert fc.list_arguments() == ["data", "myweight"]
+
+
+def test_group_and_getitem():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    act = sym.Activation(fc, name="act", act_type="tanh")
+    g = sym.Group([fc, act])
+    assert len(g) == 2
+    assert g.list_outputs() == ["fc_output", "act_output"]
+    assert g[1].list_outputs() == ["act_output"]
+    assert g["fc_output"].list_outputs() == ["fc_output"]
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    feat = internals["fc1_output"]
+    assert feat.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_arith_operators():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2.0 - a / b
+    ex = c.simple_bind(mx.cpu(), a=(2, 2), b=(2, 2))
+    ex.arg_dict["a"][:] = 3.0
+    ex.arg_dict["b"][:] = 2.0
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, ((3 + 2) * 2 - 3 / 2) * np.ones((2, 2)))
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, _ = net.infer_shape(data=(4, 8))
+    a2, o2, _ = net2.infer_shape(data=(4, 8))
+    assert o1 == o2 and a1 == a2
+
+
+def test_attr_scope_ctx_group():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    assert fc.attr("ctx_group") == "dev1"
+    assert data.attr("ctx_group") == "dev1"
+
+
+def test_variable_shape_attr():
+    v = mx.Variable("x", shape=(3, 4))
+    s = sym.Activation(v, act_type="relu")
+    a, o, _ = s.infer_shape()
+    assert o == [(3, 4)]
+
+
+def test_slice_channel_outputs():
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=3, axis=1, name="sliced")
+    assert len(parts) == 3
+    a, o, _ = parts.infer_shape(data=(2, 6, 4))
+    assert o == [(2, 2, 4)] * 3
