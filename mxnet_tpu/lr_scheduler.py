@@ -1,0 +1,59 @@
+"""Learning-rate schedulers (parity: python/mxnet/lr_scheduler.py)."""
+from __future__ import annotations
+
+import logging
+
+
+class LRScheduler:
+    def __init__(self, base_lr=0.01):
+        self.base_lr = base_lr
+
+    def __call__(self, num_update):
+        raise NotImplementedError
+
+
+class FactorScheduler(LRScheduler):
+    """lr *= factor every `step` updates (parity: FactorScheduler)."""
+
+    def __init__(self, step, factor=1.0, stop_factor_lr=1e-8):
+        super().__init__()
+        if step < 1:
+            raise ValueError("step must be >= 1")
+        if factor > 1.0:
+            raise ValueError("factor must be <= 1")
+        self.step = step
+        self.factor = factor
+        self.stop_factor_lr = stop_factor_lr
+        self.count = 0
+
+    def __call__(self, num_update):
+        while num_update > self.count + self.step:
+            self.count += self.step
+            self.base_lr *= self.factor
+            if self.base_lr < self.stop_factor_lr:
+                self.base_lr = self.stop_factor_lr
+                logging.info("lr hit stop_factor_lr %.2e", self.base_lr)
+        return self.base_lr
+
+
+class MultiFactorScheduler(LRScheduler):
+    """lr *= factor at given update milestones (parity: MultiFactorScheduler)."""
+
+    def __init__(self, step, factor=1.0):
+        super().__init__()
+        if not all(step[i] < step[i + 1] for i in range(len(step) - 1)):
+            raise ValueError("steps must be increasing")
+        self.step = list(step)
+        self.cur_step_ind = 0
+        self.factor = factor
+        self.count = 0
+
+    def __call__(self, num_update):
+        while self.cur_step_ind <= len(self.step) - 1:
+            if num_update > self.step[self.cur_step_ind]:
+                self.count = self.step[self.cur_step_ind]
+                self.cur_step_ind += 1
+                self.base_lr *= self.factor
+            else:
+                return self.base_lr
+        return self.base_lr

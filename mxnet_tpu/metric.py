@@ -1,0 +1,254 @@
+"""Evaluation metrics (parity: python/mxnet/metric.py).
+
+EvalMetric registry: Accuracy, TopKAccuracy, F1, MAE/MSE/RMSE,
+CrossEntropy, CustomMetric (+np wrapper), CompositeEvalMetric.  Metrics
+run on host numpy after a device sync — same device→host boundary as the
+reference (SURVEY.md §3.1 update_metric step).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if len(labels) != len(preds):
+        raise MXNetError(f"label/pred count mismatch: {len(labels)} vs {len(preds)}")
+
+
+class EvalMetric:
+    def __init__(self, name, num=None):
+        self.name = name
+        self.num = num
+        self.reset()
+
+    def reset(self):
+        if self.num is None:
+            self.num_inst = 0
+            self.sum_metric = 0.0
+        else:
+            self.num_inst = [0] * self.num
+            self.sum_metric = [0.0] * self.num
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num is None:
+            value = self.sum_metric / self.num_inst if self.num_inst else float("nan")
+            return (self.name, value)
+        names = [f"{self.name}_{i}" for i in range(self.num)]
+        values = [s / n if n else float("nan") for s, n in zip(self.sum_metric, self.num_inst)]
+        return (names, values)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            return [(name, value)]
+        return list(zip(name, value))
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite"):
+        super().__init__(name)
+        self.metrics = metrics or []
+
+    def add(self, metric):
+        self.metrics.append(metric)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return (names, values)
+
+
+class Accuracy(EvalMetric):
+    """Parity: metric.py Accuracy — argmax over axis 1 when needed."""
+
+    def __init__(self):
+        super().__init__("accuracy")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred_np = pred.asnumpy()
+            label_np = label.asnumpy().astype(np.int32)
+            if pred_np.ndim > 1 and pred_np.shape != label_np.shape:
+                pred_np = pred_np.argmax(axis=1)
+            pred_np = pred_np.astype(np.int32).reshape(-1)
+            label_np = label_np.reshape(-1)
+            self.sum_metric += float((pred_np == label_np).sum())
+            self.num_inst += len(label_np)
+
+
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1):
+        super().__init__(f"top_k_accuracy_{top_k}")
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            pred_np = pred.asnumpy()
+            label_np = label.asnumpy().astype(np.int32).reshape(-1)
+            argsorted = np.argsort(-pred_np, axis=1)[:, : self.top_k]
+            self.sum_metric += float((argsorted == label_np[:, None]).any(axis=1).sum())
+            self.num_inst += len(label_np)
+
+
+class F1(EvalMetric):
+    """Binary F1 (parity: metric.py F1)."""
+
+    def __init__(self):
+        super().__init__("f1")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            pred_np = pred.asnumpy()
+            label_np = label.asnumpy().astype(np.int32).reshape(-1)
+            if pred_np.ndim > 1:
+                pred_np = pred_np.argmax(axis=1)
+            pred_np = pred_np.astype(np.int32).reshape(-1)
+            tp = float(((pred_np == 1) & (label_np == 1)).sum())
+            fp = float(((pred_np == 1) & (label_np == 0)).sum())
+            fn = float(((pred_np == 0) & (label_np == 1)).sum())
+            precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+            recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+            f1 = 2 * precision * recall / (precision + recall) if precision + recall > 0 else 0.0
+            self.sum_metric += f1
+            self.num_inst += 1
+
+
+class MAE(EvalMetric):
+    def __init__(self):
+        super().__init__("mae")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l, p = label.asnumpy(), pred.asnumpy()
+            self.sum_metric += float(np.abs(l.reshape(p.shape) - p).mean())
+            self.num_inst += 1
+
+
+class MSE(EvalMetric):
+    def __init__(self):
+        super().__init__("mse")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l, p = label.asnumpy(), pred.asnumpy()
+            self.sum_metric += float(((l.reshape(p.shape) - p) ** 2).mean())
+            self.num_inst += 1
+
+
+class RMSE(EvalMetric):
+    def __init__(self):
+        super().__init__("rmse")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l, p = label.asnumpy(), pred.asnumpy()
+            self.sum_metric += float(np.sqrt(((l.reshape(p.shape) - p) ** 2).mean()))
+            self.num_inst += 1
+
+
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-8):
+        super().__init__("cross-entropy")
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy().astype(np.int32).reshape(-1)
+            pred_np = pred.asnumpy()
+            prob = pred_np[np.arange(label_np.shape[0]), label_np]
+            self.sum_metric += float((-np.log(prob + self.eps)).sum())
+            self.num_inst += label_np.shape[0]
+
+
+class Torch(EvalMetric):
+    """Parity stub: metric.py Torch (average of preds)."""
+
+    def __init__(self, name="torch"):
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        for pred in preds:
+            self.sum_metric += float(pred.asnumpy().mean())
+        self.num_inst += 1
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None, allow_extra_outputs=False):
+        super().__init__(name or getattr(feval, "__name__", "custom"))
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            res = self._feval(label.asnumpy(), pred.asnumpy())
+            if isinstance(res, tuple):
+                s, n = res
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += res
+                self.num_inst += 1
+
+
+def np_metric(name=None, allow_extra_outputs=False):
+    """Parity: mx.metric.np decorator."""
+
+    def deco(feval):
+        return CustomMetric(feval, name, allow_extra_outputs)
+
+    return deco
+
+
+np = np  # keep numpy accessible; mx.metric.np is the decorator below
+globals()["np_decorator"] = np_metric
+
+_METRICS = {
+    "acc": Accuracy,
+    "accuracy": Accuracy,
+    "f1": F1,
+    "mae": MAE,
+    "mse": MSE,
+    "rmse": RMSE,
+    "ce": CrossEntropy,
+    "cross-entropy": CrossEntropy,
+    "torch": Torch,
+}
+
+
+def create(metric, **kwargs):
+    """Parity: mx.metric.create."""
+    if callable(metric):
+        return CustomMetric(metric, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        comp = CompositeEvalMetric()
+        for m in metric:
+            comp.add(create(m, **kwargs))
+        return comp
+    if isinstance(metric, str):
+        if metric.startswith("top_k_accuracy"):
+            parts = metric.split("_")
+            return TopKAccuracy(top_k=int(parts[-1])) if parts[-1].isdigit() else TopKAccuracy()
+        if metric.lower() in _METRICS:
+            return _METRICS[metric.lower()](**kwargs)
+    raise MXNetError(f"unknown metric {metric}")

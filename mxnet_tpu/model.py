@@ -1,0 +1,200 @@
+"""Legacy FeedForward estimator + shared training internals.
+
+Parity: python/mxnet/model.py (reference): _create_kvstore (:40),
+save_checkpoint/load_checkpoint (:319-385), FeedForward (:387).
+Checkpoint format: ``prefix-symbol.json`` (graph JSON) +
+``prefix-%04d.params`` (param dict with arg:/aux: prefixes, matching the
+reference's NDArray::Save naming convention).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Parity: model.py:40-77 — decide (kvstore instance, update_on_kvstore)."""
+    from . import kvstore as kvs
+
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+        update_on_kvstore = False
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+            update_on_kvstore = False
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                # reference: big params => aggregation-only local store
+                max_size = max(
+                    (int(np.prod(p.shape)) for p in arg_params.values()), default=0
+                )
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    return (kv, update_on_kvstore if kv else False)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Parity: model.py:319 save_checkpoint."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+    logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, epoch)
+
+
+def load_checkpoint(prefix, epoch):
+    """Parity: model.py:355 load_checkpoint -> (symbol, arg_params, aux_params)."""
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward:
+    """Legacy estimator API (parity: model.py:387).
+
+    Internally delegates to Module — the reference's
+    _train_multi_device loop (model.py:132-316) is the same fit loop.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, learning_rate=0.01, **kwargs):
+        from .initializer import Uniform
+
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.learning_rate = learning_rate
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None, **kwargs):
+        """Parity: FeedForward.create (model.py) — build + fit."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger)
+        return model
+
+    def _init_iter(self, X, y, is_train):
+        from .io import DataIter, NDArrayIter
+
+        if isinstance(X, DataIter):
+            return X
+        if isinstance(X, (np.ndarray, nd.NDArray)):
+            X = X.asnumpy() if isinstance(X, nd.NDArray) else X
+            if y is not None:
+                y = y.asnumpy() if isinstance(y, nd.NDArray) else np.asarray(y)
+            batch = min(self.numpy_batch_size, X.shape[0])
+            return NDArrayIter(X, y, batch_size=batch, shuffle=is_train,
+                               last_batch_handle="discard" if is_train else "pad")
+        raise TypeError("X must be DataIter or array")
+
+    def _get_module(self, data_iter):
+        from .module import Module
+
+        data_names = [d[0] for d in data_iter.provide_data]
+        label_names = [l[0] for l in data_iter.provide_label]
+        ctx = self.ctx
+        if ctx is not None and not isinstance(ctx, list):
+            ctx = [ctx]
+        return Module(self.symbol, data_names=data_names,
+                      label_names=label_names, context=ctx)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None):
+        train_data = self._init_iter(X, y, is_train=True)
+        if eval_data is not None and isinstance(eval_data, tuple):
+            eval_data = self._init_iter(eval_data[0], eval_data[1], is_train=False)
+        self._module = self._get_module(train_data)
+        optimizer_params = {"learning_rate": self.learning_rate}
+        for k in ("momentum", "wd", "clip_gradient", "lr_scheduler", "rescale_grad"):
+            if k in self.kwargs:
+                optimizer_params[k] = self.kwargs[k]
+        self._module.fit(
+            train_data, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer,
+            optimizer_params=tuple(optimizer_params.items()),
+            initializer=self.initializer, arg_params=self.arg_params,
+            aux_params=self.aux_params, allow_missing=True,
+            begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+            monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data_iter = self._init_iter(X, None, is_train=False)
+        if self._module is None or not self._module.binded:
+            self._module = self._get_module(data_iter)
+            self._module.bind(data_shapes=data_iter.provide_data,
+                              label_shapes=data_iter.provide_label or None,
+                              for_training=False)
+            self._module.init_params(arg_params=self.arg_params,
+                                     aux_params=self.aux_params,
+                                     allow_missing=False)
+        out = self._module.predict(data_iter, num_batch=num_batch, reset=reset)
+        if isinstance(out, list):
+            return [o.asnumpy() for o in out]
+        return out.asnumpy()
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None):
+        data_iter = self._init_iter(X, y, is_train=False)
+        if self._module is None:
+            self._module = self._get_module(data_iter)
+            self._module.bind(data_shapes=data_iter.provide_data,
+                              label_shapes=data_iter.provide_label,
+                              for_training=False)
+            self._module.init_params(arg_params=self.arg_params,
+                                     aux_params=self.aux_params)
+        res = self._module.score(data_iter, eval_metric, num_batch=num_batch)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})

@@ -1,0 +1,11 @@
+"""Training modules (parity: python/mxnet/module/).
+
+Module, BucketingModule, SequentialModule, PythonModule over BaseModule;
+DataParallelExecutorGroup implements mesh-sharded data parallelism.
+"""
+from .base_module import BaseModule
+from .module import Module
+from .executor_group import DataParallelExecutorGroup
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule

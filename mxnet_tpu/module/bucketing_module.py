@@ -1,0 +1,158 @@
+"""BucketingModule — variable-length training with per-bucket executors.
+
+Parity: python/mxnet/module/bucketing_module.py (reference:16;
+switch_bucket:207-217).  The reference shares one memory pool across bucket
+executors (GraphExecutor::Init(shared_exec) -> InitDataEntryMemory);
+TPU-natively each bucket is a jit cache entry keyed by shape — the
+``shared_module`` plumbing shares the compiled-function cache and params,
+and XLA reuses device buffers across calls (SURVEY.md §5.7 bucketing row).
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._default_bucket_key = default_bucket_key
+        self._sym_gen = sym_gen
+        self._context = context
+        self._work_load_list = work_load_list
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _gen_module(self, bucket_key):
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(symbol, data_names, label_names, logger=self.logger,
+                      context=self._context, work_load_list=self._work_load_list,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+        assert shared_module is None, "shared_module not supported for BucketingModule"
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                    force_rebind=False, shared_module=None, grad_req=grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self._buckets[self._default_bucket_key] = module
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Parity: bucketing_module.py:207 — bind new bucket with
+        shared_module=default bucket (compile-cache + param sharing)."""
+        assert self.binded, "call bind before switching buckets"
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes, self._curr_module.for_training,
+                        self._curr_module.inputs_need_grad,
+                        force_rebind=False,
+                        shared_module=self._buckets[self._default_bucket_key])
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        self._curr_module._params_dirty = self._params_dirty
+        params = self._curr_module.get_params()
+        self._params_dirty = False
+        return params
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        self._curr_module.init_params(initializer, arg_params, aux_params,
+                                      allow_missing, force_init)
+        self.params_initialized = True
+        self._params_dirty = False
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
+                                         force_init=force_init)
+        for mod in self._buckets.values():
+            if mod is not self._curr_module:
+                mod.borrow_optimizer(self._curr_module)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        bucket_key = getattr(data_batch, "bucket_key", None)
+        if bucket_key is None:
+            bucket_key = self._default_bucket_key
+        data_shapes = data_batch.provide_data or [
+            (n, a.shape) for n, a in zip(self._curr_module.data_names, data_batch.data)
+        ]
+        label_shapes = data_batch.provide_label
+        self.switch_bucket(bucket_key, data_shapes, label_shapes)
+        # propagate latest params into the bucket's executor
+        if self._curr_module.params_initialized is False:
+            self._curr_module.params_initialized = True
+        self._curr_module._exec_group.set_params(
+            self._buckets[self._default_bucket_key]._arg_params or {},
+            self._buckets[self._default_bucket_key]._aux_params or {})
+        self._curr_module._arg_params = self._buckets[self._default_bucket_key]._arg_params
+        self._curr_module._aux_params = self._buckets[self._default_bucket_key]._aux_params
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+        self._params_dirty = True
+
+    def update(self):
+        self._curr_module.update()
+        # write updated params back to the default bucket's master copy
+        self._curr_module._sync_params_from_devices()
+        self._params_dirty = False
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)
+
+    @property
+    def _params_dirty(self):
+        return getattr(self, "_params_dirty_flag", False)
+
+    @_params_dirty.setter
+    def _params_dirty(self, val):
+        self._params_dirty_flag = val

@@ -1,0 +1,172 @@
+"""DataParallelExecutorGroup — data parallelism over a device mesh.
+
+Parity: python/mxnet/module/executor_group.py (reference:66): the reference
+slices each batch across contexts (_split_input_slice), binds one executor
+per device, scatters inputs (_load_data:41) and gathers outputs
+(_merge_multi_context:50); gradients meet in the kvstore.
+
+TPU-native redesign (SURVEY.md §7 'Data parallelism' row): ONE executor,
+ONE compiled SPMD program.  The contexts become a 1-D jax mesh with axis
+``data``; input batches are device_put with a batch-sharded NamedSharding,
+params/grads are replicated.  XLA GSPMD inserts the gradient all-reduce
+over ICI — the engine-scheduled P2P copy + ElementwiseSum machinery of
+CommDevice (src/kvstore/comm.h:200-360) becomes a single fused collective.
+The slice/merge API surface is preserved so Module code is unchanged.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..executor import simple_bind
+from ..ndarray import NDArray
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Parity: executor_manager.py:15 — kept for API compat (slices are
+    virtual on TPU; sharding does the real split)."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for w in work_load_list:
+        end = start + int(round(batch_size * w / total))
+        slices.append(slice(start, min(end, batch_size)))
+        start = end
+    return slices
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=None, fixed_param_names=None, grad_req="write"):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+
+        self.data_names = [d[0] for d in data_shapes]
+        self.label_names = [l[0] for l in label_shapes] if label_shapes else []
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self.batch_size = data_shapes[0][1][0]
+
+        # ----- mesh over the data axis (the TPU-native executor "group") ----
+        devices = [c.jax_device for c in contexts]
+        unique = []
+        for d in devices:
+            if d not in unique:
+                unique.append(d)
+        if self.batch_size % len(unique) != 0:
+            unique = unique[:1]  # uneven split: fall back to single device
+        self.mesh = Mesh(np.array(unique), ("data",))
+        self._data_sharding = NamedSharding(self.mesh, P("data"))
+        self._repl_sharding = NamedSharding(self.mesh, P())
+
+        arg_names = symbol.list_arguments()
+        self.arg_names = arg_names
+        self.aux_names = symbol.list_auxiliary_states()
+
+        input_shapes = dict([(n, s) for n, s in data_shapes] +
+                            ([(n, s) for n, s in label_shapes] if label_shapes else []))
+        req = {}
+        for name in arg_names:
+            if name in self.data_names:
+                req[name] = "write" if inputs_need_grad else "null"
+            elif name in self.label_names or name in self.fixed_param_names:
+                req[name] = "null"
+            else:
+                req[name] = grad_req if for_training else "null"
+        shared_exec = shared_group.execs[0] if shared_group is not None else None
+        exec_ = simple_bind(symbol, contexts[0], grad_req=req,
+                            shared_exec=shared_exec, **input_shapes)
+        # replicate params over the mesh so GSPMD sees them as shared
+        if len(unique) > 1:
+            for name, arr in exec_.arg_dict.items():
+                if name not in self.data_names and name not in self.label_names:
+                    arr._chunk.write(jax.device_put(arr._read(), self._repl_sharding))
+            for arr in exec_.aux_dict.values():
+                arr._chunk.write(jax.device_put(arr._read(), self._repl_sharding))
+        self.execs = [exec_]
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+
+    # ---------------------------------------------------------------- params
+    def set_params(self, arg_params, aux_params):
+        ex = self.execs[0]
+        for name, arr in arg_params.items():
+            if name in ex.arg_dict:
+                ex.arg_dict[name]._chunk.write(self._replicate(arr))
+        for name, arr in (aux_params or {}).items():
+            if name in ex.aux_dict:
+                ex.aux_dict[name]._chunk.write(self._replicate(arr))
+
+    def _replicate(self, arr):
+        raw = arr._read() if isinstance(arr, NDArray) else jax.numpy.asarray(arr)
+        if len(self.mesh.devices.flat) > 1:
+            return jax.device_put(raw, self._repl_sharding)
+        return raw
+
+    def get_params(self, arg_params, aux_params):
+        ex = self.execs[0]
+        for name in self.param_names:
+            if name in ex.arg_dict:
+                arg_params[name] = ex.arg_dict[name].copy()
+        for name, arr in ex.aux_dict.items():
+            aux_params[name] = arr.copy()
+
+    # --------------------------------------------------------------- running
+    def forward(self, data_batch, is_train=None):
+        """Parity: executor_group forward — scatter + forward.  Scatter is a
+        sharded device_put (one ICI-free host->device transfer per shard)."""
+        if is_train is None:
+            is_train = self.for_training
+        ex = self.execs[0]
+        self._load(ex, self.data_names, data_batch.data)
+        if self.label_names and data_batch.label:
+            self._load(ex, self.label_names, data_batch.label)
+        ex.forward(is_train=is_train)
+
+    def _load(self, ex, names, arrays):
+        for name, arr in zip(names, arrays):
+            raw = arr._read() if isinstance(arr, NDArray) else jax.numpy.asarray(np.asarray(arr))
+            if len(self.mesh.devices.flat) > 1:
+                raw = jax.device_put(raw, self._data_sharding)
+            # bypass _set's device pinning: sharded placement is intentional
+            ex.arg_dict[name]._chunk.write(raw)
+
+    def backward(self, out_grads=None):
+        self.execs[0].backward(out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        """Outputs are global (sharded) arrays — 'merge' is free."""
+        return list(self.execs[0].outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        ex = self.execs[0]
+        return [ex.grad_dict[n] for n in self.data_names if n in ex.grad_dict]
+
+    @property
+    def grad_arrays(self):
+        """Per-param grad lists (length-1: the mesh-global grad) — parity
+        shape [[grad_per_device]] collapses to [[global_grad]]."""
+        ex = self.execs[0]
+        return [[ex.grad_dict[n]] for n in self.param_names if n in ex.grad_dict]
+
+    @property
+    def param_arrays(self):
+        ex = self.execs[0]
+        return [[ex.arg_dict[n]] for n in self.param_names if n in ex.arg_dict]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        for ex in self.execs:
+            mon.install(ex)

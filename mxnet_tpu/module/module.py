@@ -1,0 +1,314 @@
+"""Module — the modern training API over one symbol.
+
+Parity: python/mxnet/module/module.py (reference:21; bind:276,
+init_optimizer:379, update:489).  Data parallelism is delegated to the
+mesh-based DataParallelExecutorGroup; the kvstore update path preserves the
+reference's two modes (_create_kvstore, model.py:40-77):
+
+- update_on_kvstore=True: push(grad) then pull(weight) per key; optimizer
+  runs inside the store,
+- update_on_kvstore=False: store aggregates only (push/pull grad); the
+  module runs the Updater locally.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..context import cpu, Context
+from ..model import _create_kvstore, load_checkpoint, save_checkpoint
+from ..ndarray import NDArray
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            from ..context import default_accelerator_context
+
+            context = [default_accelerator_context()]
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list or [1] * len(context)
+
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._exec_group = None
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Parity: Module.load — from save_checkpoint files."""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Parity: Module.save_checkpoint."""
+        self._sync_params_from_devices()
+        save_checkpoint(prefix, epoch, self.symbol, self._arg_params, self._aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    # ---------------------------------------------------------------- binding
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        outs = self._exec_group.execs[0].outputs
+        return list(zip(self.output_names, [o.shape for o in outs]))
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Parity: Module.bind (module.py:276)."""
+        if force_rebind:
+            self._exec_group = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        self._data_shapes = [x if isinstance(x, tuple) else tuple(x) for x in data_shapes]
+        self._data_shapes = [tuple(x) for x in data_shapes]
+        self._label_shapes = [tuple(x) for x in label_shapes] if label_shapes else None
+
+        shared_group = shared_module._exec_group if shared_module is not None else None
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list, self._data_shapes,
+            self._label_shapes or [], self._param_names, for_training,
+            inputs_need_grad, shared_group=shared_group, logger=self.logger,
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req)
+        if shared_module is not None and shared_module.params_initialized:
+            self.set_params(*shared_module.get_params())
+
+    # ----------------------------------------------------------------- params
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def _sync_params_from_devices(self):
+        if self._params_dirty and self._exec_group is not None:
+            self._arg_params = self._arg_params or {}
+            self._aux_params = self._aux_params or {}
+            self._exec_group.get_params(self._arg_params, self._aux_params)
+            self._params_dirty = False
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        """Parity: Module.init_params."""
+        if self.params_initialized and not force_init:
+            if arg_params or aux_params:
+                self._set_params_direct(arg_params, aux_params, allow_missing)
+            return
+        assert self.binded, "call bind before init_params"
+        from ..initializer import Uniform
+
+        initializer = initializer if initializer is not None else Uniform(0.01)
+
+        ex = self._exec_group.execs[0]
+        self._arg_params = {}
+        self._aux_params = {}
+        for name in self._param_names:
+            if name not in ex.arg_dict:
+                continue
+            arr = nd.zeros(ex.arg_dict[name].shape)
+            if arg_params is not None and name in arg_params:
+                arr[:] = arg_params[name].asnumpy()
+            else:
+                if arg_params is not None and not allow_missing and arg_params:
+                    raise MXNetError(f"param {name} missing")
+                if initializer is not None:
+                    initializer(name, arr)
+            self._arg_params[name] = arr
+        for name in self._aux_names:
+            arr = nd.zeros(ex.aux_dict[name].shape)
+            if aux_params is not None and name in aux_params:
+                arr[:] = aux_params[name].asnumpy()
+            else:
+                if initializer is not None:
+                    initializer(name, arr)
+            self._aux_params[name] = arr
+        self._exec_group.set_params(self._arg_params, self._aux_params)
+        self.params_initialized = True
+        self._params_dirty = False
+
+    def _set_params_direct(self, arg_params, aux_params, allow_missing=False):
+        for k, v in (arg_params or {}).items():
+            if k in self._arg_params:
+                self._arg_params[k][:] = v.asnumpy()
+        for k, v in (aux_params or {}).items():
+            if k in self._aux_params:
+                self._aux_params[k][:] = v.asnumpy()
+        self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    def set_params(self, arg_params, aux_params, allow_missing=False, force_init=True):
+        if not self.params_initialized:
+            self.params_initialized = True
+            self._arg_params = {k: v.copy() for k, v in (arg_params or {}).items()}
+            self._aux_params = {k: v.copy() for k, v in (aux_params or {}).items()}
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+            return
+        self._set_params_direct(arg_params, aux_params, allow_missing)
+
+    # -------------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),), force_init=False):
+        """Parity: Module.init_optimizer (module.py:379)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized")
+            return
+        kvstore_inst, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context), self._arg_params)
+
+        batch_size = self._exec_group.batch_size
+        if kvstore_inst and "dist" in kvstore_inst.type and "_sync" in kvstore_inst.type:
+            batch_size *= kvstore_inst.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        if isinstance(optimizer, str):
+            idx2name = dict(enumerate(self._param_names))
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name, **optimizer_params)
+        self._optimizer = optimizer
+        self._kvstore = kvstore_inst
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore_inst:
+            # parity: _initialize_kvstore (model.py) — init each param slot
+            for idx, name in enumerate(self._param_names):
+                if name in self._arg_params:
+                    kvstore_inst.init(idx, self._arg_params[name])
+            if update_on_kvstore:
+                kvstore_inst.set_optimizer(self._optimizer)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        """Parity: Module.borrow_optimizer — share optimizer state across
+        bucket modules."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------ computation
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.backward(out_grads)
+
+    def update(self):
+        """Parity: Module.update (module.py:489) + model.py:88-118."""
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        self._params_dirty = True
+        ex = self._exec_group.execs[0]
+        if self._update_on_kvstore:
+            for idx, name in enumerate(self._param_names):
+                if name not in ex.grad_dict:
+                    continue
+                # push grad; optimizer runs in-store; pull weight back
+                self._kvstore.push(idx, [ex.grad_dict[name]], priority=-idx)
+                self._kvstore.pull(idx, ex.arg_dict[name], priority=-idx)
+        else:
+            for idx, name in enumerate(self._param_names):
+                if name not in ex.grad_dict:
+                    continue
+                grad = ex.grad_dict[name]
+                if self._kvstore:
+                    self._kvstore.push(idx, [grad], priority=-idx)
+                    self._kvstore.pull(idx, grad, priority=-idx)
+                self._updater(idx, grad, ex.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec_group.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and self.inputs_need_grad
+        return self._exec_group.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._exec_group.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        self._exec_group.install_monitor(mon)
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())

@@ -1,0 +1,393 @@
+"""Optimizers.
+
+Parity: python/mxnet/optimizer.py (reference): registry + create, the full
+optimizer zoo (SGD:198, DCASGD:276, NAG:374, SGLD:422, ccSGD:487, Adam:493,
+AdaGrad:583, RMSProp:632, AdaDelta:708, Test:762), lr/wd multipliers,
+rescale_grad, clip_gradient, and ``get_updater`` (:780) for the kvstore
+updater path.  Where the reference calls fused CUDA kernels
+(src/operator/optimizer_op.cc), the hot optimizers dispatch to the fused
+jitted ops in ops/optimizer_ops.py so clip+decay+update is one XLA kernel.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+_OPT_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    """Parity: Optimizer.register decorator."""
+    name = klass.__name__.lower()
+    _OPT_REGISTRY[name] = klass
+    return klass
+
+
+class Optimizer:
+    """Base optimizer (parity: optimizer.py Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.sym = sym
+        self.lr_mult: Dict = {}
+        self.wd_mult: Dict = {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        """Parity: Optimizer.create_optimizer / mx.optimizer.create."""
+        if name.lower() not in _OPT_REGISTRY:
+            raise MXNetError(f"unknown optimizer {name}")
+        return _OPT_REGISTRY[name.lower()](**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # reference behavior: no decay on bias/gamma/beta by default
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+# convenience alias (parity: mx.optimizer.create)
+def create(name, **kwargs):
+    return Optimizer.create_optimizer(name, **kwargs)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (parity: optimizer.py:198); dispatches to the
+    fused sgd(_mom)_update kernels (optimizer_op.cc parity)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        attrs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                 "clip_gradient": self.clip_gradient or 0.0}
+        if state is not None:
+            new_w, new_mom = nd.sgd_mom_update(weight, grad, state,
+                                               momentum=self.momentum, **attrs)
+            weight._set(new_w._read())
+            state._set(new_mom._read())
+        else:
+            nd.sgd_update(weight, grad, out=weight, **attrs)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (parity: optimizer.py:374)."""
+
+    def update(self, index, weight, grad, state):
+        # reference NAG (optimizer.py:374): mom = momentum*mom + grad';
+        # weight -= lr * (grad' + momentum*mom)
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        grad = grad + wd * weight
+        if state is not None:
+            mom = state
+            mom._set((self.momentum * mom + grad)._read())
+            weight._set((weight - lr * (grad + self.momentum * mom))._read())
+        else:
+            weight._set((weight - lr * grad)._read())
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (parity: optimizer.py:422)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        noise = nd.normal(loc=0.0, scale=math.sqrt(lr), shape=weight.shape)
+        weight._set((weight - lr / 2 * (grad + wd * weight) + noise)._read())
+
+
+@register
+class CcSGD(SGD):
+    """Parity: ccSGD (optimizer.py:487) — same math as SGD here."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam (parity: optimizer.py:493) with bias correction; fused kernel."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        new_w, new_mean, new_var = nd.adam_update(
+            weight, grad, mean, var, lr=lr_t, beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient or 0.0)
+        weight._set(new_w._read())
+        mean._set(new_mean._read())
+        var._set(new_var._read())
+
+
+@register
+class AdaGrad(Optimizer):
+    """Parity: optimizer.py:583."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        history = state
+        history._set((history + grad * grad)._read())
+        weight._set(
+            (weight - lr * (grad / nd.sqrt(history + self.float_stable_eps) + wd * weight))._read()
+        )
+
+
+@register
+class RMSProp(Optimizer):
+    """Parity: optimizer.py:632 (Tieleman & Hinton variant w/ gamma1)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.95, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, ctx=weight.context),
+                    nd.zeros(weight.shape, ctx=weight.context),
+                    nd.zeros(weight.shape, ctx=weight.context))
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if not self.centered:
+            n = state
+            new_w, new_n = nd.rmsprop_update(
+                weight, grad, n, lr=lr, gamma1=self.gamma1, epsilon=self.epsilon,
+                wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient or 0.0,
+                clip_weights=self.clip_weights or 0.0)
+            weight._set(new_w._read())
+            n._set(new_n._read())
+            return
+        n, g, delta = state
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        n._set((self.gamma1 * n + (1 - self.gamma1) * grad * grad)._read())
+        g._set((self.gamma1 * g + (1 - self.gamma1) * grad)._read())
+        delta._set((self.gamma2 * delta - lr * grad / nd.sqrt(n - g * g + self.epsilon))._read())
+        weight._set((weight + delta)._read())
+
+
+@register
+class AdaDelta(Optimizer):
+    """Parity: optimizer.py:708."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._set((self.rho * acc_g + (1 - self.rho) * grad * grad)._read())
+        delta = nd.sqrt(acc_delta + self.epsilon) / nd.sqrt(acc_g + self.epsilon) * grad
+        acc_delta._set((self.rho * acc_delta + (1 - self.rho) * delta * delta)._read())
+        weight._set((weight - (delta + wd * weight))._read())
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (parity: optimizer.py:276)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous: Dict = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, ctx=weight.context), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        mom, prev = state
+        comp = grad + self.lamda * grad * grad * (weight - prev)
+        if mom is not None:
+            mom._set((self.momentum * mom - lr * (comp + wd * weight))._read())
+            update = mom
+        else:
+            update = -lr * (comp + wd * weight)
+        prev._set(weight._read())
+        weight._set((weight + update)._read())
+
+
+@register
+class Test(Optimizer):
+    """Deterministic test optimizer: weight += grad (parity: optimizer.py:762
+    — the kvstore-math test fixture)."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._set((weight + grad * self.rescale_grad)._read())
+
+
+class Updater:
+    """Parity: get_updater closure (optimizer.py:780) — the callable handed
+    to KVStore.set_updater; lazily creates per-key state."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def get_states(self):
+        import pickle
+
+        return pickle.dumps({k: _state_to_np(v) for k, v in self.states.items()})
+
+    def set_states(self, states):
+        import pickle
+
+        raw = pickle.loads(states)
+        self.states = {k: _state_from_np(v) for k, v in raw.items()}
+
+
+def _state_to_np(state):
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return tuple(_state_to_np(s) for s in state)
+    return state.asnumpy()
+
+
+def _state_from_np(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_from_np(s) for s in state)
+    return nd.array(state)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

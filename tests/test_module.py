@@ -1,0 +1,233 @@
+"""Module/training tests (parity model: tests/python/unittest/test_module.py
++ tests/python/train/test_mlp.py — the end-to-end convergence gate)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import get_synthetic_mnist
+
+
+def _mlp_sym(num_hidden=32, num_classes=10):
+    data = sym.Variable("data")
+    net = sym.Flatten(data)
+    net = sym.FullyConnected(net, name="fc1", num_hidden=num_hidden)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=num_classes)
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _make_iters(batch_size=64):
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(512, 128)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(xte, yte, batch_size=batch_size)
+    return train, val
+
+
+def test_module_train_mlp_converges():
+    # parity: tests/python/train/test_mlp.py accuracy gate
+    train, val = _make_iters()
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5),), num_epoch=4)
+    assert mod.score(val, "acc")[0][1] > 0.9
+
+
+def test_module_predict_and_outputs():
+    train, val = _make_iters()
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5),), num_epoch=2)
+    preds = mod.predict(val)
+    assert preds.shape == (128, 10)
+    np.testing.assert_allclose(preds.asnumpy().sum(axis=1), np.ones(128), rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    train, val = _make_iters()
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5),), num_epoch=2)
+    acc_before = mod.score(val, "acc")[0][1]
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 2)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0002.params")
+
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=val.provide_data, label_shapes=val.provide_label,
+              for_training=False)
+    mod2.set_params(*mod2._arg_params and (mod2._arg_params, mod2._aux_params))
+    acc_after = mod2.score(val, "acc")[0][1]
+    assert abs(acc_before - acc_after) < 1e-6
+
+
+def test_module_multi_device_data_parallel():
+    # parity: multi-device training on cpu contexts
+    train, val = _make_iters(batch_size=64)
+    mod = mx.mod.Module(_mlp_sym(), context=[mx.cpu(i) for i in range(4)])
+    mod.fit(train, optimizer="sgd", kvstore="device",
+            optimizer_params=(("learning_rate", 0.5),), num_epoch=3)
+    assert mod.score(val, "acc")[0][1] > 0.9
+
+
+def test_module_optimizers_run():
+    for optname in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "nag"]:
+        train, _ = _make_iters()
+        mod = mx.mod.Module(_mlp_sym(16), context=mx.cpu())
+        mod.fit(train, optimizer=optname,
+                optimizer_params=(("learning_rate", 0.05),), num_epoch=1)
+
+
+def test_feedforward_api():
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(512, 64)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=5,
+                                 learning_rate=0.5, numpy_batch_size=64)
+    model.fit(xtr, ytr)
+    acc = model.score(xte, yte)
+    assert acc > 0.9
+    preds = model.predict(xte)
+    assert preds.shape == (64, 10)
+
+
+def test_optimizer_updates_match_reference_math():
+    # SGD: w -= lr*(rescale*grad + wd*w)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, wd=0.01, rescale_grad=1.0)
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.5, 0.5])
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    expect = np.array([1.0, 2.0]) - 0.1 * (np.array([0.5, 0.5]) + 0.01 * np.array([1.0, 2.0]))
+    np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-6)
+
+    # momentum
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    w = nd.array([1.0])
+    state = opt.create_state(0, w)
+    opt.update(0, w, nd.array([1.0]), state)
+    np.testing.assert_allclose(w.asnumpy(), [0.9], rtol=1e-6)
+    opt.update(0, w, nd.array([1.0]), state)
+    # mom = 0.9*(-0.1) - 0.1 = -0.19; w = 0.9 - 0.19 = 0.71
+    np.testing.assert_allclose(w.asnumpy(), [0.71], rtol=1e-5)
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    msched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1)
+    msched.base_lr = 1.0
+    assert msched(2) == 1.0
+    assert abs(msched(7) - 0.1) < 1e-9
+    assert abs(msched(12) - 0.01) < 1e-9
+
+
+def test_metrics():
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    m = mx.metric.create("acc")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+    m2 = mx.metric.create("mse")
+    m2.update([nd.array([[1.0], [2.0]])], [nd.array([[1.5], [2.0]])])
+    assert abs(m2.get()[1] - 0.125) < 1e-6
+    m3 = mx.metric.CompositeEvalMetric(metrics=[mx.metric.Accuracy(), mx.metric.CrossEntropy()])
+    m3.update([label], [pred])
+    names, vals = m3.get()
+
+
+def test_initializers():
+    for init in [mx.init.Uniform(0.1), mx.init.Normal(0.1),
+                 mx.init.Xavier(), mx.init.Orthogonal(), mx.init.MSRAPrelu()]:
+        arr = nd.zeros((8, 8))
+        init("test_weight", arr)
+        assert np.abs(arr.asnumpy()).sum() > 0
+    arr = nd.zeros((4,))
+    mx.init.Uniform()("test_bias", arr)
+    assert (arr.asnumpy() == 0).all()
+    arr = nd.zeros((4,))
+    mx.init.Uniform()("bn_gamma", arr)
+    assert (arr.asnumpy() == 1).all()
+
+
+def test_mixed_initializer():
+    init = mx.init.Mixed([".*bias", ".*"], [mx.init.Zero(), mx.init.One()])
+    w = nd.zeros((3,))
+    init("fc_weight", w)
+    assert (w.asnumpy() == 1).all()
+    b = nd.ones((3,))
+    init("fc_bias", b)
+    assert (b.asnumpy() == 0).all()
+
+
+def test_ndarray_iter():
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it.reset()
+    batches2 = list(it)
+    assert len(batches2) == 3
+    it2 = mx.io.NDArrayIter(x, y, batch_size=4, last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_resize_iter():
+    x = np.zeros((8, 2), dtype=np.float32)
+    it = mx.io.ResizeIter(mx.io.NDArrayIter(x, batch_size=4), size=5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    x = np.random.rand(16, 3).astype(np.float32)
+    y = np.arange(16, dtype=np.float32)
+    base = mx.io.NDArrayIter(x, y, batch_size=4)
+    pre = mx.io.PrefetchingIter(base)
+    n = 0
+    for batch in pre:
+        assert batch.data[0].shape == (4, 3)
+        n += 1
+    assert n == 4
+
+
+def test_kvstore_local_math():
+    # parity: tests/python/unittest/test_kvstore.py
+    kv = mx.kv.create("local")
+    shape = (4, 4)
+    kv.init(3, nd.ones(shape))
+    out = nd.zeros(shape)
+    kv.push(3, [nd.ones(shape)] * 4)
+    kv.pull(3, out=out)
+    # aggregation-only: store now holds sum of pushes
+    np.testing.assert_allclose(out.asnumpy(), 4 * np.ones(shape))
+
+
+def test_kvstore_with_updater():
+    kv = mx.kv.create("device")
+    kv.set_optimizer(mx.optimizer.create("test"))
+    shape = (2, 2)
+    kv.init(0, nd.zeros(shape))
+    for _ in range(3):
+        kv.push(0, [nd.ones(shape), nd.ones(shape)])
+    out = nd.zeros(shape)
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 6 * np.ones(shape))
+
+
+def test_monitor():
+    train, _ = _make_iters()
+    mod = mx.mod.Module(_mlp_sym(8), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
+    mon = mx.Monitor(1, pattern=".*fc1.*")
+    mod.install_monitor(mon)
+    mod.init_params()
+    batch = next(train)
+    mon.tic()
+    mod.forward(batch, is_train=False)
+    res = mon.toc()
+    assert any("fc1" in r[1] for r in res)
