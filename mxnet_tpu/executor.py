@@ -227,6 +227,18 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             head = [g._read() if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads]
+            # pin head grads to the executor's device (caller may have
+            # created them on the default device)
+            from jax.sharding import SingleDeviceSharding
+
+            ref = next(iter(args.values()), None)
+            if ref is not None and isinstance(getattr(ref, "sharding", None), SingleDeviceSharding):
+                head = [
+                    jax.device_put(h, ref.sharding)
+                    if getattr(h, "sharding", None) != ref.sharding
+                    else h
+                    for h in head
+                ]
             outs, new_aux, grads = self._jit_fwdbwd(
                 args, aux, key, head, gnames=tuple(self._grad_names)
             )
