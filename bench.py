@@ -1,0 +1,69 @@
+"""Benchmark: ResNet-50 ImageNet-shape training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's strongest published single-device number —
+ResNet-50 training, batch 32, P100: 181.53 img/s (BASELINE.md,
+docs/how_to/perf.md:132-139).  vs_baseline = ours / 181.53.
+
+The run uses the FusedTrainer fast path (whole train step = one XLA
+computation, buffer donation, bf16 compute with fp32 master weights —
+the TPU-native equivalent of the reference's fp32 cuDNN path).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53  # P100 ResNet-50 train b32 (docs/how_to/perf.md:132-139)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu  # noqa: F401 (sets matmul precision policy)
+    from mxnet_tpu import models
+    from mxnet_tpu.trainer import FusedTrainer
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    net = models.get_symbol("resnet-50", num_classes=1000)
+    dtype = jnp.bfloat16 if os.environ.get("BENCH_DTYPE", "bf16") == "bf16" else jnp.float32
+
+    tr = FusedTrainer(
+        net,
+        optimizer="sgd",
+        optimizer_params={"lr": 0.1, "momentum": 0.9, "rescale_grad": 1.0 / batch},
+        dtype=dtype,
+    )
+    tr.init(data=(batch, 3, 224, 224))
+
+    rs = np.random.RandomState(0)
+    data = rs.uniform(0, 1, (batch, 3, 224, 224)).astype(np.float32)
+    label = rs.randint(0, 1000, batch).astype(np.float32)
+
+    # warmup / compile
+    for _ in range(3):
+        outs = tr.step(data=data, softmax_label=label)
+    jax.block_until_ready(outs)
+    jax.block_until_ready(jax.tree_util.tree_leaves(tr.params))
+
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    tic = time.perf_counter()
+    for _ in range(iters):
+        outs = tr.step(data=data, softmax_label=label)
+    jax.block_until_ready(outs)
+    jax.block_until_ready(jax.tree_util.tree_leaves(tr.params))
+    dt = time.perf_counter() - tic
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Model zoo — symbol builders for the reference's example networks.
+
+Parity: example/image-classification/symbols/ (reference): mlp, lenet,
+alexnet, vgg, inception-bn, inception-v3, resnet, resnext + the rnn/lstm
+examples.  Each get_symbol returns a Symbol ending in SoftmaxOutput named
+'softmax', matching the reference training scripts' expectations.
+"""
+from . import mlp, lenet, alexnet, vgg, inception_bn, inception_v3, resnet, resnext, lstm
+
+
+def get_symbol(name, num_classes=1000, **kwargs):
+    """Parity: example/image-classification/train_model.py symbol dispatch."""
+    builders = {
+        "mlp": mlp.get_symbol,
+        "lenet": lenet.get_symbol,
+        "alexnet": alexnet.get_symbol,
+        "vgg": vgg.get_symbol,
+        "inception-bn": inception_bn.get_symbol,
+        "inception-v3": inception_v3.get_symbol,
+        "resnet": resnet.get_symbol,
+        "resnext": resnext.get_symbol,
+    }
+    if name.startswith("resnet-"):
+        return resnet.get_symbol(num_classes, num_layers=int(name.split("-")[1]), **kwargs)
+    if name.startswith("resnext-"):
+        return resnext.get_symbol(num_classes, num_layers=int(name.split("-")[1]), **kwargs)
+    if name not in builders:
+        raise ValueError(f"unknown model {name}; have {sorted(builders)}")
+    return builders[name](num_classes, **kwargs)

@@ -1,0 +1,85 @@
+"""Unrolled LSTM language model (parity: example/rnn/lstm.py — the
+lstm_bucketing/PTB workload; also the model-parallel variant
+example/model-parallel-lstm/lstm.py, whose per-layer ctx_group annotations
+map to mesh sharding groups here).
+"""
+from collections import namedtuple
+
+from .. import symbol as sym
+
+LSTMState = namedtuple("LSTMState", ["c", "h"])
+LSTMParam = namedtuple("LSTMParam", ["i2h_weight", "i2h_bias", "h2h_weight", "h2h_bias"])
+
+
+def lstm(num_hidden, indata, prev_state, param, seqidx, layeridx, dropout=0.0):
+    """One LSTM step (parity: example/rnn/lstm.py lstm())."""
+    if dropout > 0.0:
+        indata = sym.Dropout(indata, p=dropout)
+    i2h = sym.FullyConnected(indata, weight=param.i2h_weight, bias=param.i2h_bias,
+                             num_hidden=num_hidden * 4,
+                             name=f"t{seqidx}_l{layeridx}_i2h")
+    h2h = sym.FullyConnected(prev_state.h, weight=param.h2h_weight,
+                             bias=param.h2h_bias, num_hidden=num_hidden * 4,
+                             name=f"t{seqidx}_l{layeridx}_h2h")
+    gates = i2h + h2h
+    slice_gates = sym.SliceChannel(gates, num_outputs=4,
+                                   name=f"t{seqidx}_l{layeridx}_slice")
+    in_gate = sym.Activation(slice_gates[0], act_type="sigmoid")
+    in_transform = sym.Activation(slice_gates[1], act_type="tanh")
+    forget_gate = sym.Activation(slice_gates[2], act_type="sigmoid")
+    out_gate = sym.Activation(slice_gates[3], act_type="sigmoid")
+    next_c = (forget_gate * prev_state.c) + (in_gate * in_transform)
+    next_h = out_gate * sym.Activation(next_c, act_type="tanh")
+    return LSTMState(c=next_c, h=next_h)
+
+
+def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
+                num_label, dropout=0.0):
+    """Parity: example/rnn/lstm.py lstm_unroll — the bucketing sym_gen body."""
+    embed_weight = sym.Variable("embed_weight")
+    cls_weight = sym.Variable("cls_weight")
+    cls_bias = sym.Variable("cls_bias")
+    param_cells = []
+    last_states = []
+    for i in range(num_lstm_layer):
+        param_cells.append(LSTMParam(
+            i2h_weight=sym.Variable(f"l{i}_i2h_weight"),
+            i2h_bias=sym.Variable(f"l{i}_i2h_bias"),
+            h2h_weight=sym.Variable(f"l{i}_h2h_weight"),
+            h2h_bias=sym.Variable(f"l{i}_h2h_bias")))
+        last_states.append(LSTMState(
+            c=sym.Variable(f"l{i}_init_c"), h=sym.Variable(f"l{i}_init_h")))
+
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(data, weight=embed_weight, input_dim=input_size,
+                          output_dim=num_embed, name="embed")
+    wordvec = sym.SliceChannel(embed, num_outputs=seq_len, axis=1,
+                               squeeze_axis=True)
+
+    hidden_all = []
+    for seqidx in range(seq_len):
+        hidden = wordvec[seqidx]
+        for i in range(num_lstm_layer):
+            next_state = lstm(num_hidden, indata=hidden,
+                              prev_state=last_states[i], param=param_cells[i],
+                              seqidx=seqidx, layeridx=i,
+                              dropout=dropout if i > 0 else 0.0)
+            hidden = next_state.h
+            last_states[i] = next_state
+        if dropout > 0.0:
+            hidden = sym.Dropout(hidden, p=dropout)
+        hidden_all.append(hidden)
+
+    hidden_concat = sym.Concat(*hidden_all, dim=0)
+    pred = sym.FullyConnected(hidden_concat, num_hidden=num_label,
+                              weight=cls_weight, bias=cls_bias, name="pred")
+    label_t = sym.transpose(label)
+    label_flat = sym.Reshape(label_t, shape=(-1,))
+    return sym.SoftmaxOutput(pred, label_flat, name="softmax")
+
+
+def get_symbol(num_classes=10000, seq_len=32, num_hidden=200, num_embed=200,
+               num_lstm_layer=2, dropout=0.2, **kwargs):
+    return lstm_unroll(num_lstm_layer, seq_len, num_classes, num_hidden,
+                       num_embed, num_classes, dropout)

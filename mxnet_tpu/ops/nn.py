@@ -83,8 +83,7 @@ def _convolution(ctx, data, weight, bias=None, **attrs):
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -130,8 +129,7 @@ def _deconvolution(ctx, data, weight, bias=None, **attrs):
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -169,7 +167,7 @@ def _fully_connected(ctx, data, weight, bias=None, **attrs):
     """Parity: FullyConnected (src/operator/fully_connected-inl.h); always
     flattens trailing dims like the reference v0.9 op."""
     x = data.reshape((data.shape[0], -1))
-    out = jnp.dot(x, weight.T, preferred_element_type=jnp.float32).astype(data.dtype)
+    out = jnp.dot(x, weight.T)
     if bias is not None:
         out = out + bias
     return out
@@ -494,8 +492,7 @@ def _upsampling(ctx, data, weight=None, **attrs):
         lhs_dilation=(scale, scale),
         dimension_numbers=dn,
         feature_group_count=c,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     return out
 
 

@@ -1,0 +1,8 @@
+"""Parallelism toolkit: meshes, shardings, collectives, sequence parallel.
+
+TPU-native replacement for the reference's distribution machinery
+(SURVEY.md §2.4/§5.8): where MXNet composes engine-scheduled P2P copies +
+parameter-server push/pull, this package composes jax.sharding meshes and
+XLA collectives over ICI/DCN.
+"""
+from .mesh import create_mesh, data_sharding, replicated, shard_params, ShardingRule

@@ -1,0 +1,72 @@
+"""Mesh + sharding helpers.
+
+The reference maps devices via ctx lists and ctx_group attrs
+(kvstore/comm.h device placement, graph_executor.cc PlaceDevice).  Here a
+jax.sharding.Mesh with named axes is the single source of truth:
+
+- 'data'  : batch (data parallel — kvstore local/device parity)
+- 'model' : tensor parallel (no reference analogue; SURVEY.md §2.4 marks
+            TP as absent upstream — first-class here)
+- 'pipe'  : pipeline stages (ctx_group parity)
+- 'seq'   : sequence/context parallel (ring attention)
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(shape=None, axes=("data",), devices=None) -> Mesh:
+    """Build a Mesh from the available devices.
+
+    create_mesh() -> 1-D data mesh over all devices;
+    create_mesh((4, 2), ("data", "model")) -> 2-D dp x tp mesh.
+    """
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(shape)
+    return Mesh(arr, axes)
+
+
+def data_sharding(mesh: Mesh, ndim: int, axis: str = "data") -> NamedSharding:
+    """Batch-dim sharding for an ndim array."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+@dataclass
+class ShardingRule:
+    """Regex -> PartitionSpec rule for parameter sharding (the TP analogue
+    of the reference's ctx_group model-parallel annotations)."""
+
+    pattern: str
+    spec: tuple
+
+    def matches(self, name: str) -> bool:
+        return re.match(self.pattern, name) is not None
+
+
+def shard_params(mesh: Mesh, params: dict, rules: Sequence[ShardingRule] = ()) -> dict:
+    """device_put every param according to the first matching rule
+    (default: replicated)."""
+    out = {}
+    for name, arr in params.items():
+        spec = P()
+        for rule in rules:
+            if rule.matches(name):
+                spec = P(*rule.spec)
+                break
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out

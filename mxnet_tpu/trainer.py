@@ -1,0 +1,227 @@
+"""FusedTrainer — whole-step compilation: forward+backward+optimizer in ONE
+XLA computation with buffer donation.
+
+This is the TPU-native performance path (SURVEY.md §7): where the reference
+overlaps per-op engine dispatch with per-key kvstore push/pull
+(threaded_engine_perdevice.cc + comm.h priority scheduling), XLA gets the
+entire training step as a single program — fusion handles elementwise
+chains, GSPMD inserts gradient all-reduces over the mesh, and latency
+hiding replaces the engine's comm/compute overlap (all collectives are
+scheduled inside one program rather than as separate engine ops).
+
+Donation (`donate_argnums` on params/opt-state/aux) gives in-place
+semantics — the functional analogue of the reference's in-place optimizer
+updates + PlanMemory inplace sharing.
+
+Mixed precision: dtype='bfloat16' keeps fp32 master weights and runs
+compute in bf16 (MXU fast path); the reference's fp16 path is
+test_dtype.py-style casting.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import ops
+from . import random as _random
+from .executor import _build_graph_fn
+from .initializer import Uniform
+from .ndarray import NDArray
+
+
+# pure update rules reusing the fused optimizer kernels from ops/optimizer_ops
+def _sgd_rule(opt_params):
+    momentum = opt_params.get("momentum", 0.0)
+    attrs = {k: opt_params[k] for k in ("lr", "wd", "rescale_grad", "clip_gradient")
+             if k in opt_params}
+
+    def init_state(w):
+        return (jnp.zeros_like(w),) if momentum else ()
+
+    def update(w, g, state):
+        octx = ops.OpCtx()
+        if momentum:
+            new_w, new_m = ops.get("sgd_mom_update").fn(
+                octx, w, g, state[0], momentum=momentum, **attrs)
+            return new_w, (new_m,)
+        return ops.get("sgd_update").fn(octx, w, g, **attrs), ()
+
+    return init_state, update
+
+
+def _adam_rule(opt_params):
+    attrs = {k: opt_params[k] for k in ("lr", "wd", "rescale_grad",
+                                        "clip_gradient", "beta1", "beta2",
+                                        "epsilon") if k in opt_params}
+
+    def init_state(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, state):
+        octx = ops.OpCtx()
+        new_w, m, v = ops.get("adam_update").fn(octx, w, g, state[0], state[1], **attrs)
+        return new_w, (m, v)
+
+    return init_state, update
+
+
+_RULES = {"sgd": _sgd_rule, "adam": _adam_rule}
+
+
+class FusedTrainer:
+    """One-jit-call-per-step trainer over a Symbol.
+
+    data parallel: pass a mesh (or n_devices) — inputs shard over 'data',
+    params replicate, XLA all-reduces gradients.
+    """
+
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 optimizer="sgd", optimizer_params=None, mesh: Optional[Mesh] = None,
+                 initializer=None, dtype=jnp.float32, sharding_rules=()):
+        self.symbol = symbol
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.mesh = mesh
+        self.dtype = jnp.dtype(dtype)
+        opt_params = dict(optimizer_params or {})
+        opt_params.setdefault("lr", opt_params.pop("learning_rate", 0.01))
+        if optimizer not in _RULES:
+            raise ValueError(f"FusedTrainer supports {sorted(_RULES)}; "
+                             f"use Module for {optimizer}")
+        self._init_state, self._update = _RULES[optimizer](opt_params)
+        self._sharding_rules = tuple(sharding_rules)
+        self._initializer = initializer or Uniform(0.01)
+        self._graph_fn = _build_graph_fn(symbol)
+        self.params: Dict[str, jax.Array] = {}
+        self.aux: Dict[str, jax.Array] = {}
+        self.opt_state: Dict[str, tuple] = {}
+        self._step_fn = None
+        self._step = 0
+
+    # ------------------------------------------------------------------ setup
+    def init(self, **input_shapes):
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        arg_names = self.symbol.list_arguments()
+        aux_names = self.symbol.list_auxiliary_states()
+        inputs = set(self.data_names + self.label_names)
+        repl = (NamedSharding(self.mesh, P()) if self.mesh is not None else None)
+        from .parallel.mesh import shard_params
+
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in inputs:
+                continue
+            arr = NDArray(jnp.zeros(shape, dtype=jnp.float32))
+            self._initializer(name, arr)
+            self.params[name] = arr._read()
+        if self.mesh is not None:
+            # tensor-parallel rules shard matching params; rest replicate
+            self.params = shard_params(self.mesh, self.params, self._sharding_rules)
+        for name, raw in self.params.items():
+            self.opt_state[name] = tuple(
+                jax.device_put(s, raw.sharding) if self.mesh is not None else s
+                for s in self._init_state(raw)
+            )
+        for name, shape in zip(aux_names, aux_shapes):
+            arr = NDArray(jnp.zeros(shape, dtype=jnp.float32))
+            self._initializer(name, arr)
+            raw = arr._read()
+            if repl is not None:
+                raw = jax.device_put(raw, repl)
+            self.aux[name] = raw
+        self._build_step()
+        return self
+
+    def _build_step(self):
+        graph_fn = self._graph_fn
+        update = self._update
+        dtype = self.dtype
+        data_names = self.data_names
+        label_names = self.label_names
+
+        def train_step(params, aux, opt_state, batch, key):
+            compute_params = {
+                k: v.astype(dtype) if v.dtype == jnp.float32 else v
+                for k, v in params.items()
+            }
+            compute_aux = {k: v.astype(dtype) for k, v in aux.items()}
+            args = dict(compute_params)
+            for k in data_names:
+                args[k] = batch[k].astype(dtype)
+            for k in label_names:
+                args[k] = batch[k]
+
+            def fwd(p):
+                a = dict(args)
+                a.update(p)
+                outs, new_aux = graph_fn(a, compute_aux, key, True)
+                # master aux stays fp32
+                new_aux = {k: v.astype(jnp.float32) for k, v in new_aux.items()}
+                return outs, new_aux
+
+            (outs, new_aux), vjp_fn = jax.vjp(fwd, compute_params)
+            head = [jnp.ones(o.shape, o.dtype) for o in outs]
+            aux_cot = jax.tree_util.tree_map(jnp.zeros_like, new_aux)
+            (grads,) = vjp_fn((head, aux_cot))
+
+            new_params = {}
+            new_opt = {}
+            for k, w in params.items():
+                g = grads[k].astype(jnp.float32)
+                nw, ns = update(w, g, opt_state[k])
+                new_params[k] = nw
+                new_opt[k] = ns
+            return new_params, new_aux, new_opt, outs
+
+        self._step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+        def eval_step(params, aux, batch, key):
+            compute_params = {
+                k: v.astype(dtype) if v.dtype == jnp.float32 else v
+                for k, v in params.items()
+            }
+            compute_aux = {k: v.astype(dtype) for k, v in aux.items()}
+            args = dict(compute_params)
+            for k in data_names:
+                args[k] = batch[k].astype(dtype)
+            for k in label_names:
+                if k in batch:
+                    args[k] = batch[k]
+                else:
+                    args[k] = jnp.zeros((batch[data_names[0]].shape[0],), jnp.float32)
+            outs, _ = graph_fn(args, compute_aux, key, False)
+            return outs
+
+        self._eval_fn = jax.jit(eval_step)
+
+    # ---------------------------------------------------------------- running
+    def _shard_batch(self, batch):
+        out = {}
+        for k, v in batch.items():
+            raw = v._read() if isinstance(v, NDArray) else jnp.asarray(np.asarray(v))
+            if self.mesh is not None:
+                out[k] = jax.device_put(
+                    raw, NamedSharding(self.mesh, P("data", *([None] * (raw.ndim - 1)))))
+            else:
+                out[k] = raw
+        return out
+
+    def step(self, **batch):
+        """Run one fused train step; returns outputs (list of jax arrays)."""
+        self._step += 1
+        key = jax.random.fold_in(_random.current_key(), self._step)
+        self.params, self.aux, self.opt_state, outs = self._step_fn(
+            self.params, self.aux, self.opt_state, self._shard_batch(batch), key)
+        return outs
+
+    def eval(self, **batch):
+        key = jax.random.fold_in(_random.current_key(), 0)
+        return self._eval_fn(self.params, self.aux, self._shard_batch(batch), key)
+
+    def get_params(self):
+        return ({k: NDArray(v) for k, v in self.params.items()},
+                {k: NDArray(v) for k, v in self.aux.items()})

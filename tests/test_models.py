@@ -1,0 +1,91 @@
+"""Model zoo structure tests (parity model: the symbols under
+example/image-classification/symbols/ are exercised by benchmark_score.py
+and train_*.py in the reference)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+@pytest.mark.parametrize(
+    "name,shape,classes",
+    [
+        ("mlp", (2, 1, 28, 28), 10),
+        ("lenet", (2, 1, 28, 28), 10),
+        ("alexnet", (2, 3, 224, 224), 1000),
+        ("vgg", (2, 3, 224, 224), 1000),
+        ("inception-bn", (2, 3, 224, 224), 1000),
+        ("inception-v3", (2, 3, 299, 299), 1000),
+        ("resnet-50", (2, 3, 224, 224), 1000),
+        ("resnet-18", (2, 3, 32, 32), 10),
+        ("resnext-50", (2, 3, 224, 224), 1000),
+    ],
+)
+def test_model_shapes(name, shape, classes):
+    s = models.get_symbol(name, num_classes=classes, image_shape=shape[1:])
+    args, outs, auxs = s.infer_shape(data=shape)
+    assert outs == [(shape[0], classes)]
+    assert args is not None
+
+
+def test_lenet_forward_runs():
+    s = models.get_symbol("lenet", num_classes=10)
+    ex = s.simple_bind(mx.cpu(), grad_req="null", data=(2, 1, 28, 28))
+    out = ex.forward(is_train=False)[0]
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(2), rtol=1e-4)
+
+
+def test_lstm_unroll_shapes():
+    from mxnet_tpu.models.lstm import lstm_unroll
+
+    seq_len, batch, vocab, hidden, embed = 8, 4, 50, 16, 12
+    net = lstm_unroll(2, seq_len, vocab, hidden, embed, vocab)
+    shapes = {
+        "data": (batch, seq_len),
+        "softmax_label": (batch, seq_len),
+    }
+    for i in range(2):
+        shapes[f"l{i}_init_c"] = (batch, hidden)
+        shapes[f"l{i}_init_h"] = (batch, hidden)
+    args, outs, _ = net.infer_shape(**shapes)
+    assert outs == [(batch * seq_len, vocab)]
+
+
+def test_fused_trainer_converges():
+    from mxnet_tpu.test_utils import get_synthetic_mnist
+    from mxnet_tpu.trainer import FusedTrainer
+
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(512, 128)
+    net = models.get_symbol("mlp", num_classes=10)
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.5, "rescale_grad": 1.0 / 64},
+                      initializer=mx.init.Xavier())
+    tr.init(data=(64, 1, 28, 28))
+    for epoch in range(4):
+        for i in range(0, 512, 64):
+            tr.step(data=xtr[i : i + 64], softmax_label=ytr[i : i + 64])
+    outs = tr.eval(data=xte[:64])
+    acc = (np.asarray(outs[0]).argmax(axis=1) == yte[:64]).mean()
+    assert acc > 0.9
+
+
+def test_fused_trainer_dp_mesh():
+    import jax
+
+    from mxnet_tpu.parallel.mesh import create_mesh
+    from mxnet_tpu.test_utils import get_synthetic_mnist
+    from mxnet_tpu.trainer import FusedTrainer
+
+    (xtr, ytr), _ = get_synthetic_mnist(128, 8)
+    mesh = create_mesh((4,), ("data",), devices=jax.devices("cpu")[:4])
+    net = models.get_symbol("mlp", num_classes=10)
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.1, "rescale_grad": 1.0 / 32},
+                      mesh=mesh)
+    tr.init(data=(32, 1, 28, 28))
+    outs = tr.step(data=xtr[:32], softmax_label=ytr[:32])
+    assert outs[0].shape == (32, 10)
+    # params remain replicated after the step
+    p = next(iter(tr.params.values()))
+    assert p.sharding.is_fully_replicated
