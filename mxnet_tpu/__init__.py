@@ -46,6 +46,7 @@ from . import module as mod
 from .module import Module, BucketingModule, SequentialModule, PythonModule
 from . import monitor
 from .monitor import Monitor
+from . import rnn
 from . import test_utils
 
 __all__ = [

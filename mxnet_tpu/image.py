@@ -1,0 +1,441 @@
+"""Image IO + augmentation pipeline.
+
+Parity: python/mxnet/image.py (ImageIter, CreateAugmenter) and the C++
+ImageRecordIter stack (src/io/iter_image_recordio.cc:150-487 +
+image_aug_default.cc).  The staged design is preserved (SURVEY.md §3.5):
+
+  RecordIO shard read (part_index/num_parts)     [reader]
+    -> parallel JPEG decode + augment             [thread pool,
+       (crop/mirror/resize/HSL)                    preprocess_threads]
+    -> batch assembly (NCHW float32)              [batcher]
+    -> prefetch                                   [PrefetchingIter]
+
+Codec: Pillow (the image lives as HWC RGB uint8 between stages, like the
+reference's cv::Mat).  No OpenCV in this stack.
+"""
+from __future__ import annotations
+
+import io as _io
+import logging
+import os
+import queue
+import random as pyrandom
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError, get_env
+from .io import DataBatch, DataDesc, DataIter
+from .recordio import MXIndexedRecordIO, MXRecordIO, unpack
+
+_RAW_MAGIC = b"MXTPURAW"
+
+
+def imencode(img, quality=95, img_fmt=".jpg"):
+    """Encode HWC uint8 RGB -> bytes (parity: cv::imencode use in
+    tools/im2rec.cc)."""
+    img = np.asarray(img)
+    try:
+        from PIL import Image
+
+        buf = _io.BytesIO()
+        fmt = "JPEG" if "jpg" in img_fmt or "jpeg" in img_fmt else "PNG"
+        Image.fromarray(img.astype(np.uint8)).save(buf, format=fmt, quality=quality)
+        return buf.getvalue()
+    except ImportError:
+        # raw fallback: magic + shape + bytes
+        h, w, c = img.shape
+        return _RAW_MAGIC + np.array([h, w, c], np.int32).tobytes() + \
+            img.astype(np.uint8).tobytes()
+
+
+def imdecode_np(buf: bytes) -> np.ndarray:
+    """Decode bytes -> HWC uint8 RGB numpy (parity: cv::imdecode)."""
+    if buf[:8] == _RAW_MAGIC:
+        h, w, c = np.frombuffer(buf[8:20], np.int32)
+        return np.frombuffer(buf[20:], np.uint8).reshape(h, w, c).copy()
+    from PIL import Image
+
+    img = Image.open(_io.BytesIO(buf))
+    return np.asarray(img.convert("RGB"))
+
+
+def imdecode(buf, channels=3, **kwargs):
+    """Parity: mx.image.imdecode (src/io/image_io.cc _imdecode op) —
+    returns an NDArray (H, W, C)."""
+    return nd.array(imdecode_np(bytes(buf)).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# augmenters (parity: image.py CreateAugmenter :233 + image_aug_default.cc)
+# ---------------------------------------------------------------------------
+def _resize_shorter(img, size):
+    from PIL import Image
+
+    h, w = img.shape[:2]
+    if h < w:
+        new_h, new_w = size, int(w * size / h)
+    else:
+        new_h, new_w = int(h * size / w), size
+    return np.asarray(Image.fromarray(img).resize((new_w, new_h), Image.BILINEAR))
+
+
+def _fixed_crop(img, x0, y0, w, h):
+    return img[y0 : y0 + h, x0 : x0 + w]
+
+
+def _center_crop(img, size):
+    h, w = img.shape[:2]
+    x0 = (w - size[0]) // 2
+    y0 = (h - size[1]) // 2
+    return _fixed_crop(img, x0, y0, size[0], size[1])
+
+
+def _rand_crop(img, size):
+    h, w = img.shape[:2]
+    x0 = pyrandom.randint(0, max(w - size[0], 0))
+    y0 = pyrandom.randint(0, max(h - size[1], 0))
+    return _fixed_crop(img, x0, y0, size[0], size[1])
+
+
+class Augmenter:
+    def __call__(self, img):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return _resize_shorter(img, self.size)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size):
+        self.size = size  # (w, h)
+
+    def __call__(self, img):
+        from PIL import Image
+
+        return np.asarray(Image.fromarray(img).resize(self.size, Image.BILINEAR))
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return _rand_crop(img, self.size)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return _center_crop(img, self.size)
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, img):
+        if pyrandom.random() < self.p:
+            return img[:, ::-1]
+        return img
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        self.brightness = brightness
+
+    def __call__(self, img):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return np.clip(img.astype(np.float32) * alpha, 0, 255).astype(np.uint8)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        self.contrast = contrast
+
+    def __call__(self, img):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray = img.astype(np.float32).mean()
+        return np.clip((img.astype(np.float32) - gray) * alpha + gray, 0, 255).astype(np.uint8)
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        self.saturation = saturation
+
+    def __call__(self, img):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray = img.astype(np.float32).mean(axis=2, keepdims=True)
+        return np.clip(img.astype(np.float32) * alpha + gray * (1 - alpha), 0, 255).astype(np.uint8)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (parity: image_aug_default.cc random_illumination)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, img):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = self.eigvec @ (alpha * self.eigval)
+        return np.clip(img.astype(np.float32) + rgb, 0, 255).astype(np.uint8)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Parity: image.py CreateAugmenter (:233)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size))
+    else:
+        auglist.append(CenterCropAug(crop_size))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    if brightness > 0:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        auglist.append(SaturationJitterAug(saturation))
+    if pca_noise > 0:
+        eigval = [55.46, 4.794, 1.148]
+        eigvec = [[-0.5675, 0.7192, 0.4009],
+                  [-0.5808, -0.0045, -0.8140],
+                  [-0.5836, -0.6948, 0.4203]]
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# iterators
+# ---------------------------------------------------------------------------
+class ImageIter(DataIter):
+    """Python image iterator over .rec or .lst+images (parity: image.py
+    ImageIter :277)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 mean=None, std=None, **kwargs):
+        super().__init__()
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = None
+
+        self.imglist = None
+        if path_imglist:
+            self.imglist = {}
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = np.array(parts[1:-1], dtype=np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+        elif isinstance(imglist, list):
+            self.imglist = {}
+            for i, item in enumerate(imglist):
+                self.imglist[i] = (np.array(item[0], dtype=np.float32)
+                                   if not np.isscalar(item[0])
+                                   else np.array([item[0]], dtype=np.float32), item[1])
+
+        self.path_root = path_root
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.mean = np.asarray(mean, np.float32) if mean is not None else None
+        self.std = np.asarray(std, np.float32) if std is not None else None
+        if aug_list is None:
+            aug_list = CreateAugmenter(data_shape)
+        self.auglist = aug_list
+        self.cur = 0
+        if self.imglist is not None:
+            self.seq = list(self.imglist.keys())
+        elif self.imgidx is not None:
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+        if num_parts > 1 and self.seq is not None:
+            self.seq = self.seq[part_index::num_parts]
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root or "", fname), "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = unpack(s)
+        return header.label, img
+
+    def _process(self, raw):
+        img = imdecode_np(raw)
+        for aug in self.auglist:
+            img = aug(img)
+        img = img.astype(np.float32)
+        if self.mean is not None:
+            img = img - self.mean
+        if self.std is not None:
+            img = img / self.std
+        return img.transpose(2, 0, 1)  # HWC -> CHW
+
+    def next(self):
+        batch_data = np.zeros((self.batch_size,) + self.data_shape, np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width), np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, raw = self.next_sample()
+                batch_data[i] = self._process(raw)
+                lab = np.atleast_1d(np.asarray(label, np.float32))
+                batch_label[i, : self.label_width] = lab[: self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        label_out = batch_label[:, 0] if self.label_width == 1 else batch_label
+        return DataBatch([nd.array(batch_data)], [nd.array(label_out)], pad=pad)
+
+
+class ImageRecordIter(DataIter):
+    """Threaded RecordIO image pipeline (parity: ImageRecordIter,
+    src/io/iter_image_recordio.cc:459 registration).
+
+    Stages mirror the reference: sharded record read -> thread-pool decode +
+    augment (preprocess_threads, cf. OpenMP block :259-368) -> batch.
+    Wrap with io.PrefetchingIter for the PrefetcherIter stage.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, part_index=0, num_parts=1,
+                 preprocess_threads=None, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, scale=1.0, resize=0,
+                 path_imgidx=None, round_batch=True, seed=0, **kwargs):
+        super().__init__()
+        self.batch_size = batch_size
+        self.data_shape = tuple(int(x) for x in data_shape)
+        self.label_width = label_width
+        self.scale = scale
+        mean = None
+        if mean_r or mean_g or mean_b:
+            mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.mean = mean
+        nthread = preprocess_threads or get_env("MXNET_CPU_WORKER_NTHREADS", 4)
+        self.pool = ThreadPoolExecutor(max_workers=nthread)
+        self.aug = CreateAugmenter((self.data_shape if len(self.data_shape) == 3
+                                    else (3,) + self.data_shape),
+                                   resize=resize, rand_crop=rand_crop,
+                                   rand_mirror=rand_mirror)
+        # load record offsets for sharding + shuffling
+        self.records = []
+        reader = MXRecordIO(path_imgrec, "r")
+        while True:
+            s = reader.read()
+            if s is None:
+                break
+            self.records.append(s)
+        reader.close()
+        if num_parts > 1:
+            self.records = self.records[part_index::num_parts]
+        self.shuffle = shuffle
+        self.seed = seed
+        self.order = list(range(len(self.records)))
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self.shuffle:
+            rs = np.random.RandomState(self.seed)
+            rs.shuffle(self.order)
+            self.seed += 1
+        self.cur = 0
+
+    def _decode_one(self, rec):
+        header, payload = unpack(rec)
+        img = imdecode_np(payload)
+        for aug in self.aug:
+            img = aug(img)
+        img = img.astype(np.float32)
+        if self.mean is not None:
+            img = img - self.mean
+        if self.scale != 1.0:
+            img = img * self.scale
+        label = header.label
+        lab = np.atleast_1d(np.asarray(label, np.float32))
+        return img.transpose(2, 0, 1), lab
+
+    def next(self):
+        if self.cur >= len(self.order):
+            raise StopIteration
+        idxs = self.order[self.cur : self.cur + self.batch_size]
+        pad = self.batch_size - len(idxs)
+        if pad:
+            idxs = idxs + self.order[:pad]  # wrap-around padding
+        self.cur += self.batch_size
+        results = list(self.pool.map(self._decode_one,
+                                     [self.records[i] for i in idxs]))
+        data = np.stack([r[0] for r in results])
+        labels = np.stack([r[1] for r in results])
+        label_out = labels[:, 0] if self.label_width == 1 else labels[:, : self.label_width]
+        return DataBatch([nd.array(data)], [nd.array(label_out)], pad=pad)

@@ -27,3 +27,4 @@ from . import nn  # noqa: F401
 from . import loss  # noqa: F401
 from . import sequence  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import rnn_op  # noqa: F401

@@ -71,6 +71,8 @@ class OpDef:
     infer_params: Optional[Callable] = None
     # which positional args may be omitted (e.g. bias under no_bias)
     optional_args: Callable = None  # optional_args(attrs) -> set of dropped names
+    # attr-dependent output count: num_outputs_fn(attrs) -> int
+    num_outputs_fn: Callable = None
     attr_defaults: dict = field(default_factory=dict)
     doc: str = ""
 
@@ -95,6 +97,7 @@ def register(
     infer_params=None,
     optional_args=None,
     attr_defaults=None,
+    num_outputs_fn=None,
     aliases=(),
 ):
     """Decorator registering an op implementation under ``name``.
@@ -117,6 +120,7 @@ def register(
             infer_params=infer_params,
             optional_args=optional_args,
             attr_defaults=dict(attr_defaults or {}),
+            num_outputs_fn=num_outputs_fn,
             doc=fn.__doc__ or "",
         )
         _OPS[name] = op

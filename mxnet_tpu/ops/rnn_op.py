@@ -1,0 +1,217 @@
+"""Fused RNN op — lax.scan over time, the TPU analogue of cuDNN fused RNN.
+
+Parity: src/operator/rnn-inl.h (+ cudnn_rnn-inl.h) (reference).  Inputs
+follow the reference: data (T, N, input_size) time-major, a single packed
+``parameters`` 1-D vector (rnn_single_param_size / rnn_param_size,
+rnn-inl.h:33-66), state (layers*dirs, N, H) and state_cell for LSTM.
+Outputs: output (T, N, H*dirs) [+ final state(s) when state_outputs].
+
+Packing order (per layer, per direction): W_ih (G*H x in), W_hh (G*H x H),
+then all biases b_ih (G*H), b_hh (G*H) after all weights — cuDNN's layout,
+which the reference adopts.  Gate order matches the unfused cells
+(python/mxnet/rnn/rnn_cell.py:264-277): i, g(transform), f, o for LSTM;
+r, z, n for GRU.
+
+TPU-native notes: the scan body is a fused (N,G*H) matmul per step on the
+MXU; XLA unrolls nothing — compile time is O(1) in sequence length, unlike
+the reference's symbolic unrolling.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, parse_attr, parse_bool
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Total packed parameter count (parity: rnn_param_size, rnn-inl.h:57)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            size += gates * state_size * (in_size + state_size)  # W_ih, W_hh
+            size += 2 * gates * state_size  # b_ih, b_hh
+    return size
+
+
+def _unpack_params(params, num_layers, input_size, state_size, bidirectional, mode):
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    offset = 0
+    weights = []
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        for d in range(dirs):
+            w_ih = params[offset : offset + gates * state_size * in_size].reshape(
+                gates * state_size, in_size)
+            offset += gates * state_size * in_size
+            w_hh = params[offset : offset + gates * state_size * state_size].reshape(
+                gates * state_size, state_size)
+            offset += gates * state_size * state_size
+            weights.append((w_ih, w_hh))
+    biases = []
+    for layer in range(num_layers):
+        for d in range(dirs):
+            b_ih = params[offset : offset + gates * state_size]
+            offset += gates * state_size
+            b_hh = params[offset : offset + gates * state_size]
+            offset += gates * state_size
+            biases.append((b_ih, b_hh))
+    return weights, biases
+
+
+def _cell_step(mode, state_size):
+    """Single-timestep transition: (carry, gates_preact) -> (new_h, new_c)."""
+
+    def lstm(c, h, pre):
+        i, g, f, o = jnp.split(pre, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        g = jnp.tanh(g)
+        f = jax.nn.sigmoid(f)
+        o = jax.nn.sigmoid(o)
+        new_c = f * c + i * g
+        new_h = o * jnp.tanh(new_c)
+        return new_h, new_c
+
+    def gru(h, pre_x, pre_h):
+        rx, zx, nx = jnp.split(pre_x, 3, axis=-1)
+        rh, zh, nh = jnp.split(pre_h, 3, axis=-1)
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        n = jnp.tanh(nx + r * nh)
+        return (1 - z) * n + z * h
+
+    return lstm if mode == "lstm" else gru
+
+
+def _run_layer(x, w_ih, w_hh, b_ih, b_hh, h0, c0, mode, reverse=False):
+    """Scan one direction of one layer over time (x: (T, N, in))."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    # hoist the input projection out of the scan: one big (T*N, G*H) matmul
+    pre_x = jnp.einsum("tni,gi->tng", x, w_ih) + b_ih
+
+    if mode == "lstm":
+        step = _cell_step("lstm", None)
+
+        def body(carry, px):
+            h, c = carry
+            pre = px + jnp.dot(h, w_hh.T) + b_hh
+            new_h, new_c = step(c, h, pre)
+            return (new_h, new_c), new_h
+
+        (hT, cT), ys = jax.lax.scan(body, (h0, c0), pre_x)
+    elif mode == "gru":
+        step = _cell_step("gru", None)
+
+        def body(h, px):
+            pre_h = jnp.dot(h, w_hh.T) + b_hh
+            new_h = step(h, px, pre_h)
+            return new_h, new_h
+
+        hT, ys = jax.lax.scan(body, h0, pre_x)
+        cT = None
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def body(h, px):
+            new_h = act(px + jnp.dot(h, w_hh.T) + b_hh)
+            return new_h, new_h
+
+        hT, ys = jax.lax.scan(body, h0, pre_x)
+        cT = None
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+def _rnn_params_hook(attrs, data_shape, *rest):
+    mode = attrs.get("mode", "lstm")
+    state_size = int(parse_attr(attrs["state_size"]))
+    num_layers = int(parse_attr(attrs["num_layers"]))
+    bidirectional = parse_bool(attrs.get("bidirectional", False))
+    dirs = 2 if bidirectional else 1
+    n = data_shape[1]
+    shapes = {
+        "parameters": (rnn_param_size(num_layers, data_shape[2], state_size,
+                                      bidirectional, mode),),
+        "state": (num_layers * dirs, n, state_size),
+    }
+    if mode == "lstm":
+        shapes["state_cell"] = (num_layers * dirs, n, state_size)
+    return shapes
+
+
+def _rnn_optional(attrs):
+    if attrs.get("mode", "lstm") != "lstm":
+        return {"state_cell"}
+    return set()
+
+
+def _rnn_num_outputs(attrs):
+    if not parse_bool(attrs.get("state_outputs", False)):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+@register(
+    "RNN",
+    arg_names=("data", "parameters", "state", "state_cell"),
+    param_names=("parameters",),
+    output_names=("output", "state", "state_cell"),
+    infer_params=_rnn_params_hook,
+    optional_args=_rnn_optional,
+    num_outputs_fn=_rnn_num_outputs,
+    needs_rng=True,
+)
+def _rnn(ctx, data, parameters, state, state_cell=None, **attrs):
+    """Parity: RNN op (src/operator/rnn-inl.h registration 'RNN')."""
+    mode = attrs.get("mode", "lstm")
+    if mode not in _GATES:
+        raise MXNetError(f"RNN: unknown mode {mode}")
+    state_size = int(parse_attr(attrs["state_size"]))
+    num_layers = int(parse_attr(attrs["num_layers"]))
+    bidirectional = parse_bool(attrs.get("bidirectional", False))
+    p_dropout = float(parse_attr(attrs.get("p", 0.0)))
+    state_outputs = parse_bool(attrs.get("state_outputs", False))
+    dirs = 2 if bidirectional else 1
+    t, n, input_size = data.shape
+
+    weights, biases = _unpack_params(parameters, num_layers, input_size,
+                                     state_size, bidirectional, mode)
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            w_ih, w_hh = weights[idx]
+            b_ih, b_hh = biases[idx]
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else None
+            ys, hT, cT = _run_layer(x, w_ih, w_hh, b_ih, b_hh, h0, c0, mode,
+                                    reverse=(d == 1))
+            outs.append(ys)
+            h_finals.append(hT)
+            if mode == "lstm":
+                c_finals.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p_dropout > 0.0 and ctx.is_train and layer < num_layers - 1:
+            keep = 1.0 - p_dropout
+            mask = jax.random.bernoulli(ctx.rng(), keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    if not state_outputs:
+        return x
+    h_out = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        c_out = jnp.stack(c_finals, axis=0)
+        return (x, h_out, c_out)
+    return (x, h_out)

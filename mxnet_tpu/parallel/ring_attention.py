@@ -1,0 +1,137 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO sequence parallelism — long sequences are handled by
+bucketing + gradient mirroring (SURVEY.md §5.7).  On TPU, SP is first-class
+(SURVEY.md §2.4 'Sequence/context parallelism' row): sequences shard over
+the mesh's 'seq' axis and attention runs either as
+
+- ring_attention: K/V blocks rotate around the ring via lax.ppermute while
+  each device streams an online-softmax accumulation (blockwise attention;
+  the ppermute rides ICI neighbor links, compute overlaps communication
+  when XLA schedules the collective-permute asynchronously), or
+- ulysses_attention: all-to-all re-shards (seq -> heads), each device runs
+  full-sequence attention for its head slice, then all-to-all back.
+
+Both are exact (not approximations) and differentiable (pure jnp/lax, so
+jax.vjp handles the backward — the backward ppermutes run in the reverse
+ring direction automatically).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def _stream_block(q, k, v, m, l, o, scale, mask=None):
+    """One online-softmax accumulation step (blockwise attention inner op).
+
+    q: (B, H, Tq, D), k/v: (B, H, Tk, D); m/l: (B, H, Tq); o accumulator.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows (max = -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    p = jnp.exp(s - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
+                   causal: bool = False, scale: float = None):
+    """Exact attention over sequence-sharded q/k/v.
+
+    q, k, v: (B, H, T_global, D) arrays sharded over T on `axis_name`.
+    Returns output with the same sharding.
+    """
+    b, h, t, d = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    n = mesh.shape[axis_name]
+    t_local = t // n
+    spec = P(None, None, axis_name, None)
+
+    def local_fn(q, k, v):
+        # q/k/v here are the local shards (B, H, T/n, D)
+        idx = jax.lax.axis_index(axis_name)
+        m0 = jnp.full((b, h, t_local), -jnp.inf, q.dtype)
+        l0 = jnp.zeros((b, h, t_local), q.dtype)
+        o0 = jnp.zeros_like(q)
+
+        q_pos = idx * t_local + jnp.arange(t_local)
+
+        def body(step, carry):
+            m, l, o, k_cur, v_cur = carry
+            src_idx = (idx - step) % n  # whose K/V block we hold this step
+            if causal:
+                k_pos = src_idx * t_local + jnp.arange(t_local)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                mask = jnp.broadcast_to(mask, (b, h, t_local, t_local))
+            else:
+                mask = None
+            m, l, o = _stream_block(q, k_cur, v_cur, m, l, o, scale, mask)
+            perm = [(i, (i + 1) % n) for i in range(n)]  # pass K/V to next rank
+            k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+            return (m, l, o, k_next, v_next)
+
+        m, l, o, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
+        return o / jnp.maximum(l, 1e-20)[..., None]
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
+                      causal: bool = False, scale: float = None):
+    """DeepSpeed-Ulysses-style SP: all-to-all (seq->heads), full local
+    attention, all-to-all back.  Requires H % mesh.shape[axis] == 0."""
+    b, h, t, d = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    n = mesh.shape[axis_name]
+    if h % n != 0:
+        raise ValueError(f"heads {h} not divisible by seq-par degree {n}")
+    spec = P(None, None, axis_name, None)
+
+    def local_fn(q, k, v):
+        # local: (B, H, T/n, D) -> a2a -> (B, H/n, T, D)
+        def a2a(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        ql, kl, vl = a2a(q), a2a(k), a2a(v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", ql, kl) * scale
+        if causal:
+            tq = s.shape[-2]
+            mask = jnp.tril(jnp.ones((tq, tq), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        ol = jnp.einsum("bhqk,bhkd->bhqd", p, vl)
+        # back: (B, H/n, T, D) -> (B, H, T/n, D)
+        return jax.lax.all_to_all(ol, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
+def full_attention(q, k, v, causal=False, scale=None):
+    """Single-device reference attention (the oracle for SP tests)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t = s.shape[-1]
+        mask = jnp.tril(jnp.ones((s.shape[-2], t), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

@@ -1,0 +1,5 @@
+"""RNN toolkit (parity: python/mxnet/rnn/)."""
+from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
+                       FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
+                       RNNCell, RNNParams, SequentialRNNCell, ZoneoutCell)
+from .io import BucketSentenceIter

@@ -50,6 +50,8 @@ class _Node:
         if self.op is None:
             return 1
         od = ops.get(self.op)
+        if od.num_outputs_fn is not None:
+            return od.num_outputs_fn(self.attrs)
         if od.num_outputs == -1:  # attr-dependent (SliceChannel)
             return int(self.attrs.get("num_outputs", 1))
         return od.num_outputs
@@ -100,7 +102,10 @@ class Symbol:
                 names.append(node.name)
                 continue
             od = ops.get(node.op)
-            if od.num_outputs == -1:  # attr-dependent (SliceChannel)
+            if od.num_outputs_fn is not None:
+                names.append(f"{node.name}_{od.output_names[idx]}"
+                             if idx < len(od.output_names) else f"{node.name}_output{idx}")
+            elif od.num_outputs == -1:  # attr-dependent (SliceChannel)
                 names.append(f"{node.name}_output{idx}")
             elif od.num_outputs == 1:
                 names.append(f"{node.name}_output")

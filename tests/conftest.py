@@ -5,10 +5,19 @@ CPU-only machines (tests/python/unittest/test_multi_device_exec.py uses
 mx.cpu(0..3)); here XLA's host-platform device-count flag provides 8
 virtual devices so mesh/sharding/collective paths are exercised without
 TPU hardware (SURVEY.md §4.3).
+
+Note: the TPU plugin in this image registers itself from sitecustomize and
+ignores the JAX_PLATFORMS env var, and its presence breaks shard_map
+collectives on virtual CPU devices — so we force the cpu platform via
+jax.config *before any backend initializes*.
 """
 import os
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,136 @@
+"""RecordIO + image pipeline tests (parity model:
+tests/python/unittest/test_recordio.py + test_io.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image import (
+    CenterCropAug,
+    HorizontalFlipAug,
+    ImageIter,
+    ImageRecordIter,
+    RandomCropAug,
+    imdecode_np,
+    imencode,
+)
+from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, MXRecordIO, pack, unpack
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = MXRecordIO(path, "w")
+    for i in range(10):
+        w.write(f"record_{i}".encode())
+    w.close()
+    r = MXRecordIO(path, "r")
+    for i in range(10):
+        assert r.read() == f"record_{i}".encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = MXIndexedRecordIO(idx, rec, "w")
+    for i in range(20):
+        w.write_idx(i, f"rec{i}".encode())
+    w.close()
+    r = MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(13) == b"rec13"
+    assert r.read_idx(3) == b"rec3"
+    assert sorted(r.keys) == list(range(20))
+    r.close()
+
+
+def test_pack_unpack_scalar_label():
+    header = IRHeader(0, 3.0, 7, 0)
+    s = pack(header, b"payload")
+    h2, payload = unpack(s)
+    assert h2.label == 3.0
+    assert h2.id == 7
+    assert payload == b"payload"
+
+
+def test_pack_unpack_vector_label():
+    header = IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 9, 0)
+    s = pack(header, b"xyz")
+    h2, payload = unpack(s)
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert payload == b"xyz"
+
+
+def test_imencode_imdecode_roundtrip():
+    img = (np.random.RandomState(0).rand(24, 32, 3) * 255).astype(np.uint8)
+    buf = imencode(img, img_fmt=".png")
+    back = imdecode_np(buf)
+    np.testing.assert_array_equal(back, img)
+
+
+def test_augmenters():
+    img = (np.random.RandomState(1).rand(40, 50, 3) * 255).astype(np.uint8)
+    assert CenterCropAug((32, 24))(img).shape == (24, 32, 3)
+    assert RandomCropAug((32, 24))(img).shape == (24, 32, 3)
+    flipped = HorizontalFlipAug(1.1)(img)  # p>1 => always flips
+    np.testing.assert_array_equal(flipped, img[:, ::-1])
+
+
+def _write_image_rec(tmp_path, n=16, size=(20, 20)):
+    rec = str(tmp_path / "imgs.rec")
+    w = MXRecordIO(rec, "w")
+    rs = np.random.RandomState(2)
+    for i in range(n):
+        img = (rs.rand(size[0], size[1], 3) * 255).astype(np.uint8)
+        w.write(recordio.pack(IRHeader(0, float(i % 4), i, 0),
+                              imencode(img, img_fmt=".png")))
+    w.close()
+    return rec
+
+
+def test_image_record_iter(tmp_path):
+    rec = _write_image_rec(tmp_path)
+    it = ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16), batch_size=4,
+                         rand_crop=True, rand_mirror=True)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 16, 16)
+        assert batch.label[0].shape == (4,)
+        n += 1
+    assert n == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_image_record_iter_sharded(tmp_path):
+    # parity: part_index/num_parts distributed sharding (InputSplit)
+    rec = _write_image_rec(tmp_path)
+    it0 = ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16), batch_size=4,
+                          part_index=0, num_parts=2)
+    it1 = ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16), batch_size=4,
+                          part_index=1, num_parts=2)
+    assert len(list(it0)) == 2 and len(list(it1)) == 2
+
+
+def test_image_iter_imglist(tmp_path):
+    from PIL import Image
+
+    rs = np.random.RandomState(3)
+    files = []
+    for i in range(8):
+        img = (rs.rand(24, 24, 3) * 255).astype(np.uint8)
+        fname = str(tmp_path / f"img{i}.png")
+        Image.fromarray(img).save(fname)
+        files.append((float(i % 2), f"img{i}.png"))
+    it = ImageIter(batch_size=4, data_shape=(3, 20, 20), imglist=files,
+                   path_root=str(tmp_path))
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 20, 20)
+
+
+def test_prefetch_over_record_iter(tmp_path):
+    rec = _write_image_rec(tmp_path)
+    base = ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16), batch_size=4)
+    pre = mx.io.PrefetchingIter(base)
+    assert len(list(pre)) == 4
