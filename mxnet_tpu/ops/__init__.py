@@ -28,3 +28,5 @@ from . import loss  # noqa: F401
 from . import sequence  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn_op  # noqa: F401
+from . import vision  # noqa: F401
+from . import ctc  # noqa: F401

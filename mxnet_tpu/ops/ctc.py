@@ -1,0 +1,142 @@
+"""CTC loss (parity: plugin/warpctc — WarpCTC op).
+
+The reference delegates to Baidu's warp-ctc CUDA kernels; here the CTC
+forward-backward recursion is a log-space ``lax.scan`` over time — the
+TPU-idiomatic formulation (static shapes, vectorized over the batch and the
+extended label axis; no per-sequence host loops).
+
+Semantics match the plugin: blank label = 0
+(plugin/warpctc/warpctc-inl.h), forward output is softmax over the
+alphabet, backward emits d(sum CTC loss)/d(activations) ignoring the head
+gradient (loss-layer contract, like SoftmaxOutput).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import parse_attr
+from .registry import register
+
+_NEG = -1e30
+
+
+def _log_add(a, b):
+    m = jnp.maximum(a, b)
+    return m + jnp.log1p(jnp.exp(-jnp.abs(a - b)))
+
+
+def ctc_loss(logits, labels, input_lengths=None, label_lengths=None, blank=0):
+    """Batched CTC negative log-likelihood.
+
+    logits (T, N, C) pre-softmax; labels (N, L) int with 0 = padding/blank
+    (warp-ctc convention: label id 0 is reserved for blank, so valid labels
+    are >= 1 and trailing zeros are padding).  Returns (N,) losses.
+    """
+    t, n, c = logits.shape
+    l = labels.shape[1]
+    logp = jax.nn.log_softmax(logits, axis=-1)  # (T, N, C)
+
+    if label_lengths is None:
+        label_lengths = jnp.sum((labels != blank).astype(jnp.int32), axis=1)
+    if input_lengths is None:
+        input_lengths = jnp.full((n,), t, jnp.int32)
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank  (length 2L+1)
+    s = 2 * l + 1
+    ext = jnp.full((n, s), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(s)[None, :] < (2 * label_lengths + 1)[:, None]
+
+    # alpha recursion.  allow skip from s-2 when ext[s] != blank and
+    # ext[s] != ext[s-2]
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :s]
+    can_skip = (ext != blank) & (ext != ext_prev2)
+    can_skip = can_skip & (jnp.arange(s)[None, :] >= 2)
+
+    batch = jnp.arange(n)
+
+    def emit(lp_t):
+        # lp_t (N, C) -> per extended-label emission logprob (N, S)
+        return lp_t[batch[:, None], ext]
+
+    init = jnp.full((n, s), _NEG)
+    init = init.at[:, 0].set(logp[0, batch, blank])
+    init = init.at[:, 1].set(
+        jnp.where(label_lengths > 0, emit(logp[0])[:, 1], _NEG))
+
+    def step(alpha, lp_t):
+        a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=_NEG)[:, :s]
+        a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=_NEG)[:, :s]
+        acc = _log_add(alpha, a_prev1)
+        acc = jnp.where(can_skip, _log_add(acc, a_prev2), acc)
+        new = acc + emit(lp_t)
+        new = jnp.where(ext_valid, new, _NEG)
+        return new, new
+
+    _, alphas = jax.lax.scan(step, init, logp[1:])
+    alphas = jnp.concatenate([init[None], alphas], axis=0)  # (T, N, S)
+
+    # read out at each sequence's final frame, positions 2L and 2L-1
+    t_last = jnp.clip(input_lengths - 1, 0, t - 1)
+    final = alphas[t_last, batch]  # (N, S)
+    end1 = final[batch, 2 * label_lengths]
+    end2 = jnp.where(label_lengths > 0,
+                     final[batch, jnp.maximum(2 * label_lengths - 1, 0)], _NEG)
+    return -_log_add(end1, end2)
+
+
+@register(
+    "WarpCTC",
+    arg_names=("data", "label"),
+)
+def _warp_ctc(ctx, data, label, **attrs):
+    """Parity: WarpCTC (plugin/warpctc/warpctc-inl.h).
+
+    data: (T*N, C) activations (the plugin's flat layout) or (T, N, C);
+    label: (N, L) with 0-padding.  Forward = softmax(data); backward =
+    gradient of the summed CTC loss w.r.t. data, head gradient ignored.
+    """
+    label_length = int(parse_attr(attrs.get("label_length", label.shape[-1])))
+    input_length = int(parse_attr(attrs.get("input_length", 0)))
+
+    flat = data.ndim == 2
+    if flat:
+        n = label.shape[0]
+        t = input_length if input_length > 0 else data.shape[0] // n
+        logits = data.reshape(t, n, data.shape[-1])
+    else:
+        logits = data
+    labels = label.reshape(label.shape[0], -1)[:, :label_length].astype(jnp.int32)
+
+    tshape = logits.shape
+
+    @jax.custom_vjp
+    def fwd(x, lab):
+        return jax.nn.softmax(x, axis=-1)
+
+    def f(x, lab):
+        return jax.nn.softmax(x, axis=-1), (x, lab)
+
+    def b(res, g):
+        x, lab = res
+        lg = lambda z: jnp.sum(ctc_loss(z.reshape(tshape), lab))
+        return (jax.grad(lg)(x), jnp.zeros_like(lab))
+
+    fwd.defvjp(f, b)
+    return fwd(data, labels)
+
+
+@register(
+    "_contrib_CTCLoss",
+    arg_names=("data", "label"),
+    aliases=("ctc_loss",),
+)
+def _ctc_loss_op(ctx, data, label, **attrs):
+    """Per-sequence CTC loss vector (contract of MXNet's later
+    _contrib_CTCLoss): data (T, N, C) pre-softmax activations,
+    label (N, L) 0-padded; returns (N,) losses.  Differentiable via the
+    scan-based forward-backward; no custom head-grad semantics (feed
+    through MakeLoss to train, as users of _contrib_CTCLoss do)."""
+    labels = label.reshape(label.shape[0], -1).astype(jnp.int32)
+    return ctc_loss(data, labels)

@@ -1,0 +1,519 @@
+"""Vision custom-tail ops.
+
+TPU-native implementations of the ops the reference hand-writes in CUDA:
+GridGenerator (src/operator/grid_generator-inl.h), BilinearSampler
+(src/operator/bilinear_sampler-inl.h), SpatialTransformer
+(src/operator/spatial_transformer-inl.h), ROIPooling
+(src/operator/roi_pooling-inl.h), Correlation
+(src/operator/correlation-inl.h), and the SSD multibox trio
+(example/ssd/operator/multibox_{prior,target,detection}.{cc,cu}).
+
+Design: everything is expressed as dense gather/where/reduce-window math —
+static shapes, no data-dependent control flow — so XLA can fuse and tile it.
+The inner sampling math (bilinear gather) vectorizes across the whole output
+grid at once instead of the reference's one-thread-per-output-pixel CUDA
+scheme.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, parse_attr, normalize_tuple, parse_bool
+from .registry import register
+
+
+# --------------------------------------------------------------------------
+# GridGenerator / BilinearSampler / SpatialTransformer
+# --------------------------------------------------------------------------
+def _target_shape(attrs):
+    ts = normalize_tuple(parse_attr(attrs.get("target_shape", (0, 0))), 2,
+                         "target_shape")
+    return int(ts[0]), int(ts[1])
+
+
+def _affine_grid(theta, h, w):
+    """Normalized sampling grid for a batch of 2x3 affine matrices.
+
+    Parity: GridGeneratorOp affine path (grid_generator-inl.h:60-92) —
+    target coords are normalized to [-1, 1] with x varying fastest, and the
+    source coords are ``theta @ [x, y, 1]``.
+    """
+    n = theta.shape[0]
+    theta = theta.reshape(n, 2, 3)
+    ys, xs = jnp.meshgrid(
+        jnp.linspace(-1.0, 1.0, h) if h > 1 else jnp.zeros((1,)),
+        jnp.linspace(-1.0, 1.0, w) if w > 1 else jnp.zeros((1,)),
+        indexing="ij",
+    )
+    # rows of grid_dst: (x, y, 1) per target pixel
+    grid_dst = jnp.stack([xs.ravel(), ys.ravel(), jnp.ones(h * w)], axis=0)
+    src = jnp.einsum("nij,jk->nik", theta, grid_dst)  # (N, 2, H*W)
+    return src.reshape(n, 2, h, w)
+
+
+@register("GridGenerator")
+def _grid_generator(ctx, data, **attrs):
+    """Parity: GridGenerator (src/operator/grid_generator-inl.h).
+
+    transform_type='affine': data (N, 6) -> grid (N, 2, H, W) from
+    attr target_shape.  transform_type='warp': data is a flow field
+    (N, 2, H, W); grid = normalize(flow + identity meshgrid)
+    (grid_generator-inl.h:94-126).
+    """
+    transform_type = attrs.get("transform_type", "affine")
+    if transform_type == "affine":
+        h, w = _target_shape(attrs)
+        if h <= 0 or w <= 0:
+            raise MXNetError("GridGenerator(affine) requires target_shape")
+        return _affine_grid(data, h, w)
+    if transform_type == "warp":
+        n, two, h, w = data.shape
+        ys, xs = jnp.meshgrid(jnp.arange(h, dtype=data.dtype),
+                              jnp.arange(w, dtype=data.dtype), indexing="ij")
+        x = data[:, 0] + xs
+        y = data[:, 1] + ys
+        xn = jnp.where(w > 1, x * (2.0 / max(w - 1, 1)) - 1.0, jnp.zeros_like(x))
+        yn = jnp.where(h > 1, y * (2.0 / max(h - 1, 1)) - 1.0, jnp.zeros_like(y))
+        return jnp.stack([xn, yn], axis=1)
+    raise MXNetError(f"unknown transform_type {transform_type!r}")
+
+
+def _bilinear_sample(data, grid):
+    """Sample data (N,C,H,W) at normalized grid (N,2,Ho,Wo); zeros outside.
+
+    Parity: BilinearSamplerOp (bilinear_sampler-inl.h:44-90): real coords
+    are ``(g + 1) * (size - 1) / 2``; each output is the 4-corner bilinear
+    blend, with corners falling outside the image contributing zero.
+    """
+    n, c, h, w = data.shape
+    xs = (grid[:, 0] + 1.0) * (w - 1) / 2.0  # (N, Ho, Wo)
+    ys = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    wx = xs - x0
+    wy = ys - y0
+
+    def corner(yc, xc, weight):
+        valid = (xc >= 0) & (xc <= w - 1) & (yc >= 0) & (yc <= h - 1)
+        xi = jnp.clip(xc, 0, w - 1).astype(jnp.int32)
+        yi = jnp.clip(yc, 0, h - 1).astype(jnp.int32)
+        # gather per batch: (N, C, Ho, Wo)
+        batch = jnp.arange(n).reshape(n, 1, 1)
+        vals = data[batch, :, yi, xi]  # (N, Ho, Wo, C)
+        vals = jnp.moveaxis(vals, -1, 1)
+        wgt = (weight * valid.astype(data.dtype))[:, None]
+        return vals * wgt
+
+    out = (
+        corner(y0, x0, (1 - wy) * (1 - wx))
+        + corner(y0, x0 + 1, (1 - wy) * wx)
+        + corner(y0 + 1, x0, wy * (1 - wx))
+        + corner(y0 + 1, x0 + 1, wy * wx)
+    )
+    return out.astype(data.dtype)
+
+
+@register("BilinearSampler", arg_names=("data", "grid"))
+def _bilinear_sampler(ctx, data, grid, **attrs):
+    """Parity: BilinearSampler (src/operator/bilinear_sampler-inl.h)."""
+    return _bilinear_sample(data, grid)
+
+
+def _st_params(attrs, data_shape, *rest):
+    return {"loc": (data_shape[0], 6)}
+
+
+@register(
+    "SpatialTransformer",
+    arg_names=("data", "loc"),
+    infer_params=_st_params,
+)
+def _spatial_transformer(ctx, data, loc, **attrs):
+    """Parity: SpatialTransformer (src/operator/spatial_transformer-inl.h):
+    affine grid from the localization net output + bilinear sampling.  The
+    cuDNN path (cudnn_spatial_transformer-inl.h) fuses the same two stages.
+    """
+    h, w = _target_shape(attrs)
+    if h <= 0 or w <= 0:
+        h, w = data.shape[2], data.shape[3]
+    grid = _affine_grid(loc, h, w)
+    return _bilinear_sample(data, grid)
+
+
+# --------------------------------------------------------------------------
+# ROIPooling
+# --------------------------------------------------------------------------
+@register("ROIPooling", arg_names=("data", "rois"))
+def _roi_pooling(ctx, data, rois, **attrs):
+    """Parity: ROIPooling (src/operator/roi_pooling-inl.h).
+
+    data (N,C,H,W); rois (R,5) = [batch_index, x1, y1, x2, y2] in image
+    coordinates.  Coordinates are scaled by spatial_scale and *rounded*
+    (roi_pooling-inl.h / .cu kernel), bins are [floor(i*bh), ceil((i+1)*bh))
+    and max-pooled; empty bins emit 0.
+
+    TPU shape: instead of one CUDA thread per output element doing a serial
+    scan, we build separable row/column bin-membership masks and reduce with
+    two masked maxes — a dense (R,C,PH,H,W-free) formulation XLA can fuse.
+    """
+    if "pooled_size" not in attrs:
+        raise MXNetError("ROIPooling requires attribute pooled_size")
+    pooled = normalize_tuple(parse_attr(attrs["pooled_size"]), 2, "pooled_size")
+    ph, pw = int(pooled[0]), int(pooled[1])
+    scale = float(parse_attr(attrs.get("spatial_scale", 1.0)))
+
+    n, c, h, w = data.shape
+    r = rois.shape[0]
+
+    # C round(): half away from zero (the reference kernel's rounding);
+    # jnp.round is banker's rounding and shifts bins at exact .5 products.
+    def _cround(v):
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
+    batch_idx = jnp.clip(_cround(rois[:, 0]).astype(jnp.int32), 0, n - 1)
+    x1 = _cround(rois[:, 1] * scale)
+    y1 = _cround(rois[:, 2] * scale)
+    x2 = _cround(rois[:, 3] * scale)
+    y2 = _cround(rois[:, 4] * scale)
+    roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)  # (R,)
+    roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+
+    def axis_mask(start, bin_size, nbins, size):
+        # mask[r, b, p] = pixel p belongs to bin b of roi r
+        b = jnp.arange(nbins, dtype=data.dtype)
+        lo = jnp.floor(b[None, :] * bin_size[:, None] + start[:, None])
+        hi = jnp.ceil((b[None, :] + 1.0) * bin_size[:, None] + start[:, None])
+        lo = jnp.clip(lo, 0, size)
+        hi = jnp.clip(hi, 0, size)
+        p = jnp.arange(size, dtype=data.dtype)
+        return (p[None, None, :] >= lo[:, :, None]) & (p[None, None, :] < hi[:, :, None])
+
+    mask_h = axis_mask(y1, bin_h, ph, h)  # (R, PH, H)
+    mask_w = axis_mask(x1, bin_w, pw, w)  # (R, PW, W)
+
+    picked = data[batch_idx]  # (R, C, H, W)
+    neg = jnp.asarray(-jnp.inf, dtype=data.dtype)
+    # reduce H: (R, C, PH, W)
+    tmp = jnp.where(mask_h[:, None, :, :, None], picked[:, :, None, :, :], neg)
+    tmp = tmp.max(axis=3)
+    # reduce W: (R, C, PH, PW)
+    out = jnp.where(mask_w[:, None, None, :, :], tmp[:, :, :, None, :], neg)
+    out = out.max(axis=4)
+    return jnp.where(jnp.isfinite(out), out, 0.0).astype(data.dtype)
+
+
+# --------------------------------------------------------------------------
+# Correlation (FlowNet)
+# --------------------------------------------------------------------------
+@register(
+    "Correlation",
+    arg_names=("data1", "data2"),
+    num_outputs=1,
+)
+def _correlation(ctx, data1, data2, **attrs):
+    """Parity: Correlation (src/operator/correlation-inl.h).
+
+    Patch cross-correlation between two feature maps over a displacement
+    neighborhood.  Output channel k enumerates displacements
+    (dy, dx) in stride2 * [-r, r]^2 with r = max_displacement/stride2;
+    output (i, j) centers at border + (i, j)*stride1 in the padded map;
+    values are averaged over kernel window and channels
+    (correlation-inl.h top_height/top_width math).
+
+    The displacement loop is a static Python unroll (D^2 shifted
+    multiplies); per displacement the kernel-window sum is one
+    reduce_window — both XLA-fusable, no scalar loops.
+    """
+    kernel_size = int(parse_attr(attrs.get("kernel_size", 1)))
+    max_disp = int(parse_attr(attrs.get("max_displacement", 1)))
+    stride1 = int(parse_attr(attrs.get("stride1", 1)))
+    stride2 = int(parse_attr(attrs.get("stride2", 1)))
+    pad_size = int(parse_attr(attrs.get("pad_size", 0)))
+    is_multiply = parse_bool(attrs.get("is_multiply", True))
+
+    n, c, h, w = data1.shape
+    pad_cfg = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
+    d1 = jnp.pad(data1, pad_cfg)
+    d2 = jnp.pad(data2, pad_cfg)
+    ph_, pw_ = h + 2 * pad_size, w + 2 * pad_size
+
+    kernel_radius = (kernel_size - 1) // 2
+    border = max_disp + kernel_radius
+    top_h = int(math.ceil(float(ph_ - border * 2) / stride1))
+    top_w = int(math.ceil(float(pw_ - border * 2) / stride1))
+    if top_h < 1 or top_w < 1:
+        raise MXNetError("Correlation: output would be empty")
+    grid_radius = max_disp // stride2
+    grid_width = 2 * grid_radius + 1
+
+    norm = float(kernel_size * kernel_size * c)
+    window = (1, 1, kernel_size, kernel_size)
+
+    def window_sum(x):
+        return jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, window, (1, 1, 1, 1), "VALID")
+
+    # centers in padded coords: y = border + i*stride1; after VALID
+    # reduce_window with kernel k, index (y - kernel_radius) is the window
+    # whose *center* is y.
+    ys = border - kernel_radius + stride1 * np.arange(top_h)
+    xs = border - kernel_radius + stride1 * np.arange(top_w)
+
+    outs = []
+    for dyi in range(-grid_radius, grid_radius + 1):
+        for dxi in range(-grid_radius, grid_radius + 1):
+            dy, dx = dyi * stride2, dxi * stride2
+            shifted = jnp.roll(d2, shift=(-dy, -dx), axis=(2, 3))
+            if is_multiply:
+                prod = d1 * shifted
+            else:
+                prod = jnp.abs(d1 - shifted)
+            summed = window_sum(prod.sum(axis=1, keepdims=True)) / norm
+            outs.append(summed[:, 0][:, ys][:, :, xs])
+    return jnp.stack(outs, axis=1).astype(data1.dtype)
+
+
+# --------------------------------------------------------------------------
+# SSD multibox trio (example/ssd/operator/multibox_*.{cc,cu})
+# --------------------------------------------------------------------------
+def _parse_floats(val, default):
+    v = parse_attr(val) if val is not None else default
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+@register("MultiBoxPrior", aliases=("_contrib_MultiBoxPrior",))
+def _multibox_prior(ctx, data, **attrs):
+    """Parity: MultiBoxPrior (example/ssd/operator/multibox_prior-inl.h).
+
+    Anchor generation per feature-map cell: num_anchors = |sizes| +
+    |ratios| - 1 — each size with ratios[0], plus sizes[0] with each other
+    ratio.  Centers at ((j+0.5)/W, (i+0.5)/H); box half-extents
+    (s*sqrt(r)/2, s/sqrt(r)/2).  Output (1, H*W*A, 4) corner format.
+    Pure constant-building math — computed with numpy at trace time.
+    """
+    sizes = _parse_floats(attrs.get("sizes"), (1.0,))
+    ratios = _parse_floats(attrs.get("ratios"), (1.0,))
+    clip = parse_bool(attrs.get("clip", False))
+    h, w = data.shape[2], data.shape[3]
+
+    combos = [(s, ratios[0]) for s in sizes] + [(sizes[0], r) for r in ratios[1:]]
+    cy, cx = np.meshgrid((np.arange(h) + 0.5) / h, (np.arange(w) + 0.5) / w,
+                         indexing="ij")
+    anchors = []
+    for s, r in combos:
+        hw = s * math.sqrt(r) / 2.0
+        hh = s / math.sqrt(r) / 2.0
+        anchors.append(np.stack([cx - hw, cy - hh, cx + hw, cy + hh], axis=-1))
+    out = np.stack(anchors, axis=2).reshape(1, -1, 4).astype(np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return jnp.asarray(out)
+
+
+def _iou_matrix(a, b):
+    """IoU between (A,4) and (B,4) corner boxes -> (A,B)."""
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]), 0.0)
+    area_b = jnp.maximum((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode_loc(anchors, gt, variances):
+    """Box regression targets (multibox_target-inl.h encoding)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) / 2
+    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-12)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-12)
+    gx = (gt[:, 0] + gt[:, 2]) / 2
+    gy = (gt[:, 1] + gt[:, 3]) / 2
+    v0, v1, v2, v3 = variances
+    return jnp.stack([
+        (gx - ax) / jnp.maximum(aw, 1e-12) / v0,
+        (gy - ay) / jnp.maximum(ah, 1e-12) / v1,
+        jnp.log(gw / jnp.maximum(aw, 1e-12)) / v2,
+        jnp.log(gh / jnp.maximum(ah, 1e-12)) / v3,
+    ], axis=-1)
+
+
+@register(
+    "MultiBoxTarget",
+    arg_names=("anchor", "label", "cls_pred"),
+    num_outputs=3,
+    output_names=("loc_target", "loc_mask", "cls_target"),
+    aliases=("_contrib_MultiBoxTarget",),
+)
+def _multibox_target(ctx, anchor, label, cls_pred, **attrs):
+    """Parity: MultiBoxTarget (example/ssd/operator/multibox_target-inl.h).
+
+    Anchor matching: each ground truth claims its best-IoU anchor
+    (bipartite stage), then any anchor with IoU > overlap_threshold joins
+    (threshold stage).  Unmatched anchors are background; hard negative
+    mining keeps negative_mining_ratio * num_pos negatives ranked by
+    background-class confidence (lowest background prob = hardest).
+    Outputs: loc_target (N, A*4), loc_mask (N, A*4), cls_target (N, A)
+    with 0 = background, cls_id + 1 = positive, -1 = ignored.
+    """
+    overlap_threshold = float(parse_attr(attrs.get("overlap_threshold", 0.5)))
+    ignore_label = float(parse_attr(attrs.get("ignore_label", -1.0)))
+    neg_ratio = float(parse_attr(attrs.get("negative_mining_ratio", -1.0)))
+    neg_thresh = float(parse_attr(attrs.get("negative_mining_thresh", 0.5)))
+    variances = _parse_floats(attrs.get("variances"), (0.1, 0.1, 0.2, 0.2))
+
+    anchors = anchor.reshape(-1, 4)
+    a = anchors.shape[0]
+
+    def one_sample(lab, cls_p):
+        # lab: (M, 5) [cls, x1, y1, x2, y2], cls < 0 => padding
+        valid = lab[:, 0] >= 0  # (M,)
+        gt = lab[:, 1:5]
+        iou = _iou_matrix(anchors, gt)  # (A, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+
+        # threshold matching: best gt per anchor
+        best_gt = jnp.argmax(iou, axis=1)  # (A,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou > overlap_threshold
+
+        # bipartite: each valid gt claims its best anchor.  Padded gt rows
+        # are routed to out-of-range index `a` so mode="drop" discards them
+        # instead of racing with valid gts' scatter writes at anchor 0.
+        best_anchor = jnp.where(valid, jnp.argmax(iou, axis=0), a)  # (M,)
+        claimed = jnp.zeros((a,), bool).at[best_anchor].set(
+            jnp.ones_like(valid), mode="drop")
+        gt_of_claim = jnp.zeros((a,), jnp.int32).at[best_anchor].set(
+            jnp.arange(gt.shape[0], dtype=jnp.int32), mode="drop")
+
+        match_gt = jnp.where(claimed, gt_of_claim, best_gt)
+        positive = claimed | matched
+
+        cls_t = jnp.where(positive, lab[match_gt, 0] + 1.0, 0.0)
+        loc_t = _encode_loc(anchors, gt[match_gt], variances)
+        loc_t = loc_t * positive[:, None].astype(loc_t.dtype)
+        loc_m = jnp.tile(positive[:, None].astype(jnp.float32), (1, 4))
+
+        if neg_ratio > 0:
+            num_pos = jnp.sum(positive.astype(jnp.float32))
+            max_neg = neg_ratio * num_pos
+            # hardness = max non-background confidence (higher = harder
+            # negative); restrict to anchors below the mining IoU threshold
+            probs = jax.nn.softmax(cls_p, axis=0)  # (num_classes+1, A)
+            bg_prob = probs[0]
+            neg_cand = (~positive) & (best_iou < neg_thresh)
+            hardness = jnp.where(neg_cand, 1.0 - bg_prob, -1.0)
+            order = jnp.argsort(-hardness)
+            rank = jnp.zeros((a,), jnp.float32).at[order].set(
+                jnp.arange(a, dtype=jnp.float32))
+            keep_neg = neg_cand & (rank < max_neg)
+            cls_t = jnp.where(positive, cls_t,
+                              jnp.where(keep_neg, 0.0, ignore_label))
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+def _decode_loc(anchors, loc, variances):
+    v0, v1, v2, v3 = variances
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) / 2
+    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = loc[:, 0] * v0 * aw + ax
+    cy = loc[:, 1] * v1 * ah + ay
+    w = jnp.exp(jnp.clip(loc[:, 2] * v2, -10, 10)) * aw
+    h = jnp.exp(jnp.clip(loc[:, 3] * v3, -10, 10)) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+@register(
+    "MultiBoxDetection",
+    arg_names=("cls_prob", "loc_pred", "anchor"),
+    aliases=("_contrib_MultiBoxDetection",),
+)
+def _multibox_detection(ctx, cls_prob, loc_pred, anchor, **attrs):
+    """Parity: MultiBoxDetection
+    (example/ssd/operator/multibox_detection-inl.h): decode loc predictions
+    against anchors, take per-anchor argmax class, threshold, then
+    greedy NMS.  Output (N, A, 6) rows [cls_id, score, x1, y1, x2, y2]
+    with cls_id = -1 for suppressed/invalid entries.
+
+    NMS is a fixed-length lax.fori_loop over score-sorted boxes (jit-safe:
+    A iterations, each a vectorized IoU row) instead of the reference's
+    serial CPU/CUDA loop.
+    """
+    clip = parse_bool(attrs.get("clip", True))
+    threshold = float(parse_attr(attrs.get("threshold", 0.01)))
+    nms_threshold = float(parse_attr(attrs.get("nms_threshold", 0.5)))
+    force_suppress = parse_bool(attrs.get("force_suppress", False))
+    nms_topk = int(parse_attr(attrs.get("nms_topk", -1)))
+    variances = _parse_floats(attrs.get("variances"), (0.1, 0.1, 0.2, 0.2))
+
+    anchors = anchor.reshape(-1, 4)
+    a = anchors.shape[0]
+
+    def one_sample(probs, loc):
+        # probs: (num_classes+1, A) with class 0 = background
+        boxes = _decode_loc(anchors, loc.reshape(-1, 4), variances)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        cls_id = jnp.argmax(probs[1:], axis=0).astype(jnp.float32)  # (A,)
+        score = jnp.max(probs[1:], axis=0)
+        keep = score > threshold
+        cls_id = jnp.where(keep, cls_id, -1.0)
+        score = jnp.where(keep, score, 0.0)
+
+        order = jnp.argsort(-score)
+        cls_s, score_s, boxes_s = cls_id[order], score[order], boxes[order]
+
+        # static nms_topk bounds both the IoU matrix (k x A instead of
+        # A x A) and the sequential suppression loop: suppression only ever
+        # flows *from* the top-k score-sorted rows, and past-topk entries
+        # are dropped outright (parity: nms_topk, multibox_detection-inl.h)
+        k = min(nms_topk, a) if nms_topk > 0 else a
+        if k < a:
+            cls_s = jnp.where(jnp.arange(a) < k, cls_s, -1.0)
+
+        iou = _iou_matrix(boxes_s[:k], boxes_s)  # (k, A)
+
+        def body(i, alive):
+            same_cls = force_suppress | (cls_s == cls_s[i])
+            sup = (iou[i] > nms_threshold) & same_cls & (jnp.arange(a) > i)
+            kill = alive[i] & (cls_s[i] >= 0)
+            return jnp.where(kill & sup, False, alive)
+
+        alive = jax.lax.fori_loop(0, k, body, jnp.ones((a,), bool))
+        cls_s = jnp.where(alive, cls_s, -1.0)
+        return jnp.concatenate(
+            [cls_s[:, None], score_s[:, None], boxes_s], axis=1)
+
+    return jax.vmap(one_sample)(cls_prob, loc_pred)
+
+
+# --------------------------------------------------------------------------
+# _CrossDeviceCopy — on TPU, GSPMD/jit inserts transfers; explicit op is
+# an identity marker (parity: src/operator/cross_device_copy.cc).
+# --------------------------------------------------------------------------
+@register("_CrossDeviceCopy")
+def _cross_device_copy(ctx, data, **attrs):
+    """Parity: _CrossDeviceCopy (src/operator/cross_device_copy.cc).  The
+    reference inserts this node at ctx_group boundaries; here sharding
+    annotations drive ICI transfers, so the op is identity."""
+    return data

@@ -1,0 +1,300 @@
+"""Custom-tail vision ops + CTC vs numpy references.
+
+Mirrors the reference's test pattern (tests/python/unittest/test_operator.py:
+numpy forward references + finite-difference gradients)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.ops.registry import invoke
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def test_grid_generator_affine():
+    # identity affine -> grid equals normalized meshgrid
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    out = _np(invoke("GridGenerator", [theta],
+                     {"transform_type": "affine", "target_shape": (4, 5)}))
+    assert out.shape == (1, 2, 4, 5)
+    np.testing.assert_allclose(out[0, 0, 0], np.linspace(-1, 1, 5), rtol=1e-5)
+    np.testing.assert_allclose(out[0, 1, :, 0], np.linspace(-1, 1, 4), rtol=1e-5)
+    # translation shifts the grid
+    theta_t = np.array([[1, 0, 0.5, 0, 1, -0.25]], np.float32)
+    out_t = _np(invoke("GridGenerator", [theta_t],
+                       {"transform_type": "affine", "target_shape": (4, 5)}))
+    np.testing.assert_allclose(out_t[0, 0], out[0, 0] + 0.5, rtol=1e-5)
+    np.testing.assert_allclose(out_t[0, 1], out[0, 1] - 0.25, rtol=1e-5)
+
+
+def test_grid_generator_warp_zero_flow_identity():
+    flow = np.zeros((2, 2, 3, 4), np.float32)
+    out = _np(invoke("GridGenerator", [flow], {"transform_type": "warp"}))
+    np.testing.assert_allclose(out[0, 0, 0], np.linspace(-1, 1, 4), atol=1e-6)
+    np.testing.assert_allclose(out[0, 1, :, 0], np.linspace(-1, 1, 3), atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    rs = np.random.RandomState(0)
+    data = rs.uniform(size=(2, 3, 5, 6)).astype(np.float32)
+    theta = np.tile(np.array([[1, 0, 0, 0, 1, 0]], np.float32), (2, 1))
+    grid = _np(invoke("GridGenerator", [theta],
+                      {"transform_type": "affine", "target_shape": (5, 6)}))
+    out = _np(invoke("BilinearSampler", [data, grid]))
+    np.testing.assert_allclose(out, data, rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_sampler_numpy_reference():
+    rs = np.random.RandomState(1)
+    data = rs.uniform(size=(1, 2, 4, 4)).astype(np.float32)
+    grid = rs.uniform(-1.2, 1.2, size=(1, 2, 3, 3)).astype(np.float32)
+    out = _np(invoke("BilinearSampler", [data, grid]))
+
+    # scalar numpy reference
+    n, c, h, w = data.shape
+    ref = np.zeros((1, 2, 3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            x = (grid[0, 0, i, j] + 1) * (w - 1) / 2
+            y = (grid[0, 1, i, j] + 1) * (h - 1) / 2
+            x0, y0 = int(np.floor(x)), int(np.floor(y))
+            for dy in (0, 1):
+                for dx in (0, 1):
+                    xi, yi = x0 + dx, y0 + dy
+                    if 0 <= xi < w and 0 <= yi < h:
+                        wgt = (1 - abs(x - xi)) * (1 - abs(y - yi))
+                        ref[0, :, i, j] += wgt * data[0, :, yi, xi]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    rs = np.random.RandomState(2)
+    data = rs.uniform(size=(2, 3, 6, 6)).astype(np.float32)
+    loc = np.tile(np.array([[1, 0, 0, 0, 1, 0]], np.float32), (2, 1))
+    out = _np(invoke("SpatialTransformer", [data, loc],
+                     {"target_shape": (6, 6), "transform_type": "affine",
+                      "sampler_type": "bilinear"}))
+    np.testing.assert_allclose(out, data, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pooling_reference():
+    rs = np.random.RandomState(3)
+    data = rs.uniform(size=(2, 2, 8, 8)).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7],
+                     [1, 2, 2, 6, 6],
+                     [0, 4, 4, 4, 4]], np.float32)
+    out = _np(invoke("ROIPooling", [data, rois],
+                     {"pooled_size": (2, 2), "spatial_scale": 1.0}))
+    assert out.shape == (3, 2, 2, 2)
+
+    def ref_roi(b, x1, y1, x2, y2, ph, pw):
+        rw = max(x2 - x1 + 1, 1)
+        rh = max(y2 - y1 + 1, 1)
+        res = np.zeros((data.shape[1], ph, pw), np.float32)
+        for i in range(ph):
+            for j in range(pw):
+                hs = int(np.floor(i * rh / ph)) + y1
+                he = int(np.ceil((i + 1) * rh / ph)) + y1
+                ws = int(np.floor(j * rw / pw)) + x1
+                we = int(np.ceil((j + 1) * rw / pw)) + x1
+                hs, he = max(hs, 0), min(he, 8)
+                ws, we = max(ws, 0), min(we, 8)
+                if he > hs and we > ws:
+                    res[:, i, j] = data[b, :, hs:he, ws:we].max(axis=(1, 2))
+        return res
+
+    np.testing.assert_allclose(out[0], ref_roi(0, 0, 0, 7, 7, 2, 2), rtol=1e-5)
+    np.testing.assert_allclose(out[1], ref_roi(1, 2, 2, 6, 6, 2, 2), rtol=1e-5)
+    np.testing.assert_allclose(out[2], ref_roi(0, 4, 4, 4, 4, 2, 2), rtol=1e-5)
+
+
+def test_correlation_self_kernel1():
+    # correlating a map with itself at zero displacement = mean of squares
+    rs = np.random.RandomState(4)
+    data = rs.uniform(size=(1, 4, 6, 6)).astype(np.float32)
+    out = _np(invoke("Correlation", [data, data],
+                     {"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                      "stride2": 1, "pad_size": 1, "is_multiply": True}))
+    # grid 3x3 -> 9 channels; center channel (index 4) is zero displacement
+    assert out.shape[1] == 9
+    border = 1
+    center = out[0, 4]
+    expect = (data[0] ** 2).mean(axis=0)
+    h = center.shape[0]
+    np.testing.assert_allclose(
+        center, np.pad(expect, 1)[border:border + h, border:border + h],
+        rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_prior_counts_and_centers():
+    data = np.zeros((1, 3, 4, 4), np.float32)
+    out = _np(invoke("MultiBoxPrior", [data],
+                     {"sizes": (0.5, 0.25), "ratios": (1.0, 2.0)}))
+    # |sizes| + |ratios| - 1 = 3 anchors per cell
+    assert out.shape == (1, 4 * 4 * 3, 4)
+    first = out[0, 0]
+    cx, cy = (first[0] + first[2]) / 2, (first[1] + first[3]) / 2
+    np.testing.assert_allclose([cx, cy], [0.5 / 4, 0.5 / 4], atol=1e-6)
+    np.testing.assert_allclose(first[2] - first[0], 0.5, atol=1e-6)
+
+
+def test_multibox_target_matching():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], np.float32)
+    # one gt box of class 2 overlapping anchor 0 exactly
+    label = np.array([[[2, 0.0, 0.0, 0.5, 0.5],
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 4, 3), np.float32)
+    loc_t, loc_m, cls_t = invoke(
+        "MultiBoxTarget", [anchors, label, cls_pred], {})
+    cls_t = _np(cls_t)
+    assert cls_t.shape == (1, 3)
+    assert cls_t[0, 0] == 3.0  # cls 2 -> target 3 (background=0 offset)
+    assert cls_t[0, 1] == 0.0
+    loc_m = _np(loc_m).reshape(1, 3, 4)
+    assert loc_m[0, 0].sum() == 4.0 and loc_m[0, 1].sum() == 0.0
+    # perfectly matched anchor -> zero loc target
+    np.testing.assert_allclose(_np(loc_t).reshape(1, 3, 4)[0, 0], 0.0,
+                               atol=1e-5)
+
+
+def test_multibox_target_padded_labels_dont_corrupt_matching():
+    """Padded (cls=-1) label rows must not steal/unclaim valid gts' anchors
+    (regression: scatter race between padding rows and valid rows)."""
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], np.float32)
+    # gt IoU with anchor 0 is ~0.49 < threshold: only bipartite stage matches
+    gt_row = [1, 0.0, 0.0, 0.35, 0.35]
+    for npad in (0, 1, 3):
+        label = np.array([[gt_row] + [[-1, 0, 0, 0, 0]] * npad], np.float32)
+        cls_pred = np.zeros((1, 4, 3), np.float32)
+        _, _, cls_t = invoke("MultiBoxTarget", [anchors, label, cls_pred], {})
+        assert _np(cls_t)[0, 0] == 2.0, f"npad={npad}: gt lost its anchor"
+
+
+def test_roi_pooling_half_rounding():
+    """ROI coords scale-round like C round() (half away from zero), not
+    banker's rounding: x=40 * 1/16 = 2.5 -> 3."""
+    data = np.arange(16 * 16, dtype=np.float32).reshape(1, 1, 16, 16)
+    rois = np.array([[0, 40, 40, 80, 80]], np.float32)  # /16 -> 2.5..5.0
+    out = _np(invoke("ROIPooling", [data, rois],
+                     {"pooled_size": (1, 1), "spatial_scale": 1.0 / 16}))
+    # rounds to [3,3]..[5,5] -> max = data[5,5]; banker's would give [2..5]
+    assert out[0, 0, 0, 0] == data[0, 0, 5, 5]
+
+
+def test_ctc_loss_op_returns_loss_vector():
+    """_contrib_CTCLoss contract: (T,N,C) data -> (N,) loss."""
+    rs = np.random.RandomState(8)
+    data = rs.uniform(-1, 1, size=(5, 3, 7)).astype(np.float32)
+    label = np.array([[1, 2], [3, 0], [0, 0]], np.float32)
+    out = _np(invoke("_contrib_CTCLoss", [data, label], {}))
+    assert out.shape == (3,)
+    assert (out > 0).all()
+
+
+def test_multibox_detection_nms_topk():
+    """nms_topk statically bounds the suppression loop but must keep
+    suppression semantics within the top-k."""
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.01, 0.01, 0.51, 0.51],
+                         [0.5, 0.5, 1.0, 1.0]]], np.float32)
+    cls_prob = np.array([[[0.1, 0.1, 0.1],
+                          [0.9, 0.8, 0.1],
+                          [0.0, 0.1, 0.8]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = _np(invoke("MultiBoxDetection", [cls_prob, loc_pred, anchors],
+                     {"nms_threshold": 0.5, "nms_topk": 2}))
+    kept = out[0][out[0, :, 0] >= 0]
+    # anchor 1 still suppressed by anchor 0; anchor 2 past topk -> dropped
+    assert len(kept) == 1 and kept[0, 0] == 0.0
+
+
+def test_multibox_detection_nms():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.01, 0.01, 0.51, 0.51],
+                         [0.5, 0.5, 1.0, 1.0]]], np.float32)
+    # class probs: (N, num_cls+1, A); anchors 0/1 confident class 1,
+    # anchor 2 confident class 2
+    cls_prob = np.array([[[0.1, 0.1, 0.1],
+                          [0.9, 0.8, 0.1],
+                          [0.0, 0.1, 0.8]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = _np(invoke("MultiBoxDetection", [cls_prob, loc_pred, anchors],
+                     {"nms_threshold": 0.5}))
+    assert out.shape == (1, 3, 6)
+    kept = out[0][out[0, :, 0] >= 0]
+    # anchor 1 suppressed by anchor 0 (same class, IoU > 0.5)
+    assert len(kept) == 2
+    classes = sorted(kept[:, 0].tolist())
+    assert classes == [0.0, 1.0]
+
+
+def test_ctc_loss_simple():
+    from mxnet_tpu.ops.ctc import ctc_loss
+    # T=1, single label: loss = -log softmax(label)
+    logits = np.array([[[0.0, 2.0, 0.0]]], np.float32)  # (T=1, N=1, C=3)
+    labels = np.array([[1]], np.int32)
+    loss = _np(ctc_loss(logits, labels))
+    p = np.exp(2.0) / (2 + np.exp(2.0))
+    np.testing.assert_allclose(loss[0], -np.log(p), rtol=1e-5)
+
+
+def test_ctc_loss_two_frames():
+    from mxnet_tpu.ops.ctc import ctc_loss
+    # T=2, label "a": paths = {a a, blank a, a blank}
+    rs = np.random.RandomState(5)
+    logits = rs.uniform(-1, 1, size=(2, 1, 3)).astype(np.float32)
+    labels = np.array([[1]], np.int32)
+    loss = _np(ctc_loss(logits, labels))
+    p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    prob = (p[0, 0, 1] * p[1, 0, 1] + p[0, 0, 0] * p[1, 0, 1]
+            + p[0, 0, 1] * p[1, 0, 0])
+    np.testing.assert_allclose(loss[0], -np.log(prob), rtol=1e-4)
+
+
+def test_warpctc_op_backward_ignores_head_grad():
+    import jax
+    import jax.numpy as jnp
+    rs = np.random.RandomState(6)
+    data = jnp.asarray(rs.uniform(-1, 1, size=(4, 2, 5)).astype(np.float32))
+    label = jnp.asarray(np.array([[1, 2], [3, 0]], np.int32))
+
+    out = invoke("WarpCTC", [data, label], {"label_length": 2})
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-5)
+
+    def f(d):
+        return invoke("WarpCTC", [d, label], {"label_length": 2}).sum()
+
+    g = jax.grad(f)(data)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
+
+    # gradient equals d(ctc)/d(data) regardless of head grad scaling
+    def f2(d):
+        return (invoke("WarpCTC", [d, label], {"label_length": 2}) * 7.0).sum()
+
+    g2 = jax.grad(f2)(data)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g2), rtol=1e-5)
+
+
+def test_vision_ops_in_symbol_graph():
+    """Vision ops compose through the symbolic executor."""
+    from mxnet_tpu import symbol as sym
+    data = sym.Variable("data")
+    rois = sym.Variable("rois")
+    pooled = sym.ROIPooling(data=data, rois=rois, pooled_size=(2, 2),
+                            spatial_scale=1.0, name="roi")
+    exe = pooled.simple_bind(ctx=mx.context.cpu(),
+                             data=(1, 2, 8, 8), rois=(2, 5), grad_req="null")
+    rs = np.random.RandomState(7)
+    exe.arg_dict["data"][:] = rs.uniform(size=(1, 2, 8, 8)).astype(np.float32)
+    exe.arg_dict["rois"][:] = np.array([[0, 0, 0, 7, 7], [0, 1, 1, 5, 5]],
+                                       np.float32)
+    out = exe.forward()[0].asnumpy()
+    assert out.shape == (2, 2, 2, 2)
