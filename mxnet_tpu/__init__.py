@@ -47,6 +47,11 @@ from .module import Module, BucketingModule, SequentialModule, PythonModule
 from . import monitor
 from .monitor import Monitor
 from . import rnn
+from . import operator
+from . import profiler
+from . import rtc
+from . import visualization
+from . import visualization as viz
 from . import test_utils
 
 __all__ = [

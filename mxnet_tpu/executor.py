@@ -205,9 +205,16 @@ class Executor:
             self._pending = (args, aux, key)
             self._outputs_cache = None
         else:
-            outs, new_aux = self._jit_fwd(args, aux, key, False)
-            self._pending = None
-            self._outputs_cache = [NDArray(o) for o in outs]
+            from . import profiler as _prof
+
+            with _prof.span(f"forward[{self._symbol.name or 'graph'}]",
+                            device=str(self._ctx),
+                            sync=lambda: jax.block_until_ready(
+                                self._outputs_cache[0]._read())
+                            if self._outputs_cache else None):
+                outs, new_aux = self._jit_fwd(args, aux, key, False)
+                self._pending = None
+                self._outputs_cache = [NDArray(o) for o in outs]
             if self._monitor_callback is not None:
                 self._run_monitor(args, aux, key)
         return self.outputs
@@ -217,6 +224,16 @@ class Executor:
         GraphExecutor::Backward); grads land in grad_arrays per grad_req."""
         if self._pending is None:
             raise MXNetError("backward() requires forward(is_train=True) first")
+        from . import profiler as _prof
+
+        with _prof.span(f"forward_backward[{self._symbol.name or 'graph'}]",
+                        device=str(self._ctx),
+                        sync=lambda: jax.block_until_ready(
+                            self._outputs_cache[0]._read())
+                        if self._outputs_cache else None):
+            self._backward_impl(out_grads)
+
+    def _backward_impl(self, out_grads):
         args, aux, key = self._pending
         outs_shapes = None
         if out_grads is None:

@@ -1,0 +1,216 @@
+"""Custom operators written in Python.
+
+Parity: python/mxnet/operator.py (808 LoC: CustomOp, CustomOpProp,
+register, plus the legacy PythonOp/NumpyOp/NDArrayOp) and the C++ side
+src/operator/custom-inl.h:29-249 / MXCustomOpRegister.
+
+TPU-native design: instead of ctypes callback trampolines run as async
+engine ops (FnProperty::kAsync), user Python runs on the host via
+``jax.pure_callback`` — the XLA-sanctioned escape hatch — wired into the
+graph with ``jax.custom_vjp`` so user-defined backward passes compose with
+the rest of the autodiff'd computation.  Shape/type inference happens at
+trace time through the prop's ``infer_shape``/``infer_type`` exactly like
+the reference's CustomOpProp callbacks.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+_PROPS: dict[str, type] = {}
+
+
+class CustomOp:
+    """Base class for user ops (parity: operator.py CustomOp).
+
+    Subclasses implement forward/backward on host arrays.  ``assign``
+    honors the req semantics (write/add/null) like the reference.
+    """
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req in ("null", 0):
+            return
+        src = np.asarray(src.asnumpy() if hasattr(src, "asnumpy") else src)
+        if req in ("add", "add_to"):
+            dst._npvalue[...] = dst._npvalue + src
+        else:  # write / inplace
+            dst._npvalue[...] = src
+
+
+class CustomOpProp:
+    """Op metadata + factory (parity: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0] if in_type else np.float32
+        return ([t] * len(self.list_arguments()),
+                [t] * len(self.list_outputs()),
+                [t] * len(self.list_auxiliary_states()))
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Parity: mx.operator.register — decorator registering a CustomOpProp
+    under ``op_type`` for use as ``mx.sym.Custom(..., op_type=reg_name)``."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("can only register subclasses of CustomOpProp")
+        _PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop(op_type: str) -> CustomOpProp:
+    try:
+        return _PROPS[op_type]()
+    except KeyError:
+        raise MXNetError(f"custom op type '{op_type}' is not registered "
+                         "(use @mx.operator.register)") from None
+
+
+class _HostArray:
+    """Minimal NDArray-alike handed to user forward/backward callbacks:
+    supports .asnumpy(), .shape, .dtype, and in-place writes through
+    CustomOp.assign."""
+
+    __slots__ = ("_npvalue",)
+
+    def __init__(self, arr):
+        self._npvalue = np.asarray(arr)
+
+    def asnumpy(self):
+        return self._npvalue
+
+    @property
+    def shape(self):
+        return self._npvalue.shape
+
+    @property
+    def dtype(self):
+        return self._npvalue.dtype
+
+    def __array__(self, dtype=None):
+        return self._npvalue if dtype is None else self._npvalue.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Legacy numpy-callback op styles kept for API parity
+# (reference: PythonOp/NumpyOp/NDArrayOp in python/mxnet/operator.py; the
+# reference itself marks them deprecated in favor of CustomOp).
+# ---------------------------------------------------------------------------
+class PythonOp:
+    """Deprecated base (parity: operator.py PythonOp).  Use CustomOp."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+class NumpyOp(PythonOp):
+    """Parity shim for the deprecated NumpyOp: adapts the simple
+    forward(in_data, out_data) protocol onto the CustomOp machinery."""
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+
+        outer = self
+        name = f"_numpy_op_{type(self).__name__}_{id(self):x}"
+
+        class _Prop(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=outer.need_top_grad())
+
+            def list_arguments(self):
+                return outer.list_arguments()
+
+            def list_outputs(self):
+                return outer.list_outputs()
+
+            def infer_shape(self, in_shape):
+                res = outer.infer_shape(in_shape)
+                return (res[0], res[1], []) if len(res) == 2 else res
+
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                class _Op(CustomOp):
+                    def forward(self, is_train, req, in_data, out_data, aux):
+                        outer.forward([d.asnumpy() for d in in_data],
+                                      [o._npvalue for o in out_data])
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        outer.backward([g.asnumpy() for g in out_grad],
+                                       [d.asnumpy() for d in in_data],
+                                       [o.asnumpy() for o in out_data],
+                                       [g._npvalue for g in in_grad])
+
+                return _Op()
+
+        if name not in _PROPS:
+            _PROPS[name] = _Prop
+        return sym._make_symbol_fn("Custom")(*args, op_type=name, **kwargs)
+
+
+NDArrayOp = NumpyOp  # reference exposes both protocols; one shim serves

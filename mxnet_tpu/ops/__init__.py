@@ -30,3 +30,4 @@ from . import optimizer_ops  # noqa: F401
 from . import rnn_op  # noqa: F401
 from . import vision  # noqa: F401
 from . import ctc  # noqa: F401
+from . import custom  # noqa: F401

@@ -190,7 +190,23 @@ def invoke(name: str, inputs, attrs=None, is_train: bool = True, key=None):
 
         key = _random.next_key()
     fn = _jitted(op.name, frozen_attrs(attrs), bool(is_train), key is not None)
-    out = fn(key, *inputs) if key is not None else fn(*inputs)
+    from .. import profiler as _prof
+
+    if _prof.is_running() and _prof.mode() == "all":
+        # parity: imperative ops profiled under mode='all'
+        # (MXNET_PROFILER_MODE, env_var.md:64-67); sync for accurate dur
+        holder = {}
+
+        def _sync():
+            import jax as _jax
+
+            if "out" in holder:
+                _jax.block_until_ready(holder["out"])
+
+        with _prof.span(op.name, sync=_sync):
+            holder["out"] = out = fn(key, *inputs) if key is not None else fn(*inputs)
+    else:
+        out = fn(key, *inputs) if key is not None else fn(*inputs)
     from .. import engine
 
     engine.on_push(out)

@@ -1,0 +1,131 @@
+"""Operator-level profiler emitting chrome://tracing JSON.
+
+Parity: src/engine/profiler.{h,cc} (Profiler/OprExecStat/DevStat,
+DumpProfile/EmitEvent emit chrome-trace events) + python/mxnet/profiler.py
+(profiler_set_config/profiler_set_state/dump_profile) + env autostart
+MXNET_PROFILER_AUTOSTART / MXNET_PROFILER_MODE (docs/how_to/env_var.md:64-67).
+
+TPU-native twist: alongside the host-side per-op trace we can start a
+real XLA/xprof device trace (jax.profiler.start_trace) so kernel-level
+timelines land next to the op-level one — the unified view SURVEY.md §5.1
+calls for.  Host-side timing wraps the *dispatch + optional device sync*:
+under mode='all' every timed op is blocked on (accurate, slow); under
+mode='symbolic' only executor-level spans are recorded (cheap).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from .base import get_env
+
+_lock = threading.Lock()
+_state = {
+    "mode": os.environ.get("MXNET_PROFILER_MODE", "symbolic"),
+    "filename": "profile.json",
+    "running": bool(get_env("MXNET_PROFILER_AUTOSTART", 0, int)),
+    "xla_trace_dir": None,
+    "xla_tracing": False,
+}
+_events: list = []
+_t0 = time.monotonic()
+
+
+def _now_us() -> float:
+    return (time.monotonic() - _t0) * 1e6
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json",
+                        xla_trace_dir=None):
+    """Parity: MXSetProfilerConfig (src/c_api/c_api.cc).  mode is
+    'symbolic' (executor spans only) or 'all' (imperative ops too, each
+    synced for accurate timing).  xla_trace_dir additionally captures an
+    xprof/XLA device trace."""
+    if mode not in ("symbolic", "all"):
+        raise ValueError("mode must be 'symbolic' or 'all'")
+    with _lock:
+        _state["mode"] = mode
+        _state["filename"] = filename
+        _state["xla_trace_dir"] = xla_trace_dir
+
+
+def profiler_set_state(state="stop"):
+    """Parity: MXSetProfilerState; 'run' or 'stop'."""
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    with _lock:
+        _state["running"] = state == "run"
+        if _state["xla_trace_dir"]:
+            import jax
+
+            if _state["running"] and not _state["xla_tracing"]:
+                jax.profiler.start_trace(_state["xla_trace_dir"])
+                _state["xla_tracing"] = True
+            elif not _state["running"] and _state["xla_tracing"]:
+                jax.profiler.stop_trace()
+                _state["xla_tracing"] = False
+
+
+def is_running() -> bool:
+    return _state["running"]
+
+
+def mode() -> str:
+    return _state["mode"]
+
+
+def record(name: str, device: str, start_us: float, end_us: float,
+           category: str = "operator"):
+    """Append one complete ('X') chrome-trace event (parity: OprExecStat +
+    EmitEvent, src/engine/profiler.h:90-110)."""
+    with _lock:
+        _events.append({
+            "name": name,
+            "cat": category,
+            "ph": "X",
+            "ts": start_us,
+            "dur": max(end_us - start_us, 0.0),
+            "pid": device,
+            "tid": threading.get_ident() & 0xFFFF,
+        })
+
+
+@contextmanager
+def span(name: str, device: str = "cpu/0", category: str = "operator",
+         sync=None):
+    """Time a region if the profiler is running.  ``sync`` is an optional
+    zero-arg callable run before closing the span (e.g. block_until_ready)
+    so async dispatch doesn't under-report."""
+    if not _state["running"]:
+        yield
+        return
+    start = _now_us()
+    try:
+        yield
+    finally:
+        if sync is not None:
+            try:
+                sync()
+            except Exception:
+                pass
+        record(name, device, start, _now_us(), category)
+
+
+def dump_profile(filename=None):
+    """Parity: MXDumpProfile — write accumulated events as
+    chrome://tracing JSON and clear the buffer."""
+    fname = filename or _state["filename"]
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        _events.clear()
+    with open(fname, "w") as f:
+        json.dump(payload, f)
+    return fname
+
+
+def clear():
+    with _lock:
+        _events.clear()

@@ -1,0 +1,89 @@
+"""Aux subsystems: profiler (chrome trace), rtc (Pallas source), viz."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd, symbol as sym
+
+
+def test_profiler_chrome_trace(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.profiler_set_config(mode="all", filename=fname)
+    mx.profiler.profiler_set_state("run")
+    try:
+        a = nd.array(np.ones((32, 32), np.float32))
+        b = nd.dot(a, a)
+        nd.sum(b).asnumpy()
+    finally:
+        mx.profiler.profiler_set_state("stop")
+    out = mx.profiler.dump_profile()
+    assert out == fname
+    payload = json.load(open(fname))
+    names = [e["name"] for e in payload["traceEvents"]]
+    assert "dot" in names and "sum" in names
+    for e in payload["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_profiler_symbolic_mode_records_executor_spans(tmp_path):
+    fname = str(tmp_path / "p2.json")
+    mx.profiler.profiler_set_config(mode="symbolic", filename=fname)
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    exe = net.simple_bind(ctx=mx.context.cpu(), data=(2, 3))
+    exe.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    mx.profiler.profiler_set_state("run")
+    try:
+        exe.forward(is_train=True)
+        exe.backward()
+        exe.forward(is_train=False)
+    finally:
+        mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    names = [e["name"] for e in json.load(open(fname))["traceEvents"]]
+    assert any(n.startswith("forward_backward[") for n in names)
+    assert any(n.startswith("forward[") for n in names)
+
+
+def test_rtc_pallas_kernel():
+    src = """
+def axpy(x_ref, y_ref, o_ref):
+    o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+"""
+    x = nd.array(np.arange(8, dtype=np.float32))
+    y = nd.array(np.ones(8, np.float32))
+    out = nd.empty((8,))
+    rtc = mx.rtc.Rtc("axpy", [("x", x), ("y", y)], [("out", out)], src)
+    rtc.push([x, y], [out])
+    np.testing.assert_allclose(out.asnumpy(), 2.0 * np.arange(8) + 1.0)
+
+
+def test_rtc_bad_source_errors():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.Rtc("f", [], [("o", nd.empty((2,)))], "def f(:")
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.Rtc("f", [], [("o", nd.empty((2,)))], "g = 3")
+
+
+def test_plot_network_dot():
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Activation(
+            sym.FullyConnected(data, num_hidden=8, name="fc1"),
+            act_type="relu", name="relu1"), num_hidden=4, name="fc2"),
+        sym.Variable("softmax_label"), name="softmax")
+    g = mx.viz.plot_network(net, shape={"data": (2, 6)})
+    src = g.source
+    assert "fc1" in src and "softmax" in src and "digraph" in src
+    assert "fc1_weight" not in src  # hidden weights
+
+
+def test_print_summary(capsys):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    total = mx.viz.print_summary(net, shape={"data": (2, 6)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "Total params: 56" in out
+    assert total == 6 * 8 + 8

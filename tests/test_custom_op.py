@@ -1,0 +1,147 @@
+"""Custom-op frontend (parity pattern: example/numpy-ops/custom_softmax.py
+and tests for python/mxnet/operator.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd, symbol as sym
+
+
+class _Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(int)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], y)
+        self.assign(in_grad[1], "null", None)
+
+
+@mx.operator.register("test_softmax")
+class _SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = [in_shape[0][0]]
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Softmax()
+
+
+def test_custom_op_imperative():
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-1, 1, size=(4, 5)).astype(np.float32)
+    lbl = np.array([0, 1, 2, 3], np.float32)
+    out = nd.Custom(nd.array(x), nd.array(lbl), op_type="test_softmax")
+    ref = np.exp(x - x.max(1, keepdims=True))
+    ref /= ref.sum(1, keepdims=True)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+
+
+def test_custom_op_symbolic_forward_backward():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    net = sym.Custom(data, label, op_type="test_softmax", name="csm")
+    exe = net.simple_bind(ctx=mx.context.cpu(), data=(4, 5), label=(4,),
+                          grad_req={"data": "write", "label": "null"})
+    rs = np.random.RandomState(1)
+    x = rs.uniform(-1, 1, size=(4, 5)).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["label"][:] = np.array([1, 0, 3, 2], np.float32)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    ref = np.exp(x - x.max(1, keepdims=True))
+    ref /= ref.sum(1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    exe.backward()
+    g = exe.grad_dict["data"].asnumpy()
+    expect = ref.copy()
+    expect[np.arange(4), [1, 0, 3, 2]] -= 1.0
+    np.testing.assert_allclose(g, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_op_in_module_fit():
+    """A custom loss layer trains through Module.fit."""
+    from mxnet_tpu import module, io as mio
+
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = sym.Custom(fc, sym.Variable("softmax_label"),
+                     op_type="test_softmax", name="loss")
+    rs = np.random.RandomState(2)
+    X = rs.uniform(size=(32, 6)).astype(np.float32)
+    Y = (X[:, 0] > 0.5).astype(np.float32) + (X[:, 1] > 0.5)
+    it = mio.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    m = module.Module(net, context=mx.context.cpu(),
+                      label_names=("softmax_label",))
+    m.fit(it, num_epoch=3, optimizer="sgd",
+          optimizer_params={"learning_rate": 0.5})
+    acc = mx.metric.Accuracy()
+    m.score(it, acc)
+    assert acc.get()[1] > 0.4  # learns something
+
+
+def test_custom_op_need_top_grad():
+    """need_top_grad=True ops receive the true head gradient."""
+
+    class _Scale(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0].asnumpy() * 2.0)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], out_grad[0].asnumpy() * 2.0)
+
+    @mx.operator.register("test_scale2")
+    class _ScaleProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _Scale()
+
+    data = sym.Variable("data")
+    net = sym.sum(sym.Custom(data, op_type="test_scale2") * 3.0)
+    exe = net.simple_bind(ctx=mx.context.cpu(), data=(2, 3))
+    exe.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    exe.forward(is_train=True)
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               np.full((2, 3), 6.0), rtol=1e-6)
+
+
+def test_numpy_op_shim():
+    class _Sq(mx.operator.NumpyOp):
+        def forward(self, in_data, out_data):
+            out_data[0][...] = in_data[0] ** 2
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][...] = 2.0 * in_data[0] * out_grad[0]
+
+    op = _Sq()
+    net = op(sym.Variable("data"), name="sq")
+    exe = net.simple_bind(ctx=mx.context.cpu(), data=(3,))
+    exe.arg_dict["data"][:] = np.array([1.0, 2.0, 3.0], np.float32)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, [1, 4, 9], rtol=1e-6)
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               [2, 4, 6], rtol=1e-6)
+
+
+def test_unregistered_op_type_errors():
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.array(np.zeros((2, 2), np.float32)), op_type="nope")
