@@ -73,6 +73,18 @@ class Initializer:
         arr[:] = 0.0
 
 
+class Constant(Initializer):
+    """Fill with a constant value regardless of the name pattern (used by
+    per-variable ``init=`` attributes, e.g. SSD's conv4_3 L2-norm scale)."""
+
+    def __init__(self, value=0.0):
+        self.value = value
+        self._kwargs = {"value": value}
+
+    def __call__(self, name, arr):
+        arr[:] = self.value
+
+
 class Uniform(Initializer):
     def __init__(self, scale=0.07):
         self.scale = scale
@@ -205,3 +217,18 @@ class Mixed:
                 init(name, arr)
                 return
         raise MXNetError(f"no initializer pattern matches {name}")
+
+
+def create(dumps_json: str) -> Initializer:
+    """Rebuild an initializer from Initializer.dumps() JSON — consumed by
+    Module.init_params for per-variable ``init=`` symbol attributes
+    (parity: the reference's InitDesc + __init__ attr protocol)."""
+    name, kwargs = json.loads(dumps_json)
+    registry = {
+        "uniform": Uniform, "normal": Normal, "one": One, "zero": Zero,
+        "constant": Constant, "orthogonal": Orthogonal, "xavier": Xavier,
+        "msraprelu": MSRAPrelu, "bilinear": Bilinear,
+    }
+    if name not in registry:
+        raise MXNetError(f"unknown initializer '{name}'")
+    return registry[name](**kwargs)

@@ -5,7 +5,8 @@ alexnet, vgg, inception-bn, inception-v3, resnet, resnext + the rnn/lstm
 examples.  Each get_symbol returns a Symbol ending in SoftmaxOutput named
 'softmax', matching the reference training scripts' expectations.
 """
-from . import mlp, lenet, alexnet, vgg, inception_bn, inception_v3, resnet, resnext, lstm
+from . import (mlp, lenet, alexnet, vgg, googlenet, inception_bn,
+               inception_v3, inception_resnet, resnet, resnext, lstm, ssd)
 
 
 def get_symbol(name, num_classes=1000, **kwargs):
@@ -15,10 +16,13 @@ def get_symbol(name, num_classes=1000, **kwargs):
         "lenet": lenet.get_symbol,
         "alexnet": alexnet.get_symbol,
         "vgg": vgg.get_symbol,
+        "googlenet": googlenet.get_symbol,
         "inception-bn": inception_bn.get_symbol,
         "inception-v3": inception_v3.get_symbol,
+        "inception-resnet-v2": inception_resnet.get_symbol,
         "resnet": resnet.get_symbol,
         "resnext": resnext.get_symbol,
+        "ssd-vgg16": ssd.get_symbol_train,
     }
     if name.startswith("resnet-"):
         return resnet.get_symbol(num_classes, num_layers=int(name.split("-")[1]), **kwargs)

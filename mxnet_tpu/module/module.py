@@ -155,6 +155,18 @@ class Module(BaseModule):
         initializer = initializer if initializer is not None else Uniform(0.01)
 
         ex = self._exec_group.execs[0]
+        # per-variable init= attrs override the global initializer
+        # (parity: the reference's InitDesc/__init__ attr protocol)
+        from .. import initializer as _init_mod
+
+        var_inits = {}
+        for node in self._symbol.nodes:
+            if node.is_variable and node.extra_attrs.get("__init__"):
+                try:
+                    var_inits[node.name] = _init_mod.create(
+                        node.extra_attrs["__init__"])
+                except MXNetError:
+                    pass
         self._arg_params = {}
         self._aux_params = {}
         for name in self._param_names:
@@ -166,8 +178,9 @@ class Module(BaseModule):
             else:
                 if arg_params is not None and not allow_missing and arg_params:
                     raise MXNetError(f"param {name} missing")
-                if initializer is not None:
-                    initializer(name, arr)
+                init_fn = var_inits.get(name, initializer)
+                if init_fn is not None:
+                    init_fn(name, arr)
             self._arg_params[name] = arr
         for name in self._aux_names:
             arr = nd.zeros(ex.aux_dict[name].shape)

@@ -204,11 +204,13 @@ def _make_loss(ctx, data, **attrs):
         return data, data.shape
 
     def b(shape, g):
+        import math
+
         scale = grad_scale
         if normalization == "batch":
             scale = scale / shape[0]
         elif normalization == "valid":
-            scale = scale / max(int(jnp.prod(jnp.array(shape))), 1)
+            scale = scale / max(math.prod(shape), 1)
         return (jnp.full(shape, scale, dtype=jnp.float32),)
 
     fwd.defvjp(f, b)

@@ -20,6 +20,8 @@ from mxnet_tpu import models
         ("resnet-50", (2, 3, 224, 224), 1000),
         ("resnet-18", (2, 3, 32, 32), 10),
         ("resnext-50", (2, 3, 224, 224), 1000),
+        ("googlenet", (2, 3, 224, 224), 1000),
+        ("inception-resnet-v2", (2, 3, 299, 299), 1000),
     ],
 )
 def test_model_shapes(name, shape, classes):
@@ -89,3 +91,66 @@ def test_fused_trainer_dp_mesh():
     # params remain replicated after the step
     p = next(iter(tr.params.values()))
     assert p.sharding.is_fully_replicated
+
+
+def test_ssd_vgg16_anchors_and_outputs():
+    """SSD-300: canonical 8732 anchors; train graph emits cls_prob,
+    loc_loss, cls_label, det; deploy graph emits (N, 8732, 6)."""
+    from mxnet_tpu.models import ssd
+
+    s = ssd.get_symbol_train(num_classes=20)
+    _, outs, _ = s.infer_shape(data=(1, 3, 300, 300), label=(1, 3, 5))
+    assert outs[0] == (1, 21, 8732)      # cls_prob
+    assert outs[1] == (1, 8732 * 4)      # loc smooth-l1
+    assert outs[2] == (1, 8732)          # cls_target (blocked)
+    assert outs[3] == (1, 8732, 6)       # detections (blocked)
+
+    d = ssd.get_symbol(num_classes=20)
+    _, outs2, _ = d.infer_shape(data=(1, 3, 300, 300))
+    assert outs2 == [(1, 8732, 6)]
+
+
+def test_ssd_train_step_runs():
+    """One fwd/bwd step of the SSD training graph on a tiny 96x96 input
+    (anchors shrink with the feature maps; the graph is input-size
+    agnostic)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import ssd
+
+    s = ssd.get_symbol_train(num_classes=3)
+    exe = s.simple_bind(mx.cpu(), data=(1, 3, 96, 96), label=(1, 2, 5),
+                        grad_req="write")
+    rs = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            arr[:] = rs.uniform(size=arr.shape).astype(np.float32)
+        elif name == "label":
+            arr[:] = np.array([[[1, 0.1, 0.1, 0.4, 0.4],
+                                [-1, 0, 0, 0, 0]]], np.float32)
+        elif name.endswith("_scale"):
+            pass  # keep init
+        else:
+            arr[:] = rs.uniform(-0.02, 0.02, arr.shape).astype(np.float32)
+    outs = exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["conv1_1_weight"].asnumpy()
+    assert np.isfinite(g).all()
+    assert np.isfinite(outs[0].asnumpy()).all()
+
+
+def test_variable_init_attr_honored_by_module():
+    """Variable(init=...) overrides the global initializer in
+    Module.init_params (SSD's constant-20 L2-norm scale relies on it)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym, module
+
+    data = sym.Variable("data")
+    scale = sym.Variable("myscale", shape=(1, 4),
+                         init='["constant", {"value": 20.0}]')
+    net = sym.LinearRegressionOutput(sym.broadcast_mul(data, scale),
+                                     sym.Variable("label"), name="lro")
+    m = module.Module(net, context=mx.context.cpu(), label_names=("label",))
+    m.bind(data_shapes=[("data", (2, 4))], label_shapes=[("label", (2, 4))])
+    m.init_params()
+    args, _ = m.get_params()
+    np.testing.assert_allclose(args["myscale"].asnumpy(), 20.0)
