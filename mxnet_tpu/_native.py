@@ -1,0 +1,349 @@
+"""ctypes bindings for libmxtpu, the native C++ runtime.
+
+Parity rationale (SURVEY.md §2.1): the reference's engine, storage
+manager and RecordIO layer are C++; this module loads our TPU-native C++
+equivalents (src/*.cc) and exposes them to Python.  Everything degrades
+gracefully: if the library is missing it is built on demand with g++, and
+if that fails the callers fall back to their pure-Python paths.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "lib", "libmxtpu.so")
+
+ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _build():
+    src_dir = os.path.join(_REPO_ROOT, "src")
+    if not os.path.isdir(src_dir):
+        return False
+    try:
+        subprocess.run(["make", "-C", src_dir], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.isfile(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _bind(lib):
+    lib.mxe_create.restype = ctypes.c_void_p
+    lib.mxe_create.argtypes = [ctypes.c_int]
+    lib.mxe_destroy.argtypes = [ctypes.c_void_p]
+    lib.mxe_new_var.restype = ctypes.c_int64
+    lib.mxe_new_var.argtypes = [ctypes.c_void_p]
+    lib.mxe_push.restype = ctypes.c_int
+    lib.mxe_push.argtypes = [
+        ctypes.c_void_p, ENGINE_FN, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+    lib.mxe_wait_for_var.restype = ctypes.c_int
+    lib.mxe_wait_for_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.mxe_wait_all.argtypes = [ctypes.c_void_p]
+    lib.mxe_pending.restype = ctypes.c_int64
+    lib.mxe_pending.argtypes = [ctypes.c_void_p]
+
+    lib.mxr_open.restype = ctypes.c_void_p
+    lib.mxr_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.mxr_close.argtypes = [ctypes.c_void_p]
+    lib.mxr_reset.argtypes = [ctypes.c_void_p]
+    lib.mxr_next.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.mxr_next.argtypes = [ctypes.c_void_p,
+                             ctypes.POINTER(ctypes.c_uint64)]
+    lib.mxr_next_batch.restype = ctypes.c_int64
+    lib.mxr_next_batch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
+    lib.mxr_index.restype = ctypes.c_int64
+    lib.mxr_index.argtypes = [ctypes.c_char_p,
+                              ctypes.POINTER(ctypes.c_uint64),
+                              ctypes.c_int64]
+    lib.mxr_writer_open.restype = ctypes.c_void_p
+    lib.mxr_writer_open.argtypes = [ctypes.c_char_p]
+    lib.mxr_write.restype = ctypes.c_int
+    lib.mxr_write.argtypes = [ctypes.c_void_p,
+                              ctypes.POINTER(ctypes.c_uint8),
+                              ctypes.c_uint64]
+    lib.mxr_writer_close.argtypes = [ctypes.c_void_p]
+
+    lib.mxs_alloc.restype = ctypes.c_void_p
+    lib.mxs_alloc.argtypes = [ctypes.c_uint64]
+    lib.mxs_free.argtypes = [ctypes.c_void_p]
+    lib.mxs_direct_free.argtypes = [ctypes.c_void_p]
+    lib.mxs_pool_bytes.restype = ctypes.c_uint64
+    lib.mxs_release_all.argtypes = []
+    return lib
+
+
+def get_lib():
+    """The loaded libmxtpu, or None when native support is unavailable."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB if _LIB is not False else None
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB if _LIB is not False else None
+        if not os.path.isfile(_LIB_PATH) and not _build():
+            _LIB = False
+            return None
+        try:
+            _LIB = _bind(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _LIB = False
+            return None
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# --------------------------------------------------------------------------
+# Engine wrapper
+# --------------------------------------------------------------------------
+class NativeEngine:
+    """Host-side async dependency engine (parity: Engine::PushAsync /
+    NewVariable / WaitForVar / WaitForAll, include/mxnet/engine.h:75-229).
+
+    Python callables are pushed with read (const_vars) / write
+    (mutable_vars) dependencies; the C++ scheduler guarantees writers
+    serialize and readers parallelize per var.  Exceptions inside
+    callbacks are captured and re-raised at the next wait point.
+    """
+
+    def __init__(self, num_threads=0):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("libmxtpu unavailable")
+        self._lib = lib
+        self._handle = lib.mxe_create(int(num_threads))
+        self._callbacks = {}          # keep CFUNCTYPE refs alive
+        self._cb_lock = threading.Lock()
+        self._cb_id = 0
+        self._errors = []
+        # tear down while the interpreter can still service callbacks —
+        # a worker hitting a Python trampoline during interpreter
+        # finalization would crash
+        import atexit
+
+        atexit.register(self._shutdown)
+
+    def _shutdown(self):
+        if getattr(self, "_handle", None):
+            try:
+                self._lib.mxe_wait_all(self._handle)
+                self._lib.mxe_destroy(self._handle)
+            finally:
+                self._handle = None
+
+    def new_var(self) -> int:
+        return int(self._lib.mxe_new_var(self._handle))
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        with self._cb_lock:
+            self._cb_id += 1
+            token = self._cb_id
+
+        def trampoline(_ctx, _token=token, _fn=fn):
+            try:
+                _fn()
+            except BaseException as e:  # surfaced at wait points
+                self._errors.append(e)
+            finally:
+                with self._cb_lock:
+                    self._callbacks.pop(_token, None)
+
+        cfn = ENGINE_FN(trampoline)
+        with self._cb_lock:
+            self._callbacks[token] = cfn
+        nc, nm = len(const_vars), len(mutable_vars)
+        carr = (ctypes.c_int64 * max(nc, 1))(*const_vars)
+        marr = (ctypes.c_int64 * max(nm, 1))(*mutable_vars)
+        rc = self._lib.mxe_push(self._handle, cfn, None, carr, nc, marr, nm,
+                                int(priority))
+        if rc != 0:
+            with self._cb_lock:
+                self._callbacks.pop(token, None)
+            raise ValueError(
+                "duplicate or overlapping const/mutable var lists "
+                "(parity: ThreadedEngine::CheckDuplicate)")
+
+    def wait_for_var(self, var: int):
+        self._lib.mxe_wait_for_var(self._handle, int(var))
+        self._raise_pending()
+
+    def wait_all(self):
+        self._lib.mxe_wait_all(self._handle)
+        self._raise_pending()
+
+    def pending(self) -> int:
+        return int(self._lib.mxe_pending(self._handle))
+
+    def _raise_pending(self):
+        if self._errors:
+            err = self._errors.pop(0)
+            raise err
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# RecordIO wrappers
+# --------------------------------------------------------------------------
+class NativeRecordReader:
+    """Sharded sequential RecordIO reader (parity: dmlc::InputSplit +
+    RecordIOChunkReader as used by iter_image_recordio.cc:259-368)."""
+
+    def __init__(self, path, part_index=0, num_parts=1):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("libmxtpu unavailable")
+        self._lib = lib
+        self._handle = lib.mxr_open(path.encode(), int(part_index),
+                                    int(num_parts))
+        if not self._handle:
+            raise IOError(f"cannot open {path}")
+
+    def read(self):
+        """Next record payload as bytes, or None at end of shard."""
+        length = ctypes.c_uint64()
+        ptr = self._lib.mxr_next(self._handle, ctypes.byref(length))
+        if not ptr:
+            return None
+        return ctypes.string_at(ptr, length.value)
+
+    def read_batch(self, max_records=1024, buf_bytes=1 << 24):
+        """Up to max_records payloads with ONE FFI crossing (the
+        per-record crossing is what makes naive native readers lose to
+        Python's buffered file IO)."""
+        if not hasattr(self, "_batch_buf") or len(self._batch_buf) < buf_bytes:
+            self._batch_buf = (ctypes.c_uint8 * buf_bytes)()
+            self._batch_lens = (ctypes.c_uint64 * max(max_records, 1024))()
+        if len(self._batch_lens) < max_records:
+            self._batch_lens = (ctypes.c_uint64 * max_records)()
+        n = self._lib.mxr_next_batch(self._handle, self._batch_buf,
+                                     buf_bytes, self._batch_lens,
+                                     max_records)
+        if n <= 0:
+            return []
+        raw = memoryview(self._batch_buf)
+        # numpy view over lens: ctypes element access is ~1us each and
+        # dominates at high record rates
+        lens = np.frombuffer(self._batch_lens, dtype=np.uint64, count=n)
+        ends = np.cumsum(lens)
+        starts = ends - lens
+        return [bytes(raw[int(s):int(e)]) for s, e in zip(starts, ends)]
+
+    def reset(self):
+        self._lib.mxr_reset(self._handle)
+
+    def close(self):
+        if self._handle:
+            self._lib.mxr_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        while True:
+            rec = self.read()
+            if rec is None:
+                return
+            yield rec
+
+
+def native_index(path, max_records=1 << 24):
+    """Offsets of every record in a RecordIO file (fast .idx rebuild)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("libmxtpu unavailable")
+    buf = (ctypes.c_uint64 * max_records)()
+    n = lib.mxr_index(path.encode(), buf, max_records)
+    if n < 0:
+        raise IOError(f"cannot open {path}")
+    return np.ctypeslib.as_array(buf, shape=(max_records,))[:n].copy()
+
+
+class NativeRecordWriter:
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("libmxtpu unavailable")
+        self._lib = lib
+        self._handle = lib.mxr_writer_open(path.encode())
+        if not self._handle:
+            raise IOError(f"cannot open {path} for writing")
+
+    def write(self, buf: bytes):
+        arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+        if self._lib.mxr_write(self._handle, arr, len(buf)) != 0:
+            raise IOError("record write failed")
+
+    def close(self):
+        if self._handle:
+            self._lib.mxr_writer_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# Storage arena wrapper
+# --------------------------------------------------------------------------
+class NativeArena:
+    """Pooled host staging buffers (parity: Storage::Alloc/Free with
+    GPUPooledStorageManager recycling).  Returns numpy views over
+    arena-owned memory; free() recycles into the size-class pool."""
+
+    def __init__(self):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("libmxtpu unavailable")
+        self._lib = lib
+
+    def alloc(self, shape, dtype=np.float32):
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        ptr = self._lib.mxs_alloc(max(nbytes, 1))
+        if not ptr:
+            raise MemoryError(f"arena alloc of {nbytes} bytes failed")
+        buf = (ctypes.c_uint8 * max(nbytes, 1)).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)))
+        arr = arr.reshape(shape)
+        arr.flags.writeable = True
+        self._ptr_of = getattr(self, "_ptr_of", {})
+        self._ptr_of[id(arr)] = ptr
+        return arr
+
+    def free(self, arr):
+        ptr = self._ptr_of.pop(id(arr), None)
+        if ptr is not None:
+            self._lib.mxs_free(ptr)
+
+    def pool_bytes(self) -> int:
+        return int(self._lib.mxs_pool_bytes())
+
+    def release_all(self):
+        self._lib.mxs_release_all()

@@ -65,12 +65,15 @@ def wait_for_var(arr):
 
 
 def wait_for_all():
-    """Parity: Engine::WaitForAll (include/mxnet/engine.h:184)."""
+    """Parity: Engine::WaitForAll (include/mxnet/engine.h:184) — drains
+    both the device stream (live arrays) and the host task engine."""
     for arr in list(_live_arrays.values()):
         try:
             jax.block_until_ready(arr)
         except Exception:
             pass
+    if _host_engine:
+        _host_engine.wait_all()
 
 
 class _Variable:
@@ -87,3 +90,58 @@ class _Variable:
 
     def on_write(self):
         self.version += 1
+
+
+# ---------------------------------------------------------------------------
+# Host task engine — the native C++ scheduler for host-side async work.
+#
+# Device compute ordering belongs to XLA; what the reference *also* ran
+# through its engine was host work: IO prefetch, checkpoint writes, kvstore
+# staging (e.g. KVStoreDist pushes ZPush lambdas through PushAsync,
+# src/kvstore/kvstore_dist.h:103-121).  That role lives here, backed by
+# libmxtpu's threaded var-ordered scheduler (src/engine.cc).
+# ---------------------------------------------------------------------------
+_host_engine = None
+
+
+def host_engine():
+    """Singleton NativeEngine, or None when libmxtpu is unavailable."""
+    global _host_engine
+    if _host_engine is None:
+        try:
+            from ._native import NativeEngine
+
+            _host_engine = NativeEngine(
+                num_threads=get_env("MXNET_CPU_WORKER_NTHREADS", 0, int))
+        except Exception:
+            _host_engine = False
+    return _host_engine or None
+
+
+def push(fn, const_vars=(), mutable_vars=(), priority=0):
+    """Parity: Engine::PushAsync (include/mxnet/engine.h:125) for host
+    tasks.  Falls back to synchronous execution without libmxtpu."""
+    eng = host_engine()
+    if eng is None or _engine_is_naive():
+        fn()
+        return
+    eng.push(fn, const_vars=const_vars, mutable_vars=mutable_vars,
+             priority=priority)
+
+
+def new_host_var():
+    """Parity: Engine::NewVariable for host-task ordering."""
+    eng = host_engine()
+    return eng.new_var() if eng is not None else 0
+
+
+def wait_for_host_var(var):
+    eng = host_engine()
+    if eng is not None:
+        eng.wait_for_var(var)
+
+
+def wait_for_all_host():
+    eng = host_engine()
+    if eng is not None:
+        eng.wait_all()

@@ -377,17 +377,32 @@ class ImageRecordIter(DataIter):
                                     else (3,) + self.data_shape),
                                    resize=resize, rand_crop=rand_crop,
                                    rand_mirror=rand_mirror)
-        # load record offsets for sharding + shuffling
+        # load records for sharding + shuffling.  Native path: libmxtpu
+        # byte-range sharded scan (parity: dmlc::InputSplit used by
+        # iter_image_recordio.cc); fallback: python reader + stride shard.
         self.records = []
-        reader = MXRecordIO(path_imgrec, "r")
-        while True:
-            s = reader.read()
-            if s is None:
-                break
-            self.records.append(s)
-        reader.close()
-        if num_parts > 1:
-            self.records = self.records[part_index::num_parts]
+        native_ok = False
+        try:
+            from . import _native
+
+            if _native.available():
+                rd = _native.NativeRecordReader(path_imgrec, part_index,
+                                                num_parts)
+                self.records = list(rd)
+                rd.close()
+                native_ok = True
+        except Exception:
+            self.records = []
+        if not native_ok:
+            reader = MXRecordIO(path_imgrec, "r")
+            while True:
+                s = reader.read()
+                if s is None:
+                    break
+                self.records.append(s)
+            reader.close()
+            if num_parts > 1:
+                self.records = self.records[part_index::num_parts]
         self.shuffle = shuffle
         self.seed = seed
         self.order = list(range(len(self.records)))

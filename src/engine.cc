@@ -1,0 +1,297 @@
+/*
+ * Threaded dependency engine (parity: src/engine/threaded_engine.{h,cc} +
+ * threaded_engine_perdevice.cc in the reference).
+ *
+ * Semantics reproduced exactly:
+ *  - per-var FIFO queues; readers run in parallel, writers serialize
+ *    (ThreadedVar::AppendRead/WriteDependency, threaded_engine.cc:82-103)
+ *  - an op runs when all its var dependencies grant access; completion
+ *    wakes successors (CompleteRead/WriteDependency)
+ *  - overlapping const/mutable lists rejected (CheckDuplicate,
+ *    threaded_engine.cc:207-239)
+ *  - ready ops drain through a priority queue onto a worker pool
+ *    (the reference's per-device pools collapse to one host pool here —
+ *    device scheduling belongs to PjRt/XLA on TPU).
+ */
+#include "mxtpu.h"
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Opr;
+
+// One entry in a var's pending queue.
+struct VarBlock {
+  Opr *opr;
+  bool is_write;
+};
+
+struct Var {
+  std::deque<VarBlock> queue;   // pending ops, FIFO
+  int running_reads = 0;        // granted, not yet completed reads
+  bool running_write = false;   // granted, not yet completed write
+};
+
+struct Opr {
+  mxe_fn_t fn;
+  void *ctx;
+  std::vector<int64_t> const_vars;
+  std::vector<int64_t> mutable_vars;
+  int priority;
+  uint64_t seq;                     // FIFO tiebreak within a priority
+  std::atomic<int> wait{0};         // deps not yet granted
+};
+
+struct OprLess {
+  bool operator()(const Opr *a, const Opr *b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;  // earlier push first
+  }
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_threads) {
+    if (num_threads <= 0) {
+      num_threads = static_cast<int>(std::thread::hardware_concurrency());
+      if (num_threads <= 0) num_threads = 4;
+    }
+    for (int i = 0; i < num_threads; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitAll();
+    {
+      std::unique_lock<std::mutex> lk(ready_mu_);
+      shutdown_ = true;
+    }
+    ready_cv_.notify_all();
+    for (auto &t : workers_) t.join();
+  }
+
+  int64_t NewVar() {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    int64_t id = next_var_++;
+    vars_.emplace(id, Var{});
+    return id;
+  }
+
+  int Push(mxe_fn_t fn, void *ctx, const int64_t *cvars, int nc,
+           const int64_t *mvars, int nm, int priority) {
+    // CheckDuplicate parity: no dup within or across lists
+    std::vector<int64_t> c(cvars, cvars + nc), m(mvars, mvars + nm);
+    std::sort(c.begin(), c.end());
+    std::sort(m.begin(), m.end());
+    if (std::adjacent_find(c.begin(), c.end()) != c.end()) return -1;
+    if (std::adjacent_find(m.begin(), m.end()) != m.end()) return -1;
+    std::vector<int64_t> inter;
+    std::set_intersection(c.begin(), c.end(), m.begin(), m.end(),
+                          std::back_inserter(inter));
+    if (!inter.empty()) return -1;
+
+    auto *opr = new Opr;
+    opr->fn = fn;
+    opr->ctx = ctx;
+    opr->const_vars.assign(cvars, cvars + nc);
+    opr->mutable_vars.assign(mvars, mvars + nm);
+    opr->priority = priority;
+    pending_.fetch_add(1, std::memory_order_relaxed);
+
+    int blocked = 0;
+    {
+      std::lock_guard<std::mutex> lk(vars_mu_);
+      opr->seq = next_seq_++;
+      // reserve wait so concurrent grants can't fire before all deps are
+      // appended
+      opr->wait.store(nc + nm + 1, std::memory_order_relaxed);
+      for (int64_t v : opr->const_vars) {
+        Var &var = vars_.at(v);
+        if (var.queue.empty() && !var.running_write) {
+          ++var.running_reads;            // grant immediately
+          opr->wait.fetch_sub(1, std::memory_order_acq_rel);
+        } else {
+          var.queue.push_back({opr, false});
+          ++blocked;
+        }
+      }
+      for (int64_t v : opr->mutable_vars) {
+        Var &var = vars_.at(v);
+        if (var.queue.empty() && !var.running_write &&
+            var.running_reads == 0) {
+          var.running_write = true;       // grant immediately
+          opr->wait.fetch_sub(1, std::memory_order_acq_rel);
+        } else {
+          var.queue.push_back({opr, true});
+          ++blocked;
+        }
+      }
+    }
+    if (opr->wait.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      Enqueue(opr);
+    }
+    return 0;
+  }
+
+  int WaitForVar(int64_t var) {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    struct W {
+      std::mutex *mu;
+      std::condition_variable *cv;
+      bool *done;
+    } w{&mu, &cv, &done};
+    int rc = Push(
+        [](void *p) {
+          auto *w = static_cast<W *>(p);
+          std::lock_guard<std::mutex> lk(*w->mu);
+          *w->done = true;
+          w->cv->notify_all();
+        },
+        &w, &var, 1, nullptr, 0, /*priority=*/1 << 30);
+    if (rc != 0) return rc;
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+    return 0;
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(all_mu_);
+    all_cv_.wait(lk, [this] {
+      return pending_.load(std::memory_order_acquire) == 0;
+    });
+  }
+
+  int64_t Pending() const {
+    return pending_.load(std::memory_order_acquire);
+  }
+
+ private:
+  void Enqueue(Opr *opr) {
+    {
+      std::lock_guard<std::mutex> lk(ready_mu_);
+      ready_.push(opr);
+    }
+    ready_cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr *opr;
+      {
+        std::unique_lock<std::mutex> lk(ready_mu_);
+        ready_cv_.wait(lk, [this] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        opr = ready_.top();
+        ready_.pop();
+      }
+      opr->fn(opr->ctx);
+      OnComplete(opr);
+      delete opr;
+      if (pending_.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        std::lock_guard<std::mutex> lk(all_mu_);
+        all_cv_.notify_all();
+      }
+    }
+  }
+
+  // Release this op's grants and wake successors (parity:
+  // ThreadedVar::CompleteReadDependency / CompleteWriteDependency).
+  void OnComplete(Opr *opr) {
+    std::vector<Opr *> to_run;
+    {
+      std::lock_guard<std::mutex> lk(vars_mu_);
+      for (int64_t v : opr->const_vars) {
+        Var &var = vars_.at(v);
+        --var.running_reads;
+        DrainLocked(&var, &to_run);
+      }
+      for (int64_t v : opr->mutable_vars) {
+        Var &var = vars_.at(v);
+        var.running_write = false;
+        DrainLocked(&var, &to_run);
+      }
+    }
+    for (Opr *o : to_run) Enqueue(o);
+  }
+
+  // Grant queued accesses now admissible; collect ops whose last dep just
+  // resolved.  Must hold vars_mu_.
+  void DrainLocked(Var *var, std::vector<Opr *> *to_run) {
+    while (!var->queue.empty()) {
+      VarBlock blk = var->queue.front();
+      if (blk.is_write) {
+        if (var->running_reads > 0 || var->running_write) break;
+        var->running_write = true;
+      } else {
+        if (var->running_write) break;
+        ++var->running_reads;
+      }
+      var->queue.pop_front();
+      if (blk.opr->wait.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        to_run->push_back(blk.opr);
+      }
+      if (blk.is_write) break;  // writer holds the var exclusively
+    }
+  }
+
+  std::mutex vars_mu_;
+  std::unordered_map<int64_t, Var> vars_;
+  int64_t next_var_ = 1;
+  uint64_t next_seq_ = 0;
+
+  std::mutex ready_mu_;
+  std::condition_variable ready_cv_;
+  std::priority_queue<Opr *, std::vector<Opr *>, OprLess> ready_;
+  bool shutdown_ = false;
+
+  std::mutex all_mu_;
+  std::condition_variable all_cv_;
+  std::atomic<int64_t> pending_{0};
+
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void *mxe_create(int num_threads) { return new Engine(num_threads); }
+
+void mxe_destroy(void *engine) { delete static_cast<Engine *>(engine); }
+
+int64_t mxe_new_var(void *engine) {
+  return static_cast<Engine *>(engine)->NewVar();
+}
+
+int mxe_push(void *engine, mxe_fn_t fn, void *ctx, const int64_t *const_vars,
+             int num_const, const int64_t *mutable_vars, int num_mutable,
+             int priority) {
+  return static_cast<Engine *>(engine)->Push(fn, ctx, const_vars, num_const,
+                                             mutable_vars, num_mutable,
+                                             priority);
+}
+
+int mxe_wait_for_var(void *engine, int64_t var) {
+  return static_cast<Engine *>(engine)->WaitForVar(var);
+}
+
+void mxe_wait_all(void *engine) { static_cast<Engine *>(engine)->WaitAll(); }
+
+int64_t mxe_pending(void *engine) {
+  return static_cast<Engine *>(engine)->Pending();
+}
+
+}  // extern "C"

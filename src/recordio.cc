@@ -1,0 +1,186 @@
+/*
+ * RecordIO native reader/writer (parity: dmlc-core recordio framing as
+ * consumed by src/io/iter_image_recordio.cc, plus dmlc::InputSplit's
+ * part_index/num_parts byte-range sharding used for distributed readers).
+ *
+ * Frame format (bit-compatible with python/mxnet/recordio.py and our
+ * mxnet_tpu/recordio.py): [magic u32 = 0xced7230a][len u32][payload]
+ * [pad to 4B].
+ */
+#include "mxtpu.h"
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Reader {
+  FILE *fp = nullptr;
+  uint64_t begin = 0;   // shard start (aligned to a record)
+  uint64_t end = 0;     // shard end: records *starting* before end belong
+  uint64_t pos = 0;
+  std::vector<uint8_t> buf;
+};
+
+uint64_t FileSize(FILE *fp) {
+  long cur = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_END);
+  long size = std::ftell(fp);
+  std::fseek(fp, cur, SEEK_SET);
+  return static_cast<uint64_t>(size);
+}
+
+// Scan forward from `from` to the first record boundary at or after it.
+// A boundary is a magic word followed by a plausible length.
+uint64_t AlignToRecord(FILE *fp, uint64_t from, uint64_t fsize) {
+  if (from == 0) return 0;
+  std::fseek(fp, static_cast<long>(from), SEEK_SET);
+  // stream bytes looking for magic; check length sanity
+  uint64_t off = from;
+  uint32_t window = 0;
+  int have = 0;
+  for (; off < fsize; ++off) {
+    int c = std::fgetc(fp);
+    if (c == EOF) break;
+    window = (window >> 8) | (static_cast<uint32_t>(c) << 24);
+    ++have;
+    if (have >= 4 && window == kMagic) {
+      uint64_t start = off - 3;
+      // validate: length word must keep the record inside the file
+      uint32_t len;
+      if (std::fread(&len, 4, 1, fp) != 1) break;
+      uint64_t payload_end = start + 8 + len;
+      std::fseek(fp, static_cast<long>(off + 1), SEEK_SET);
+      if (payload_end <= fsize) return start;
+    }
+  }
+  return fsize;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *mxr_open(const char *path, int part_index, int num_parts) {
+  FILE *fp = std::fopen(path, "rb");
+  if (!fp) return nullptr;
+  auto *r = new Reader;
+  r->fp = fp;
+  uint64_t fsize = FileSize(fp);
+  if (num_parts <= 1) {
+    r->begin = 0;
+    r->end = fsize;
+  } else {
+    uint64_t chunk = fsize / num_parts;
+    uint64_t lo = chunk * part_index;
+    uint64_t hi = (part_index == num_parts - 1) ? fsize
+                                                : chunk * (part_index + 1);
+    r->begin = AlignToRecord(fp, lo, fsize);
+    r->end = (part_index == num_parts - 1) ? fsize
+                                           : AlignToRecord(fp, hi, fsize);
+  }
+  r->pos = r->begin;
+  std::fseek(fp, static_cast<long>(r->begin), SEEK_SET);
+  return r;
+}
+
+void mxr_close(void *reader) {
+  auto *r = static_cast<Reader *>(reader);
+  if (r) {
+    if (r->fp) std::fclose(r->fp);
+    delete r;
+  }
+}
+
+void mxr_reset(void *reader) {
+  auto *r = static_cast<Reader *>(reader);
+  r->pos = r->begin;
+  std::fseek(r->fp, static_cast<long>(r->begin), SEEK_SET);
+}
+
+const uint8_t *mxr_next(void *reader, uint64_t *len) {
+  auto *r = static_cast<Reader *>(reader);
+  if (r->pos >= r->end) return nullptr;
+  uint32_t header[2];
+  if (std::fread(header, 4, 2, r->fp) != 2) return nullptr;
+  if (header[0] != kMagic) return nullptr;
+  uint32_t length = header[1];
+  r->buf.resize(length);
+  if (length > 0 && std::fread(r->buf.data(), 1, length, r->fp) != length) {
+    return nullptr;
+  }
+  uint32_t pad = (4 - length % 4) % 4;
+  if (pad) std::fseek(r->fp, pad, SEEK_CUR);
+  r->pos += 8 + length + pad;
+  *len = length;
+  return r->buf.data();
+}
+
+int64_t mxr_next_batch(void *reader, uint8_t *buf, uint64_t buf_cap,
+                       uint64_t *lens, int64_t max_records) {
+  auto *r = static_cast<Reader *>(reader);
+  int64_t count = 0;
+  uint64_t used = 0;
+  while (count < max_records && r->pos < r->end) {
+    uint32_t header[2];
+    long rollback = std::ftell(r->fp);
+    if (std::fread(header, 4, 2, r->fp) != 2) break;
+    if (header[0] != kMagic) break;
+    uint32_t length = header[1];
+    uint32_t pad = (4 - length % 4) % 4;
+    if (used + length > buf_cap) {  // batch full: rewind this record
+      std::fseek(r->fp, rollback, SEEK_SET);
+      break;
+    }
+    if (length > 0 && std::fread(buf + used, 1, length, r->fp) != length) {
+      break;
+    }
+    if (pad) std::fseek(r->fp, pad, SEEK_CUR);
+    r->pos += 8 + length + pad;
+    lens[count++] = length;
+    used += length;
+  }
+  return count;
+}
+
+int64_t mxr_index(const char *path, uint64_t *offsets, int64_t cap) {
+  FILE *fp = std::fopen(path, "rb");
+  if (!fp) return -1;
+  int64_t count = 0;
+  uint64_t pos = 0;
+  uint32_t header[2];
+  while (std::fread(header, 4, 2, fp) == 2) {
+    if (header[0] != kMagic) break;
+    if (count < cap) offsets[count] = pos;
+    ++count;
+    uint32_t length = header[1];
+    uint32_t pad = (4 - length % 4) % 4;
+    if (std::fseek(fp, length + pad, SEEK_CUR) != 0) break;
+    pos += 8 + length + pad;
+  }
+  std::fclose(fp);
+  return count;
+}
+
+void *mxr_writer_open(const char *path) { return std::fopen(path, "wb"); }
+
+int mxr_write(void *writer, const uint8_t *buf, uint64_t len) {
+  FILE *fp = static_cast<FILE *>(writer);
+  uint32_t header[2] = {kMagic, static_cast<uint32_t>(len)};
+  if (std::fwrite(header, 4, 2, fp) != 2) return -1;
+  if (len > 0 && std::fwrite(buf, 1, len, fp) != len) return -1;
+  uint32_t pad = (4 - len % 4) % 4;
+  static const uint8_t zeros[4] = {0, 0, 0, 0};
+  if (pad && std::fwrite(zeros, 1, pad, fp) != pad) return -1;
+  return 0;
+}
+
+void mxr_writer_close(void *writer) {
+  if (writer) std::fclose(static_cast<FILE *>(writer));
+}
+
+}  // extern "C"

@@ -1,0 +1,104 @@
+/*
+ * Pooled host storage arena (parity: src/storage/storage.cc +
+ * pooled_storage_manager.h — GPUPooledStorageManager's size-class
+ * recycling, applied to host staging buffers; device memory on TPU is
+ * owned by PjRt/XLA buffer assignment).
+ */
+#include "mxtpu.h"
+
+#include <cstdlib>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kAlign = 64;
+
+struct Pool {
+  std::mutex mu;
+  // size-class (rounded size) -> free blocks
+  std::unordered_map<uint64_t, std::vector<void *>> free_list;
+  // live ptr -> rounded size
+  std::unordered_map<void *, uint64_t> sizes;
+  uint64_t pooled_bytes = 0;
+};
+
+Pool &pool() {
+  static Pool *p = new Pool;
+  return *p;
+}
+
+uint64_t RoundSize(uint64_t size) {
+  // round up to next power of two >= 256 (size-class recycling like
+  // GPUPooledStorageManager's exact-size buckets but with bounded class
+  // count)
+  uint64_t r = 256;
+  while (r < size) r <<= 1;
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *mxs_alloc(uint64_t size) {
+  uint64_t rounded = RoundSize(size);
+  Pool &p = pool();
+  {
+    std::lock_guard<std::mutex> lk(p.mu);
+    auto it = p.free_list.find(rounded);
+    if (it != p.free_list.end() && !it->second.empty()) {
+      void *ptr = it->second.back();
+      it->second.pop_back();
+      p.pooled_bytes -= rounded;
+      p.sizes[ptr] = rounded;
+      return ptr;
+    }
+  }
+  void *ptr = nullptr;
+  if (posix_memalign(&ptr, kAlign, rounded) != 0) return nullptr;
+  std::lock_guard<std::mutex> lk(p.mu);
+  p.sizes[ptr] = rounded;
+  return ptr;
+}
+
+void mxs_free(void *ptr) {
+  if (!ptr) return;
+  Pool &p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  auto it = p.sizes.find(ptr);
+  if (it == p.sizes.end()) return;
+  p.free_list[it->second].push_back(ptr);
+  p.pooled_bytes += it->second;
+  p.sizes.erase(it);
+}
+
+void mxs_direct_free(void *ptr) {
+  if (!ptr) return;
+  Pool &p = pool();
+  {
+    std::lock_guard<std::mutex> lk(p.mu);
+    p.sizes.erase(ptr);
+  }
+  std::free(ptr);
+}
+
+uint64_t mxs_pool_bytes(void) {
+  Pool &p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  return p.pooled_bytes;
+}
+
+void mxs_release_all(void) {
+  Pool &p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  for (auto &kv : p.free_list) {
+    for (void *ptr : kv.second) std::free(ptr);
+  }
+  p.free_list.clear();
+  p.pooled_bytes = 0;
+}
+
+}  // extern "C"
