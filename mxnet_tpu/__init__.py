@@ -5,7 +5,21 @@ the API surface is MXNet's (nd/sym/mod/kv/io) so reference user code maps
 1:1.  See SURVEY.md at the repo root for the blueprint and per-module
 docstrings for reference citations.
 """
+import os as _os
+
 import jax as _jax
+
+# Platform selection must happen before ANY backend initializes (some TPU
+# plugins ignore JAX_PLATFORMS).  MXTPU_PLATFORM=cpu pins a process to
+# host XLA — used by multi-process launches on a single-accelerator box;
+# server-role processes (parameter server) are host-only and never touch
+# the accelerator (parity: reference servers are CPU processes).
+_platform = _os.environ.get("MXTPU_PLATFORM")
+if _platform is None and _os.environ.get(
+        "MXTPU_ROLE", _os.environ.get("DMLC_ROLE")) == "server":
+    _platform = "cpu"
+if _platform:
+    _jax.config.update("jax_platforms", _platform)
 
 from .base import MXNetError, AttrScope, NameManager, __version__, get_env as _get_env
 
@@ -39,8 +53,11 @@ from . import lr_scheduler
 from . import callback
 from . import io
 from . import kvstore as kv
+from . import kvstore_server
 from . import model
 from .model import FeedForward, save_checkpoint, load_checkpoint
+from . import executor_manager
+from . import predict
 from . import module
 from . import module as mod
 from .module import Module, BucketingModule, SequentialModule, PythonModule
@@ -68,3 +85,8 @@ __all__ = [
     "engine",
     "random",
 ]
+
+# Must be the LAST statement: server-role processes serve the parameter
+# store here and exit without reaching user code (parity: reference
+# mxnet/__init__.py importing kvstore_server at the bottom).
+kvstore_server._init_kvstore_server_module()

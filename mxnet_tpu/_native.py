@@ -128,6 +128,7 @@ class NativeEngine:
         self._lib = lib
         self._handle = lib.mxe_create(int(num_threads))
         self._callbacks = {}          # keep CFUNCTYPE refs alive
+        self._done = []               # tokens whose fn has returned
         self._cb_lock = threading.Lock()
         self._cb_id = 0
         self._errors = []
@@ -142,6 +143,7 @@ class NativeEngine:
         if getattr(self, "_handle", None):
             try:
                 self._lib.mxe_wait_all(self._handle)
+                self._reap()
                 self._lib.mxe_destroy(self._handle)
             finally:
                 self._handle = None
@@ -150,6 +152,8 @@ class NativeEngine:
         return int(self._lib.mxe_new_var(self._handle))
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        if not self._lib.mxe_pending(self._handle):
+            self._reap()  # quiescent: every done closure has unwound
         with self._cb_lock:
             self._cb_id += 1
             token = self._cb_id
@@ -160,8 +164,12 @@ class NativeEngine:
             except BaseException as e:  # surfaced at wait points
                 self._errors.append(e)
             finally:
+                # only MARK done: dropping the CFUNCTYPE here would free
+                # the libffi closure while the worker thread is still
+                # returning through its trampoline code (use-after-free).
+                # Actual release happens in _reap() at quiescent points.
                 with self._cb_lock:
-                    self._callbacks.pop(_token, None)
+                    self._done.append(_token)
 
         cfn = ENGINE_FN(trampoline)
         with self._cb_lock:
@@ -178,12 +186,23 @@ class NativeEngine:
                 "duplicate or overlapping const/mutable var lists "
                 "(parity: ThreadedEngine::CheckDuplicate)")
 
+    def _reap(self):
+        """Free CFUNCTYPE closures of completed callbacks.  Safe only
+        when no op is in flight (wait_all returned / pending()==0): the
+        engine completes an op strictly after its callback returned, so
+        every marked-done closure has fully unwound."""
+        with self._cb_lock:
+            for token in self._done:
+                self._callbacks.pop(token, None)
+            self._done.clear()
+
     def wait_for_var(self, var: int):
         self._lib.mxe_wait_for_var(self._handle, int(var))
         self._raise_pending()
 
     def wait_all(self):
         self._lib.mxe_wait_all(self._handle)
+        self._reap()
         self._raise_pending()
 
     def pending(self) -> int:
@@ -239,7 +258,12 @@ class NativeRecordReader:
                                      buf_bytes, self._batch_lens,
                                      max_records)
         if n <= 0:
-            return []
+            # either true end-of-shard, or a single record larger than
+            # buf_bytes (the C side rewinds it): fall back to the
+            # resizable per-record path so oversized records are not
+            # silently dropped as EOF
+            rec = self.read()
+            return [rec] if rec is not None else []
         raw = memoryview(self._batch_buf)
         # numpy view over lens: ctypes element access is ~1us each and
         # dominates at high record rates
@@ -270,16 +294,23 @@ class NativeRecordReader:
             yield rec
 
 
-def native_index(path, max_records=1 << 24):
-    """Offsets of every record in a RecordIO file (fast .idx rebuild)."""
+def native_index(path):
+    """Offsets of every record in a RecordIO file (fast .idx rebuild).
+
+    Two-pass: mxr_index counts records past the cap without writing, so
+    a cap-0 call sizes the buffer exactly (no 128MB worst-case alloc)."""
     lib = get_lib()
     if lib is None:
         raise RuntimeError("libmxtpu unavailable")
-    buf = (ctypes.c_uint64 * max_records)()
-    n = lib.mxr_index(path.encode(), buf, max_records)
+    total = lib.mxr_index(path.encode(), (ctypes.c_uint64 * 1)(), 0)
+    if total < 0:
+        raise IOError(f"cannot open {path}")
+    buf = (ctypes.c_uint64 * max(total, 1))()
+    n = lib.mxr_index(path.encode(), buf, total)
     if n < 0:
         raise IOError(f"cannot open {path}")
-    return np.ctypeslib.as_array(buf, shape=(max_records,))[:n].copy()
+    n = min(n, total)
+    return np.ctypeslib.as_array(buf, shape=(max(total, 1),))[:n].copy()
 
 
 class NativeRecordWriter:

@@ -388,7 +388,11 @@ class ImageRecordIter(DataIter):
             if _native.available():
                 rd = _native.NativeRecordReader(path_imgrec, part_index,
                                                 num_parts)
-                self.records = list(rd)
+                while True:
+                    batch = rd.read_batch()  # one FFI crossing per batch
+                    if not batch:
+                        break
+                    self.records.extend(batch)
                 rd.close()
                 native_ok = True
         except Exception:

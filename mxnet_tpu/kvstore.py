@@ -29,6 +29,8 @@ import os
 import pickle
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from . import ndarray as nd
 from .base import MXNetError
 from .ndarray import NDArray
@@ -142,23 +144,160 @@ class KVStore:
         return 0
 
 
-class KVStoreDist(KVStore):
-    """Multi-worker kvstore over jax.distributed (parity:
-    src/kvstore/kvstore_dist.h — the ps-lite worker client).
+class _PSClient:
+    """Worker-side parameter-server client (parity: the ps::KVWorker role
+    of src/kvstore/kvstore_dist.h).  One TCP connection per server;
+    big arrays are sliced evenly across ALL servers, small keys hash to
+    one server (EncodeKey, kvstore_dist.h:264-302)."""
 
-    On TPU pods, jax.distributed.initialize() wires the processes; sync
-    aggregation rides DCN/ICI collectives executed inside the training
-    step rather than an external parameter server.  Single-process runs
-    degrade to local semantics with rank 0/size 1, matching how the
-    reference behaves when launched without a tracker.
+    def __init__(self, servers):
+        import socket
+
+        from . import kvstore_server as ps
+
+        self._ps = ps
+        self._socks = []
+        self._locks = []
+        import threading
+
+        import time
+
+        for addr in servers:
+            host, port = addr.rsplit(":", 1)
+            # servers come up in parallel with workers (launch.py starts
+            # them together); retry until the listener is bound
+            deadline = time.monotonic() + 120
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)), timeout=120)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # the timeout above applies to connect only: a sync-mode pull
+            # or barrier legitimately parks server-side until the slowest
+            # worker arrives, so reads must block indefinitely
+            s.settimeout(None)
+            self._socks.append(s)
+            self._locks.append(threading.Lock())
+        self.num_servers = len(servers)
+        self.bigarray_bound = int(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", str(1000 * 1000)))
+
+    def rpc(self, server, msg):
+        with self._locks[server]:
+            self._ps.send_msg(self._socks[server], msg)
+            return self._ps.recv_msg(self._socks[server])
+
+    def rpc_all(self, msg):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=self.num_servers) as ex:
+            return list(ex.map(lambda i: self.rpc(i, dict(msg)), range(self.num_servers)))
+
+    # -- key encoding -----------------------------------------------------
+    def _assignment(self, key, size):
+        """Returns [(server, part_key, flat_slice)] for one logical key."""
+        if size < self.bigarray_bound or self.num_servers == 1:
+            # deterministic across processes (Python's hash() is salted):
+            # parity with EncodeKey's stable key->server map
+            # (kvstore_dist.h:264-302)
+            import zlib
+
+            server = zlib.crc32(str(key).encode()) % self.num_servers
+            return [(server, str(key), slice(0, size))]
+        bounds = np.linspace(0, size, self.num_servers + 1).astype(np.int64)
+        return [(i, f"{key}#p{i}", slice(int(bounds[i]), int(bounds[i + 1])))
+                for i in range(self.num_servers)]
+
+    def init(self, key, value: np.ndarray):
+        flat = value.reshape(-1)
+        for server, pkey, sl in self._assignment(key, flat.size):
+            self.rpc(server, {"cmd": "init", "key": pkey, "value": flat[sl]})
+
+    def push(self, key, value: np.ndarray):
+        flat = np.ascontiguousarray(value).reshape(-1)
+        parts = self._assignment(key, flat.size)
+        if len(parts) == 1:
+            server, pkey, sl = parts[0]
+            self.rpc(server, {"cmd": "push", "key": pkey, "value": flat[sl]})
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(parts)) as ex:
+            list(ex.map(lambda p: self.rpc(p[0], {"cmd": "push", "key": p[1],
+                                                  "value": flat[p[2]]}), parts))
+
+    def pull(self, key, shape, dtype):
+        size = int(np.prod(shape))
+        parts = self._assignment(key, size)
+        out = np.empty(size, dtype=dtype)
+        if len(parts) == 1:
+            server, pkey, sl = parts[0]
+            out[sl] = self.rpc(server, {"cmd": "pull", "key": pkey})["value"]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            def fetch(p):
+                out[p[2]] = self.rpc(p[0], {"cmd": "pull", "key": p[1]})["value"]
+
+            with ThreadPoolExecutor(max_workers=len(parts)) as ex:
+                list(ex.map(fetch, parts))
+        return out.reshape(shape)
+
+    def barrier(self):
+        self.rpc(0, {"cmd": "barrier"})
+
+    def control(self, head, body=None):
+        self.rpc_all({"cmd": "control", "head": head, "body": body})
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class KVStoreDist(KVStore):
+    """Multi-process kvstore (parity: src/kvstore/kvstore_dist.h — the
+    ps-lite worker client).
+
+    Two transports, chosen by launch context:
+
+    - **Parameter server** (``MXTPU_PS_SERVERS`` set by tools/launch.py):
+      real multi-process PS with sync/async modes — the reference's
+      dist_sync / dist_async semantics over host TCP, including
+      server-side optimizers and big-array sharding across servers.
+    - **jax.distributed** (TPU pods): sync aggregation should instead
+      ride DCN/ICI collectives inside the training step (parallel/,
+      FusedTrainer) — the PS is only needed for async semantics.
+      Single-process runs degrade to local semantics with rank 0/size 1,
+      matching the reference launched without a tracker.
     """
 
     def __init__(self, kv_type):
         super().__init__(kv_type)
-        self._rank = int(os.environ.get("MXNET_TPU_RANK",
+        self._rank = int(os.environ.get("MXTPU_RANK",
                                         os.environ.get("DMLC_RANK", "0")))
-        self._size = int(os.environ.get("MXNET_TPU_NUM_WORKERS",
+        self._size = int(os.environ.get("MXTPU_NUM_WORKERS",
                                         os.environ.get("DMLC_NUM_WORKER", "1")))
+        self._shapes = {}
+        self._client = None
+        servers = os.environ.get("MXTPU_PS_SERVERS", "")
+        if servers:
+            self._client = _PSClient(servers.split(","))
+            if "async" not in kv_type:
+                if self._rank == 0:
+                    from .kvstore_server import K_SYNC_MODE
+
+                    self._client.control(K_SYNC_MODE)
+                self._client.barrier()
+            import atexit
+
+            atexit.register(self._send_stop)
 
     @property
     def rank(self):
@@ -168,7 +307,67 @@ class KVStoreDist(KVStore):
     def num_workers(self):
         return self._size
 
+    # ------------------------------------------------------------------ ops
+    def init(self, key, value):
+        if self._client is None:
+            return super().init(key, value)
+        keys, _ = _key_list(key)
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            self._shapes[k] = (v.shape, np.dtype(v.dtype))
+            if self._rank == 0:
+                self._client.init(k, v.asnumpy())
+        self._client.barrier()
+
+    def push(self, key, value, priority=0):
+        if self._client is None:
+            return super().push(key, value, priority)
+        keys, single = _key_list(key)
+        values = [value] if single else value
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                merged = v[0].copy()
+                for other in v[1:]:
+                    merged += other.as_in_context(merged.context)
+            else:
+                merged = v
+            if k not in self._shapes:
+                self._shapes[k] = (merged.shape, np.dtype(merged.dtype))
+            self._client.push(k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0):
+        if self._client is None:
+            return super().pull(key, out, priority)
+        keys, single = _key_list(key)
+        outs = [out] if isinstance(out, NDArray) else out
+        if single and isinstance(out, (list, tuple)):
+            outs = [out]
+        for k, o in zip(keys, outs):
+            shape, dtype = self._shapes[k]
+            val = self._client.pull(k, shape, dtype)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for oo in targets:
+                oo._set(val)
+
+    def set_optimizer(self, optimizer):
+        if self._client is None:
+            return super().set_optimizer(optimizer)
+        # parity: worker 0 ships the optimizer to servers (kvstore.py
+        # set_optimizer -> send_command_to_servers)
+        if self._rank == 0:
+            from .kvstore_server import K_SET_OPTIMIZER
+
+            self._client.control(K_SET_OPTIMIZER, pickle.dumps(optimizer))
+        self._client.barrier()
+
+    def send_command_to_servers(self, head, body):
+        if self._client is not None and self._rank == 0:
+            self._client.control(head, body)
+
     def barrier(self):
+        if self._client is not None:
+            self._client.barrier()
+            return
         # with a live jax.distributed backend this is a cross-host sync
         try:
             import jax
@@ -179,6 +378,17 @@ class KVStoreDist(KVStore):
                 _dist.barrier()
         except Exception:
             pass
+
+    def _send_stop(self):
+        if self._client is not None:
+            try:
+                from .kvstore_server import K_STOP_SERVER
+
+                self._client.control(K_STOP_SERVER)
+                self._client.close()
+            except Exception:
+                pass
+            self._client = None
 
 
 def create(name="local") -> KVStore:

@@ -1,0 +1,279 @@
+"""Parameter-server process — the ``dist_*`` kvstore backend.
+
+Parity: src/kvstore/kvstore_dist_server.h (reference) + python/mxnet/
+kvstore_server.py.  The reference runs a ps-lite ``KVServer`` over ZMQ:
+``DataHandle`` accumulates worker pushes into ``merge_buf_``; in **sync**
+mode it waits for all workers, runs the updater once on the merged
+gradient and replies to parked pulls (kvstore_dist_server.h:164-199); in
+**async** mode it updates immediately per push (:200-210).  Controller
+commands (kStopServer / kSyncMode / server_optimizer) arrive via
+``CommandHandle`` (:121-133).
+
+TPU-native redesign: on TPU pods the *synchronous* data-parallel path
+does not need a parameter server at all — gradients ride ICI/DCN
+collectives inside the compiled step (see parallel/mesh.py and
+kvstore.py 'device').  The PS here exists for the semantics a collective
+cannot express: ``dist_async`` (workers update a shared model without
+barriers) and ``update_on_kvstore`` server-side optimizers.  Transport is
+a length-prefixed-pickle TCP loop instead of ZMQ/ps-lite; everything
+stays on the host (params live as numpy, the TPU is untouched), matching
+the reference where server processes are CPU-only.
+
+Launch contract (tools/launch.py): every process gets
+``MXTPU_ROLE`` (worker|server), ``MXTPU_SERVER_RANK``,
+``MXTPU_NUM_WORKERS``, ``MXTPU_NUM_SERVERS`` and ``MXTPU_PS_SERVERS``
+(comma-separated host:port, one per server).  Server processes run the
+*same user script* as workers: importing :mod:`mxnet_tpu` calls
+:func:`_init_kvstore_server_module`, which (like the reference's
+kvstore_server.py:70-90) detects the server role, serves until told to
+stop, then exits the process.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import sys
+import threading
+
+import numpy as np
+
+# controller command heads (parity: kvstore_dist_server.h:33-38)
+K_STOP_SERVER = 0
+K_SYNC_MODE = 1
+K_SET_OPTIMIZER = 2
+
+
+def _role():
+    return os.environ.get("MXTPU_ROLE", os.environ.get("DMLC_ROLE", "worker"))
+
+
+class _SysModulesUnpickler(pickle.Unpickler):
+    """Unpickler that resolves classes from sys.modules without touching
+    the import machinery.  The server's main thread is parked *inside*
+    ``import mxnet_tpu`` (holding the package import lock), so a plain
+    pickle.loads on a handler thread — which __import__s the class's
+    module and waits on that lock — would deadlock.  Everything a pickled
+    optimizer needs (mxnet_tpu.optimizer, numpy) is fully imported before
+    the server starts."""
+
+    def find_class(self, module, name):
+        mod = sys.modules.get(module)
+        if mod is not None:
+            return getattr(mod, name)
+        return super().find_class(module, name)
+
+
+def _loads_no_import(data):
+    import io
+
+    return _SysModulesUnpickler(io.BytesIO(data)).load()
+
+
+def send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def recv_msg(sock):
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (length,) = struct.unpack("<Q", header)
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _ServerState:
+    """Shared mutable server state guarded by one lock + condvar."""
+
+    def __init__(self, num_workers):
+        self.num_workers = num_workers
+        self.store = {}            # key -> np.ndarray (the weights)
+        self.merge_buf = {}        # key -> (accumulated np.ndarray, count)
+        self.updater = None        # fn(key, recv, stored) -> None (mutates stored)
+        self.sync_mode = False
+        self.barrier_count = 0
+        self.barrier_gen = 0
+        self.stopped = False
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+
+    def default_update(self, key, recv, stored):
+        # parity: kvstore_dist_server.h:229-236 — without an optimizer the
+        # server merely accumulates (workers pull aggregated grads and
+        # update locally: update_on_kvstore=False mode).
+        stored[...] = recv
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        st: _ServerState = self.server.state
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            msg = recv_msg(sock)
+            if msg is None:
+                return
+            cmd = msg["cmd"]
+            if cmd == "init":
+                with st.cond:
+                    st.store[msg["key"]] = np.array(msg["value"], copy=True)
+                send_msg(sock, {"ok": True})
+            elif cmd == "push":
+                self._push(st, msg)
+                send_msg(sock, {"ok": True})
+            elif cmd == "pull":
+                send_msg(sock, {"value": self._pull(st, msg["key"])})
+            elif cmd == "barrier":
+                self._barrier(st)
+                send_msg(sock, {"ok": True})
+            elif cmd == "control":
+                self._control(st, msg["head"], msg.get("body"))
+                send_msg(sock, {"ok": True})
+                if msg["head"] == K_STOP_SERVER:
+                    with st.cond:
+                        if st.stop_count >= st.num_workers:
+                            return
+            else:
+                send_msg(sock, {"error": f"unknown cmd {cmd}"})
+
+    # parity: DataHandle (kvstore_dist_server.h:136-227)
+    def _push(self, st, msg):
+        key, recv = msg["key"], np.asarray(msg["value"])
+        with st.cond:
+            if key not in st.store:
+                # first push defines the key (reference inits on first push
+                # when workers race init; our init is explicit, keep safe)
+                st.store[key] = np.zeros_like(recv)
+            if st.sync_mode:
+                buf = st.merge_buf.get(key)
+                if buf is None:
+                    st.merge_buf[key] = [recv.copy(), 1]
+                else:
+                    buf[0] += recv
+                    buf[1] += 1
+                merged, count = st.merge_buf[key]
+                if count == st.num_workers:
+                    (st.updater or st.default_update)(key, merged, st.store[key])
+                    del st.merge_buf[key]
+                    st.cond.notify_all()
+            else:
+                (st.updater or st.default_update)(key, recv, st.store[key])
+
+    def _pull(self, st, key):
+        with st.cond:
+            # sync mode: park the pull until no merge is in flight for key
+            # (parity: parked pull replies, kvstore_dist_server.h:186-198)
+            while st.sync_mode and key in st.merge_buf:
+                st.cond.wait()
+            return st.store[key]
+
+    def _barrier(self, st):
+        with st.cond:
+            gen = st.barrier_gen
+            st.barrier_count += 1
+            if st.barrier_count == st.num_workers:
+                st.barrier_count = 0
+                st.barrier_gen += 1
+                st.cond.notify_all()
+            else:
+                while st.barrier_gen == gen:
+                    st.cond.wait()
+
+    # parity: CommandHandle (kvstore_dist_server.h:121-133)
+    def _control(self, st, head, body):
+        with st.cond:
+            if head == K_SYNC_MODE:
+                st.sync_mode = True
+            elif head == K_SET_OPTIMIZER:
+                # NB: resolved via sys.modules, not `from . import` — the
+                # server blocks inside `import mxnet_tpu` (the main thread
+                # holds the package import lock), so a relative import
+                # from this handler thread would deadlock.  Both modules
+                # are fully imported before _init_kvstore_server_module
+                # runs (see __init__.py ordering).
+                opt = sys.modules[__package__ + ".optimizer"]
+                nd = sys.modules[__package__ + ".ndarray"]
+
+                optimizer = _loads_no_import(body)
+                updater = opt.get_updater(optimizer)
+
+                def np_updater(key, recv, stored, _u=updater, _nd=nd):
+                    w = _nd.array(stored)
+                    _u(key, _nd.array(recv), w)
+                    stored[...] = w.asnumpy()
+
+                st.updater = np_updater
+            elif head == K_STOP_SERVER:
+                st.stop_count += 1
+                if st.stop_count >= st.num_workers:
+                    st.stopped = True
+                    st.cond.notify_all()
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class KVStoreServer:
+    """Blocking server run-loop (parity: python/mxnet/kvstore_server.py
+    KVStoreServer — blocks in RunServer with a controller callback)."""
+
+    def __init__(self, num_workers=None, port=None):
+        self.num_workers = num_workers or int(
+            os.environ.get("MXTPU_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER", "1")))
+        if port is None:
+            rank = int(os.environ.get("MXTPU_SERVER_RANK", "0"))
+            servers = os.environ.get("MXTPU_PS_SERVERS", "").split(",")
+            port = int(servers[rank].rsplit(":", 1)[1]) if servers[0] else 9090
+        self.port = port
+        self.state = _ServerState(self.num_workers)
+        self.state.stop_count = 0
+
+    def run(self):
+        """Serve until every worker has sent kStopServer."""
+        srv = _TCPServer(("0.0.0.0", self.port), _Handler)
+        srv.state = self.state
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        with self.state.cond:
+            while not self.state.stopped:
+                self.state.cond.wait()
+        srv.shutdown()
+        srv.server_close()
+
+
+def _init_kvstore_server_module():
+    """Parity: kvstore_server.py:70-90 — if this process was launched in
+    the server role, serve then exit (never returns to user code)."""
+    if _role() == "server":
+        # The main thread parks here while still *inside* `import
+        # mxnet_tpu`, holding the package import lock.  Handler threads
+        # perform imports (lazy `from . import ...` in the op engine,
+        # pickle class lookups) that would wait on that lock forever.
+        # The package body has fully executed at this point (the hook is
+        # the last statement of __init__.py), so mark it initialized to
+        # let _find_and_load return it without locking.
+        pkg = sys.modules.get(__package__)
+        spec = getattr(pkg, "__spec__", None)
+        if spec is not None and getattr(spec, "_initializing", False):
+            spec._initializing = False
+        server = KVStoreServer()
+        server.run()
+        sys.exit(0)

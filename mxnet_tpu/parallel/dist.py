@@ -1,0 +1,60 @@
+"""Multi-host process group helpers over jax.distributed.
+
+Parity: the ps-lite ``Postoffice`` role (ranks, barriers, dead-node
+surface — include/mxnet/kvstore.h:158-242) for TPU pods, where process
+wiring is jax.distributed + ICI/DCN collectives instead of a ZMQ
+scheduler.  The host-TCP parameter server lives in kvstore_server.py;
+this module is the collective-native side.
+"""
+from __future__ import annotations
+
+import os
+
+
+def init_from_env():
+    """Initialize jax.distributed from standard launcher env vars
+    (parity: InitPSEnv, include/mxnet/kvstore.h:158-208).  No-op if
+    single-process or already initialized."""
+    import jax
+
+    # NB: do not probe jax.process_count() here — it initializes the XLA
+    # backends, after which jax.distributed.initialize() would fail.
+    # Check the distributed client state directly instead.
+    try:
+        from jax._src import distributed as _jd
+
+        if _jd.global_state.client is not None:
+            return
+    except Exception:
+        pass
+    coord = os.environ.get("MXTPU_COORDINATOR",
+                           os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+    rank = int(os.environ.get("MXTPU_RANK", "0"))
+    if coord and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=rank)
+
+
+def rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def num_workers() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def barrier(name: str = "mxtpu_barrier"):
+    """Cross-host sync (parity: KVStore::Barrier → ps::Postoffice
+    barrier).  Rides a tiny DCN all-reduce."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -1,0 +1,173 @@
+"""Predict-only inference API.
+
+Parity: include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc
+(reference): a self-contained ABI — ``MXPredCreate`` (symbol JSON +
+param blob + input shapes), ``MXPredSetInput``, ``MXPredForward``,
+``MXPredGetOutputShape``, ``MXPredGetOutput``, ``MXPredPartialForward``,
+``MXPredFree`` — used by the amalgamation/mobile/JNI builds, with the
+engine forced to the synchronous NaiveEngine (``MXNET_PREDICT_ONLY``,
+include/mxnet/base.h:72-74).
+
+TPU-native design: a Predictor is ONE jitted XLA computation (inputs →
+outputs) with weights captured as device constants; ``forward`` is a
+single dispatch.  The same class backs the C predict ABI exported from
+src/ (see src/c_predict.cc) so non-Python frontends get the reference's
+deployment story.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+
+
+class Predictor:
+    """Parity: the ``MXPredCreate``/``SetInput``/``Forward``/``GetOutput``
+    lifecycle rolled into one object."""
+
+    def __init__(self, symbol_json_str=None, param_bytes=None,
+                 input_shapes=None, dev_type="cpu", dev_id=0,
+                 symbol=None, arg_params=None, aux_params=None,
+                 output_index=None):
+        from . import context as ctx_mod
+        from .executor import simple_bind
+
+        if symbol is None:
+            if symbol_json_str is None:
+                raise MXNetError("need symbol or symbol_json_str")
+            symbol = sym_mod.load_json(symbol_json_str)
+        if arg_params is None:
+            arg_params, aux_params = {}, {}
+            if param_bytes is not None:
+                loaded = _load_param_bytes(param_bytes)
+                for k, v in loaded.items():
+                    tp, name = k.split(":", 1)
+                    if tp == "arg":
+                        arg_params[name] = v
+                    elif tp == "aux":
+                        aux_params[name] = v
+        aux_params = aux_params or {}
+
+        # parity: MXPredCreatePartialOut — cut the graph at named outputs
+        if output_index is not None:
+            outs = symbol.get_internals()
+            symbol = outs[output_index] if isinstance(output_index, int) else outs
+
+        self.symbol = symbol
+        self._input_names = [n for n in symbol.list_arguments()
+                             if n not in arg_params]
+        input_shapes = dict(input_shapes or {})
+        missing = [n for n in self._input_names if n not in input_shapes]
+        if missing:
+            # label-style args (e.g. softmax_label) are not fed at
+            # inference; infer their shapes from the given inputs and
+            # bind zeros (the reference's predict path does the same by
+            # treating outputs as plain activations without labels)
+            try:
+                arg_shapes, _, _ = symbol.infer_shape(**input_shapes)
+                inferred = dict(zip(symbol.list_arguments(), arg_shapes))
+                for n in missing:
+                    input_shapes[n] = inferred[n]
+            except Exception as e:
+                raise MXNetError(
+                    f"input_shapes missing for inputs {missing}") from e
+            self._input_names = [n for n in self._input_names
+                                 if n not in missing]
+
+        device = ctx_mod.Context(dev_type, dev_id) \
+            if isinstance(dev_type, str) else dev_type
+        self._exec = simple_bind(symbol, device, grad_req="null",
+                                 **input_shapes)
+        self._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=True)
+        self._dirty = True
+
+    # ------------------------------------------------------------------ API
+    def set_input(self, name, value):
+        """Parity: MXPredSetInput."""
+        if name not in self._input_names:
+            raise MXNetError(f"unknown input {name}; inputs: {self._input_names}")
+        arr = self._exec.arg_dict[name]
+        value = np.asarray(value, dtype=arr.dtype)
+        if value.shape != arr.shape:
+            raise MXNetError(
+                f"shape mismatch for {name}: got {value.shape}, bound {arr.shape}")
+        arr[:] = value
+        self._dirty = True
+
+    def forward(self, **inputs):
+        """Parity: MXPredForward (kwargs are a convenience for set_input)."""
+        for name, value in inputs.items():
+            self.set_input(name, value)
+        self._exec.forward(is_train=False)
+        self._dirty = False
+
+    def partial_forward(self, step):
+        """Parity: MXPredPartialForward — the reference runs the op
+        sequence up to `step` for debugging.  XLA executes the graph as
+        one fused computation, so partial execution is served from the
+        internals graph: output `step` of get_internals()."""
+        internals = self.symbol.get_internals()
+        names = internals.list_outputs()
+        step = min(step, len(names) - 1)
+        sub = internals[step]
+        shapes = {n: self._exec.arg_dict[n].shape for n in self._input_names}
+        ex = sub.simple_bind(self._exec._ctx, grad_req="null", **shapes)
+        ex.copy_params_from(
+            {k: v for k, v in self._exec.arg_dict.items()
+             if k not in self._input_names},
+            dict(self._exec.aux_dict), allow_extra_params=True)
+        for n in self._input_names:
+            if n in ex.arg_dict:
+                ex.arg_dict[n][:] = self._exec.arg_dict[n].asnumpy()
+        ex.forward(is_train=False)
+        return [o.asnumpy() for o in ex.outputs]
+
+    def get_output_shape(self, index=0):
+        """Parity: MXPredGetOutputShape."""
+        return tuple(self._exec.outputs[index].shape)
+
+    def get_output(self, index=0):
+        """Parity: MXPredGetOutput — blocking copy-out."""
+        if self._dirty:
+            self.forward()
+        return self._exec.outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self):
+        return len(self._exec.outputs)
+
+    def reshape(self, input_shapes):
+        """Parity: MXPredReshape — rebind with new input shapes (the jit
+        cache makes repeat shapes free)."""
+        arg_params = {k: v for k, v in self._exec.arg_dict.items()
+                      if k not in self._input_names}
+        aux_params = dict(self._exec.aux_dict)
+        new = Predictor(symbol=self.symbol, arg_params=arg_params,
+                        aux_params=aux_params, input_shapes=input_shapes)
+        self.__dict__.update(new.__dict__)
+
+
+def _load_param_bytes(param_bytes):
+    import tempfile, os
+
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as f:
+        f.write(param_bytes)
+        path = f.name
+    try:
+        return nd.load(path)
+    finally:
+        os.unlink(path)
+
+
+def create(prefix, epoch, input_shapes, dev_type="cpu", dev_id=0):
+    """Load a save_checkpoint()-style checkpoint into a Predictor
+    (parity: the common MXPredCreate usage in c_predict_api examples)."""
+    from .model import load_checkpoint
+
+    symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+    return Predictor(symbol=symbol, arg_params=arg_params,
+                     aux_params=aux_params, input_shapes=input_shapes,
+                     dev_type=dev_type, dev_id=dev_id)

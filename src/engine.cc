@@ -100,6 +100,15 @@ class Engine {
     std::set_intersection(c.begin(), c.end(), m.begin(), m.end(),
                           std::back_inserter(inter));
     if (!inter.empty()) return -1;
+    {
+      // unknown var ids must surface as the documented -1 error, not as
+      // a std::out_of_range unwinding through the C ABI (UB / abort)
+      std::lock_guard<std::mutex> lk(vars_mu_);
+      for (int64_t v : c)
+        if (vars_.find(v) == vars_.end()) return -1;
+      for (int64_t v : m)
+        if (vars_.find(v) == vars_.end()) return -1;
+    }
 
     auto *opr = new Opr;
     opr->fn = fn;

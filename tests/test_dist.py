@@ -1,0 +1,109 @@
+"""Distributed kvstore tests — multi-process on localhost.
+
+Parity model: tests/nightly/dist_sync_kvstore.py launched via
+``tools/launch.py -n 2 --launcher local`` (reference test_all.sh:37):
+real worker+server processes, deterministic PS-sync invariant asserted
+inside each worker; the test passes iff every worker exits 0.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+
+SYNC_WORKER = textwrap.dedent("""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create('dist_sync')
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2, nw
+
+    shape = (4, 5)
+    big = (113, 97)  # > MXNET_KVSTORE_BIGARRAY_BOUND=1000 -> sharded over servers
+    kv.init('w', mx.nd.ones(shape))
+    kv.init('big', mx.nd.zeros(big))
+
+    # aggregation-only sync mode: pull returns the sum over workers' pushes
+    for i in range(3):
+        kv.push('w', mx.nd.ones(shape) * (rank + 1))
+        out = mx.nd.zeros(shape)
+        kv.pull('w', out=out)
+        expect = sum(r + 1 for r in range(nw))
+        assert np.allclose(out.asnumpy(), expect), (i, out.asnumpy()[0, 0], expect)
+        kv.barrier()
+
+    # big-array path: slices spread across both servers
+    kv.push('big', mx.nd.ones(big) * (rank + 1))
+    out = mx.nd.zeros(big)
+    kv.pull('big', out=out)
+    assert np.allclose(out.asnumpy(), 3.0), out.asnumpy().ravel()[:4]
+    kv.barrier()
+
+    # server-side optimizer (update_on_kvstore): weight -= lr * sum(grads)
+    kv2_key = 'opt_w'
+    kv.init(kv2_key, mx.nd.zeros(shape))
+    kv.set_optimizer(mx.optimizer.create('sgd', learning_rate=0.1,
+                                         rescale_grad=1.0))
+    kv.push(kv2_key, mx.nd.ones(shape))
+    out = mx.nd.zeros(shape)
+    kv.pull(kv2_key, out=out)
+    # one sync update on the merged grad (= nw): w = 0 - 0.1 * nw
+    assert np.allclose(out.asnumpy(), -0.1 * nw, atol=1e-6), out.asnumpy()[0, 0]
+    print('worker', rank, 'OK')
+""")
+
+ASYNC_WORKER = textwrap.dedent("""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create('dist_async')
+    shape = (3, 3)
+    if kv.rank == 0:
+        pass
+    kv.init('a', mx.nd.zeros(shape))
+    kv.set_optimizer(mx.optimizer.create('sgd', learning_rate=1.0,
+                                         rescale_grad=1.0))
+    kv.barrier()
+    # async: every push applies immediately; after both workers push once
+    # and barrier, the weight reflects both updates
+    kv.push('a', mx.nd.ones(shape))
+    kv.barrier()
+    out = mx.nd.zeros(shape)
+    kv.pull('a', out=out)
+    assert np.allclose(out.asnumpy(), -2.0), out.asnumpy()[0, 0]
+    print('worker', kv.rank, 'OK')
+""")
+
+
+def _launch(script, n=2, s=2, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_PLATFORM"] = "cpu"  # keep subprocesses off the accelerator
+    env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "1000"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    path = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                        f"dist_worker_{os.getpid()}.py")
+    with open(path, "w") as f:
+        f.write(script)
+    try:
+        proc = subprocess.run(
+            [sys.executable, LAUNCH, "-n", str(n), "-s", str(s),
+             "--launcher", "local", sys.executable, path],
+            env=env, timeout=timeout, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    finally:
+        os.unlink(path)
+
+
+def test_dist_sync_kvstore():
+    _launch(SYNC_WORKER, n=2, s=2)
+
+
+def test_dist_async_kvstore():
+    _launch(ASYNC_WORKER, n=2, s=1)

@@ -1,0 +1,116 @@
+"""Predict-only API + legacy executor manager tests.
+
+Parity model: the reference's c_predict_api usage (predict from a
+save_checkpoint checkpoint: MXPredCreate/SetInput/Forward/GetOutput,
+tests via amalgamation examples) and executor_manager.py's
+DataParallelExecutorManager used by FeedForward.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.predict import Predictor, create as pred_create
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(sym.Flatten(data), name="fc1", num_hidden=16)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=4)
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _trained_checkpoint(tmp_path):
+    rs = np.random.RandomState(0)
+    x = rs.uniform(size=(64, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 4).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd")
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    return prefix, x
+
+
+def test_predictor_from_checkpoint(tmp_path):
+    prefix, x = _trained_checkpoint(tmp_path)
+    p = pred_create(prefix, 1, {"data": (16, 8)})
+    p.forward(data=x[:16])
+    out = p.get_output(0)
+    assert out.shape == (16, 4)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)  # softmax rows
+
+    # parity with the module's own forward
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, 1)
+    mod = mx.mod.Module(symbol, context=mx.cpu(), label_names=[])
+    mod.bind(data_shapes=[("data", (16, 8))], for_training=False)
+    mod.set_params(arg_params, aux_params)
+    mod.forward(mx.io.DataBatch(data=[nd.array(x[:16])], label=None))
+    ref = mod.get_outputs()[0].asnumpy()
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_predictor_set_input_validation(tmp_path):
+    prefix, x = _trained_checkpoint(tmp_path)
+    p = pred_create(prefix, 1, {"data": (4, 8)})
+    with pytest.raises(mx.MXNetError):
+        p.set_input("nope", x[:4])
+    with pytest.raises(mx.MXNetError):
+        p.set_input("data", x[:3])  # wrong shape
+
+
+def test_predictor_reshape(tmp_path):
+    prefix, x = _trained_checkpoint(tmp_path)
+    p = pred_create(prefix, 1, {"data": (16, 8)})
+    p.forward(data=x[:16])
+    first = p.get_output(0)
+    p.reshape({"data": (32, 8)})
+    p.forward(data=x[:32])
+    out = p.get_output(0)
+    assert out.shape == (32, 4)
+    assert np.allclose(out[:16], first, atol=1e-5)
+
+
+def test_predictor_partial_forward(tmp_path):
+    prefix, x = _trained_checkpoint(tmp_path)
+    p = pred_create(prefix, 1, {"data": (8, 8)})
+    p.forward(data=x[:8])
+    internals = p.symbol.get_internals().list_outputs()
+    outs = p.partial_forward(len(internals) - 1)
+    assert np.allclose(outs[0], p.get_output(0), atol=1e-5)
+
+
+def test_executor_manager_train_step():
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    rs = np.random.RandomState(0)
+    x = rs.uniform(size=(64, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 4).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mgr = DataParallelExecutorManager(_mlp(), [mx.cpu(0), mx.cpu(1)], it)
+
+    arg_params, aux_params = {}, {}
+    init = mx.init.Uniform(0.1)
+    for name in mgr.param_names:
+        shape = dict(zip(mgr.execgrp.arg_names,
+                         _mlp().infer_shape(data=(32, 8))[0]))[name]
+        arr = nd.zeros(shape)
+        init(name, arr)
+        arg_params[name] = arr
+    mgr.set_params(arg_params, aux_params)
+
+    metric = mx.metric.create("acc")
+    it.reset()
+    batch = next(it)
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    assert all(g[0] is not None for g in mgr.grad_arrays)
+    metric.reset()
+    mgr.update_metric(metric, batch.label)
+    assert 0.0 <= metric.get()[1] <= 1.0
+
+    out_params, out_aux = {}, {}
+    mgr.copy_to(out_params, out_aux)
+    assert set(out_params) == set(mgr.param_names)

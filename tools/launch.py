@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Cluster launcher for distributed training.
+
+Parity: tools/launch.py (reference) + the dmlc-core tracker: spawn
+``-n`` worker and ``-s`` server processes running the same command, with
+roles assigned via environment variables (DMLC_ROLE et al.; server
+processes detect the role at ``import mxnet_tpu`` and serve — see
+mxnet_tpu/kvstore_server.py).
+
+Launchers:
+- ``local``  (default): N workers + S servers as subprocesses on this
+  host — the mode the reference's nightly dist tests use
+  (tests/nightly/test_all.sh:37 ``launch.py -n 4 --launcher local``).
+- ``ssh``: one process per host from ``-H hostfile`` (round-robin),
+  sharing the same env contract over ``ssh -q``.  Limitation: server
+  ports are probed on the launcher, not the remote hosts — pick hosts
+  with those ports free (a bind failure surfaces as workers timing out
+  after their 120s connect-retry window).
+Other reference launchers (mpi/sge/yarn) map to cluster schedulers that
+do not exist for TPU pods — there, use ``--launcher pod`` which simply
+execs the command once per host under `jax.distributed` coordinates
+(GKE/xmanager-style schedulers start one process per host already).
+
+On TPU pods the sync data-parallel path needs NO server processes
+(gradients ride ICI/DCN collectives inside the step); ``-s`` is for the
+parameter-server semantics (dist_async / server-side optimizer).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _role_env(base, role, rank, args, servers):
+    env = dict(base)
+    env.update({
+        "MXTPU_ROLE": role,
+        "MXTPU_NUM_WORKERS": str(args.num_workers),
+        "MXTPU_NUM_SERVERS": str(args.num_servers),
+        "MXTPU_PS_SERVERS": ",".join(servers),
+        # DMLC aliases so reference scripts reading these keep working
+        "DMLC_ROLE": role,
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+    })
+    if role == "server":
+        env["MXTPU_SERVER_RANK"] = str(rank)
+    else:
+        env["MXTPU_RANK"] = str(rank)
+        env["DMLC_RANK"] = str(rank)
+    return env
+
+
+def launch_local(args, command):
+    servers = [f"127.0.0.1:{p}" for p in _free_ports(args.num_servers)]
+    procs = []
+    try:
+        for i in range(args.num_servers):
+            procs.append(subprocess.Popen(
+                command, env=_role_env(os.environ, "server", i, args, servers)))
+        workers = []
+        for i in range(args.num_workers):
+            p = subprocess.Popen(
+                command, env=_role_env(os.environ, "worker", i, args, servers))
+            procs.append(p)
+            workers.append(p)
+        rc = 0
+        for p in workers:
+            rc = p.wait() or rc
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        return rc
+    except BaseException:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        raise
+
+
+def launch_ssh(args, command):
+    if not args.hostfile:
+        raise SystemExit("--launcher ssh requires -H/--hostfile")
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
+    ports = _free_ports(args.num_servers)
+    # servers round-robin over hosts; workers likewise
+    servers = [f"{hosts[i % len(hosts)]}:{ports[i]}" for i in range(args.num_servers)]
+    procs = []
+    cmd_str = " ".join(command)
+
+    def remote(host, env):
+        env_str = " ".join(f"{k}={v}" for k, v in env.items()
+                           if k.startswith(("MXTPU_", "DMLC_")))
+        return subprocess.Popen(
+            ["ssh", "-q", "-o", "StrictHostKeyChecking=no", host,
+             f"cd {os.getcwd()} && env {env_str} {cmd_str}"])
+
+    for i in range(args.num_servers):
+        procs.append(remote(hosts[i % len(hosts)],
+                            _role_env({}, "server", i, args, servers)))
+    rc = 0
+    workers = []
+    for i in range(args.num_workers):
+        p = remote(hosts[i % len(hosts)], _role_env({}, "worker", i, args, servers))
+        procs.append(p)
+        workers.append(p)
+    for p in workers:
+        rc = p.wait() or rc
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    return rc
+
+
+def launch_pod(args, command):
+    """One-process-per-host schedulers (TPU pods): just exec with worker
+    env; jax.distributed coordinates (parallel/dist.py)."""
+    env = dict(os.environ)
+    env.setdefault("MXTPU_NUM_WORKERS", str(args.num_workers))
+    os.execvpe(command[0], command, env)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0)
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "ssh", "pod"])
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    command = [c for c in args.command if c != "--"]
+    if not command:
+        raise SystemExit("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args, command))
+    elif args.launcher == "ssh":
+        sys.exit(launch_ssh(args, command))
+    else:
+        launch_pod(args, command)
+
+
+if __name__ == "__main__":
+    main()
