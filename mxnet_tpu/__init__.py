@@ -52,6 +52,8 @@ from . import metric
 from . import lr_scheduler
 from . import callback
 from . import io
+from . import recordio
+from . import image
 from . import kvstore as kv
 from . import kvstore_server
 from . import model

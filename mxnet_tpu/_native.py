@@ -129,6 +129,7 @@ class NativeEngine:
         self._handle = lib.mxe_create(int(num_threads))
         self._callbacks = {}          # keep CFUNCTYPE refs alive
         self._done = []               # tokens whose fn has returned
+        self._done_old = []           # previous generation, safe to free
         self._cb_lock = threading.Lock()
         self._cb_id = 0
         self._errors = []
@@ -144,6 +145,7 @@ class NativeEngine:
             try:
                 self._lib.mxe_wait_all(self._handle)
                 self._reap()
+                self._reap()  # flush both generations before destroy
                 self._lib.mxe_destroy(self._handle)
             finally:
                 self._handle = None
@@ -152,8 +154,10 @@ class NativeEngine:
         return int(self._lib.mxe_new_var(self._handle))
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
-        if not self._lib.mxe_pending(self._handle):
-            self._reap()  # quiescent: every done closure has unwound
+        # NB: no reap here — a pending()==0 probe followed by _reap() is
+        # a TOCTOU race when another thread pushes in between (its
+        # closure could be freed mid-unwind).  Reaping happens only at
+        # wait_all/_shutdown, where quiescence is held by the caller.
         with self._cb_lock:
             self._cb_id += 1
             token = self._cb_id
@@ -187,14 +191,17 @@ class NativeEngine:
                 "(parity: ThreadedEngine::CheckDuplicate)")
 
     def _reap(self):
-        """Free CFUNCTYPE closures of completed callbacks.  Safe only
-        when no op is in flight (wait_all returned / pending()==0): the
-        engine completes an op strictly after its callback returned, so
-        every marked-done closure has fully unwound."""
+        """Free CFUNCTYPE closures of completed callbacks.  Two-phase:
+        tokens marked done before the PREVIOUS reap are freed now —
+        their closures have long unwound — while freshly-done tokens age
+        one cycle.  This stays safe even when other threads push
+        concurrently with wait_all (a just-done closure may still be
+        unwinding on its worker thread)."""
         with self._cb_lock:
-            for token in self._done:
+            for token in self._done_old:
                 self._callbacks.pop(token, None)
-            self._done.clear()
+            self._done_old = self._done
+            self._done = []
 
     def wait_for_var(self, var: int):
         self._lib.mxe_wait_for_var(self._handle, int(var))

@@ -150,17 +150,21 @@ class _PSClient:
     big arrays are sliced evenly across ALL servers, small keys hash to
     one server (EncodeKey, kvstore_dist.h:264-302)."""
 
-    def __init__(self, servers):
+    def __init__(self, servers, rank=0):
         import socket
+        import threading
+        import time
+        from concurrent.futures import ThreadPoolExecutor
 
         from . import kvstore_server as ps
 
         self._ps = ps
+        self.rank = rank
         self._socks = []
         self._locks = []
-        import threading
-
-        import time
+        # persistent pool: one slot per server (matches the per-socket
+        # locks) — spawning a pool per push/pull would dominate small RPCs
+        self._pool = ThreadPoolExecutor(max_workers=max(len(servers), 1))
 
         for addr in servers:
             host, port = addr.rsplit(":", 1)
@@ -192,10 +196,8 @@ class _PSClient:
             return self._ps.recv_msg(self._socks[server])
 
     def rpc_all(self, msg):
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(max_workers=self.num_servers) as ex:
-            return list(ex.map(lambda i: self.rpc(i, dict(msg)), range(self.num_servers)))
+        return list(self._pool.map(lambda i: self.rpc(i, dict(msg)),
+                                   range(self.num_servers)))
 
     # -- key encoding -----------------------------------------------------
     def _assignment(self, key, size):
@@ -222,13 +224,13 @@ class _PSClient:
         parts = self._assignment(key, flat.size)
         if len(parts) == 1:
             server, pkey, sl = parts[0]
-            self.rpc(server, {"cmd": "push", "key": pkey, "value": flat[sl]})
+            self.rpc(server, {"cmd": "push", "key": pkey, "value": flat[sl],
+                              "rank": self.rank})
             return
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(max_workers=len(parts)) as ex:
-            list(ex.map(lambda p: self.rpc(p[0], {"cmd": "push", "key": p[1],
-                                                  "value": flat[p[2]]}), parts))
+        list(self._pool.map(
+            lambda p: self.rpc(p[0], {"cmd": "push", "key": p[1],
+                                      "value": flat[p[2]],
+                                      "rank": self.rank}), parts))
 
     def pull(self, key, shape, dtype):
         size = int(np.prod(shape))
@@ -236,15 +238,14 @@ class _PSClient:
         out = np.empty(size, dtype=dtype)
         if len(parts) == 1:
             server, pkey, sl = parts[0]
-            out[sl] = self.rpc(server, {"cmd": "pull", "key": pkey})["value"]
+            out[sl] = self.rpc(server, {"cmd": "pull", "key": pkey,
+                                        "rank": self.rank})["value"]
         else:
-            from concurrent.futures import ThreadPoolExecutor
-
             def fetch(p):
-                out[p[2]] = self.rpc(p[0], {"cmd": "pull", "key": p[1]})["value"]
+                out[p[2]] = self.rpc(p[0], {"cmd": "pull", "key": p[1],
+                                            "rank": self.rank})["value"]
 
-            with ThreadPoolExecutor(max_workers=len(parts)) as ex:
-                list(ex.map(fetch, parts))
+            list(self._pool.map(fetch, parts))
         return out.reshape(shape)
 
     def barrier(self):
@@ -254,6 +255,7 @@ class _PSClient:
         self.rpc_all({"cmd": "control", "head": head, "body": body})
 
     def close(self):
+        self._pool.shutdown(wait=False)
         for s in self._socks:
             try:
                 s.close()
@@ -288,7 +290,7 @@ class KVStoreDist(KVStore):
         self._client = None
         servers = os.environ.get("MXTPU_PS_SERVERS", "")
         if servers:
-            self._client = _PSClient(servers.split(","))
+            self._client = _PSClient(servers.split(","), rank=self._rank)
             if "async" not in kv_type:
                 if self._rank == 0:
                     from .kvstore_server import K_SYNC_MODE

@@ -138,7 +138,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 self._push(st, msg)
                 send_msg(sock, {"ok": True})
             elif cmd == "pull":
-                send_msg(sock, {"value": self._pull(st, msg["key"])})
+                send_msg(sock, {"value": self._pull(st, msg["key"],
+                                                    msg.get("rank", -1))})
             elif cmd == "barrier":
                 self._barrier(st)
                 send_msg(sock, {"ok": True})
@@ -155,6 +156,7 @@ class _Handler(socketserver.BaseRequestHandler):
     # parity: DataHandle (kvstore_dist_server.h:136-227)
     def _push(self, st, msg):
         key, recv = msg["key"], np.asarray(msg["value"])
+        rank = msg.get("rank", -1)
         with st.cond:
             if key not in st.store:
                 # first push defines the key (reference inits on first push
@@ -163,25 +165,33 @@ class _Handler(socketserver.BaseRequestHandler):
             if st.sync_mode:
                 buf = st.merge_buf.get(key)
                 if buf is None:
-                    st.merge_buf[key] = [recv.copy(), 1]
+                    buf = st.merge_buf[key] = [recv.copy(), set()]
                 else:
                     buf[0] += recv
-                    buf[1] += 1
-                merged, count = st.merge_buf[key]
-                if count == st.num_workers:
-                    (st.updater or st.default_update)(key, merged, st.store[key])
+                buf[1].add(rank)
+                if len(buf[1]) == st.num_workers:
+                    (st.updater or st.default_update)(key, buf[0], st.store[key])
                     del st.merge_buf[key]
                     st.cond.notify_all()
             else:
                 (st.updater or st.default_update)(key, recv, st.store[key])
 
-    def _pull(self, st, key):
+    def _pull(self, st, key, rank=-1):
         with st.cond:
-            # sync mode: park the pull until no merge is in flight for key
-            # (parity: parked pull replies, kvstore_dist_server.h:186-198)
-            while st.sync_mode and key in st.merge_buf:
+            # sync mode: park the pull ONLY while a merge this worker has
+            # already contributed to is in flight — it wants the post-
+            # update value (parity: parked pull replies,
+            # kvstore_dist_server.h:186-198).  A pull from a worker that
+            # has NOT contributed belongs to the previous round (our
+            # client pulls synchronously), so it gets the last completed
+            # value immediately — parking it would deadlock the cluster
+            # under worker skew.
+            while (st.sync_mode and key in st.merge_buf
+                   and rank in st.merge_buf[key][1]):
                 st.cond.wait()
-            return st.store[key]
+            # copy under the lock: the live array is mutated in place by
+            # concurrent updaters while the reply is pickled
+            return st.store[key].copy()
 
     def _barrier(self, st):
         with st.cond:

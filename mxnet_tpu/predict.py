@@ -50,10 +50,26 @@ class Predictor:
                         aux_params[name] = v
         aux_params = aux_params or {}
 
-        # parity: MXPredCreatePartialOut — cut the graph at named outputs
+        # parity: MXPredCreatePartialOut — cut the graph at selected
+        # internal outputs (by index, name, or list thereof)
         if output_index is not None:
-            outs = symbol.get_internals()
-            symbol = outs[output_index] if isinstance(output_index, int) else outs
+            internals = symbol.get_internals()
+            indices = output_index if isinstance(output_index, (list, tuple)) \
+                else [output_index]
+            picked = []
+            names = internals.list_outputs()
+            for sel in indices:
+                if isinstance(sel, str):
+                    if sel not in names:
+                        raise MXNetError(
+                            f"unknown output {sel!r}; internals: {names}")
+                    picked.append(internals[names.index(sel)])
+                elif isinstance(sel, int):
+                    picked.append(internals[sel])
+                else:
+                    raise MXNetError(
+                        f"output_index entries must be int or str, got {sel!r}")
+            symbol = picked[0] if len(picked) == 1 else sym_mod.Group(picked)
 
         self.symbol = symbol
         self._input_names = [n for n in symbol.list_arguments()
@@ -146,7 +162,8 @@ class Predictor:
                       if k not in self._input_names}
         aux_params = dict(self._exec.aux_dict)
         new = Predictor(symbol=self.symbol, arg_params=arg_params,
-                        aux_params=aux_params, input_shapes=input_shapes)
+                        aux_params=aux_params, input_shapes=input_shapes,
+                        dev_type=self._exec._ctx)  # keep the original device
         self.__dict__.update(new.__dict__)
 
 

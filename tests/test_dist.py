@@ -29,14 +29,19 @@ SYNC_WORKER = textwrap.dedent("""
     kv.init('w', mx.nd.ones(shape))
     kv.init('big', mx.nd.zeros(big))
 
-    # aggregation-only sync mode: pull returns the sum over workers' pushes
-    for i in range(3):
+    # aggregation-only sync mode: pull returns the sum over workers'
+    # pushes.  NO per-round barrier + rank-skewed sleeps: a fast worker
+    # laps the slow one, exercising the parked-pull round tracking
+    # (a naive park-on-any-merge deadlocks here).
+    import time
+    expect = sum(r + 1 for r in range(nw))
+    for i in range(4):
+        time.sleep(0.2 * rank)
         kv.push('w', mx.nd.ones(shape) * (rank + 1))
         out = mx.nd.zeros(shape)
         kv.pull('w', out=out)
-        expect = sum(r + 1 for r in range(nw))
         assert np.allclose(out.asnumpy(), expect), (i, out.asnumpy()[0, 0], expect)
-        kv.barrier()
+    kv.barrier()
 
     # big-array path: slices spread across both servers
     kv.push('big', mx.nd.ones(big) * (rank + 1))

@@ -1,0 +1,87 @@
+"""Tools tests (parity model: the reference exercises im2rec/parse_log
+through example workflows; here they get direct unit coverage)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _env():
+    env = dict(os.environ)
+    env["MXTPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_im2rec_roundtrip(tmp_path):
+    from PIL import Image
+
+    import mxnet_tpu as mx
+
+    rs = np.random.RandomState(0)
+    for cls in ("a", "b"):
+        os.makedirs(tmp_path / "imgs" / cls)
+        for i in range(4):
+            Image.fromarray(rs.randint(0, 255, (40, 50, 3), np.uint8)).save(
+                str(tmp_path / "imgs" / cls / f"{i}.jpg"))
+    prefix = str(tmp_path / "data")
+    r = subprocess.run([sys.executable, os.path.join(TOOLS, "im2rec.py"),
+                        prefix, str(tmp_path / "imgs"), "--list"],
+                       env=_env(), capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".lst")
+    r = subprocess.run([sys.executable, os.path.join(TOOLS, "im2rec.py"),
+                        prefix, str(tmp_path / "imgs"), "--resize", "32",
+                        "--center-crop"],
+                       env=_env(), capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+
+    it = mx.image.ImageRecordIter(path_imgrec=prefix + ".rec",
+                                  data_shape=(3, 32, 32), batch_size=4)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    labels = set()
+    rec = mx.recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    for k in rec.keys:
+        h, img = mx.recordio.unpack_img(rec.read_idx(k))
+        assert img.shape == (32, 32, 3)
+        labels.add(float(h.label))
+    assert labels == {0.0, 1.0}
+
+
+def test_parse_log():
+    log = ("INFO:root:Epoch[0] Train-accuracy=0.5\n"
+           "INFO:root:Epoch[0] Time cost=3.2\n"
+           "INFO:root:Epoch[0] Validation-accuracy=0.6\n"
+           "INFO:root:Epoch[1] Train-accuracy=0.8\n")
+    r = subprocess.run([sys.executable, os.path.join(TOOLS, "parse_log.py"),
+                        "--format", "csv"],
+                       input=log, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "epoch,time,train-accuracy,valid-accuracy"
+    assert lines[1].startswith("0,3.2,0.5,0.6")
+
+
+def test_bandwidth_collective():
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bandwidth", "measure.py"),
+         "--network", "mlp", "--num-classes", "10",
+         "--kv-store", "collective", "--num-devices", "2", "--repeat", "1"],
+        env={**_env(), "XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "bandwidth=" in r.stdout
+
+
+def test_kill_dry_run():
+    r = subprocess.run([sys.executable, os.path.join(TOOLS, "kill-mxtpu.py"),
+                        "--dry-run", "no_such_process_pattern_xyz"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Pack an image folder (+ optional .lst) into RecordIO shards.
+
+Parity: tools/im2rec.py + tools/im2rec.cc (reference).  Two modes:
+
+1. ``--list``: walk an image root, emit ``prefix.lst`` lines of
+   ``index \\t label \\t relpath`` (label = folder index, like the
+   reference's per-directory labelling), with optional train/test split.
+2. pack (default): read ``prefix.lst``, load + optionally resize each
+   image, and write ``prefix.rec`` (+ ``prefix.idx``) via the
+   MXRecordIO/IRHeader format shared with the C++ reader (src/recordio.cc).
+
+Record payload layout is byte-compatible with python/mxnet/recordio.py's
+``pack_img`` so ImageRecordIter / ImageRecordUInt8Iter consume the output
+directly.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(args):
+    root = args.root
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    if classes:
+        for label, cls in enumerate(classes):
+            for dirpath, _, files in os.walk(os.path.join(root, cls)):
+                for f in sorted(files):
+                    if f.lower().endswith(EXTS):
+                        rel = os.path.relpath(os.path.join(dirpath, f), root)
+                        entries.append((rel, float(label)))
+    else:  # flat folder: label 0
+        for f in sorted(os.listdir(root)):
+            if f.lower().endswith(EXTS):
+                entries.append((f, 0.0))
+    if args.shuffle:
+        random.Random(args.seed).shuffle(entries)
+
+    n_test = int(len(entries) * args.test_ratio)
+    splits = [("", entries[n_test:])] if n_test == 0 else [
+        ("_train", entries[n_test:]), ("_test", entries[:n_test])]
+    for suffix, part in splits:
+        path = f"{args.prefix}{suffix}.lst"
+        with open(path, "w") as f:
+            for i, (rel, label) in enumerate(part):
+                f.write(f"{i}\t{label}\t{rel}\n")
+        print(f"wrote {path} ({len(part)} entries)")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def pack(args):
+    # --list with --test-ratio writes prefix_train.lst/prefix_test.lst;
+    # pack every split that exists so the documented two-step workflow
+    # works for both the plain and the split case
+    candidates = [args.prefix] + [args.prefix + s for s in ("_train", "_test")]
+    prefixes = [p for p in candidates if os.path.exists(p + ".lst")]
+    if not prefixes:
+        raise SystemExit(f"{args.prefix}.lst not found — run with --list first")
+    for prefix in prefixes:
+        _pack_one(args, prefix)
+
+
+def _pack_one(args, prefix):
+    import numpy as np
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import imdecode_np, imencode
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        path = os.path.join(args.root, rel)
+        with open(path, "rb") as f:
+            buf = f.read()
+        if args.resize > 0 or args.quality != 95 or args.center_crop:
+            img = imdecode_np(buf)
+            if args.resize > 0:
+                h, w = img.shape[:2]
+                scale = args.resize / min(h, w)
+                from PIL import Image
+
+                im = Image.fromarray(img).resize(
+                    (max(1, int(w * scale)), max(1, int(h * scale))))
+                img = np.asarray(im)
+            if args.center_crop:
+                h, w = img.shape[:2]
+                s = min(h, w)
+                y, x = (h - s) // 2, (w - s) // 2
+                img = img[y:y + s, x:x + s]
+            buf = imencode(img, quality=args.quality,
+                           img_fmt=args.encoding)
+        label = labels[0] if len(labels) == 1 else np.array(labels, np.float32)
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, buf))
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count}")
+    rec.close()
+    print(f"wrote {prefix}.rec ({count} records)")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("prefix", help="output prefix for .lst/.rec/.idx")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate .lst instead of packing")
+    ap.add_argument("--shuffle", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--test-ratio", type=float, default=0.0)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge to this (0 = keep)")
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    args = ap.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        pack(args)
+
+
+if __name__ == "__main__":
+    main()

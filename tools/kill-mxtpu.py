@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Kill stray distributed-training processes on this host.
+
+Parity: tools/kill-mxnet.py (reference) — the ops-side cleanup tool for
+runs whose launcher died: finds processes whose environment carries the
+launcher's role variables (MXTPU_ROLE / DMLC_ROLE) or whose command line
+matches the given pattern, and SIGTERMs (then SIGKILLs) them.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def find_procs(pattern):
+    victims = []
+    me = os.getpid()
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read().decode(errors="replace")
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace").replace("\0", " ")
+        except OSError:
+            continue
+        launched = "MXTPU_ROLE=" in env or "DMLC_ROLE=" in env
+        if launched or (pattern and pattern in cmd):
+            victims.append((int(pid), cmd.strip()))
+    return victims
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("pattern", nargs="?", default=None,
+                    help="also kill processes whose cmdline contains this")
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+    victims = find_procs(args.pattern)
+    if not victims:
+        print("nothing to kill")
+        return
+    for pid, cmd in victims:
+        print(f"{'would kill' if args.dry_run else 'killing'} {pid}: {cmd[:100]}")
+        if not args.dry_run:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+    if args.dry_run:
+        return
+    time.sleep(2)
+    for pid, _ in victims:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    main()

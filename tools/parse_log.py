@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Parse training logs into per-epoch tables (parity: tools/parse_log.py).
+
+Reads a log produced by Module.fit / Speedometer and prints
+``epoch  train-acc  valid-acc  time`` in markdown or csv — the format the
+reference's CI accuracy gates grep (tests/nightly/test_all.sh check_val).
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+RE_EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\]\s+(?:Batch\s+\[\d+\]\s+.*?)?Train-([\w-]+)=([\d.naif]+)", re.I)
+RE_VAL_METRIC = re.compile(r"Epoch\[(\d+)\]\s+Validation-([\w-]+)=([\d.naif]+)", re.I)
+RE_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.]+)")
+
+
+def parse(lines):
+    rows = {}
+    for line in lines:
+        m = RE_EPOCH_METRIC.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})[f"train-{m.group(2)}"] = float(m.group(3))
+        m = RE_VAL_METRIC.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})[f"valid-{m.group(2)}"] = float(m.group(3))
+        m = RE_TIME.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logfile", nargs="?", default="-")
+    ap.add_argument("--format", default="markdown", choices=["markdown", "csv"])
+    args = ap.parse_args()
+    lines = (sys.stdin if args.logfile == "-" else open(args.logfile)).readlines()
+    rows = parse(lines)
+    if not rows:
+        print("no epochs found", file=sys.stderr)
+        return
+    cols = sorted({c for r in rows.values() for c in r})
+    sep = "," if args.format == "csv" else " | "
+    print(sep.join(["epoch"] + cols))
+    if args.format == "markdown":
+        print(sep.join(["---"] * (len(cols) + 1)))
+    for epoch in sorted(rows):
+        vals = [f"{rows[epoch].get(c, float('nan')):.6g}" for c in cols]
+        print(sep.join([str(epoch)] + vals))
+
+
+if __name__ == "__main__":
+    main()
