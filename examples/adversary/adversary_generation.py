@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""FGSM adversarial examples (parity: example/adversary/): train a small
+net, then bind with inputs_need_grad=True and perturb inputs along
+sign(dLoss/dx) to flip predictions."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.test_utils import get_synthetic_mnist  # noqa: E402
+
+
+def build_net():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(5, 5), num_filter=8, name="c1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=10, name="fc")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epsilon", type=float, default=0.15)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(2048, 256)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch_size,
+                              shuffle=True)
+    net = build_net()
+    mod = mx.mod.Module(net)
+    mod.fit(train, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    arg_params, aux_params = mod.get_params()
+
+    # rebind with input grads enabled
+    b = args.batch_size
+    atk = mx.mod.Module(net)
+    atk.bind(data_shapes=[("data", (b,) + xte.shape[1:])],
+             label_shapes=[("softmax_label", (b,))],
+             for_training=True, inputs_need_grad=True)
+    atk.set_params(arg_params, aux_params)
+
+    x, y = xte[:b], yte[:b]
+    atk.forward(mx.io.DataBatch([mx.nd.array(x)], [mx.nd.array(y)]),
+                is_train=True)
+    clean_pred = atk.get_outputs()[0].asnumpy().argmax(axis=1)
+    atk.backward()
+    grad = atk.get_input_grads()[0].asnumpy()
+
+    x_adv = np.clip(x + args.epsilon * np.sign(grad), 0, 1)
+    atk.forward(mx.io.DataBatch([mx.nd.array(x_adv)], [mx.nd.array(y)]),
+                is_train=False)
+    adv_pred = atk.get_outputs()[0].asnumpy().argmax(axis=1)
+
+    clean_acc = float((clean_pred == y).mean())
+    adv_acc = float((adv_pred == y).mean())
+    logging.info("clean acc %.3f -> adversarial acc %.3f (eps=%.2f)",
+                 clean_acc, adv_acc, args.epsilon)

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Stacked autoencoder with layerwise pretraining (parity:
+example/autoencoder/): each layer pretrained as a shallow
+encoder/decoder with LinearRegressionOutput, then the full stack
+finetuned end-to-end."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.test_utils import get_synthetic_mnist  # noqa: E402
+
+
+def ae_symbol(dims, out_name="decoded"):
+    """Encoder dims[0]->dims[-1] then mirrored decoder, MSE loss against
+    the input itself."""
+    data = sym.Variable("data")
+    target = sym.Variable("target_label")
+    net = data
+    for i, d in enumerate(dims[1:]):
+        net = sym.FullyConnected(net, num_hidden=d, name=f"enc{i}")
+        net = sym.Activation(net, act_type="relu")
+    for i, d in enumerate(reversed(dims[:-1])):
+        net = sym.FullyConnected(net, num_hidden=d, name=f"dec{i}")
+        if i < len(dims) - 2:
+            net = sym.Activation(net, act_type="relu")
+    return sym.LinearRegressionOutput(net, target, name=out_name)
+
+
+def train_ae(x, dims, num_epochs, batch_size, lr, arg_params=None):
+    net = ae_symbol(dims)
+    it = mx.io.NDArrayIter({"data": x}, {"target_label": x},
+                           batch_size=batch_size, shuffle=True)
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("target_label",))
+    mod.fit(it, num_epoch=num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": lr},
+            arg_params=arg_params, allow_missing=True,
+            eval_metric="mse")
+    args_out, _ = mod.get_params()
+    score = mod.score(it, "mse")[0][1]
+    return args_out, score
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--pretrain-epochs", type=int, default=2)
+    ap.add_argument("--finetune-epochs", type=int, default=3)
+    ap.add_argument("--dims", type=str, default="784,128,32")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    dims = [int(d) for d in args.dims.split(",")]
+    (xtr, _), _ = get_synthetic_mnist(2048, 16)
+    x = xtr.reshape(len(xtr), -1).astype(np.float32)
+
+    # layerwise pretraining: train each (d_i -> d_{i+1}) pair alone
+    pretrained = {}
+    h = x
+    for i in range(len(dims) - 1):
+        pair_args, mse = train_ae(h, [dims[i], dims[i + 1]],
+                                  args.pretrain_epochs, args.batch_size,
+                                  1e-3)
+        logging.info("layer %d pretrain mse %.4f", i, mse)
+        pretrained[f"enc{i}_weight"] = pair_args["enc0_weight"]
+        pretrained[f"enc{i}_bias"] = pair_args["enc0_bias"]
+        pretrained[f"dec{len(dims) - 2 - i}_weight"] = pair_args["dec0_weight"]
+        pretrained[f"dec{len(dims) - 2 - i}_bias"] = pair_args["dec0_bias"]
+        # encode h for the next layer with the trained encoder
+        w = pair_args["enc0_weight"].asnumpy()
+        bset = pair_args["enc0_bias"].asnumpy()
+        h = np.maximum(h @ w.T + bset, 0.0)
+
+    _, final_mse = train_ae(x, dims, args.finetune_epochs, args.batch_size,
+                            1e-4, arg_params=pretrained)
+    logging.info("finetuned stack mse %.4f", final_mse)

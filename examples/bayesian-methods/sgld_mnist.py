@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Bayesian learning via SGLD (parity: example/bayesian-methods/sgld.py):
+stochastic gradient Langevin dynamics — SGD plus Gaussian gradient noise
+— collects posterior weight samples whose averaged predictions beat any
+single sample."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.test_utils import get_synthetic_mnist  # noqa: E402
+
+
+def build_net():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(sym.Flatten(data), num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--burn-in-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(4096, 512)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(xte, yte, batch_size=args.batch_size)
+
+    net = build_net()
+    mod = mx.mod.Module(net)
+    samples = []
+
+    def collect(epoch, symbol, arg_params, aux_params):
+        if epoch >= args.burn_in_epochs:
+            samples.append({k: v.copy() for k, v in arg_params.items()})
+
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="sgld",
+            optimizer_params={"learning_rate": args.lr, "wd": 1e-4},
+            epoch_end_callback=collect,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 30))
+
+    # posterior predictive = average softmax over weight samples
+    probs = np.zeros((len(xte), 10), np.float32)
+    scorer = mx.mod.Module(net)
+    scorer.bind(data_shapes=[("data", (args.batch_size,) + xte.shape[1:])],
+                for_training=False, label_shapes=None)
+    for s in samples:
+        scorer.set_params(s, {}, allow_missing=True)
+        val.reset()
+        preds = scorer.predict(val)
+        probs += preds.asnumpy()[: len(xte)]
+    ensemble_acc = float((probs.argmax(axis=1) == yte).mean())
+    single_acc = mod.score(val, "acc")[0][1]
+    logging.info("last-sample acc %.3f, posterior-averaged acc %.3f "
+                 "(%d samples)", single_acc, ensemble_acc, len(samples))

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Inference throughput across the symbol zoo (parity:
+example/image-classification/benchmark_score.py — the script behind the
+reference's perf.md tables, docs/how_to/perf.md:30-100)."""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def score(network, batch_size, num_batches=10, image_shape=(3, 224, 224),
+          num_classes=1000, dev=None):
+    sym = models.get_symbol(network, num_classes=num_classes)
+    data_shape = (batch_size,) + image_shape
+    ex = sym.simple_bind(ctx=dev, grad_req="null", data=data_shape)
+    init = mx.init.Xavier(magnitude=2.0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            init(name, arr)
+    data = mx.nd.array(np.random.uniform(size=data_shape).astype(np.float32))
+
+    # warmup (compile) then timed steps
+    ex.arg_dict["data"][:] = data.asnumpy()
+    for _ in range(2):
+        ex.forward(is_train=False)
+        ex.outputs[0].wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        ex.forward(is_train=False)
+    ex.outputs[0].wait_to_read()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--networks", type=str,
+                    default="alexnet,vgg-16,inception-bn,inception-v3,"
+                            "resnet-50,resnet-152")
+    ap.add_argument("--batch-sizes", type=str, default="1,32")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    args = ap.parse_args()
+    for net in args.networks.split(","):
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            try:
+                ips = score(net, b)
+                print(f"network: {net:20s} batch: {b:3d}  {ips:9.1f} img/s",
+                      flush=True)
+            except Exception as e:
+                print(f"network: {net:20s} batch: {b:3d}  FAILED {e}",
+                      flush=True)

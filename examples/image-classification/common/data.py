@@ -1,0 +1,101 @@
+"""Dataset iterators for the image-classification examples.
+
+Parity: example/image-classification/common/data.py (reference) — which
+downloads MNIST/CIFAR RecordIO.  This environment has no network egress,
+so each loader prefers on-disk data (``data/`` next to the scripts, same
+filenames as the reference) and otherwise synthesizes a deterministic
+learnable dataset of the same shape, keeping every example runnable.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "data")
+
+
+def _synthetic_images(num, shape, num_classes, seed):
+    """Class-dependent blob patterns + noise: learnable by small convnets
+    but not trivially linearly separable."""
+    rs = np.random.RandomState(seed)
+    c, h, w = shape
+    proto = rs.uniform(0, 1, (num_classes, c, h, w)).astype(np.float32)
+    y = rs.randint(0, num_classes, num).astype(np.float32)
+    x = proto[y.astype(int)] + rs.normal(0, 0.3, (num, c, h, w)).astype(np.float32)
+    return x.astype(np.float32), y
+
+
+def get_mnist_iter(args):
+    """MNIST (real idx files if present, else synthetic 1x28x28)."""
+    batch = args.batch_size
+    names = ["train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+             "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"]
+    paths = [os.path.join(DATA_DIR, n) for n in names]
+    if all(os.path.exists(p) for p in paths):
+        def read(images, labels):
+            with gzip.open(labels) as f:
+                struct.unpack(">II", f.read(8))
+                lab = np.frombuffer(f.read(), dtype=np.uint8).astype(np.float32)
+            with gzip.open(images) as f:
+                _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                img = np.frombuffer(f.read(), dtype=np.uint8)
+                img = img.reshape(num, 1, rows, cols).astype(np.float32) / 255
+            return img, lab
+
+        xtr, ytr = read(paths[0], paths[1])
+        xte, yte = read(paths[2], paths[3])
+    else:
+        xtr, ytr = _synthetic_images(4096, (1, 28, 28), 10, seed=7)
+        xte, yte = _synthetic_images(1024, (1, 28, 28), 10, seed=8)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=batch, shuffle=True)
+    val = mx.io.NDArrayIter(xte, yte, batch_size=batch)
+    return train, val
+
+
+def get_cifar10_iter(args):
+    """CIFAR-10 (RecordIO shards if present, else synthetic 3x32x32)."""
+    batch = args.batch_size
+    rec = os.path.join(DATA_DIR, "cifar10_train.rec")
+    if os.path.exists(rec):
+        train = mx.image.ImageRecordIter(
+            path_imgrec=rec, data_shape=(3, 32, 32), batch_size=batch,
+            rand_crop=True, rand_mirror=True)
+        val = mx.image.ImageRecordIter(
+            path_imgrec=os.path.join(DATA_DIR, "cifar10_val.rec"),
+            data_shape=(3, 32, 32), batch_size=batch)
+        return train, val
+    xtr, ytr = _synthetic_images(4096, (3, 32, 32), 10, seed=11)
+    xte, yte = _synthetic_images(1024, (3, 32, 32), 10, seed=12)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=batch, shuffle=True)
+    val = mx.io.NDArrayIter(xte, yte, batch_size=batch)
+    return train, val
+
+
+def get_imagenet_iter(args):
+    """ImageNet RecordIO pipeline (parity: train_imagenet.py data args);
+    synthetic 3x224x224 when no --data-train rec is given."""
+    batch = args.batch_size
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    if getattr(args, "data_train", None) and os.path.exists(args.data_train):
+        train = mx.image.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=shape, batch_size=batch,
+            rand_crop=True, rand_mirror=True,
+            part_index=getattr(args, "part_index", 0),
+            num_parts=getattr(args, "num_parts", 1),
+            preprocess_threads=args.data_nthreads)
+        val = None
+        if getattr(args, "data_val", None) and os.path.exists(args.data_val):
+            val = mx.image.ImageRecordIter(
+                path_imgrec=args.data_val, data_shape=shape, batch_size=batch,
+                preprocess_threads=args.data_nthreads)
+        return train, val
+    xtr, ytr = _synthetic_images(args.num_examples, shape,
+                                 args.num_classes, seed=21)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=batch, shuffle=True)
+    return train, None

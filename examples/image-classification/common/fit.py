@@ -1,0 +1,115 @@
+"""Shared training harness for the image-classification examples.
+
+Parity: example/image-classification/common/fit.py (reference): one
+argparse surface (network, devices, batch, lr schedule, kvstore,
+checkpointing, resume) + one ``fit()`` that wires iterators, Module,
+Speedometer and checkpoint callbacks.  Device flags are TPU-flavored:
+``--devices 0,1,..`` builds the data-parallel mesh (the reference's
+``--gpus``).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser: argparse.ArgumentParser):
+    train = parser.add_argument_group("Training")
+    train.add_argument("--network", type=str, default="resnet-18")
+    train.add_argument("--devices", type=str, default="",
+                       help="comma list of device ids for data parallelism"
+                            " (empty = default device)")
+    train.add_argument("--kv-store", type=str, default="device")
+    train.add_argument("--num-epochs", type=int, default=10)
+    train.add_argument("--lr", type=float, default=0.1)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str, default="",
+                       help="e.g. 30,60 — epochs to decay lr at")
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=1e-4)
+    train.add_argument("--batch-size", type=int, default=128)
+    train.add_argument("--disp-batches", type=int, default=20)
+    train.add_argument("--model-prefix", type=str, default=None)
+    train.add_argument("--load-epoch", type=int, default=None)
+    train.add_argument("--monitor", type=int, default=0,
+                       help="log weight/grad stats every N batches")
+    train.add_argument("--num-examples", type=int, default=4096)
+    train.add_argument("--num-classes", type=int, default=10)
+    train.add_argument("--data-nthreads", type=int, default=4)
+    return parser
+
+
+def _devices(args):
+    if not args.devices:
+        return None
+    ids = [int(x) for x in args.devices.split(",") if x != ""]
+    dev = mx.context.default_accelerator_context().device_type
+    return [mx.Context(dev, i) for i in ids]
+
+
+def _lr_scheduler(args, steps_per_epoch):
+    if not args.lr_step_epochs:
+        return None
+    epochs = [int(e) for e in args.lr_step_epochs.split(",")]
+    begin = args.load_epoch or 0
+    steps = [(e - begin) * steps_per_epoch for e in epochs if e > begin]
+    if not steps:
+        return None
+    return mx.lr_scheduler.MultiFactorScheduler(step=steps,
+                                                factor=args.lr_factor)
+
+
+def fit(args, network, data_loader, **kwargs):
+    """Parity: common/fit.py fit() — train `network` with `data_loader`
+    (a fn(args) -> (train_iter, val_iter))."""
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    logging.info("start with arguments %s", args)
+    train, val = data_loader(args)
+
+    devs = _devices(args)
+    mod = mx.mod.Module(symbol=network, context=devs)
+
+    arg_params, aux_params = None, None
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        logging.info("resumed from %s-%04d.params",
+                     args.model_prefix, args.load_epoch)
+
+    steps_per_epoch = max(args.num_examples // args.batch_size, 1)
+    optimizer_params = {
+        "learning_rate": args.lr,
+        "wd": args.wd,
+        "lr_scheduler": _lr_scheduler(args, steps_per_epoch),
+    }
+    if args.optimizer in ("sgd", "nag"):
+        optimizer_params["momentum"] = args.mom
+
+    checkpoint = (mx.callback.do_checkpoint(args.model_prefix)
+                  if args.model_prefix else None)
+    monitor = (mx.Monitor(args.monitor, pattern=".*") if args.monitor > 0
+               else None)
+
+    mod.fit(train,
+            begin_epoch=args.load_epoch or 0,
+            num_epoch=args.num_epochs,
+            eval_data=val,
+            eval_metric=kwargs.get("eval_metric", "acc"),
+            kvstore=args.kv_store,
+            optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            arg_params=arg_params,
+            aux_params=aux_params,
+            allow_missing=True,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       args.disp_batches),
+            epoch_end_callback=checkpoint,
+            monitor=monitor)
+    return mod

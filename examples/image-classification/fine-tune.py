@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Fine-tune a pretrained checkpoint on a new dataset (parity:
+example/image-classification/fine-tune.py): load ``--pretrained-model``,
+chop the head at the last feature layer, attach a fresh FC+Softmax for
+``--num-classes``, and train with the backbone params loaded."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from common import data, fit  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name="flatten"):
+    """Parity: fine-tune.py get_fine_tune_model — new head on an internal
+    feature layer; backbone weights reused, head initialized fresh."""
+    internals = symbol.get_internals()
+    outputs = internals.list_outputs()
+    candidates = [n for n in outputs if layer_name in n]
+    if not candidates:
+        raise ValueError(f"no internal output matching {layer_name!r}")
+    net = internals[outputs.index(candidates[-1])]
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc_new")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    new_args = {k: v for k, v in arg_params.items()
+                if k in net.list_arguments()}
+    return net, new_args
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="fine-tune a pretrained model",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    parser.add_argument("--pretrained-model", type=str, required=True,
+                        help="checkpoint prefix to start from")
+    parser.add_argument("--pretrained-epoch", type=int, default=0)
+    parser.add_argument("--layer-before-fullc", type=str, default="flatten")
+    parser.set_defaults(network="resnet-18", num_epochs=2, batch_size=64,
+                        lr=0.01, num_classes=10)
+    args = parser.parse_args()
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.pretrained_model, args.pretrained_epoch)
+    net, new_args = get_fine_tune_model(sym, arg_params, args.num_classes,
+                                        args.layer_before_fullc)
+
+    logging.basicConfig(level=logging.INFO)
+    train, val = data.get_cifar10_iter(args)
+    mod = mx.mod.Module(net, context=None)
+    mod.fit(train, eval_data=val,
+            num_epoch=args.num_epochs,
+            arg_params=new_args, aux_params=aux_params, allow_missing=True,
+            kvstore=args.kv_store, optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr, "momentum": args.mom,
+                              "wd": args.wd},
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       args.disp_batches))

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Train a ResNet on CIFAR-10 (parity: example/image-classification/
+train_cifar10.py)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from common import data, fit  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train CIFAR-10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="resnet-20", num_epochs=10, batch_size=128,
+                        lr=0.05, lr_step_epochs="60,80", num_classes=10,
+                        num_examples=4096)
+    args = parser.parse_args()
+
+    net = models.get_symbol(args.network, num_classes=args.num_classes,
+                            image_shape=(3, 32, 32))
+    fit.fit(args, net, data.get_cifar10_iter)

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Train ImageNet-scale networks (parity: example/image-classification/
+train_imagenet.py — the reference's north-star benchmark config,
+kvstore=device ⇒ ICI all-reduce on TPU).
+
+With --fused 1 the whole train step (fwd+bwd+optimizer) compiles to one
+donated XLA computation with bf16 compute — the TPU-native fast path
+bench.py measures."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from common import data, fit  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def train_fused(args, net):
+    import logging
+    import time
+
+    import numpy as np
+
+    from mxnet_tpu.trainer import FusedTrainer
+
+    logging.basicConfig(level=logging.INFO)
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    train, _ = data.get_imagenet_iter(args)
+    tr = FusedTrainer(net, optimizer=args.optimizer,
+                      optimizer_params={"lr": args.lr, "momentum": args.mom,
+                                        "wd": args.wd,
+                                        "rescale_grad": 1.0 / args.batch_size})
+    tr.init(data=(args.batch_size,) + shape)
+    for epoch in range(args.num_epochs):
+        train.reset()
+        tic, n = time.time(), 0
+        for batch in train:
+            tr.step(data=batch.data[0].asnumpy(),
+                    softmax_label=batch.label[0].asnumpy())
+            n += args.batch_size
+            if n % (args.disp_batches * args.batch_size) == 0:
+                logging.info("Epoch[%d] %.1f img/s", epoch,
+                             n / (time.time() - tic))
+        logging.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train ImageNet",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--data-train", type=str, default=None,
+                        help="RecordIO file (synthetic data if absent)")
+    parser.add_argument("--data-val", type=str, default=None)
+    parser.add_argument("--fused", type=int, default=1,
+                        help="1: FusedTrainer one-XLA-computation step")
+    parser.set_defaults(network="resnet-50", num_epochs=1, batch_size=32,
+                        lr=0.1, num_classes=1000, num_examples=1024)
+    args = parser.parse_args()
+
+    net = models.get_symbol(args.network, num_classes=args.num_classes)
+    if args.fused:
+        train_fused(args, net)
+    else:
+        fit.fit(args, net, data.get_imagenet_iter)

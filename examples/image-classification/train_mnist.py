@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Train MLP/LeNet on MNIST (parity: example/image-classification/
+train_mnist.py — the reference's minimum end-to-end slice and the first
+milestone of SURVEY.md §7's build order)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from common import data, fit  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train MNIST",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=5, batch_size=64, lr=0.05,
+                        num_classes=10, num_examples=4096, kv_store="local")
+    args = parser.parse_args()
+
+    if args.network == "mlp":
+        net = models.mlp.get_symbol(num_classes=args.num_classes)
+    else:
+        net = models.get_symbol(args.network, num_classes=args.num_classes,
+                                image_shape=(1, 28, 28))
+    fit.fit(args, net, data.get_mnist_iter)

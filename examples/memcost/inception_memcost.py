@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Gradient-mirroring memory/speed trade (parity: example/memcost/):
+the reference's MXNET_BACKWARD_DO_MIRROR recomputes cheap activations in
+backward; on TPU the same trade is jax.checkpoint (rematerialization)
+applied to the fused train step.  This script times both settings."""
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+from mxnet_tpu.trainer import FusedTrainer  # noqa: E402
+
+
+def run(remat, args):
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1" if remat else "0"
+    net = models.get_symbol(args.network, num_classes=10,
+                            image_shape=(3, 32, 32))
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.05},
+                      remat=remat)
+    tr.init(data=(args.batch_size, 3, 32, 32))
+    rs = np.random.RandomState(0)
+    x = rs.uniform(size=(args.batch_size, 3, 32, 32)).astype(np.float32)
+    y = rs.randint(0, 10, args.batch_size).astype(np.float32)
+    tr.step(data=x, softmax_label=y)  # compile
+    tic = time.time()
+    for _ in range(args.iterations):
+        out = tr.step(data=x, softmax_label=y)
+    import jax
+
+    jax.block_until_ready(out)
+    return (time.time() - tic) / args.iterations
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet-20")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--iterations", type=int, default=10)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    base = run(False, args)
+    remat = run(True, args)
+    logging.info("no-mirror %.1f ms/step, mirror(remat) %.1f ms/step "
+                 "(%.0f%% slower, activations not stored)",
+                 base * 1e3, remat * 1e3, (remat / base - 1) * 100)

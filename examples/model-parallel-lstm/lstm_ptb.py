@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Model-parallel LSTM: layers placed on different devices via ctx_group.
+
+Parity: example/model-parallel-lstm/lstm_ptb.py (reference): each LSTM
+layer is annotated with ``AttrScope(ctx_group=...)`` and ``bind(
+group2ctx={group: device})`` places it; the engine overlaps the stages.
+
+TPU-native meaning (SURVEY.md §7 PlaceDevice row): the ctx_group
+annotations become sharding hints — XLA/GSPMD schedules the pipeline and
+inserts the inter-device transfers that `_CrossDeviceCopy` nodes did in
+the reference.  Run with MXTPU_PLATFORM=cpu and
+XLA_FLAGS=--xla_force_host_platform_device_count=2 to see two-device
+placement without hardware."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.models.lstm import LSTMParam, LSTMState, lstm  # noqa: E402
+
+
+def model_parallel_lstm(num_layers, seq_len, vocab_size, num_hidden,
+                        num_embed, group_per_layer):
+    """Parity: model-parallel-lstm/lstm.py lstm_unroll with per-layer
+    ctx_group annotations (reference lstm.py:48-99)."""
+    with mx.AttrScope(ctx_group="embed"):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed_weight = sym.Variable("embed_weight")
+        embed = sym.Embedding(data, weight=embed_weight,
+                              input_dim=vocab_size, output_dim=num_embed,
+                              name="embed")
+        slices = sym.SliceChannel(embed, num_outputs=seq_len, axis=1,
+                                  squeeze_axis=True)
+
+    params, states = [], []
+    for layer in range(num_layers):
+        with mx.AttrScope(ctx_group=group_per_layer[layer]):
+            params.append(LSTMParam(
+                i2h_weight=sym.Variable(f"l{layer}_i2h_weight"),
+                i2h_bias=sym.Variable(f"l{layer}_i2h_bias"),
+                h2h_weight=sym.Variable(f"l{layer}_h2h_weight"),
+                h2h_bias=sym.Variable(f"l{layer}_h2h_bias")))
+            states.append(LSTMState(c=sym.Variable(f"l{layer}_init_c"),
+                                    h=sym.Variable(f"l{layer}_init_h")))
+
+    outputs = []
+    for t in range(seq_len):
+        x = slices[t]
+        for layer in range(num_layers):
+            with mx.AttrScope(ctx_group=group_per_layer[layer]):
+                states[layer] = lstm(num_hidden, x, states[layer],
+                                     params[layer], t, layer)
+                x = states[layer].h
+        outputs.append(x)
+
+    with mx.AttrScope(ctx_group="out"):
+        concat = sym.Concat(*outputs, dim=0)
+        pred = sym.FullyConnected(concat, num_hidden=vocab_size, name="pred")
+        label_t = sym.transpose(label)
+        label_flat = sym.Reshape(label_t, shape=(-1,))
+        return sym.SoftmaxOutput(pred, label_flat, name="softmax")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description="model-parallel LSTM")
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--vocab-size", type=int, default=1000)
+    ap.add_argument("--num-hidden", type=int, default=128)
+    ap.add_argument("--num-embed", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-batches", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ndev = mx.num_devices(mx.context.default_accelerator_context().device_type)
+    groups = [f"layer{i}" for i in range(args.num_layers)]
+    net = model_parallel_lstm(args.num_layers, args.seq_len, args.vocab_size,
+                              args.num_hidden, args.num_embed, groups)
+
+    # each layer group on its own device (wraps when layers > devices)
+    dev_t = mx.context.default_accelerator_context().device_type
+    group2ctx = {"embed": mx.Context(dev_t, 0), "out": mx.Context(dev_t, 0)}
+    for i, g in enumerate(groups):
+        group2ctx[g] = mx.Context(dev_t, i % max(ndev, 1))
+    logging.info("placement: %s", {k: str(v) for k, v in group2ctx.items()})
+
+    shapes = {"data": (args.batch_size, args.seq_len),
+              "softmax_label": (args.batch_size, args.seq_len)}
+    for layer in range(args.num_layers):
+        shapes[f"l{layer}_init_c"] = (args.batch_size, args.num_hidden)
+        shapes[f"l{layer}_init_h"] = (args.batch_size, args.num_hidden)
+    ex = net.simple_bind(ctx=mx.Context(dev_t, 0), group2ctx=group2ctx,
+                         **shapes)
+
+    init = mx.init.Xavier(magnitude=2.34)
+    rs = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name not in shapes:
+            init(name, arr)
+
+    for step in range(args.num_batches):
+        data = rs.randint(0, args.vocab_size,
+                          (args.batch_size, args.seq_len)).astype(np.float32)
+        label = np.roll(data, -1, axis=1)
+        ex.arg_dict["data"][:] = data
+        ex.arg_dict["softmax_label"][:] = label
+        ex.forward(is_train=True)
+        ex.backward()
+        for name, grad in ex.grad_dict.items():
+            if grad is not None and name not in shapes:
+                ex.arg_dict[name][:] = (ex.arg_dict[name] - args.lr * grad).asnumpy()
+        loss = -np.log(np.maximum(
+            ex.outputs[0].asnumpy()[np.arange(args.batch_size * args.seq_len),
+                                    label.T.reshape(-1).astype(int)], 1e-9)).mean()
+        logging.info("step %d loss %.3f", step, loss)

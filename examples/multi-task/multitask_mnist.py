@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Multi-task training: one trunk, two softmax heads (parity:
+example/multi-task/example_multi_task.py — digit class + parity bit),
+with a Group'd symbol and a custom composite metric."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.test_utils import get_synthetic_mnist  # noqa: E402
+
+
+class MultiTaskIter(mx.io.DataIter):
+    """Wraps an iter to emit two labels (digit, parity)."""
+
+    def __init__(self, base):
+        super().__init__()
+        self._base = base
+        self.batch_size = base.batch_size
+
+    @property
+    def provide_data(self):
+        return self._base.provide_data
+
+    @property
+    def provide_label(self):
+        (name, shape) = self._base.provide_label[0][:2]
+        return [("softmax1_label", shape), ("softmax2_label", shape)]
+
+    def reset(self):
+        self._base.reset()
+
+    def next(self):
+        batch = self._base.next()
+        digit = batch.label[0]
+        parity = mx.nd.array(digit.asnumpy() % 2)
+        return mx.io.DataBatch(batch.data, [digit, parity], pad=batch.pad)
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Parity: example_multi_task.py Multi_Accuracy — per-output acc
+    (EvalMetric's ``num`` gives per-output sum/inst lists)."""
+
+    def __init__(self, num=2):
+        super().__init__("multi-accuracy", num=num)
+
+    def update(self, labels, preds):
+        for i, (label, pred) in enumerate(zip(labels, preds)):
+            y = label.asnumpy().astype(int)
+            p = pred.asnumpy().argmax(axis=1)
+            self.sum_metric[i] += float((y == p).sum())
+            self.num_inst[i] += y.shape[0]
+
+
+def build_net():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(sym.Flatten(data), num_hidden=128, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    digit = sym.FullyConnected(net, num_hidden=10, name="fcd")
+    digit = sym.SoftmaxOutput(digit, name="softmax1")
+    parity = sym.FullyConnected(net, num_hidden=2, name="fcp")
+    parity = sym.SoftmaxOutput(parity, name="softmax2")
+    return sym.Group([digit, parity])
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=4)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(4096, 512)
+    train = MultiTaskIter(mx.io.NDArrayIter(xtr, ytr,
+                                            batch_size=args.batch_size,
+                                            shuffle=True))
+    val = MultiTaskIter(mx.io.NDArrayIter(xte, yte,
+                                          batch_size=args.batch_size))
+    mod = mx.mod.Module(build_net(),
+                        label_names=("softmax1_label", "softmax2_label"))
+    mod.fit(train, eval_data=val, eval_metric=MultiAccuracy(),
+            num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    logging.info("scores: %s", mod.score(val, MultiAccuracy()))

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""User-defined operator in Python (parity: example/numpy-ops/
+custom_softmax.py): a CustomOp softmax with numpy forward/backward,
+registered and used inside a symbolic network.
+
+On TPU the custom op runs through the host-callback bridge — the
+symbolic graph stays compiled, with an escape hatch for the op body
+(mxnet_tpu/ops/custom.py)."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.test_utils import get_synthetic_mnist  # noqa: E402
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(int)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register("softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    net = sym.FullyConnected(sym.Flatten(data), name="fc1", num_hidden=128)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=10)
+    net = sym.Custom(net, label, name="softmax", op_type="softmax")
+
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(2048, 256)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(xte, yte, batch_size=args.batch_size)
+    mod = mx.mod.Module(net)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    logging.info("val acc: %.3f", mod.score(val, "acc")[0][1])

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Op-level profiling to chrome://tracing JSON (parity:
+example/profiler/profiler_executor.py): run a bound executor with the
+profiler on, dump profile.json, open in chrome://tracing or Perfetto."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="lenet")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--iterations", type=int, default=5)
+    ap.add_argument("--filename", default="profile_executor.json")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = models.get_symbol(args.network, num_classes=10,
+                            image_shape=(1, 28, 28))
+    ex = net.simple_bind(ctx=None, data=(args.batch_size, 1, 28, 28))
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            init(name, arr)
+    ex.arg_dict["data"][:] = np.random.uniform(
+        size=(args.batch_size, 1, 28, 28)).astype(np.float32)
+
+    mx.profiler.profiler_set_config(mode="all", filename=args.filename)
+    mx.profiler.profiler_set_state("run")
+    for _ in range(args.iterations):
+        ex.forward(is_train=True)
+        ex.backward()
+    ex.outputs[0].wait_to_read()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    logging.info("wrote %s — open in chrome://tracing", args.filename)

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""LSTM language model with bucketing (parity: example/rnn/
+lstm_bucketing.py — PTB next-word prediction).
+
+Variable-length sentences are binned into buckets; BucketingModule keeps
+one executor per bucket sharing parameters.  On TPU each bucket is one
+jit cache entry (SURVEY.md §5.7: the reference's shared memory pool
+becomes the compile cache), so the bucket list should stay short.
+
+Uses the PTB text at ``data/ptb.train.txt`` when present; otherwise a
+synthetic corpus with Zipf-distributed tokens and sentence lengths."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models.lstm import lstm_unroll  # noqa: E402
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    """Parity: lstm_bucketing.py tokenize_text."""
+    with open(fname) as f:
+        lines = f.read().splitlines()
+    sentences = [line.split() for line in lines if line.strip()]
+    if vocab is None:
+        vocab = {}
+    out = []
+    for words in sentences:
+        ids = []
+        for w in words:
+            if w not in vocab:
+                vocab[w] = len(vocab) + start_label
+            ids.append(vocab[w])
+        out.append(ids)
+    return out, vocab
+
+
+def synthetic_corpus(num_sentences, vocab_size, seed=3):
+    rs = np.random.RandomState(seed)
+    ranks = np.arange(1, vocab_size + 1)
+    probs = (1.0 / ranks) / (1.0 / ranks).sum()  # Zipf
+    sentences = []
+    for _ in range(num_sentences):
+        length = int(rs.randint(5, 33))
+        sentences.append(rs.choice(vocab_size, size=length, p=probs).tolist())
+    return sentences
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description="LSTM bucketing LM")
+    ap.add_argument("--num-hidden", type=int, default=200)
+    ap.add_argument("--num-embed", type=int, default=200)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--buckets", type=str, default="8,16,24,32")
+    ap.add_argument("--vocab-size", type=int, default=2000)
+    ap.add_argument("--num-sentences", type=int, default=2000)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ptb = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data", "ptb.train.txt")
+    if os.path.exists(ptb):
+        sentences, vocab = tokenize_text(ptb, start_label=1)
+        vocab_size = len(vocab) + 1
+    else:
+        sentences = synthetic_corpus(args.num_sentences, args.vocab_size - 1)
+        vocab_size = args.vocab_size
+
+    buckets = [int(b) for b in args.buckets.split(",")]
+    # init LSTM states are fed through the iterator as zero arrays (the
+    # v0.9 bucketing pattern); BucketSentenceIter produces next-token
+    # labels (shift-by-one) itself
+    init_states = []
+    for layer in range(args.num_layers):
+        init_states += [(f"l{layer}_init_c", (args.batch_size, args.num_hidden)),
+                        (f"l{layer}_init_h", (args.batch_size, args.num_hidden))]
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                      buckets=buckets, invalid_label=0,
+                                      init_states=init_states)
+
+    def sym_gen(seq_len):
+        symbol = lstm_unroll(args.num_layers, seq_len, vocab_size,
+                             args.num_hidden, args.num_embed, vocab_size,
+                             dropout=0.2)
+        data_names = ("data",) + tuple(n for n, _ in init_states)
+        return symbol, data_names, ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train.default_bucket_key)
+    mod.fit(train,
+            eval_metric=mx.metric.Perplexity(ignore_label=0),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-5},
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))

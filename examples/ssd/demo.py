@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""SSD inference demo (parity: example/ssd/demo.py): deploy graph with
+softmax + MultiBoxDetection NMS, prints detections [cls, score, box]."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models import ssd  # noqa: E402
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-classes", type=int, default=3)
+    ap.add_argument("--data-size", type=int, default=300)
+    ap.add_argument("--nms-thresh", type=float, default=0.45)
+    ap.add_argument("--thresh", type=float, default=0.2)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = ssd.get_symbol(num_classes=args.num_classes,
+                         nms_thresh=args.nms_thresh)
+    ex = net.simple_bind(ctx=None, grad_req="null",
+                         data=(1, 3, args.data_size, args.data_size))
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            init(name, arr)
+    img = np.random.uniform(0, 1,
+                            (1, 3, args.data_size, args.data_size))
+    ex.arg_dict["data"][:] = img.astype(np.float32)
+    ex.forward(is_train=False)
+    dets = ex.outputs[0].asnumpy()[0]
+    keep = dets[dets[:, 1] > args.thresh]
+    logging.info("detections above %.2f: %d (of %d anchors)",
+                 args.thresh, len(keep), dets.shape[0])
+    for d in keep[:10]:
+        logging.info("cls=%d score=%.2f box=(%.2f,%.2f,%.2f,%.2f)",
+                     int(d[0]), d[1], *d[2:6])

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""SSD-VGG16 detection training (parity: example/ssd/train.py with the
+custom multibox ops from example/ssd/operator/ — here MultiBoxPrior/
+Target/Detection are built-in ops, SURVEY.md Appendix A custom tail).
+
+Synthetic detection data: images with colored rectangles, boxes as
+(cls, x1, y1, x2, y2) normalized, -1 padded."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models import ssd  # noqa: E402
+
+
+def synthetic_detections(num, size, max_boxes, num_classes, seed=5):
+    rs = np.random.RandomState(seed)
+    imgs = np.zeros((num, 3, size, size), np.float32)
+    labels = np.full((num, max_boxes, 5), -1.0, np.float32)
+    for i in range(num):
+        nbox = rs.randint(1, max_boxes + 1)
+        for j in range(nbox):
+            cls = rs.randint(0, num_classes)
+            w, h = rs.uniform(0.2, 0.5, 2)
+            x1, y1 = rs.uniform(0, 1 - w), rs.uniform(0, 1 - h)
+            x2, y2 = x1 + w, y1 + h
+            px = (np.array([x1, y1, x2, y2]) * size).astype(int)
+            imgs[i, cls % 3, px[1]:px[3], px[0]:px[2]] = 1.0
+            labels[i, j] = [cls, x1, y1, x2, y2]
+    return imgs, labels
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-classes", type=int, default=3)
+    ap.add_argument("--data-size", type=int, default=300)
+    ap.add_argument("--num-steps", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.001)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = ssd.get_symbol_train(num_classes=args.num_classes)
+    b = args.batch_size
+    ex = net.simple_bind(ctx=None, data=(b, 3, args.data_size, args.data_size),
+                         label=(b, 8, 5))
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label"):
+            init(name, arr)
+
+    imgs, labels = synthetic_detections(64, args.data_size, 8,
+                                        args.num_classes)
+    opt = mx.optimizer.create("sgd", learning_rate=args.lr, momentum=0.9,
+                              wd=5e-4)
+    updater = mx.optimizer.get_updater(opt)
+    for step in range(args.num_steps):
+        sel = slice((step * b) % 64, (step * b) % 64 + b)
+        ex.arg_dict["data"][:] = imgs[sel]
+        ex.arg_dict["label"][:] = labels[sel]
+        ex.forward(is_train=True)
+        ex.backward()
+        for i, name in enumerate(ex.symbol.list_arguments()):
+            if name in ("data", "label") or ex.grad_dict.get(name) is None:
+                continue
+            updater(i, ex.grad_dict[name], ex.arg_dict[name])
+        outs = [o.asnumpy() for o in ex.outputs]
+        logging.info("step %d  outputs %s", step,
+                     [tuple(o.shape) for o in outs])
+    logging.info("done — deploy graph: models.ssd.get_symbol() adds "
+                 "softmax + NMS MultiBoxDetection")

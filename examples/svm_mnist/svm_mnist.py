@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Large-margin (SVM) output head (parity: example/svm_mnist/
+svm_mnist.py): same MLP trunk, SVMOutput loss instead of softmax."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.test_utils import get_synthetic_mnist  # noqa: E402
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--use-l2", type=int, default=1,
+                    help="1: squared hinge (L2-SVM), 0: hinge")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(sym.Flatten(data), name="fc1", num_hidden=256)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=10)
+    net = sym.SVMOutput(net, name="svm", use_linear=not args.use_l2)
+
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(4096, 512)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch_size,
+                              shuffle=True, label_name="svm_label")
+    val = mx.io.NDArrayIter(xte, yte, batch_size=args.batch_size,
+                            label_name="svm_label")
+    mod = mx.mod.Module(net, label_names=("svm_label",))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "wd": 1e-4},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    logging.info("val acc: %.3f", mod.score(val, "acc")[0][1])

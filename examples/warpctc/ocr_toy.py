@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""CTC OCR toy (parity: example/warpctc/ — digit-sequence images trained
+with CTC loss; the reference needs the warpctc plugin, here WarpCTC is a
+built-in op backed by a lax.scan alpha recursion)."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+
+def gen_sample(rs, seq_len, num_digit, num_classes):
+    """Image = seq of digit 'glyph' columns; label = the digit ids + pad."""
+    glyphs = gen_sample.glyphs
+    cols = rs.randint(1, num_classes, num_digit)
+    img = np.concatenate([glyphs[c] for c in cols], axis=1)
+    img = img + rs.normal(0, 0.1, img.shape)
+    label = np.full((num_digit,), -1.0, np.float32)
+    label[: len(cols)] = cols
+    return img.astype(np.float32), label
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-steps", type=int, default=30)
+    ap.add_argument("--seq-len", type=int, default=4)
+    ap.add_argument("--num-classes", type=int, default=11,
+                    help="10 digits + blank(0)")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(0)
+    gen_sample.glyphs = rs.uniform(0, 1, (args.num_classes, 8, 6))
+
+    T = args.seq_len * 6  # input time steps = image columns
+    data = sym.Variable("data")          # (N, 8, T)
+    label = sym.Variable("label")        # (N, seq_len)
+    net = sym.transpose(data, axes=(2, 0, 1))   # (T, N, 8)
+    net = sym.Reshape(net, shape=(-1, 8))
+    net = sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=args.num_classes, name="fc2")
+    net = sym.Reshape(net, shape=(T, -1, args.num_classes))
+    net = sym.WarpCTC(net, label, label_length=args.seq_len,
+                      input_length=T, name="ctc")
+
+    ex = net.simple_bind(ctx=None, data=(args.batch_size, 8, T),
+                         label=(args.batch_size, args.seq_len))
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label"):
+            init(name, arr)
+
+    opt = mx.optimizer.create("adam", learning_rate=0.01)
+    updater = mx.optimizer.get_updater(opt)
+    for step in range(args.num_steps):
+        imgs, labels = zip(*[gen_sample(rs, args.seq_len, args.seq_len,
+                                        args.num_classes)
+                             for _ in range(args.batch_size)])
+        ex.arg_dict["data"][:] = np.stack(imgs)
+        ex.arg_dict["label"][:] = np.stack(labels)
+        ex.forward(is_train=True)
+        ex.backward()
+        for i, name in enumerate(ex.symbol.list_arguments()):
+            if name in ("data", "label"):
+                continue
+            updater(i, ex.grad_dict[name], ex.arg_dict[name])
+        if step % 10 == 0:
+            out = ex.outputs[0].asnumpy()  # (T, N, C) post-softmax
+            pred = out.argmax(axis=2).T    # greedy decode
+            logging.info("step %d  sample pred path %s", step, pred[0][:12])
+    logging.info("done")

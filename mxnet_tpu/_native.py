@@ -154,10 +154,12 @@ class NativeEngine:
         return int(self._lib.mxe_new_var(self._handle))
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
-        # NB: no reap here — a pending()==0 probe followed by _reap() is
-        # a TOCTOU race when another thread pushes in between (its
-        # closure could be freed mid-unwind).  Reaping happens only at
-        # wait_all/_shutdown, where quiescence is held by the caller.
+        # NB: no pending()==0-probe reap here — that was a TOCTOU race
+        # with concurrent pushers.  The two-generation reap is safe at
+        # any time (only frees tokens aged a full generation), so bound
+        # memory for wait-less workloads with a size trigger.
+        if len(self._done_old) > 4096:
+            self._reap()
         with self._cb_lock:
             self._cb_id += 1
             token = self._cb_id
@@ -205,6 +207,12 @@ class NativeEngine:
 
     def wait_for_var(self, var: int):
         self._lib.mxe_wait_for_var(self._handle, int(var))
+        # two-generation reap is safe here too: _done_old tokens were
+        # marked done before a previous reap call, and at least one full
+        # native wait round-trip has happened since — their trampoline
+        # epilogues have long retired.  Without this, workloads that only
+        # ever wait_for_var would leak closures unboundedly.
+        self._reap()
         self._raise_pending()
 
     def wait_all(self):

@@ -104,7 +104,7 @@ class _ServerState:
     def __init__(self, num_workers):
         self.num_workers = num_workers
         self.store = {}            # key -> np.ndarray (the weights)
-        self.merge_buf = {}        # key -> (accumulated np.ndarray, count)
+        self.merge_buf = {}        # key -> [accumulated np.ndarray, set(ranks)]
         self.updater = None        # fn(key, recv, stored) -> None (mutates stored)
         self.sync_mode = False
         self.barrier_count = 0

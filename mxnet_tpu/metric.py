@@ -177,6 +177,38 @@ class CrossEntropy(EvalMetric):
             self.num_inst += label_np.shape[0]
 
 
+class Perplexity(EvalMetric):
+    """exp(mean NLL) for language models; ``ignore_label`` entries
+    (padding from bucketing) are excluded (parity: mx.metric.Perplexity
+    as used by example/rnn training scripts)."""
+
+    def __init__(self, ignore_label=None, axis=-1):
+        super().__init__("Perplexity")
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        loss, num = 0.0, 0
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy().astype(np.int32).reshape(-1)
+            pred_np = pred.asnumpy()
+            if self.axis not in (-1, pred_np.ndim - 1):
+                pred_np = np.moveaxis(pred_np, self.axis, -1)
+            pred_np = pred_np.reshape(label_np.shape[0], -1)
+            prob = pred_np[np.arange(label_np.shape[0]),
+                           np.clip(label_np, 0, pred_np.shape[1] - 1)]
+            mask = np.ones_like(prob, dtype=bool)
+            if self.ignore_label is not None:
+                mask = label_np != self.ignore_label
+            loss += float(-np.log(np.maximum(prob[mask], 1e-10)).sum())
+            num += int(mask.sum())
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        return (self.name, float(np.exp(self.sum_metric / max(self.num_inst, 1))))
+
+
 class Torch(EvalMetric):
     """Parity stub: metric.py Torch (average of preds)."""
 
@@ -231,6 +263,7 @@ _METRICS = {
     "ce": CrossEntropy,
     "cross-entropy": CrossEntropy,
     "torch": Torch,
+    "perplexity": Perplexity,
 }
 
 

@@ -98,18 +98,20 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
 def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224), **kwargs):
     """Parity: resnet.py get_symbol — depth -> unit configuration table."""
     (nchannel, height, width) = image_shape
-    if height <= 28:
+    # mnist/cifar-style 3-stage variant for depths 6n+2 / 9n+2 (20, 50,
+    # 56, 110, 164...); depths outside those families (18, 34...) use
+    # the 4-stage imagenet topology even on small images
+    if height <= 32 and ((num_layers - 2) % 9 == 0 and num_layers >= 164
+                         or (num_layers - 2) % 6 == 0 and num_layers < 164):
         num_stages = 3
-        if (num_layers - 2) % 9 == 0 and num_layers >= 164:
+        if num_layers >= 164:
             per_unit = [(num_layers - 2) // 9]
             filter_list = [16, 64, 128, 256]
             bottle_neck = True
-        elif (num_layers - 2) % 6 == 0 and num_layers < 164:
+        else:
             per_unit = [(num_layers - 2) // 6]
             filter_list = [16, 16, 32, 64]
             bottle_neck = False
-        else:
-            raise ValueError(f"no experiments done on num_layers {num_layers}")
         units = per_unit * num_stages
     else:
         if num_layers >= 50:

@@ -72,6 +72,12 @@ class BucketingModule(BaseModule):
                         self._curr_module.inputs_need_grad,
                         force_rebind=False,
                         shared_module=self._buckets[self._default_bucket_key])
+            if self.optimizer_initialized:
+                # buckets created after init_optimizer share its state
+                # (parity: switch_bucket borrow_optimizer,
+                # bucketing_module.py:214-216)
+                module.borrow_optimizer(
+                    self._buckets[self._default_bucket_key])
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key

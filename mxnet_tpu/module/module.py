@@ -241,10 +241,22 @@ class Module(BaseModule):
         self._updater = None
 
         if kvstore_inst:
-            # parity: _initialize_kvstore (model.py) — init each param slot
+            # parity: _initialize_kvstore (model.py) — init each param slot,
+            # then PULL the stored value back: multi-worker launches init
+            # with different local random params, and only rank 0's init
+            # defines the shared model — every worker must start from it
+            ex = self._exec_group.execs[0]
+            # the pull-back only matters when other workers exist (their
+            # random init differs); single-process stores would round-trip
+            # the value just pushed
+            pull_back = update_on_kvstore and kvstore_inst.num_workers > 1
             for idx, name in enumerate(self._param_names):
                 if name in self._arg_params:
                     kvstore_inst.init(idx, self._arg_params[name])
+                    if pull_back:
+                        kvstore_inst.pull(idx, ex.arg_dict[name],
+                                          priority=-idx)
+                        self._arg_params[name][:] = ex.arg_dict[name].asnumpy()
             if update_on_kvstore:
                 kvstore_inst.set_optimizer(self._optimizer)
         if not update_on_kvstore:

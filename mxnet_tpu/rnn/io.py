@@ -16,7 +16,7 @@ from ..io import DataBatch, DataDesc, DataIter
 class BucketSentenceIter(DataIter):
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
                  data_name="data", label_name="softmax_label", dtype="float32",
-                 layout="NT"):
+                 layout="NT", init_states=None):
         super().__init__()
         if not buckets:
             buckets = [i for i, j in enumerate(np.bincount([len(s) for s in sentences]))
@@ -42,7 +42,14 @@ class BucketSentenceIter(DataIter):
         self.ndlabel = []
         self.major_axis = layout.find("N")
         self.default_bucket_key = max(buckets)
-        self.provide_data = [DataDesc(data_name, (batch_size, self.default_bucket_key))]
+        # init_states: [(name, shape)] appended to provide_data with zero
+        # arrays per batch (parity: the v0.9 lstm_bucketing pattern that
+        # feeds l*_init_c/h shapes through the iterator)
+        self.init_states = list(init_states or [])
+        self._init_arrays = [nd.array(np.zeros(s, dtype))
+                             for _, s in self.init_states]
+        self.provide_data = [DataDesc(data_name, (batch_size, self.default_bucket_key))] + \
+            [DataDesc(n, s) for n, s in self.init_states]
         self.provide_label = [DataDesc(label_name, (batch_size, self.default_bucket_key))]
         self.idx = []
         for i, buck in enumerate(self.data):
@@ -73,7 +80,8 @@ class BucketSentenceIter(DataIter):
         data = self.nddata[i][j : j + self.batch_size]
         label = self.ndlabel[i][j : j + self.batch_size]
         return DataBatch(
-            [nd.array(data)], [nd.array(label)], pad=0,
+            [nd.array(data)] + self._init_arrays, [nd.array(label)], pad=0,
             bucket_key=self.buckets[i],
-            provide_data=[DataDesc(self.data_name, data.shape)],
+            provide_data=[DataDesc(self.data_name, data.shape)] +
+                         [DataDesc(n, s) for n, s in self.init_states],
             provide_label=[DataDesc(self.label_name, label.shape)])

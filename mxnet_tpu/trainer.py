@@ -19,6 +19,7 @@ test_dtype.py-style casting.
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Dict, Optional
 
@@ -82,7 +83,15 @@ class FusedTrainer:
 
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  optimizer="sgd", optimizer_params=None, mesh: Optional[Mesh] = None,
-                 initializer=None, dtype=jnp.float32, sharding_rules=()):
+                 initializer=None, dtype=jnp.float32, sharding_rules=(),
+                 remat=None):
+        # rematerialization = the reference's MXNET_BACKWARD_DO_MIRROR
+        # (recompute activations in backward, env_var.md:55-57) — on TPU
+        # it is jax.checkpoint around the forward.  Default follows the
+        # same env var for parity.
+        if remat is None:
+            remat = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") == "1"
+        self.remat = bool(remat)
         self.symbol = symbol
         self.data_names = list(data_names)
         self.label_names = list(label_names)
@@ -163,6 +172,8 @@ class FusedTrainer:
                 new_aux = {k: v.astype(jnp.float32) for k, v in new_aux.items()}
                 return outs, new_aux
 
+            if self.remat:
+                fwd = jax.checkpoint(fwd)
             (outs, new_aux), vjp_fn = jax.vjp(fwd, compute_params)
             head = [jnp.ones(o.shape, o.dtype) for o in outs]
             aux_cot = jax.tree_util.tree_map(jnp.zeros_like, new_aux)

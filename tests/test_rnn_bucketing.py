@@ -1,0 +1,62 @@
+"""Bucketing + RNN training regression tests (parity model:
+tests/python/unittest/test_rnn.py + the lstm_bucketing example path)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.lstm import lstm_unroll
+
+
+def _corpus(n, vocab, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(1, vocab, rs.randint(4, 17)).tolist() for _ in range(n)]
+
+
+def test_bucketing_module_fit_with_optimizer_borrow():
+    """Buckets bound AFTER init_optimizer must share its optimizer —
+    regression: update() asserted on unseen buckets mid-epoch."""
+    vocab, hidden, batch = 60, 16, 8
+    init_states = [("l0_init_c", (batch, hidden)), ("l0_init_h", (batch, hidden))]
+    it = mx.rnn.BucketSentenceIter(_corpus(200, vocab), batch,
+                                   buckets=[8, 16], invalid_label=0,
+                                   init_states=init_states)
+
+    def sym_gen(seq_len):
+        s = lstm_unroll(1, seq_len, vocab, hidden, hidden, vocab)
+        return s, ("data",) + tuple(n for n, _ in init_states), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=it.default_bucket_key)
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            eval_metric=mx.metric.Perplexity(ignore_label=0))
+    # both buckets must have been exercised
+    assert set(mod._buckets) == {8, 16}
+
+
+def test_perplexity_metric():
+    m = mx.metric.create("perplexity", ignore_label=0)
+    pred = mx.nd.array(np.full((4, 5), 0.2, np.float32))
+    label = mx.nd.array(np.array([1, 2, 0, 3], np.float32))
+    m.update([label], [pred])
+    name, val = m.get()
+    assert name == "Perplexity"
+    assert np.isclose(val, 5.0, rtol=1e-5)  # uniform over 5 classes
+
+
+def test_fused_trainer_remat_matches():
+    from mxnet_tpu import models
+    from mxnet_tpu.trainer import FusedTrainer
+
+    net = models.get_symbol("mlp", num_classes=10)
+    rs = np.random.RandomState(0)
+    x = rs.uniform(size=(8, 784)).astype(np.float32)
+    y = rs.randint(0, 10, 8).astype(np.float32)
+    outs = {}
+    for remat in (False, True):
+        np.random.seed(42)  # identical param init across the two trainers
+        tr = FusedTrainer(net, optimizer="sgd",
+                          optimizer_params={"lr": 0.1}, remat=remat)
+        tr.init(data=(8, 784))
+        tr.step(data=x, softmax_label=y)
+        outs[remat] = {k: np.asarray(v) for k, v in tr.params.items()}
+    for k in outs[False]:
+        assert np.allclose(outs[False][k], outs[True][k], atol=1e-5), k
