@@ -48,7 +48,10 @@ def synthetic_corpus(num_sentences, vocab_size, seed=3):
     sentences = []
     for _ in range(num_sentences):
         length = int(rs.randint(5, 33))
-        sentences.append(rs.choice(vocab_size, size=length, p=probs).tolist())
+        # ids offset +1: 0 is the padding/ignore label (like the PTB
+        # path's start_label=1)
+        ids = rs.choice(vocab_size, size=length, p=probs) + 1
+        sentences.append(ids.tolist())
     return sentences
 
 
@@ -72,7 +75,7 @@ if __name__ == "__main__":
         sentences, vocab = tokenize_text(ptb, start_label=1)
         vocab_size = len(vocab) + 1
     else:
-        sentences = synthetic_corpus(args.num_sentences, args.vocab_size - 1)
+        sentences = synthetic_corpus(args.num_sentences, args.vocab_size - 2)
         vocab_size = args.vocab_size
 
     buckets = [int(b) for b in args.buckets.split(",")]

@@ -47,6 +47,12 @@ def _bind(lib):
         ctypes.c_void_p, ENGINE_FN, ctypes.c_void_p,
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+    lib.mxe_push_ex.restype = ctypes.c_int
+    lib.mxe_push_ex.argtypes = [
+        ctypes.c_void_p, ENGINE_FN, ctypes.c_void_p, ENGINE_FN,
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
     lib.mxe_wait_for_var.restype = ctypes.c_int
     lib.mxe_wait_for_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.mxe_wait_all.argtypes = [ctypes.c_void_p]
@@ -128,11 +134,16 @@ class NativeEngine:
         self._lib = lib
         self._handle = lib.mxe_create(int(num_threads))
         self._callbacks = {}          # keep CFUNCTYPE refs alive
-        self._done = []               # tokens whose fn has returned
-        self._done_old = []           # previous generation, safe to free
+        self._retired = []            # tokens safe to free (see _on_retire)
         self._cb_lock = threading.Lock()
         self._cb_id = 0
         self._errors = []
+        # ONE persistent retirement trampoline shared by every op: the C
+        # worker invokes it with the op's token strictly AFTER the op's
+        # own closure returned (mxe_push_ex contract), making it the
+        # provably-safe release point for that closure.  This CFUNCTYPE
+        # itself is never freed while the engine lives.
+        self._retire_cb = ENGINE_FN(self._on_retire)
         # tear down while the interpreter can still service callbacks —
         # a worker hitting a Python trampoline during interpreter
         # finalization would crash
@@ -145,7 +156,6 @@ class NativeEngine:
             try:
                 self._lib.mxe_wait_all(self._handle)
                 self._reap()
-                self._reap()  # flush both generations before destroy
                 self._lib.mxe_destroy(self._handle)
             finally:
                 self._handle = None
@@ -153,29 +163,22 @@ class NativeEngine:
     def new_var(self) -> int:
         return int(self._lib.mxe_new_var(self._handle))
 
+    def _on_retire(self, token_ptr):
+        # runs on a C worker thread AFTER the op closure fully unwound
+        with self._cb_lock:
+            self._retired.append(int(token_ptr or 0))
+
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
-        # NB: no pending()==0-probe reap here — that was a TOCTOU race
-        # with concurrent pushers.  The two-generation reap is safe at
-        # any time (only frees tokens aged a full generation), so bound
-        # memory for wait-less workloads with a size trigger.
-        if len(self._done_old) > 4096:
-            self._reap()
+        self._reap()
         with self._cb_lock:
             self._cb_id += 1
             token = self._cb_id
 
-        def trampoline(_ctx, _token=token, _fn=fn):
+        def trampoline(_ctx, _fn=fn):
             try:
                 _fn()
             except BaseException as e:  # surfaced at wait points
                 self._errors.append(e)
-            finally:
-                # only MARK done: dropping the CFUNCTYPE here would free
-                # the libffi closure while the worker thread is still
-                # returning through its trampoline code (use-after-free).
-                # Actual release happens in _reap() at quiescent points.
-                with self._cb_lock:
-                    self._done.append(_token)
 
         cfn = ENGINE_FN(trampoline)
         with self._cb_lock:
@@ -183,8 +186,9 @@ class NativeEngine:
         nc, nm = len(const_vars), len(mutable_vars)
         carr = (ctypes.c_int64 * max(nc, 1))(*const_vars)
         marr = (ctypes.c_int64 * max(nm, 1))(*mutable_vars)
-        rc = self._lib.mxe_push(self._handle, cfn, None, carr, nc, marr, nm,
-                                int(priority))
+        rc = self._lib.mxe_push_ex(self._handle, cfn, None, self._retire_cb,
+                                   ctypes.c_void_p(token), carr, nc, marr,
+                                   nm, int(priority))
         if rc != 0:
             with self._cb_lock:
                 self._callbacks.pop(token, None)
@@ -193,25 +197,16 @@ class NativeEngine:
                 "(parity: ThreadedEngine::CheckDuplicate)")
 
     def _reap(self):
-        """Free CFUNCTYPE closures of completed callbacks.  Two-phase:
-        tokens marked done before the PREVIOUS reap are freed now —
-        their closures have long unwound — while freshly-done tokens age
-        one cycle.  This stays safe even when other threads push
-        concurrently with wait_all (a just-done closure may still be
-        unwinding on its worker thread)."""
+        """Free closures of retired ops.  Safe at ANY time from ANY
+        thread: a token only enters _retired from the C-side retirement
+        hook, which fires strictly after the op's trampoline returned."""
         with self._cb_lock:
-            for token in self._done_old:
+            for token in self._retired:
                 self._callbacks.pop(token, None)
-            self._done_old = self._done
-            self._done = []
+            self._retired.clear()
 
     def wait_for_var(self, var: int):
         self._lib.mxe_wait_for_var(self._handle, int(var))
-        # two-generation reap is safe here too: _done_old tokens were
-        # marked done before a previous reap call, and at least one full
-        # native wait round-trip has happened since — their trampoline
-        # epilogues have long retired.  Without this, workloads that only
-        # ever wait_for_var would leak closures unboundedly.
         self._reap()
         self._raise_pending()
 

@@ -206,7 +206,9 @@ class Perplexity(EvalMetric):
         self.num_inst += num
 
     def get(self):
-        return (self.name, float(np.exp(self.sum_metric / max(self.num_inst, 1))))
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(np.exp(self.sum_metric / self.num_inst)))
 
 
 class Torch(EvalMetric):

@@ -31,3 +31,4 @@ from . import rnn_op  # noqa: F401
 from . import vision  # noqa: F401
 from . import ctc  # noqa: F401
 from . import custom  # noqa: F401
+from . import flash_attention  # noqa: F401

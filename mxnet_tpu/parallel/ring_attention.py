@@ -125,7 +125,9 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
 
 
 def full_attention(q, k, v, causal=False, scale=None):
-    """Single-device reference attention (the oracle for SP tests)."""
+    """Single-device reference attention (the oracle for SP tests) —
+    materializes the (T, T) score matrix; use :func:`attention` for the
+    memory-efficient dispatcher."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -135,3 +137,22 @@ def full_attention(q, k, v, causal=False, scale=None):
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def attention(q, k, v, causal=False, scale=None, impl="auto"):
+    """Single-device attention dispatcher.
+
+    impl='flash' (or 'auto' on TPU with block-compatible shapes) runs the
+    Pallas flash kernels (ops/flash_attention.py) — O(T·D) memory, score
+    tiles live only in VMEM.  Everything else falls back to the lax path
+    (XLA still fuses well, but the (T, T) scores hit HBM)."""
+    from ..ops import flash_attention as fa
+
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "flash" if on_tpu and fa.supports(q.shape) else "lax"
+    if impl == "flash":
+        return fa.flash_attention(q, k, v, causal, scale)
+    if impl == "flash_interpret":  # CPU test path for the kernels
+        return fa.flash_attention(q, k, v, causal, scale, 128, 128, True)
+    return full_attention(q, k, v, causal=causal, scale=scale)

@@ -45,6 +45,8 @@ struct Var {
 struct Opr {
   mxe_fn_t fn;
   void *ctx;
+  mxe_fn_t done_fn = nullptr;       // fired after fn returns (see mxtpu.h)
+  void *done_ctx = nullptr;
   std::vector<int64_t> const_vars;
   std::vector<int64_t> mutable_vars;
   int priority;
@@ -89,7 +91,8 @@ class Engine {
   }
 
   int Push(mxe_fn_t fn, void *ctx, const int64_t *cvars, int nc,
-           const int64_t *mvars, int nm, int priority) {
+           const int64_t *mvars, int nm, int priority,
+           mxe_fn_t done_fn = nullptr, void *done_ctx = nullptr) {
     // CheckDuplicate parity: no dup within or across lists
     std::vector<int64_t> c(cvars, cvars + nc), m(mvars, mvars + nm);
     std::sort(c.begin(), c.end());
@@ -113,6 +116,8 @@ class Engine {
     auto *opr = new Opr;
     opr->fn = fn;
     opr->ctx = ctx;
+    opr->done_fn = done_fn;
+    opr->done_ctx = done_ctx;
     opr->const_vars.assign(cvars, cvars + nc);
     opr->mutable_vars.assign(mvars, mvars + nm);
     opr->priority = priority;
@@ -207,6 +212,8 @@ class Engine {
         ready_.pop();
       }
       opr->fn(opr->ctx);
+      // fn's closure has fully unwound here: fire the retirement hook
+      if (opr->done_fn) opr->done_fn(opr->done_ctx);
       OnComplete(opr);
       delete opr;
       if (pending_.fetch_sub(1, std::memory_order_acq_rel) == 1) {
@@ -291,6 +298,14 @@ int mxe_push(void *engine, mxe_fn_t fn, void *ctx, const int64_t *const_vars,
   return static_cast<Engine *>(engine)->Push(fn, ctx, const_vars, num_const,
                                              mutable_vars, num_mutable,
                                              priority);
+}
+
+int mxe_push_ex(void *engine, mxe_fn_t fn, void *ctx, mxe_fn_t done_fn,
+                void *done_ctx, const int64_t *const_vars, int num_const,
+                const int64_t *mutable_vars, int num_mutable, int priority) {
+  return static_cast<Engine *>(engine)->Push(fn, ctx, const_vars, num_const,
+                                             mutable_vars, num_mutable,
+                                             priority, done_fn, done_ctx);
 }
 
 int mxe_wait_for_var(void *engine, int64_t var) {

@@ -44,6 +44,17 @@ int mxe_push(void *engine, mxe_fn_t fn, void *ctx,
              const int64_t *mutable_vars, int num_mutable,
              int priority);
 
+/* Like mxe_push, plus a retirement hook: done_fn(done_ctx) is invoked on
+ * the worker thread strictly AFTER fn has returned.  Callers managing
+ * closure lifetimes (ctypes trampolines) use it as the release point —
+ * once done_fn fires, fn's stack frame and trampoline have fully
+ * unwound, so freeing fn is safe. */
+int mxe_push_ex(void *engine, mxe_fn_t fn, void *ctx,
+                mxe_fn_t done_fn, void *done_ctx,
+                const int64_t *const_vars, int num_const,
+                const int64_t *mutable_vars, int num_mutable,
+                int priority);
+
 /* Block until all ops touching var have completed. */
 int mxe_wait_for_var(void *engine, int64_t var);
 /* Block until every pushed op has completed. */

@@ -1,0 +1,81 @@
+"""Flash-attention Pallas kernel tests (interpret mode on the CPU mesh;
+oracle = the dense lax attention used by the SP tests)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.flash_attention import flash_attention, supports
+from mxnet_tpu.parallel.ring_attention import attention, full_attention
+
+
+def _qkv(b=2, h=2, t=128, d=16, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.normal(size=(b, h, t, d)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_dense(causal):
+    q, k, v = _qkv()
+    ref = full_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal, None, 64, 64, True)
+    assert jnp.abs(ref - out).max() < 1e-5
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_dense(causal):
+    q, k, v = _qkv()
+
+    def loss(fn):
+        def f(q, k, v):
+            return (fn(q, k, v) * (v + 1.0)).sum()
+        return f
+
+    flash = loss(lambda q, k, v: flash_attention(q, k, v, causal, None,
+                                                 64, 64, True))
+    dense = loss(lambda q, k, v: full_attention(q, k, v, causal=causal))
+    g1 = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert jnp.abs(a - b).max() < 2e-5
+
+
+def test_flash_uneven_blocks():
+    # block_q != block_k and T not a multiple of 128
+    q, k, v = _qkv(t=192)
+    ref = full_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True, None, 64, 32, True)
+    assert jnp.abs(ref - out).max() < 1e-5
+
+
+def test_supports_predicate():
+    assert supports((1, 2, 256, 64))
+    assert not supports((1, 2, 250, 64))   # ragged T
+    assert not supports((1, 2, 256, 63))   # ragged D
+
+
+def test_attention_dispatcher_and_op():
+    q, k, v = _qkv(t=64, d=8)
+    ref = full_attention(q, k, v, causal=True)
+    out = attention(q, k, v, causal=True, impl="flash_interpret")
+    assert jnp.abs(ref - out).max() < 1e-5
+
+    nd_out = mx.nd.FlashAttention(
+        mx.nd.array(np.asarray(q)), mx.nd.array(np.asarray(k)),
+        mx.nd.array(np.asarray(v)), causal=True, impl="lax")
+    assert np.abs(nd_out.asnumpy() - np.asarray(ref)).max() < 1e-5
+
+    # symbolic path: bind + forward + backward
+    qs, ks, vs = (mx.sym.Variable(n) for n in "qkv")
+    net = mx.sym.FlashAttention(qs, ks, vs, causal=True, impl="lax")
+    ex = net.simple_bind(ctx=mx.cpu(), q=q.shape, k=k.shape, v=v.shape)
+    ex.arg_dict["q"][:] = np.asarray(q)
+    ex.arg_dict["k"][:] = np.asarray(k)
+    ex.arg_dict["v"][:] = np.asarray(v)
+    ex.forward(is_train=True)
+    assert np.abs(ex.outputs[0].asnumpy() - np.asarray(ref)).max() < 1e-5
+    ex.backward()
+    assert ex.grad_dict["q"].asnumpy().shape == q.shape
