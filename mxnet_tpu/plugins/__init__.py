@@ -1,0 +1,28 @@
+"""Optional plugins (parity: plugin/ in the reference — torch, caffe,
+warpctc, opencv, sframe, compiled in via make flags).
+
+Here each plugin is an importable module that registers extra ops when
+its backing library is present:
+
+- ``plugins.torch_plugin`` — TorchModule / TorchCriterion over CPU
+  torch (parity: plugin/torch/).  Imported automatically when torch is
+  installed.
+- WarpCTC is a built-in op (ops/ctc.py) — no plugin needed.
+- OpenCV-based image ops are covered by the PIL pipeline (image.py).
+- Caffe / SFrame plugins have no backing libraries in this environment;
+  importing them raises with a clear message (the reference gates them
+  behind build flags the same way).
+"""
+
+
+def _try_torch():
+    try:
+        import torch  # noqa: F401
+    except ImportError:
+        return False
+    from . import torch_plugin  # noqa: F401
+
+    return True
+
+
+torch_available = _try_torch()

@@ -1,0 +1,209 @@
+"""Torch interop ops (parity: plugin/torch/ — TorchModule/TorchCriterion,
+which embedded Lua-torch layers inside MXNet graphs).
+
+TPU-native design: the torch module runs on the host CPU behind
+``jax.pure_callback`` with a custom VJP that calls torch autograd for the
+backward — the XLA graph stays compiled around the host island, the
+same escape-hatch architecture as the Custom op (ops/custom.py).  Torch
+parameters are passed in as explicit graph inputs so they train under
+any mxnet_tpu optimizer.
+
+Usage::
+
+    torch_mod = torch.nn.Linear(4, 3)
+    out = nd.TorchModule(x, w, b, module_id=register_module(torch_mod))
+
+or symbolically with variables for each torch parameter.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from ..base import parse_attr
+from ..ops.registry import register
+
+_MODULES: dict[int, "torch.nn.Module"] = {}
+_CRITERIA: dict[int, "torch.nn.Module"] = {}
+
+
+def register_module(module) -> int:
+    """Register a torch.nn.Module; returns the id to pass as module_id.
+    The module's parameters (in ``module.parameters()`` order) become
+    the op's trailing inputs."""
+    mid = len(_MODULES)
+    _MODULES[mid] = module.cpu()
+    return mid
+
+
+def register_criterion(criterion) -> int:
+    mid = len(_CRITERIA)
+    _CRITERIA[mid] = criterion.cpu()
+    return mid
+
+
+def _load_params(module, params):
+    with torch.no_grad():
+        for p, new in zip(module.parameters(), params):
+            p.copy_(torch.from_numpy(np.asarray(new)))
+
+
+def _run_forward(module, is_train, x, params, seed):
+    was_training = module.training
+    module.train(bool(is_train))
+    try:
+        _load_params(module, params)
+        # the backward pass re-runs the forward: seeding both identically
+        # makes stochastic layers (dropout) sample the same masks
+        torch.manual_seed(int(seed))
+        with torch.no_grad():
+            return module(torch.from_numpy(np.asarray(x))).numpy()
+    finally:
+        module.train(was_training)
+
+
+def _run_backward(module, is_train, x, params, gout, seed):
+    was_training = module.training
+    module.train(bool(is_train))
+    # buffers (BatchNorm running stats...) were already advanced by the
+    # forward pass — snapshot so the recompute doesn't advance them twice
+    buffers = [b.detach().clone() for b in module.buffers()]
+    try:
+        _load_params(module, params)
+        for p in module.parameters():
+            p.requires_grad_(True)
+            p.grad = None
+        torch.manual_seed(int(seed))
+        # torch.tensor copies: callback buffers are read-only numpy views
+        xt = torch.tensor(np.asarray(x)).requires_grad_(True)
+        out = module(xt)
+        out.backward(torch.tensor(np.asarray(gout)))
+        grads = [xt.grad.numpy() if xt.grad is not None
+                 else np.zeros(xt.shape, np.float32)]
+        grads += [p.grad.detach().numpy() if p.grad is not None
+                  else np.zeros(tuple(p.shape), np.float32)
+                  for p in module.parameters()]
+        return tuple(grads)
+    finally:
+        with torch.no_grad():
+            for b, saved in zip(module.buffers(), buffers):
+                b.copy_(saved)
+        module.train(was_training)
+
+
+@register("TorchModule", arg_names=("data",), varargs=True)
+def _torch_module(ctx, data, *params, **attrs):
+    """Run a registered torch.nn.Module as a graph node (parity:
+    plugin/torch/torch_module-inl.h).  Inputs: data + one array per
+    torch parameter; attrs: module_id."""
+    mid = int(parse_attr(attrs["module_id"]))
+    module = _MODULES[mid]
+    is_train = bool(ctx.is_train)  # static per traced executable
+
+    # shape probe: eval mode (batch-1 through train-mode BatchNorm would
+    # crash), buffers restored so the probe leaves no trace
+    was_training = module.training
+    buffers = [b.detach().clone() for b in module.buffers()]
+    module.eval()
+    try:
+        with torch.no_grad():
+            probe = module(torch.zeros((1,) + tuple(data.shape[1:])))
+    finally:
+        with torch.no_grad():
+            for b, saved in zip(module.buffers(), buffers):
+                b.copy_(saved)
+        module.train(was_training)
+    out_shape = (data.shape[0],) + tuple(probe.shape[1:])
+    out_sds = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+
+    # one seed per invocation, shared by forward and backward-recompute
+    # so stochastic layers sample identical masks
+    # (carried as float32: custom_vjp wants float cotangents for its
+    # differentiable positional args; the host side truncates back)
+    if ctx._key is not None:
+        seed = jax.random.randint(ctx.rng(), (), 0, 2**31 - 1).astype(jnp.float32)
+    else:
+        seed = jnp.float32(0)
+
+    @jax.custom_vjp
+    def apply(x, seed, *ps):
+        return jax.pure_callback(
+            lambda ss, xx, *pp: _run_forward(module, is_train, xx, pp, ss),
+            out_sds, seed, x, *ps)
+
+    def fwd(x, seed, *ps):
+        return apply(x, seed, *ps), (x, seed, ps)
+
+    def bwd(res, g):
+        x, seed, ps = res
+        shapes = tuple(jax.ShapeDtypeStruct(p.shape, jnp.float32) for p in (x,) + ps)
+        grads = jax.pure_callback(
+            lambda ss, xx, gg, *pp: _run_backward(module, is_train, xx, pp,
+                                                  gg, ss),
+            shapes, seed, x, g, *ps)
+        return (grads[0], jnp.zeros_like(seed)) + tuple(grads[1:])
+
+    apply.defvjp(fwd, bwd)
+    return apply(data.astype(jnp.float32), seed,
+                 *[p.astype(jnp.float32) for p in params])
+
+
+@register("TorchCriterion", arg_names=("data", "label"))
+def _torch_criterion(ctx, data, label, **attrs):
+    """Torch loss as an output op (parity: plugin/torch/
+    torch_criterion-inl.h).  Forward emits the per-call loss; backward
+    feeds d(loss)/d(data) from torch autograd, ignoring head grads like
+    the reference's loss layers."""
+    mid = int(parse_attr(attrs["criterion_id"]))
+    crit = _CRITERIA[mid]
+    grad_scale = float(parse_attr(attrs.get("grad_scale", 1.0)))
+
+    def fwd_host(x, y):
+        xt = torch.from_numpy(np.asarray(x))
+        yt = torch.from_numpy(np.asarray(y))
+        loss = crit(xt, yt)
+        if loss.numel() != 1:
+            raise ValueError(
+                "TorchCriterion requires a scalar loss — register the "
+                "criterion with a reduction (e.g. reduction='mean'), got "
+                f"output shape {tuple(loss.shape)}")
+        return np.asarray(loss.item(), np.float32)
+
+    def bwd_host(x, y):
+        xt = torch.tensor(np.asarray(x)).requires_grad_(True)
+        yt = torch.tensor(np.asarray(y))
+        loss = crit(xt, yt)
+        loss.backward()
+        return xt.grad.numpy() * grad_scale
+
+    @jax.custom_vjp
+    def apply(x, y):
+        return jax.pure_callback(fwd_host,
+                                 jax.ShapeDtypeStruct((), jnp.float32), x, y)
+
+    def fwd(x, y):
+        return apply(x, y), (x, y)
+
+    def bwd(res, g):
+        x, y = res
+        dx = jax.pure_callback(bwd_host,
+                               jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                               x, y)
+        return dx, jnp.zeros_like(y)
+
+    apply.defvjp(fwd, bwd)
+    return apply(data.astype(jnp.float32), label.astype(jnp.float32))
+
+
+# late registration: regenerate the autogen op functions so
+# nd.TorchModule / sym.TorchModule exist even though this plugin loads
+# after the package (both init fns skip names that already exist)
+from .. import ndarray as _nd  # noqa: E402
+from .. import symbol as _sym  # noqa: E402
+
+_nd._init_op_functions()
+_sym._init_symbol_functions()
