@@ -155,6 +155,11 @@ class Predictor:
     def num_outputs(self):
         return len(self._exec.outputs)
 
+    def _input_shape(self, name):
+        """Bound shape of an input (used by the C ABI to reshape flat
+        buffers, src/c_predict.cc)."""
+        return tuple(self._exec.arg_dict[name].shape)
+
     def reshape(self, input_shapes):
         """Parity: MXPredReshape — rebind with new input shapes (the jit
         cache makes repeat shapes free)."""

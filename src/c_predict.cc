@@ -1,0 +1,232 @@
+/*
+ * C predict ABI — standalone inference entry points for non-Python
+ * frontends (parity: include/mxnet/c_predict_api.h +
+ * src/c_api/c_predict_api.cc; the reference uses this for its
+ * amalgamation/mobile/JNI builds).
+ *
+ * TPU-native design: the compute path IS the XLA runtime driven through
+ * mxnet_tpu.predict.Predictor, so this layer embeds CPython and
+ * forwards each C call to that class.  The first MXPredCreate
+ * initializes the interpreter (no-op when the host app already embeds
+ * Python); everything after SetInput/Forward runs compiled XLA — the
+ * interpreter only marshals buffers.
+ *
+ * Exported surface (mxtpu.h):
+ *   MXPredCreate, MXPredSetInput, MXPredForward, MXPredGetOutputShape,
+ *   MXPredGetOutput, MXPredReshape, MXPredFree, MXPredGetLastError.
+ * All functions return 0 on success, -1 on failure (error text via
+ * MXPredGetLastError — thread-local, like the reference's c_api_error).
+ */
+#include "mxtpu.h"
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string last_error;
+
+struct PredHandle {
+  PyObject *predictor = nullptr;             // mxnet_tpu.predict.Predictor
+  std::vector<std::vector<int64_t>> out_shapes;
+  std::vector<std::vector<float>> out_bufs;  // filled by GetOutput
+};
+
+std::once_flag init_flag;
+bool interpreter_ours = false;
+
+void EnsurePython() {
+  std::call_once(init_flag, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      interpreter_ours = true;
+      // release the GIL acquired by initialization so the gil guards
+      // below work uniformly
+      PyEval_SaveThread();
+    }
+  });
+}
+
+struct GilGuard {
+  PyGILState_STATE st;
+  GilGuard() { st = PyGILState_Ensure(); }
+  ~GilGuard() { PyGILState_Release(st); }
+};
+
+int Fail(const char *where) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  last_error = where;
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      last_error += ": ";
+      last_error += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXPredGetLastError() { return last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 const uint32_t *input_shape_indptr,
+                 const uint32_t *input_shape_data, void **out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.predict");
+  if (!mod) return Fail("import mxnet_tpu.predict");
+  PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  if (!cls) return Fail("Predictor lookup");
+
+  PyObject *shapes = PyDict_New();
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *tup = PyTuple_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(tup, j - lo, PyLong_FromLong(input_shape_data[j]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], tup);
+    Py_DECREF(tup);
+  }
+  PyObject *params = param_bytes
+      ? PyBytes_FromStringAndSize(static_cast<const char *>(param_bytes),
+                                  param_size)
+      : Py_NewRef(Py_None);
+  const char *dev = (dev_type == 2) ? "tpu" : (dev_type == 1 ? "cpu" : "cpu");
+  PyObject *kwargs = Py_BuildValue(
+      "{s:s, s:O, s:O, s:s, s:i}", "symbol_json_str", symbol_json,
+      "param_bytes", params, "input_shapes", shapes, "dev_type", dev,
+      "dev_id", dev_id);
+  Py_DECREF(params);
+  Py_DECREF(shapes);
+  PyObject *empty = PyTuple_New(0);
+  PyObject *pred = PyObject_Call(cls, empty, kwargs);
+  Py_DECREF(empty);
+  Py_DECREF(kwargs);
+  Py_DECREF(cls);
+  if (!pred) return Fail("Predictor()");
+  auto *h = new PredHandle;
+  h->predictor = pred;
+  *out = h;
+  return 0;
+}
+
+int MXPredSetInput(void *handle, const char *key, const float *data,
+                   uint32_t size) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GilGuard gil;
+  // hand the buffer over as a python list-free memoryview -> numpy
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (!np) return Fail("import numpy");
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      static_cast<Py_ssize_t>(size) * 4, PyBUF_READ);
+  PyObject *arr = PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32");
+  Py_DECREF(mv);
+  Py_DECREF(np);
+  if (!arr) return Fail("frombuffer");
+  // reshape to the bound input shape
+  PyObject *exec_arr = PyObject_CallMethod(h->predictor, "_input_shape", "s",
+                                           key);
+  PyObject *reshaped;
+  if (exec_arr) {
+    reshaped = PyObject_CallMethod(arr, "reshape", "O", exec_arr);
+    Py_DECREF(exec_arr);
+  } else {
+    PyErr_Clear();
+    reshaped = Py_NewRef(arr);
+  }
+  Py_DECREF(arr);
+  if (!reshaped) return Fail("reshape");
+  PyObject *r = PyObject_CallMethod(h->predictor, "set_input", "sO", key,
+                                    reshaped);
+  Py_DECREF(reshaped);
+  if (!r) return Fail("set_input");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(void *handle) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GilGuard gil;
+  PyObject *r = PyObject_CallMethod(h->predictor, "forward", nullptr);
+  if (!r) return Fail("forward");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(void *handle, uint32_t index, uint32_t **shape_data,
+                         uint32_t *shape_ndim) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GilGuard gil;
+  PyObject *shp = PyObject_CallMethod(h->predictor, "get_output_shape", "I",
+                                      index);
+  if (!shp) return Fail("get_output_shape");
+  Py_ssize_t n = PyTuple_Size(shp);
+  if (h->out_shapes.size() <= index) h->out_shapes.resize(index + 1);
+  auto &dst = h->out_shapes[index];
+  dst.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    dst[i] = PyLong_AsLong(PyTuple_GetItem(shp, i));
+  }
+  Py_DECREF(shp);
+  static thread_local std::vector<uint32_t> tmp;
+  tmp.assign(dst.begin(), dst.end());
+  *shape_data = tmp.data();
+  *shape_ndim = static_cast<uint32_t>(n);
+  return 0;
+}
+
+int MXPredGetOutput(void *handle, uint32_t index, float *data, uint32_t size) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GilGuard gil;
+  PyObject *out = PyObject_CallMethod(h->predictor, "get_output", "I", index);
+  if (!out) return Fail("get_output");
+  PyObject *flat = PyObject_CallMethod(out, "astype", "s", "float32");
+  Py_DECREF(out);
+  if (!flat) return Fail("astype");
+  PyObject *ravel = PyObject_CallMethod(flat, "ravel", nullptr);
+  Py_DECREF(flat);
+  if (!ravel) return Fail("ravel");
+  PyObject *bytes = PyObject_CallMethod(ravel, "tobytes", nullptr);
+  Py_DECREF(ravel);
+  if (!bytes) return Fail("tobytes");
+  Py_ssize_t nbytes = PyBytes_Size(bytes);
+  if (nbytes > static_cast<Py_ssize_t>(size) * 4) {
+    Py_DECREF(bytes);
+    last_error = "output buffer too small";
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), nbytes);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredFree(void *handle) {
+  auto *h = static_cast<PredHandle *>(handle);
+  {
+    GilGuard gil;
+    Py_XDECREF(h->predictor);
+  }
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

@@ -93,6 +93,24 @@ void mxs_direct_free(void *ptr);   /* bypass pool */
 uint64_t mxs_pool_bytes(void);      /* bytes held in free lists */
 void mxs_release_all(void);         /* drop pooled blocks */
 
+/* ---- predict-only C ABI (libmxtpu_predict.so; parity:
+ * include/mxnet/c_predict_api.h).  Embeds CPython; XLA does the math.
+ * dev_type: 1 = cpu, 2 = accelerator.  All return 0/-1; error text via
+ * MXPredGetLastError(). */
+const char *MXPredGetLastError(void);
+int MXPredCreate(const char *symbol_json, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 const uint32_t *input_shape_indptr,
+                 const uint32_t *input_shape_data, void **out);
+int MXPredSetInput(void *handle, const char *key, const float *data,
+                   uint32_t size);
+int MXPredForward(void *handle);
+int MXPredGetOutputShape(void *handle, uint32_t index, uint32_t **shape_data,
+                         uint32_t *shape_ndim);
+int MXPredGetOutput(void *handle, uint32_t index, float *data, uint32_t size);
+int MXPredFree(void *handle);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,136 @@
+"""C predict ABI test: compile a real C client against
+libmxtpu_predict.so and check its output against the Python Predictor
+(parity model: the reference's amalgamation/c_predict_api examples)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "mxnet_tpu", "lib", "libmxtpu_predict.so")
+
+C_CLIENT = textwrap.dedent("""
+    #include <stdio.h>
+    #include <stdlib.h>
+    #include <string.h>
+    #include "mxtpu.h"
+
+    static char *read_file(const char *path, long *size) {
+        FILE *f = fopen(path, "rb");
+        if (!f) return NULL;
+        fseek(f, 0, SEEK_END);
+        *size = ftell(f);
+        fseek(f, 0, SEEK_SET);
+        char *buf = malloc(*size + 1);
+        fread(buf, 1, *size, f);
+        buf[*size] = 0;
+        fclose(f);
+        return buf;
+    }
+
+    int main(int argc, char **argv) {
+        long sym_size, param_size;
+        char *sym = read_file(argv[1], &sym_size);
+        char *params = read_file(argv[2], &param_size);
+        if (!sym || !params) { fprintf(stderr, "io\\n"); return 2; }
+
+        const char *keys[] = {"data"};
+        unsigned indptr[] = {0, 2};
+        unsigned shapes[] = {4, 8};
+        void *h = NULL;
+        if (MXPredCreate(sym, params, (int)param_size, 1, 0, 1, keys,
+                         indptr, shapes, &h) != 0) {
+            fprintf(stderr, "create: %s\\n", MXPredGetLastError());
+            return 3;
+        }
+        float input[32];
+        for (int i = 0; i < 32; ++i) input[i] = (float)i / 32.0f;
+        if (MXPredSetInput(h, "data", input, 32) != 0) {
+            fprintf(stderr, "set_input: %s\\n", MXPredGetLastError());
+            return 4;
+        }
+        if (MXPredForward(h) != 0) {
+            fprintf(stderr, "forward: %s\\n", MXPredGetLastError());
+            return 5;
+        }
+        unsigned *oshape, ondim;
+        if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) return 6;
+        unsigned total = 1;
+        for (unsigned i = 0; i < ondim; ++i) total *= oshape[i];
+        float *out = malloc(total * sizeof(float));
+        if (MXPredGetOutput(h, 0, out, total) != 0) {
+            fprintf(stderr, "get_output: %s\\n", MXPredGetLastError());
+            return 7;
+        }
+        printf("shape:");
+        for (unsigned i = 0; i < ondim; ++i) printf(" %u", oshape[i]);
+        printf("\\n");
+        for (unsigned i = 0; i < total; ++i) printf("%.6f\\n", out[i]);
+        MXPredFree(h);
+        return 0;
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    tmp = tmp_path_factory.mktemp("cpred")
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=6)
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=3)
+    net = sym.SoftmaxOutput(net, name="softmax")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 8))
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            init(name, arr)
+    arg_params = {n: a for n, a in ex.arg_dict.items()
+                  if n not in ("data", "softmax_label")}
+    prefix = str(tmp / "m")
+    mx.model.save_checkpoint(prefix, 0, net, arg_params, {})
+    return prefix
+
+
+def test_c_predict_matches_python(checkpoint, tmp_path):
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make", "-C", os.path.join(REPO, "src"),
+                            "predict"], capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+
+    c_path = tmp_path / "client.c"
+    c_path.write_text(C_CLIENT)
+    exe = tmp_path / "client"
+    r = subprocess.run(
+        ["gcc", str(c_path), "-I", os.path.join(REPO, "src"),
+         str(LIB), "-o", str(exe),
+         f"-Wl,-rpath,{os.path.dirname(LIB)}"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ)
+    env["MXTPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [str(exe), checkpoint + "-symbol.json", checkpoint + "-0000.params"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "shape: 4 3"
+    c_out = np.array([float(x) for x in lines[1:]]).reshape(4, 3)
+
+    # python-side reference
+    from mxnet_tpu.predict import create
+
+    p = create(checkpoint, 0, {"data": (4, 8)})
+    x = (np.arange(32, dtype=np.float32) / 32.0).reshape(4, 8)
+    p.forward(data=x)
+    py_out = p.get_output(0)
+    assert np.allclose(c_out, py_out, atol=1e-5), (c_out, py_out)

@@ -1,0 +1,76 @@
+"""End-to-end convergence gates (parity: tests/python/train/ —
+test_mlp.py / test_conv.py / test_dtype.py train small nets and assert
+accuracy thresholds)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import get_synthetic_mnist
+
+
+def _conv_sym(num_classes=10):
+    data = sym.Variable("data")
+    net = sym.Convolution(data, name="conv1", kernel=(3, 3), num_filter=8)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, name="conv2", kernel=(3, 3), num_filter=16)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.FullyConnected(sym.Flatten(net), name="fc", num_hidden=num_classes)
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_conv_converges():
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(2048, 512)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(xte, yte, batch_size=64)
+    mod = mx.mod.Module(_conv_sym())
+    mod.fit(train, eval_data=val, num_epoch=2,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    assert mod.score(val, "acc")[0][1] > 0.9
+
+
+def test_fused_trainer_bf16_converges():
+    """Parity: test_dtype.py — training in reduced precision (bf16
+    compute, fp32 master weights) must still hit the accuracy gate."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.trainer import FusedTrainer
+
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(2048, 512)
+    tr = FusedTrainer(_conv_sym(), optimizer="sgd",
+                      optimizer_params={"lr": 0.1, "momentum": 0.9,
+                                        "rescale_grad": 1.0 / 64},
+                      initializer=mx.init.Xavier(),
+                      dtype=jnp.bfloat16)
+    tr.init(data=(64, 1, 28, 28))
+    for epoch in range(2):
+        for i in range(0, len(xtr), 64):
+            tr.step(data=xtr[i:i + 64], softmax_label=ytr[i:i + 64])
+    preds = []
+    for i in range(0, len(xte), 64):
+        outs = tr.eval(data=xte[i:i + 64])
+        preds.append(np.asarray(outs[0]).argmax(axis=1))
+    acc = float((np.concatenate(preds) == yte).mean())
+    assert acc > 0.9, acc
+
+
+def test_adam_and_schedulers_converge():
+    (xtr, ytr), (xte, yte) = get_synthetic_mnist(1024, 256)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=64, shuffle=True)
+    val = mx.io.NDArrayIter(xte, yte, batch_size=64)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(sym.Flatten(data), name="fc1", num_hidden=64)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=10)
+    net = sym.SoftmaxOutput(net, name="softmax")
+    sched = mx.lr_scheduler.FactorScheduler(step=20, factor=0.9)
+    mod = mx.mod.Module(net)
+    mod.fit(train, eval_data=val, num_epoch=3,
+            initializer=mx.init.Xavier(),
+            optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3, "lr_scheduler": sched})
+    assert mod.score(val, "acc")[0][1] > 0.9
