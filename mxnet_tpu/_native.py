@@ -107,6 +107,24 @@ def get_lib():
         except OSError:
             _LIB = False
             return None
+        except AttributeError:
+            # a stale prebuilt .so predating a required symbol: rebuild
+            # once, then keep the documented graceful fallback to the
+            # pure-Python paths rather than letting AttributeError escape
+            try:
+                # make would consider a freshly-copied stale .so up to
+                # date; force the relink
+                os.unlink(_LIB_PATH)
+            except OSError:
+                pass
+            try:
+                if _build():
+                    _LIB = _bind(ctypes.CDLL(_LIB_PATH))
+                    return _LIB
+            except (OSError, AttributeError):
+                pass
+            _LIB = False
+            return None
         return _LIB
 
 
@@ -192,6 +210,10 @@ class NativeEngine:
         if rc != 0:
             with self._cb_lock:
                 self._callbacks.pop(token, None)
+            if rc == -2:
+                raise ValueError(
+                    "unknown engine var id in const/mutable var lists "
+                    "(freed, or created on a different engine?)")
             raise ValueError(
                 "duplicate or overlapping const/mutable var lists "
                 "(parity: ThreadedEngine::CheckDuplicate)")

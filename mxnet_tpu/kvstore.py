@@ -25,6 +25,7 @@ turns per-key psums into one bucketed all-reduce.
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 from typing import Callable, Dict, List, Optional
@@ -254,6 +255,26 @@ class _PSClient:
     def control(self, head, body=None):
         self.rpc_all({"cmd": "control", "head": head, "body": body})
 
+    def control_sequential(self, head, body=None):
+        """Deliver a control message to every server WITHOUT the thread
+        pool.  atexit handlers run after threading._shutdown has joined
+        executor workers, so pool.map there raises 'cannot schedule new
+        futures after interpreter shutdown' and the message is lost —
+        the shutdown path must use the still-open sockets directly.
+        Returns [(server, exception)] for servers that could not be
+        reached."""
+        errors = []
+        for i in range(self.num_servers):
+            try:
+                # a hung-but-alive server must not block process exit:
+                # bound the shutdown RPC (normal RPCs block indefinitely
+                # by design — sync-mode pulls park server-side)
+                self._socks[i].settimeout(5.0)
+                self.rpc(i, {"cmd": "control", "head": head, "body": body})
+            except Exception as exc:  # noqa: BLE001 — collected, not hidden
+                errors.append((i, exc))
+        return errors
+
     def close(self):
         self._pool.shutdown(wait=False)
         for s in self._socks:
@@ -383,14 +404,13 @@ class KVStoreDist(KVStore):
 
     def _send_stop(self):
         if self._client is not None:
-            try:
-                from .kvstore_server import K_STOP_SERVER
+            client, self._client = self._client, None
+            from .kvstore_server import K_STOP_SERVER
 
-                self._client.control(K_STOP_SERVER)
-                self._client.close()
-            except Exception:
-                pass
-            self._client = None
+            for server, exc in client.control_sequential(K_STOP_SERVER):
+                logging.warning("kvstore: failed to stop server %d: %r",
+                                server, exc)
+            client.close()
 
 
 def create(name="local") -> KVStore:

@@ -30,6 +30,7 @@ stop, then exits the process.
 """
 from __future__ import annotations
 
+import io
 import os
 import pickle
 import socket
@@ -50,6 +51,15 @@ def _role():
     return os.environ.get("MXTPU_ROLE", os.environ.get("DMLC_ROLE", "worker"))
 
 
+def _logical_key(part_key):
+    """'3' / '3#p1' -> 3; non-integer logical keys pass through as str."""
+    base = str(part_key).split("#p", 1)[0]
+    try:
+        return int(base)
+    except ValueError:
+        return base
+
+
 class _SysModulesUnpickler(pickle.Unpickler):
     """Unpickler that resolves classes from sys.modules without touching
     the import machinery.  The server's main thread is parked *inside*
@@ -67,9 +77,36 @@ class _SysModulesUnpickler(pickle.Unpickler):
 
 
 def _loads_no_import(data):
-    import io
-
     return _SysModulesUnpickler(io.BytesIO(data)).load()
+
+
+# Transport messages are dicts of str/int/float/bytes/ndarray.  The
+# reference's ps-lite transport is a binary protocol; a pickle transport
+# must not be an arbitrary-object-deserialization RCE surface, so the
+# unpickler allowlists exactly the globals numpy payloads need.  The one
+# richer payload — the K_SET_OPTIMIZER body — travels as *bytes inside*
+# a data message and is unpickled separately under the documented
+# trusted-cluster assumption (see _control).
+_SAFE_GLOBALS = {
+    ("numpy", "ndarray"), ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
+}
+
+
+class _DataUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _SAFE_GLOBALS:
+            mod = sys.modules.get(module)
+            if mod is None:
+                mod = __import__(module, fromlist=[name])
+            return getattr(mod, name)
+        raise pickle.UnpicklingError(
+            f"global {module}.{name} is not allowed on the kvstore transport")
 
 
 def send_msg(sock, obj):
@@ -85,7 +122,7 @@ def recv_msg(sock):
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
-    return pickle.loads(payload)
+    return _DataUnpickler(io.BytesIO(payload)).load()
 
 
 def _recv_exact(sock, n):
@@ -224,8 +261,13 @@ class _Handler(socketserver.BaseRequestHandler):
                 updater = opt.get_updater(optimizer)
 
                 def np_updater(key, recv, stored, _u=updater, _nd=nd):
+                    # the store key is the string part-key ('3' or '3#p0');
+                    # lr_mult/wd_mult/idx2name are indexed by the logical
+                    # int key — recover it so per-param lr/wd rules apply
+                    # in distributed training too (parity: the server's
+                    # DecodeKey, kvstore_dist_server.h:221-224)
                     w = _nd.array(stored)
-                    _u(key, _nd.array(recv), w)
+                    _u(_logical_key(key), _nd.array(recv), w)
                     stored[...] = w.asnumpy()
 
                 st.updater = np_updater
@@ -245,20 +287,29 @@ class KVStoreServer:
     """Blocking server run-loop (parity: python/mxnet/kvstore_server.py
     KVStoreServer — blocks in RunServer with a controller callback)."""
 
-    def __init__(self, num_workers=None, port=None):
+    def __init__(self, num_workers=None, port=None, host=None):
         self.num_workers = num_workers or int(
             os.environ.get("MXTPU_NUM_WORKERS", os.environ.get("DMLC_NUM_WORKER", "1")))
+        rank = int(os.environ.get("MXTPU_SERVER_RANK", "0"))
+        servers = os.environ.get("MXTPU_PS_SERVERS", "").split(",")
         if port is None:
-            rank = int(os.environ.get("MXTPU_SERVER_RANK", "0"))
-            servers = os.environ.get("MXTPU_PS_SERVERS", "").split(",")
             port = int(servers[rank].rsplit(":", 1)[1]) if servers[0] else 9090
+        if host is None:
+            # bind the address advertised for THIS server rank (127.0.0.1
+            # for local launches) — not 0.0.0.0, which would expose the
+            # pickle transport to anything that can reach the port.
+            # MXTPU_PS_BIND overrides for multi-homed hosts.
+            advertised = (servers[rank].rsplit(":", 1)[0]
+                          if servers[0] else "127.0.0.1")
+            host = os.environ.get("MXTPU_PS_BIND", advertised or "127.0.0.1")
+        self.host = host
         self.port = port
         self.state = _ServerState(self.num_workers)
         self.state.stop_count = 0
 
     def run(self):
         """Serve until every worker has sent kStopServer."""
-        srv = _TCPServer(("0.0.0.0", self.port), _Handler)
+        srv = _TCPServer((self.host, self.port), _Handler)
         srv.state = self.state
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()

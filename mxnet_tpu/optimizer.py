@@ -10,6 +10,7 @@ jitted ops in ops/optimizer_ops.py so clip+decay+update is one XLA kernel.
 """
 from __future__ import annotations
 
+import logging
 import math
 from typing import Dict, Optional
 
@@ -35,6 +36,11 @@ class Optimizer:
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, **kwargs):
+        if "lr" in kwargs:  # widely-used alias; silently dropping it would
+            learning_rate = kwargs.pop("lr")  # train at the 0.01 default
+        if kwargs:
+            logging.warning("Optimizer: ignoring unknown arguments %s",
+                            sorted(kwargs))
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler

@@ -104,13 +104,13 @@ class Engine {
                           std::back_inserter(inter));
     if (!inter.empty()) return -1;
     {
-      // unknown var ids must surface as the documented -1 error, not as
-      // a std::out_of_range unwinding through the C ABI (UB / abort)
+      // unknown var ids surface as -2 (vs -1 for duplicate/overlap), not
+      // as a std::out_of_range unwinding through the C ABI (UB / abort)
       std::lock_guard<std::mutex> lk(vars_mu_);
       for (int64_t v : c)
-        if (vars_.find(v) == vars_.end()) return -1;
+        if (vars_.find(v) == vars_.end()) return -2;
       for (int64_t v : m)
-        if (vars_.find(v) == vars_.end()) return -1;
+        if (vars_.find(v) == vars_.end()) return -2;
     }
 
     auto *opr = new Opr;

@@ -36,9 +36,9 @@ int64_t mxe_new_var(void *engine);
 
 /* Push an async op: fn(ctx) runs once all deps resolve.  const_vars are
  * read deps (parallel), mutable_vars write deps (serialized, FIFO per
- * var).  Duplicate or overlapping var lists are rejected (returns -1,
- * parity: ThreadedEngine::CheckDuplicate).  priority: higher runs first
- * among ready ops. */
+ * var).  Duplicate or overlapping var lists are rejected with -1
+ * (parity: ThreadedEngine::CheckDuplicate); unknown/freed var ids with
+ * -2.  priority: higher runs first among ready ops. */
 int mxe_push(void *engine, mxe_fn_t fn, void *ctx,
              const int64_t *const_vars, int num_const,
              const int64_t *mutable_vars, int num_mutable,
