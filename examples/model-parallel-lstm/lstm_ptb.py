@@ -5,10 +5,12 @@ Parity: example/model-parallel-lstm/lstm_ptb.py (reference): each LSTM
 layer is annotated with ``AttrScope(ctx_group=...)`` and ``bind(
 group2ctx={group: device})`` places it; the engine overlaps the stages.
 
-TPU-native meaning (SURVEY.md §7 PlaceDevice row): the ctx_group
-annotations become sharding hints — XLA/GSPMD schedules the pipeline and
-inserts the inter-device transfers that `_CrossDeviceCopy` nodes did in
-the reference.  Run with MXTPU_PLATFORM=cpu and
+TPU-native meaning (SURVEY.md §7 PlaceDevice row): the executor cuts the
+graph into per-device segments, compiles each as its own XLA program, and
+jax.device_put between segments is the explicit transfer point — the
+_CrossDeviceCopy parity (executor.py placement_plan/_build_placed_fn).
+XLA's async dispatch overlaps the stages the way the reference's engine
+did.  Run with MXTPU_PLATFORM=cpu and
 XLA_FLAGS=--xla_force_host_platform_device_count=2 to see two-device
 placement without hardware."""
 import argparse

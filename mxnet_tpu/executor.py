@@ -42,6 +42,27 @@ from .symbol import Symbol, _topo_order
 _GRAD_REQ = ("write", "add", "null")
 
 
+def _eval_node(node, topo_index, env, key, is_train):
+    """Evaluate one op node into env; returns {aux_name: new_val} updates."""
+    od = ops.get(node.op)
+    ins = [env[id(src)][oidx] for src, oidx in node.inputs]
+    octx = ops.OpCtx(
+        is_train=is_train,
+        key=jax.random.fold_in(key, topo_index) if od.needs_rng else None,
+    )
+    res = od.fn(octx, *ins, **node.attrs)
+    aux_updates = {}
+    if od.aux_names:
+        res, updates = res
+        aux_arg_names = node.inputs[-len(od.aux_names):]
+        for (aux_node, _), val in zip(aux_arg_names, updates):
+            aux_updates[aux_node.name] = val
+    if not isinstance(res, tuple):
+        res = (res,)
+    env[id(node)] = res
+    return aux_updates
+
+
 def _build_graph_fn(symbol: Symbol):
     """Build f(arg_dict, aux_dict, key, is_train) -> (outputs, new_aux_dict).
 
@@ -62,21 +83,153 @@ def _build_graph_fn(symbol: Symbol):
                 else:
                     env[id(node)] = (arg_vals[node.name],)
                 continue
-            od = ops.get(node.op)
-            ins = [env[id(src)][oidx] for src, oidx in node.inputs]
-            octx = ops.OpCtx(
-                is_train=is_train,
-                key=jax.random.fold_in(key, i) if od.needs_rng else None,
-            )
-            res = od.fn(octx, *ins, **node.attrs)
-            if od.aux_names:
-                res, aux_updates = res
-                aux_arg_names = node.inputs[-len(od.aux_names):]
-                for (aux_node, _), val in zip(aux_arg_names, aux_updates):
-                    new_aux[aux_node.name] = val
-            if not isinstance(res, tuple):
-                res = (res,)
-            env[id(node)] = res
+            new_aux.update(_eval_node(node, i, env, key, is_train))
+        outputs = [env[id(n)][i] for n, i in out_entries]
+        return outputs, new_aux
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# ctx_group placement (parity: nnvm::pass::PlaceDevice + _CrossDeviceCopy,
+# graph_executor.cc:225-314)
+# ---------------------------------------------------------------------------
+def placement_plan(symbol: Symbol, group2ctx, default_ctx):
+    """Assign every graph node a concrete jax.Device from its ctx_group.
+
+    Returns (node_ctx, var_ctx, n_distinct) where node_ctx maps
+    id(op_node) -> Context, var_ctx maps variable *name* -> Context (a
+    variable lives with its first consumer, mirroring PlaceDevice's
+    device propagation), and n_distinct counts distinct concrete devices
+    in the plan.  group2ctx entries not matching any annotation are
+    ignored, as in the reference.
+    """
+    topo = _topo_order([n for n, _ in symbol._outputs])
+    node_ctx, var_ctx = {}, {}
+    # a variable's OWN annotation wins (reference PlaceDevice honors the
+    # node's __ctx_group__); unannotated variables fall to first consumer
+    for node in topo:
+        if node.is_variable:
+            grp = node.extra_attrs.get("ctx_group")
+            if grp and grp in group2ctx:
+                var_ctx[node.name] = group2ctx[grp]
+    for node in topo:
+        if node.is_variable:
+            continue
+        grp = node.extra_attrs.get("ctx_group")
+        ctx = group2ctx.get(grp) if grp else None
+        if ctx is None:
+            ctx = default_ctx
+        node_ctx[id(node)] = ctx
+        for src, _ in node.inputs:
+            if src.is_variable and src.name not in var_ctx:
+                var_ctx[src.name] = ctx  # first consumer wins
+    distinct = {c.jax_device for c in node_ctx.values()} | {
+        c.jax_device for c in var_ctx.values()}
+    return node_ctx, var_ctx, len(distinct)
+
+
+class _Segment:
+    """A maximal run of topo-consecutive op nodes on one device, compiled
+    as one XLA program.  Transfers between segments are the explicit
+    _CrossDeviceCopy points."""
+
+    __slots__ = ("device", "nodes", "indices", "inputs", "outputs", "jit_fn")
+
+    def __init__(self, device):
+        self.device = device
+        self.nodes = []
+        self.indices = []  # global topo index per node (stable RNG folding)
+
+    def finalize(self, produced_by_me, needed_entries):
+        # entries this segment consumes but does not produce
+        seen, ins = set(), []
+        for node in self.nodes:
+            for src, oidx in node.inputs:
+                e = (id(src), oidx)
+                if e not in produced_by_me and e not in seen:
+                    seen.add(e)
+                    ins.append(e)
+        self.inputs = ins
+        self.outputs = list(needed_entries)
+
+        nodes, indices = self.nodes, self.indices
+        inputs, outputs = self.inputs, self.outputs
+
+        def seg_fn(in_vals, key, is_train):
+            env = {}
+            for (nid, oidx), v in zip(inputs, in_vals):
+                env.setdefault(nid, {})[oidx] = v
+            aux_updates = {}
+            for node, gi in zip(nodes, indices):
+                aux_updates.update(_eval_node(node, gi, env, key, is_train))
+            return tuple(env[nid][oidx] for nid, oidx in outputs), aux_updates
+
+        self.jit_fn = jax.jit(seg_fn, static_argnums=(2,))
+
+
+def _build_placed_fn(symbol: Symbol, node_ctx, var_ctx, default_ctx):
+    """Multi-device execution plan for a ctx_group-annotated graph.
+
+    The graph is cut into per-device segments; each segment is its own
+    jit (committed to its device via its inputs), and jax.device_put
+    between segments is the explicit transfer point — the TPU-native
+    _CrossDeviceCopy.  XLA's async dispatch overlaps segments on
+    different devices exactly the way the reference's dependency engine
+    overlaps ctx_group stages (docs/how_to/model_parallel_lstm.md).
+    Autodiff traces through the segment jits, so the fused fwd+bwd path
+    and grad placement follow the same plan.
+    """
+    default_dev = default_ctx.jax_device
+    node_device = {k: c.jax_device for k, c in node_ctx.items()}
+    var_device = {k: c.jax_device for k, c in var_ctx.items()}
+    out_entries = list(symbol._outputs)
+    topo = _topo_order([n for n, _ in out_entries])
+
+    segments = []
+    node_seg = {}  # id(op_node) -> segment index
+    for i, node in enumerate(topo):
+        if node.is_variable:
+            continue
+        dev = node_device.get(id(node), default_dev)
+        if not segments or segments[-1].device is not dev:
+            segments.append(_Segment(dev))
+        segments[-1].nodes.append(node)
+        segments[-1].indices.append(i)
+        node_seg[id(node)] = len(segments) - 1
+
+    # entries needed outside their producing segment: graph outputs + any
+    # entry crossing a segment boundary (those are the transfer points)
+    needed = set((id(n), i) for n, i in out_entries if not n.is_variable)
+    for si, seg in enumerate(segments):
+        for node in seg.nodes:
+            for src, oidx in node.inputs:
+                if not src.is_variable and node_seg[id(src)] != si:
+                    needed.add((id(src), oidx))
+    for seg in segments:
+        produced = set()
+        for node in seg.nodes:
+            for k in range(node.num_outputs()):
+                produced.add((id(node), k))
+        seg.finalize(produced, sorted(needed & produced))
+
+    var_nodes = [n for n in topo if n.is_variable]
+
+    def fn(arg_vals: Dict, aux_vals: Dict, key, is_train: bool):
+        env = {}
+        for n in var_nodes:
+            val = aux_vals[n.name] if n.is_aux else arg_vals[n.name]
+            dev = var_device.get(n.name, default_dev)
+            env[id(n)] = (jax.device_put(val, dev),)
+        new_aux = dict(aux_vals)
+        for seg in segments:
+            ins = tuple(jax.device_put(env[nid][oidx], seg.device)
+                        for nid, oidx in seg.inputs)
+            outs, aux_updates = seg.jit_fn(
+                ins, jax.device_put(key, seg.device), is_train)
+            for (nid, oidx), v in zip(seg.outputs, outs):
+                env.setdefault(nid, {})[oidx] = v
+            new_aux.update(aux_updates)
         outputs = [env[id(n)][i] for n, i in out_entries]
         return outputs, new_aux
 
@@ -142,12 +295,30 @@ class Executor:
             raise MXNetError(f"bind: missing aux states {missing_aux}")
         self.aux_arrays = [self.aux_dict[k] for k in aux_names]
 
-        self._graph_fn = _build_graph_fn(symbol)
+        # ctx_group placement (parity: PlaceDevice, graph_executor.cc:225-314):
+        # only a plan spanning >1 device changes execution; a single-device
+        # plan keeps the whole-graph jit fast path.
+        self._placed = False
+        self._plan = None
+        if self._group2ctx:
+            node_dev, var_dev, n_distinct = placement_plan(
+                symbol, self._group2ctx, self._ctx)
+            self._placed = n_distinct > 1
+            if self._placed:
+                self._plan = (node_dev, var_dev)
         self._grad_names = [k for k in arg_names if self.grad_req.get(k) != "null"]
-        if shared_exec is not None and shared_exec._symbol is symbol:
+        if self._placed:
+            self._graph_fn = _build_placed_fn(symbol, node_dev, var_dev, self._ctx)
+            # segments carry their own jits; the outer pipeline must stay
+            # un-jitted or GSPMD would re-place everything on one device
+            self._jit_fwd = self._graph_fn
+            self._jit_fwdbwd = self._make_fwdbwd()
+        elif shared_exec is not None and shared_exec._symbol is symbol:
+            self._graph_fn = _build_graph_fn(symbol)
             self._jit_fwd = shared_exec._jit_fwd
             self._jit_fwdbwd = shared_exec._jit_fwdbwd
         else:
+            self._graph_fn = _build_graph_fn(symbol)
             self._jit_fwd = jax.jit(
                 lambda a, x, k, t: self._graph_fn(a, x, k, t), static_argnums=(3,)
             )
@@ -160,6 +331,7 @@ class Executor:
     # ------------------------------------------------------------------ build
     def _make_fwdbwd(self):
         graph_fn = self._graph_fn
+        placed = self._placed
 
         def fwdbwd(arg_vals, aux_vals, key, head_grads, gnames: tuple):
             def fwd_for_grad(grad_args):
@@ -172,6 +344,15 @@ class Executor:
             (outs, new_aux), vjp_fn = jax.vjp(
                 lambda ga: fwd_for_grad(ga), grad_args, has_aux=False
             )
+            if placed:
+                # the seed cotangent must sit where its primal output sits,
+                # or the last segment's transposed pjit sees mixed device
+                # commitments; interior cotangents then follow the
+                # transposed device_put edges automatically
+                head_grads = [
+                    jax.device_put(h, next(iter(o.devices())))
+                    for h, o in zip(head_grads, outs)
+                ]
             # cotangent: (outputs_cot, aux_cot=zeros)
             aux_cot = jax.tree_util.tree_map(jnp.zeros_like, new_aux)
             (grads,) = vjp_fn((head_grads, aux_cot))
@@ -310,7 +491,13 @@ class Executor:
 
     def _run_monitor(self, args, aux, key):
         internals = self._symbol.get_internals()
-        fn = _build_graph_fn(internals)
+        if self._placed:
+            # internals share the same node objects, so the stored plan
+            # (keyed by id(node) / var name) places them identically —
+            # a flat _build_graph_fn would feed ops mixed-device operands
+            fn = _build_placed_fn(internals, *self._plan, self._ctx)
+        else:
+            fn = _build_graph_fn(internals)
         outs, _ = fn(args, aux, key, False)
         for name, val in zip(internals.list_outputs(), outs):
             self._monitor_callback(name, NDArray(val))
@@ -335,6 +522,7 @@ class Executor:
         shapes = {k: v.shape for k, v in self.arg_dict.items()}
         shapes.update(kwargs)
         return simple_bind(self._symbol, self._ctx, grad_req=self.grad_req,
+                           group2ctx=self._group2ctx or None,
                            shared_exec=self, **shapes)
 
     @property
@@ -352,12 +540,19 @@ def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
         raise MXNetError(f"simple_bind: cannot infer shapes from {kwargs}")
     arg_names = symbol.list_arguments()
     aux_names = symbol.list_auxiliary_states()
+    # ctx_group-annotated graphs: allocate each variable on its group's
+    # device so params/grads live where their layer computes
+    var_ctx = {}
+    if group2ctx:
+        _, var_ctx, _ = placement_plan(symbol, group2ctx, ctx)
     args = {}
     for name, shape in zip(arg_names, arg_shapes):
-        args[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32), ctx=ctx)
+        args[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32),
+                             ctx=var_ctx.get(name, ctx))
     aux = {}
     for name, shape in zip(aux_names, aux_shapes):
-        aux[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32), ctx=ctx)
+        aux[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32),
+                            ctx=var_ctx.get(name, ctx))
 
     if isinstance(grad_req, str):
         req = {k: grad_req for k in arg_names}
@@ -366,7 +561,8 @@ def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
     else:
         req = {k: grad_req.get(k, "null") for k in arg_names}
     grads = {
-        k: NDArray(jnp.zeros(dict(zip(arg_names, arg_shapes))[k], dtype=jnp.float32), ctx=ctx)
+        k: NDArray(jnp.zeros(dict(zip(arg_names, arg_shapes))[k], dtype=jnp.float32),
+                   ctx=var_ctx.get(k, ctx))
         for k in arg_names
         if req.get(k, "null") != "null"
     }
