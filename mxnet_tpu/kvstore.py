@@ -49,6 +49,31 @@ class KVStore:
         self._store: Dict = {}
         self._updater: Optional[Callable] = None
         self._optimizer = None
+        # 'device'-class stores reduce on-device with per-key merge
+        # buffers load-balanced across the grads' devices (parity:
+        # CommDevice::InitMergeBuffer, src/kvstore/comm.h:321-348)
+        self._device_mode = kv_type in ("device", "local_allreduce_device")
+        self._merge_ctx: Dict = {}
+        self._merge_load: Dict = {}
+
+    def _merge_context(self, k, vals):
+        """Pick (once per key) the least-loaded device among the pushed
+        copies for the merge buffer.  Spreading keys across devices gives
+        aggregate reduction bandwidth, and since every jax dispatch is
+        async, different keys reduce concurrently on their own devices —
+        the engine-free analogue of the reference's priority-scheduled
+        per-key overlap (SURVEY §3.4)."""
+        ctx = self._merge_ctx.get(k)
+        if ctx is None:
+            cands = sorted({v.context for v in vals}, key=repr)
+            ctx = min(cands, key=lambda c: self._merge_load.get(c, 0))
+            self._merge_load[ctx] = (self._merge_load.get(ctx, 0)
+                                     + vals[0].size * 4)
+            self._merge_ctx[k] = ctx
+            if k in self._store:
+                # in-store optimizer updates then run device-side too
+                self._store[k] = self._store[k].as_in_context(ctx)
+        return ctx
 
     # ------------------------------------------------------------------ basic
     def init(self, key, value):
@@ -71,9 +96,19 @@ class KVStore:
             values = value
         for k, v in zip(keys, values):
             if isinstance(v, (list, tuple)):
-                merged = v[0].copy()
-                for other in v[1:]:
-                    merged += other.as_in_context(merged.context)
+                if self._device_mode:
+                    # reduce on the key's merge device: async copies in
+                    # (CopyFromTo/P2P parity) + on-device sum; dispatch
+                    # returns immediately, so reductions for this key
+                    # overlap with the caller's remaining backward work
+                    mctx = self._merge_context(k, v)
+                    merged = v[0].copyto(mctx)
+                    for other in v[1:]:
+                        merged += other.as_in_context(mctx)
+                else:
+                    merged = v[0].copy()
+                    for other in v[1:]:
+                        merged += other.as_in_context(merged.context)
             else:
                 merged = v.copy()
             if self._updater is not None:
