@@ -66,6 +66,9 @@ if __name__ == "__main__":
     ap.add_argument("--buckets", type=str, default="8,16,24,32")
     ap.add_argument("--vocab-size", type=int, default=2000)
     ap.add_argument("--num-sentences", type=int, default=2000)
+    ap.add_argument("--no-compile-sharing", action="store_true",
+                    help="bind one XLA executable per bucket (the naive "
+                         "path) instead of padding to the largest bucket")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -91,14 +94,21 @@ if __name__ == "__main__":
                                       init_states=init_states)
 
     def sym_gen(seq_len):
+        # ignore_label=0 masks padding out of loss AND gradient — this is
+        # what makes compile-bucket padding exact, and also fixes the
+        # within-bucket padding the reference example silently trains on
         symbol = lstm_unroll(args.num_layers, seq_len, vocab_size,
                              args.num_hidden, args.num_embed, vocab_size,
-                             dropout=0.2)
+                             dropout=0.2, ignore_label=0)
         data_names = ("data",) + tuple(n for n, _ in init_states)
         return symbol, data_names, ("softmax_label",)
 
+    # compile sharing: all buckets pad to the default (largest) bucket and
+    # run through ONE compiled fwd+bwd — seconds of XLA compile per bucket
+    # collapse to a single compile (docs/how_to/bucketing.md)
     mod = mx.mod.BucketingModule(sym_gen,
-                                 default_bucket_key=train.default_bucket_key)
+                                 default_bucket_key=train.default_bucket_key,
+                                 compile_buckets=not args.no_compile_sharing)
     mod.fit(train,
             eval_metric=mx.metric.Perplexity(ignore_label=0),
             optimizer="sgd",

@@ -164,3 +164,24 @@ class AttrScope:
 
 def current_attr_scope():
     return AttrScope._current
+
+
+def mxu_precision(*arrays):
+    """Per-op matmul precision: single-pass MXU for low-precision inputs.
+
+    The package default (jax_default_matmul_precision=float32, __init__.py)
+    gives fp32 arrays reference-parity fp32 math — but that global knob
+    would ALSO make explicit bfloat16/fp16 data run multi-pass emulated
+    matmuls, wasting the MXU fast path.  Hot ops pass
+    ``precision=mxu_precision(x, w)``: lax.Precision.DEFAULT (one MXU pass)
+    when any operand is already low-precision, None (defer to the global
+    fp32 policy) otherwise.
+    """
+    import jax
+
+    low = (("bfloat16", "float16"))
+    for a in arrays:
+        dt = getattr(a, "dtype", None)
+        if dt is not None and str(dt) in low:
+            return jax.lax.Precision.DEFAULT
+    return None

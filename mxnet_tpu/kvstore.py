@@ -67,8 +67,9 @@ class KVStore:
         if ctx is None:
             cands = sorted({v.context for v in vals}, key=repr)
             ctx = min(cands, key=lambda c: self._merge_load.get(c, 0))
-            self._merge_load[ctx] = (self._merge_load.get(ctx, 0)
-                                     + vals[0].size * 4)
+            self._merge_load[ctx] = (
+                self._merge_load.get(ctx, 0)
+                + vals[0].size * np.dtype(vals[0].dtype).itemsize)
             self._merge_ctx[k] = ctx
             if k in self._store:
                 # in-store optimizer updates then run device-side too
@@ -112,6 +113,13 @@ class KVStore:
             else:
                 merged = v.copy()
             if self._updater is not None:
+                # the update must run where the stored weight lives: for
+                # 'local' stores that is host memory (parity: CommCPU
+                # reduces into pinned_ctx_, comm.h:74-130), for 'device'
+                # stores the merge device (weight moved in _merge_context).
+                # Without this, a TPU-resident grad meeting a host-resident
+                # weight is a cross-platform op error.
+                merged = merged.as_in_context(self._store[k].context)
                 self._updater(k if isinstance(k, int) else k, merged, self._store[k])
             else:
                 # aggregation-only mode: stored value replaced by merged grad

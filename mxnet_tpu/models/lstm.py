@@ -34,8 +34,12 @@ def lstm(num_hidden, indata, prev_state, param, seqidx, layeridx, dropout=0.0):
 
 
 def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
-                num_label, dropout=0.0):
-    """Parity: example/rnn/lstm.py lstm_unroll — the bucketing sym_gen body."""
+                num_label, dropout=0.0, ignore_label=None):
+    """Parity: example/rnn/lstm.py lstm_unroll — the bucketing sym_gen body.
+
+    ``ignore_label`` masks that label id out of the loss (use_ignore
+    SoftmaxOutput) — required for exact gradients under compile-bucket
+    padding (BucketingModule(compile_buckets=...))."""
     embed_weight = sym.Variable("embed_weight")
     cls_weight = sym.Variable("cls_weight")
     cls_bias = sym.Variable("cls_bias")
@@ -76,6 +80,9 @@ def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
                               weight=cls_weight, bias=cls_bias, name="pred")
     label_t = sym.transpose(label)
     label_flat = sym.Reshape(label_t, shape=(-1,))
+    if ignore_label is not None:
+        return sym.SoftmaxOutput(pred, label_flat, name="softmax",
+                                 use_ignore=True, ignore_label=ignore_label)
     return sym.SoftmaxOutput(pred, label_flat, name="softmax")
 
 

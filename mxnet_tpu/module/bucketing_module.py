@@ -6,19 +6,71 @@ executors (GraphExecutor::Init(shared_exec) -> InitDataEntryMemory);
 TPU-natively each bucket is a jit cache entry keyed by shape — the
 ``shared_module`` plumbing shares the compiled-function cache and params,
 and XLA reuses device buffers across calls (SURVEY.md §5.7 bucketing row).
+
+Compile-cost control (SURVEY.md §7 "Bucketing vs compile cost"): on TPU a
+new bucket = a new unrolled graph = a full XLA compile, so naive bucketing
+pays seconds per bucket where the reference pays only a cheap memory-plan
+reuse.  ``compile_buckets`` caps that: bucket keys are rounded UP to a
+small set of compile keys, batches are padded along the bucketed axis to
+the compile key's shape, and the padded positions carry ``label_pad`` so a
+symbol built with ``use_ignore=True, ignore_label=label_pad`` gets *exactly*
+the same gradients as the unpadded bucket graph (SoftmaxOutput masks both
+loss and d(loss) at ignored labels — ops/loss.py).  With
+``compile_buckets=True`` everything runs through the default bucket's one
+executable: ≤2 XLA compilations (fwd, fused fwd+bwd) for any number of
+buckets.
 """
 from __future__ import annotations
 
 import logging
 
+import numpy as np
+
 from ..base import MXNetError
+from ..io import DataBatch, DataDesc
+from ..ndarray import NDArray
 from .base_module import BaseModule
 from .module import Module
 
 
+def _key_tuple(key):
+    return tuple(key) if isinstance(key, (list, tuple)) else (key,)
+
+
+def _key_le(a, b):
+    ta, tb = _key_tuple(a), _key_tuple(b)
+    return len(ta) == len(tb) and all(x <= y for x, y in zip(ta, tb))
+
+
+def _pad_shape(shape, default_shape, key, default_key, ckey):
+    """Compute the padded target shape for one array.
+
+    The bucketed axes are exactly those where this batch's shape differs
+    from the default bucket's bound shape (so constant axes — batch size,
+    hidden dims — are never touched even if they numerically collide with a
+    bucket key).  Each such axis maps to the bucket-key component whose
+    value matches it, and is promoted to that component of the compile key.
+    """
+    if default_shape is None or len(default_shape) != len(shape):
+        return tuple(shape)
+    tk = _key_tuple(key)
+    tdk = _key_tuple(default_key)
+    tck = _key_tuple(ckey)
+    out = []
+    for d, dd in zip(shape, default_shape):
+        if d != dd:
+            for j, kc in enumerate(tk):
+                if d == kc and (j >= len(tdk) or tdk[j] == dd):
+                    d = tck[j]
+                    break
+        out.append(d)
+    return tuple(out)
+
+
 class BucketingModule(BaseModule):
     def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
-                 context=None, work_load_list=None, fixed_param_names=None):
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 compile_buckets=None, data_pad=0.0, label_pad=0.0):
         super().__init__(logger=logger)
         assert default_bucket_key is not None
         self._default_bucket_key = default_bucket_key
@@ -29,6 +81,72 @@ class BucketingModule(BaseModule):
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
+        if compile_buckets is True:
+            compile_buckets = [default_bucket_key]
+        if compile_buckets:
+            compile_buckets = list(compile_buckets)
+            if not any(_key_le(default_bucket_key, k) for k in compile_buckets):
+                compile_buckets.append(default_bucket_key)
+            compile_buckets.sort(key=_key_tuple)
+        self._compile_buckets = compile_buckets or None
+        self._data_pad = data_pad
+        self._label_pad = label_pad
+        self._metric_labels = None  # padded labels for update_metric
+
+    def _compile_key(self, bucket_key):
+        """Smallest compile bucket covering bucket_key (identity when off)."""
+        if not self._compile_buckets:
+            return bucket_key
+        for ck in self._compile_buckets:
+            if _key_le(bucket_key, ck):
+                return ck
+        raise MXNetError(
+            f"bucket_key {bucket_key!r} exceeds every compile bucket "
+            f"{self._compile_buckets!r}")
+
+    def _pad_batch(self, data_batch, key, ckey):
+        """Pad a bucket-``key`` batch up to the compile bucket's shapes.
+
+        Data pads with ``data_pad``; labels pad with ``label_pad`` so that a
+        use_ignore symbol contributes zero loss/gradient at the padding."""
+        default_mod = self._buckets[self._default_bucket_key]
+        defaults = dict(default_mod._data_shapes)
+        if default_mod._label_shapes:
+            defaults.update(dict(default_mod._label_shapes))
+
+        def pad(arrs, descs, names, fill):
+            import jax.numpy as jnp
+
+            out_arrs, out_descs = [], []
+            for i, a in enumerate(arrs):
+                shape = tuple(a.shape)
+                name = descs[i][0] if descs and i < len(descs) else names[i]
+                tgt = _pad_shape(shape, defaults.get(name), key,
+                                 self._default_bucket_key, ckey)
+                if tgt != shape:
+                    if isinstance(a, NDArray):
+                        # pad on whatever device the array lives — no
+                        # host round-trip for device-staged pipelines
+                        raw = a._read()
+                    else:
+                        raw = jnp.asarray(np.asarray(a))
+                    widths = [(0, t - s) for s, t in zip(shape, tgt)]
+                    a = NDArray(jnp.pad(raw, widths, constant_values=fill))
+                out_arrs.append(a)
+                out_descs.append(DataDesc(name, tgt))
+            return out_arrs, out_descs
+
+        mod = self._curr_module
+        data, ddesc = pad(data_batch.data, data_batch.provide_data or [],
+                          mod.data_names, self._data_pad)
+        if data_batch.label is not None:
+            label, ldesc = pad(data_batch.label, data_batch.provide_label or [],
+                               mod._label_names, self._label_pad)
+        else:
+            label, ldesc = None, None
+        return DataBatch(data, label=label, pad=data_batch.pad,
+                         index=data_batch.index, bucket_key=ckey,
+                         provide_data=ddesc, provide_label=ldesc)
 
     @property
     def default_bucket_key(self):
@@ -117,6 +235,12 @@ class BucketingModule(BaseModule):
         bucket_key = getattr(data_batch, "bucket_key", None)
         if bucket_key is None:
             bucket_key = self._default_bucket_key
+        compile_key = self._compile_key(bucket_key)
+        self._orig_labels = data_batch.label
+        if compile_key != bucket_key:
+            data_batch = self._pad_batch(data_batch, bucket_key, compile_key)
+            bucket_key = compile_key
+        self._metric_labels = data_batch.label
         data_shapes = data_batch.provide_data or [
             (n, a.shape) for n, a in zip(self._curr_module.data_names, data_batch.data)
         ]
@@ -149,6 +273,18 @@ class BucketingModule(BaseModule):
         return self._curr_module.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        # Under compile-bucket padding the executor outputs carry the
+        # padded length, so the labels the caller took from the ORIGINAL
+        # batch no longer line up — substitute the padded labels (the
+        # ignore_label masks the padding).  Only the fit()-style case
+        # where the caller passes that same batch's labels is rewritten;
+        # custom label lists pass through untouched.
+        if (self._compile_buckets and self._metric_labels is not None
+                and labels is not None
+                and getattr(self, "_orig_labels", None) is not None
+                and len(labels) == len(self._orig_labels)
+                and all(a is b for a, b in zip(labels, self._orig_labels))):
+            labels = self._metric_labels
         self._curr_module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):

@@ -108,10 +108,13 @@ class DataParallelExecutorGroup:
                 ex.aux_dict[name]._chunk.write(self._replicate(arr))
 
     def _replicate(self, arr):
+        # ALWAYS place on the group's devices: params handed in are host
+        # arrays (Module master copies), and writing them through as-is
+        # would leave executor buffers on the wrong platform when the
+        # default device is an accelerator (caught by lstm_bucketing on a
+        # real TPU host: cpu weight vs tpu grad in the optimizer).
         raw = arr._read() if isinstance(arr, NDArray) else jax.numpy.asarray(arr)
-        if len(self.mesh.devices.flat) > 1:
-            return jax.device_put(raw, self._repl_sharding)
-        return raw
+        return jax.device_put(raw, self._repl_sharding)
 
     def get_params(self, arg_params, aux_params):
         ex = self.execs[0]
@@ -136,8 +139,10 @@ class DataParallelExecutorGroup:
     def _load(self, ex, names, arrays):
         for name, arr in zip(names, arrays):
             raw = arr._read() if isinstance(arr, NDArray) else jax.numpy.asarray(np.asarray(arr))
-            if len(self.mesh.devices.flat) > 1:
-                raw = jax.device_put(raw, self._data_sharding)
+            # always place on the group's devices (host-resident batches
+            # would otherwise leave the input on the cpu platform when the
+            # executor runs on an accelerator)
+            raw = jax.device_put(raw, self._data_sharding)
             # bypass _set's device pinning: sharded placement is intentional
             ex.arg_dict[name]._chunk.write(raw)
 

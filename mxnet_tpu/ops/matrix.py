@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import MXNetError, parse_attr, parse_bool
+from ..base import mxu_precision, MXNetError, parse_attr, parse_bool
 from .registry import register
 
 
@@ -26,7 +26,7 @@ def _dot(ctx, lhs, rhs, **attrs):
         return jnp.dot(lhs, rhs).reshape((1,))
     a = lhs.T if ta else lhs
     b = rhs.T if tb else rhs
-    return jnp.dot(a, b)
+    return jnp.dot(a, b, precision=mxu_precision(a, b))
 
 
 @register("batch_dot", arg_names=("lhs", "rhs"))
@@ -36,7 +36,7 @@ def _batch_dot(ctx, lhs, rhs, **attrs):
     tb = parse_bool(attrs.get("transpose_b", False))
     a = jnp.swapaxes(lhs, -1, -2) if ta else lhs
     b = jnp.swapaxes(rhs, -1, -2) if tb else rhs
-    return jnp.matmul(a, b)
+    return jnp.matmul(a, b, precision=mxu_precision(a, b))
 
 
 @register("transpose")

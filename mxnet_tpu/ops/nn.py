@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import MXNetError, normalize_tuple, parse_attr, parse_bool
+from ..base import MXNetError, mxu_precision, normalize_tuple, parse_attr, parse_bool
 from .registry import register
 
 # ---------------------------------------------------------------------------
@@ -83,6 +83,7 @@ def _convolution(ctx, data, weight, bias=None, **attrs):
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
+        precision=mxu_precision(data, weight),
     )
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
@@ -129,6 +130,7 @@ def _deconvolution(ctx, data, weight, bias=None, **attrs):
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
+        precision=mxu_precision(data, weight),
     )
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
@@ -167,7 +169,7 @@ def _fully_connected(ctx, data, weight, bias=None, **attrs):
     """Parity: FullyConnected (src/operator/fully_connected-inl.h); always
     flattens trailing dims like the reference v0.9 op."""
     x = data.reshape((data.shape[0], -1))
-    out = jnp.dot(x, weight.T)
+    out = jnp.dot(x, weight.T, precision=mxu_precision(data, weight))
     if bias is not None:
         out = out + bias
     return out
@@ -491,6 +493,7 @@ def _upsampling(ctx, data, weight=None, **attrs):
         padding=[(k - 1 - p, k - 1 - p + scale - 1), (k - 1 - p, k - 1 - p + scale - 1)],
         lhs_dilation=(scale, scale),
         dimension_numbers=dn,
+        precision=mxu_precision(data, weight),
         feature_group_count=c,
     )
     return out

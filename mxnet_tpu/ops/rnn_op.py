@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import MXNetError, parse_attr, parse_bool
+from ..base import mxu_precision, MXNetError, parse_attr, parse_bool
 from .registry import register
 
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
@@ -96,14 +96,15 @@ def _run_layer(x, w_ih, w_hh, b_ih, b_hh, h0, c0, mode, reverse=False):
     if reverse:
         x = jnp.flip(x, axis=0)
     # hoist the input projection out of the scan: one big (T*N, G*H) matmul
-    pre_x = jnp.einsum("tni,gi->tng", x, w_ih) + b_ih
+    pre_x = jnp.einsum("tni,gi->tng", x, w_ih,
+                       precision=mxu_precision(x, w_ih)) + b_ih
 
     if mode == "lstm":
         step = _cell_step("lstm", None)
 
         def body(carry, px):
             h, c = carry
-            pre = px + jnp.dot(h, w_hh.T) + b_hh
+            pre = px + jnp.dot(h, w_hh.T, precision=mxu_precision(h, w_hh)) + b_hh
             new_h, new_c = step(c, h, pre)
             return (new_h, new_c), new_h
 
@@ -112,7 +113,7 @@ def _run_layer(x, w_ih, w_hh, b_ih, b_hh, h0, c0, mode, reverse=False):
         step = _cell_step("gru", None)
 
         def body(h, px):
-            pre_h = jnp.dot(h, w_hh.T) + b_hh
+            pre_h = jnp.dot(h, w_hh.T, precision=mxu_precision(h, w_hh)) + b_hh
             new_h = step(h, px, pre_h)
             return new_h, new_h
 
@@ -122,7 +123,7 @@ def _run_layer(x, w_ih, w_hh, b_ih, b_hh, h0, c0, mode, reverse=False):
         act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
 
         def body(h, px):
-            new_h = act(px + jnp.dot(h, w_hh.T) + b_hh)
+            new_h = act(px + jnp.dot(h, w_hh.T, precision=mxu_precision(h, w_hh)) + b_hh)
             return new_h, new_h
 
         hT, ys = jax.lax.scan(body, h0, pre_x)

@@ -22,6 +22,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..base import mxu_precision
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
@@ -32,7 +34,8 @@ def _stream_block(q, k, v, m, l, o, scale, mask=None):
 
     q: (B, H, Tq, D), k/v: (B, H, Tk, D); m/l: (B, H, Tq); o accumulator.
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   precision=mxu_precision(q, k)) * scale
     if mask is not None:
         s = jnp.where(mask, s, -jnp.inf)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -43,7 +46,7 @@ def _stream_block(q, k, v, m, l, o, scale, mask=None):
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
     l_new = l * corr + jnp.sum(p, axis=-1)
-    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v, precision=mxu_precision(p, v))
     return m_new, l_new, o_new
 
 
@@ -109,13 +112,14 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
                                       tiled=True)
 
         ql, kl, vl = a2a(q), a2a(k), a2a(v)
-        s = jnp.einsum("bhqd,bhkd->bhqk", ql, kl) * scale
+        s = jnp.einsum("bhqd,bhkd->bhqk", ql, kl,
+                       precision=mxu_precision(ql, kl)) * scale
         if causal:
             tq = s.shape[-2]
             mask = jnp.tril(jnp.ones((tq, tq), bool))
             s = jnp.where(mask, s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
-        ol = jnp.einsum("bhqk,bhkd->bhqd", p, vl)
+        ol = jnp.einsum("bhqk,bhkd->bhqd", p, vl, precision=mxu_precision(p, vl))
         # back: (B, H/n, T, D) -> (B, H, T/n, D)
         return jax.lax.all_to_all(ol, axis_name, split_axis=2, concat_axis=1,
                                   tiled=True)
@@ -130,13 +134,14 @@ def full_attention(q, k, v, causal=False, scale=None):
     memory-efficient dispatcher."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   precision=mxu_precision(q, k)) * scale
     if causal:
         t = s.shape[-1]
         mask = jnp.tril(jnp.ones((s.shape[-2], t), bool))
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v, precision=mxu_precision(p, v))
 
 
 def attention(q, k, v, causal=False, scale=None, impl="auto"):

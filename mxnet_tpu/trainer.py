@@ -213,7 +213,12 @@ class FusedTrainer:
     def _shard_batch(self, batch):
         out = {}
         for k, v in batch.items():
-            raw = v._read() if isinstance(v, NDArray) else jnp.asarray(np.asarray(v))
+            if isinstance(v, NDArray):
+                raw = v._read()
+            elif isinstance(v, jax.Array):
+                raw = v  # already on device — never round-trip to host
+            else:
+                raw = jnp.asarray(np.asarray(v))
             if self.mesh is not None:
                 out[k] = jax.device_put(
                     raw, NamedSharding(self.mesh, P("data", *([None] * (raw.ndim - 1)))))
