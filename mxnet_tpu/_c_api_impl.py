@@ -1,0 +1,230 @@
+"""Implementation behind the general C API (src/c_api.cc).
+
+Parity model: include/mxnet/c_api.h (reference, 115 fns) — this module
+carries the logic for the subset that language bindings actually consume
+(SURVEY.md App B: NDArray lifecycle, symbol composition, executor
+bind/forward/backward, kvstore init/push/pull).  The native layer
+(libmxtpu_capi.so) embeds CPython, marshals C buffers, and calls these
+functions; XLA does the math, exactly like the predict ABI
+(src/c_predict.cc).
+
+Handles on the C side are plain ``PyObject*``; every function here takes
+and returns Python objects that the C layer owns via refcounts.  Errors
+propagate as exceptions — the C layer converts them to -1 +
+MXGetLastError, mirroring the reference's c_api_error.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as _symbol_mod
+from .base import MXNetError
+from .context import cpu, Context
+from .ndarray import NDArray
+
+
+def _ctx(dev_type, dev_id):
+    # dev_type follows the predict ABI: 1 = cpu, 2 = accelerator
+    if int(dev_type) == 1:
+        return cpu(int(dev_id))
+    from .context import default_accelerator_context
+
+    return default_accelerator_context()
+
+
+# ------------------------------------------------------------------ NDArray
+def ndarray_create(shape, dev_type, dev_id):
+    return nd.zeros(tuple(int(s) for s in shape), ctx=_ctx(dev_type, dev_id))
+
+
+def ndarray_shape(arr):
+    return [int(s) for s in arr.shape]
+
+
+def ndarray_sync_copy_from(arr, buf):
+    """buf: C float32 buffer (bytes/memoryview) of exactly arr.size items.
+
+    The copy MUST be materialized before returning: ``buf`` views borrowed
+    C memory the caller may free the moment this returns (sync semantics,
+    like the reference's SyncCopyFromCPU WaitToWrite)."""
+    src = np.frombuffer(buf, dtype=np.float32, count=arr.size).copy()
+    arr[:] = src.reshape(arr.shape)
+    arr._read().block_until_ready()
+
+
+def ndarray_sync_copy_to(arr):
+    """Returns float32 bytes; blocks until the value is computed."""
+    return np.ascontiguousarray(arr.asnumpy(), dtype=np.float32).tobytes()
+
+
+def ndarray_wait_all():
+    from . import engine
+
+    engine.wait_all()
+
+
+# ------------------------------------------------------------------- Symbol
+class _AtomicSymbol:
+    """MXSymbolCreateAtomicSymbol result: an op + attrs awaiting Compose
+    (parity: c_api.cc CreateAtomicSymbol -> Symbol::Compose)."""
+
+    def __init__(self, op, attrs):
+        self.op = op
+        self.attrs = attrs
+        self.symbol = None  # set by compose
+
+
+def symbol_list_atomic_creators():
+    from .ops import registry
+
+    return sorted(registry.list_ops())
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    from . import sym
+
+    if not hasattr(sym, op_name):
+        raise MXNetError(f"unknown operator {op_name!r}")
+    return _AtomicSymbol(op_name, dict(zip(keys, vals)))
+
+
+def symbol_create_variable(name):
+    return _symbol_mod.Variable(name)
+
+
+def symbol_compose(handle, name, keys, args):
+    """Fill an atomic symbol's inputs (keys may be empty = positional)."""
+    from . import sym
+
+    if isinstance(handle, _AtomicSymbol):
+        fn = getattr(sym, handle.op)
+        kwargs = dict(handle.attrs)
+        if name:
+            kwargs["name"] = name
+        inputs = [_sym(a) if isinstance(a, _AtomicSymbol) else a for a in args]
+        if keys:
+            kwargs.update(dict(zip(keys, inputs)))
+            handle.symbol = fn(**kwargs)
+        else:
+            handle.symbol = fn(*inputs, **kwargs)
+        return handle
+    raise MXNetError("Compose target must be an atomic symbol")
+
+
+def _sym(handle):
+    if isinstance(handle, _AtomicSymbol):
+        if handle.symbol is None:
+            raise MXNetError(f"atomic symbol {handle.op!r} is not composed yet")
+        return handle.symbol
+    return handle
+
+
+def symbol_from_json(json_str):
+    return _symbol_mod.load_json(json_str)
+
+
+def symbol_to_json(handle):
+    return _sym(handle).tojson()
+
+
+def symbol_list_arguments(handle):
+    return _sym(handle).list_arguments()
+
+
+def symbol_list_outputs(handle):
+    return _sym(handle).list_outputs()
+
+
+def symbol_list_auxiliary_states(handle):
+    return _sym(handle).list_auxiliary_states()
+
+
+def symbol_infer_shape(handle, keys, shapes):
+    s = _sym(handle)
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(
+        **{k: tuple(v) for k, v in zip(keys, shapes)})
+    to_list = lambda shs: [[int(d) for d in sh] for sh in shs]  # noqa: E731
+    return to_list(arg_shapes), to_list(out_shapes), to_list(aux_shapes)
+
+
+# ----------------------------------------------------------------- Executor
+def executor_simple_bind(handle, dev_type, dev_id, grad_req, keys, shapes):
+    s = _sym(handle)
+    return s.simple_bind(ctx=_ctx(dev_type, dev_id), grad_req=grad_req,
+                         **{k: tuple(v) for k, v in zip(keys, shapes)})
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex):
+    ex.backward()
+
+
+def executor_num_outputs(ex):
+    return len(ex.outputs)
+
+
+def executor_output(ex, index):
+    return ex.outputs[int(index)]
+
+
+def executor_arg_array(ex, name):
+    try:
+        return ex.arg_dict[name]
+    except KeyError:
+        raise MXNetError(f"no argument named {name!r}")
+
+
+def executor_grad_array(ex, name):
+    g = ex.grad_dict.get(name)
+    if g is None:
+        raise MXNetError(f"no gradient for {name!r} (grad_req null?)")
+    return g
+
+
+def executor_arg_names(ex):
+    return list(ex.arg_dict.keys())
+
+
+# ------------------------------------------------------------------ KVStore
+def kvstore_create(kv_type):
+    from . import kvstore
+
+    return kvstore.create(kv_type.decode() if isinstance(kv_type, bytes)
+                          else kv_type)
+
+
+def kvstore_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kvstore_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=int(priority))
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+
+
+def kvstore_set_updater(kv, py_callback):
+    """py_callback(key:int, recv:NDArray, local:NDArray) — the C layer
+    wraps the user's C function pointer in a Python callable."""
+    kv._set_updater(py_callback)
+
+
+def kvstore_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_num_workers(kv):
+    return int(kv.num_workers)
+
+
+# --------------------------------------------------------------------- misc
+def random_seed(seed):
+    from . import random as _random
+
+    _random.seed(int(seed))

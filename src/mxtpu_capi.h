@@ -1,0 +1,129 @@
+/*
+ * mxtpu general C API — the training-capable ABI for non-Python
+ * frontends (parity: include/mxnet/c_api.h, the 115-function surface
+ * SURVEY.md App B calls "the real product"; this is the subset language
+ * bindings actually consume: NDArray lifecycle, symbol composition,
+ * executor bind/forward/backward, kvstore init/push/pull/updater).
+ *
+ * Conventions (same as the reference):
+ *   - every function returns 0 on success, -1 on failure;
+ *     MXGetLastError() returns the failure text (thread-local)
+ *   - handles are opaque; free NDArray/Symbol/Executor/KVStore handles
+ *     with their MX*Free call exactly once
+ *   - dev_type: 1 = cpu, 2 = accelerator (tpu), as in the predict ABI
+ *   - all tensor data crosses this ABI as float32 (the reference's
+ *     default real_t; mixed precision stays on-device)
+ *
+ * List-returning calls (ListArguments etc.) and SaveToJSON return
+ * pointers owned by the library, valid until the next call ON THE SAME
+ * HANDLE; copy out if you need them longer.  InferShape results are
+ * thread-local, valid until the next MXSymbolInferShape on that thread.
+ */
+#ifndef MXTPU_CAPI_H_
+#define MXTPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+
+const char *MXGetLastError(void);
+int MXRandomSeed(int seed);
+/* Block until all queued work has completed (parity: MXNDArrayWaitAll). */
+int MXNDArrayWaitAll(void);
+
+/* ----------------------------------------------------------- NDArray */
+int MXNDArrayCreate(const uint32_t *shape, uint32_t ndim, int dev_type,
+                    int dev_id, NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+/* Writes ndim to *out_ndim and up to buf_cap dims into shape_buf. */
+int MXNDArrayGetShape(NDArrayHandle handle, uint32_t *out_ndim,
+                      uint32_t *shape_buf, uint32_t buf_cap);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const float *data,
+                             uint64_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, float *data, uint64_t size);
+
+/* ------------------------------------------------------------ Symbol */
+int MXSymbolListAtomicSymbolCreators(uint32_t *out_size,
+                                     const char ***out_array);
+/* Atomic symbol = op name + string attrs; fill inputs with Compose. */
+int MXSymbolCreateAtomicSymbol(const char *op, uint32_t num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+/* keys NULL = positional args.  Mutates sym in place (reference
+ * semantics: nnvm Symbol::Compose). */
+int MXSymbolCompose(SymbolHandle sym, const char *name, uint32_t num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+int MXSymbolListArguments(SymbolHandle sym, uint32_t *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle sym, uint32_t *out_size,
+                        const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, uint32_t *out_size,
+                                const char ***out_array);
+/* Known input shapes as CSR (keys / ind_ptr / shape_data, like the
+ * reference); result counts via out params, then fetch each shape with
+ * MXSymbolInferShapeGet(which: 0=args 1=outputs 2=aux). */
+int MXSymbolInferShape(SymbolHandle sym, uint32_t num_known,
+                       const char **keys, const uint32_t *arg_ind_ptr,
+                       const uint32_t *arg_shape_data, uint32_t *arg_count,
+                       uint32_t *out_count, uint32_t *aux_count);
+int MXSymbolInferShapeGet(int which, uint32_t index, uint32_t *out_ndim,
+                          uint32_t *shape_buf, uint32_t buf_cap);
+int MXSymbolFree(SymbolHandle sym);
+
+/* ---------------------------------------------------------- Executor */
+/* grad_req: "write", "add" or "null".  Input shapes as CSR like
+ * InferShape.  (parity: MXExecutorSimpleBind; memory planning is XLA's.) */
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         const char *grad_req, uint32_t num_args,
+                         const char **keys, const uint32_t *arg_ind_ptr,
+                         const uint32_t *arg_shape_data,
+                         ExecutorHandle *out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+/* Head gradient = ones (the training path through MakeLoss/SoftmaxOutput,
+ * same default as the reference's Backward with no ograds). */
+int MXExecutorBackward(ExecutorHandle handle);
+int MXExecutorNumOutputs(ExecutorHandle handle, uint32_t *out);
+/* Output/Arg/Grad lookups return OWNED handles: free each with
+ * MXNDArrayFree.  The buffer stays shared with the executor, so writes
+ * through an arg handle feed the next Forward. */
+int MXExecutorOutput(ExecutorHandle handle, uint32_t index,
+                     NDArrayHandle *out);
+int MXExecutorArgArray(ExecutorHandle handle, const char *name,
+                       NDArrayHandle *out);
+int MXExecutorGradArray(ExecutorHandle handle, const char *name,
+                        NDArrayHandle *out);
+int MXExecutorFree(ExecutorHandle handle);
+
+/* ----------------------------------------------------------- KVStore */
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, uint32_t num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, uint32_t num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, uint32_t num, const int *keys,
+                  NDArrayHandle *outs, int priority);
+/* updater(key, recv_grad, local_weight, updater_handle) runs for every
+ * pushed key (parity: MXKVStoreSetUpdater).  recv/local are borrowed. */
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *updater_handle);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_CAPI_H_ */

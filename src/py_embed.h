@@ -1,0 +1,64 @@
+/*
+ * Shared CPython-embedding helpers for the C ABIs (c_predict.cc,
+ * c_api.cc).  Each translation unit gets its own thread-local error
+ * string + interpreter bootstrap (safe: Py_InitializeEx is guarded by
+ * Py_IsInitialized, and both libs may be loaded into one process).
+ */
+#ifndef MXTPU_PY_EMBED_H_
+#define MXTPU_PY_EMBED_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace mxtpu_embed {
+
+inline thread_local std::string last_error;
+
+inline void EnsurePython() {
+  static std::once_flag flag;
+  std::call_once(flag, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL taken by initialization so GilGuard works
+      // uniformly for embedder- and host-initialized interpreters
+      PyEval_SaveThread();
+    }
+  });
+}
+
+struct GilGuard {
+  PyGILState_STATE st;
+  GilGuard() { st = PyGILState_Ensure(); }
+  ~GilGuard() { PyGILState_Release(st); }
+};
+
+/* Capture the pending Python exception into last_error; returns -1. */
+inline int Fail(const char *where) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  last_error = where;
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      last_error += ": ";
+      last_error += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  return -1;
+}
+
+/* import mxnet_tpu.<submodule> and return the module (new ref). */
+inline PyObject *ImportImpl(const char *module) {
+  PyObject *m = PyImport_ImportModule(module);
+  return m;
+}
+
+}  // namespace mxtpu_embed
+
+#endif  // MXTPU_PY_EMBED_H_

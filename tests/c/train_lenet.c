@@ -1,0 +1,245 @@
+/*
+ * Pure-C LeNet training driver over the general C ABI (mxtpu_capi.h) —
+ * the training analogue of the predict-ABI client in test_c_predict.py.
+ * Parity model: the reference's language bindings (R/Scala) which build
+ * symbols with MXSymbolCreateAtomicSymbol/Compose, bind, and train via
+ * kvstore push/pull + updater (R-package/R/model.R train loop).
+ *
+ * Composes conv -> tanh -> pool -> flatten -> fc -> softmax, binds on
+ * CPU, trains on synthetic data with an SGD updater written in plain C,
+ * and prints first/last epoch loss; exit 0 iff loss decreased >20%.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu_capi.h"
+
+#define BATCH 8
+#define CLASSES 10
+#define STEPS 40
+/* SoftmaxOutput grads are per-sample sums (normalization=null, reference
+ * default) — fold the 1/batch rescale into the learning rate. */
+#define LR (0.15f / BATCH)
+
+static unsigned long rng_state = 12345;
+static float frand(void) { /* deterministic LCG in [-0.5, 0.5) */
+  rng_state = rng_state * 6364136223846793005UL + 1442695040888963407UL;
+  return ((rng_state >> 33) & 0xFFFFFF) / (float)0x1000000 - 0.5f;
+}
+
+#define CHECK(expr)                                                       \
+  do {                                                                    \
+    if ((expr) != 0) {                                                    \
+      fprintf(stderr, "FAIL %s: %s\n", #expr, MXGetLastError());          \
+      return 1;                                                           \
+    }                                                                     \
+  } while (0)
+
+static SymbolHandle atomic1(const char *op, const char *k1, const char *v1,
+                            const char *k2, const char *v2,
+                            const char *name, SymbolHandle in) {
+  const char *keys[4];
+  const char *vals[4];
+  uint32_t n = 0;
+  if (k1) { keys[n] = k1; vals[n] = v1; ++n; }
+  if (k2) { keys[n] = k2; vals[n] = v2; ++n; }
+  SymbolHandle h = NULL;
+  if (MXSymbolCreateAtomicSymbol(op, n, keys, vals, &h) != 0) return NULL;
+  SymbolHandle args[1] = {in};
+  if (MXSymbolCompose(h, name, 1, NULL, args) != 0) return NULL;
+  return h;
+}
+
+/* SGD updater in plain C: local -= lr * recv (both pulled to host). */
+static void sgd_updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                        void *state) {
+  (void)key;
+  (void)state;
+  uint32_t ndim = 0, shape[8];
+  if (MXNDArrayGetShape(local, &ndim, shape, 8) != 0) return;
+  uint64_t size = 1;
+  for (uint32_t i = 0; i < ndim; ++i) size *= shape[i];
+  float *w = (float *)malloc(size * sizeof(float));
+  float *g = (float *)malloc(size * sizeof(float));
+  if (MXNDArraySyncCopyToCPU(local, w, size) == 0 &&
+      MXNDArraySyncCopyToCPU(recv, g, size) == 0) {
+    if (getenv("LENET_DEBUG"))
+      printf("  upd key %d size %llu w0 %.5f g0 %.5f\n", key,
+             (unsigned long long)size, w[0], g[0]);
+    for (uint64_t i = 0; i < size; ++i) w[i] -= LR * g[i];
+    MXNDArraySyncCopyFromCPU(local, w, size);
+  } else if (getenv("LENET_DEBUG")) {
+    printf("  upd key %d COPY FAILED: %s\n", key, MXGetLastError());
+  }
+  free(w);
+  free(g);
+}
+
+int main(void) {
+  CHECK(MXRandomSeed(7));
+
+  /* ---- compose LeNet-small ------------------------------------- */
+  SymbolHandle data = NULL, label = NULL;
+  CHECK(MXSymbolCreateVariable("data", &data));
+  CHECK(MXSymbolCreateVariable("softmax_label", &label));
+
+  SymbolHandle conv = NULL;
+  {
+    const char *keys[] = {"kernel", "num_filter"};
+    const char *vals[] = {"(5,5)", "8"};
+    CHECK(MXSymbolCreateAtomicSymbol("Convolution", 2, keys, vals, &conv));
+    SymbolHandle args[] = {data};
+    CHECK(MXSymbolCompose(conv, "conv1", 1, NULL, args));
+  }
+  SymbolHandle act = atomic1("Activation", "act_type", "tanh", NULL, NULL,
+                             "tanh1", conv);
+  if (!act) { fprintf(stderr, "act: %s\n", MXGetLastError()); return 1; }
+  SymbolHandle pool = atomic1("Pooling", "pool_type", "max", "kernel",
+                              "(2,2)", "pool1", act);
+  if (!pool) { fprintf(stderr, "pool: %s\n", MXGetLastError()); return 1; }
+  /* stride attr goes through string parsing exactly like symbol JSON */
+  SymbolHandle flat = atomic1("Flatten", NULL, NULL, NULL, NULL, "flat",
+                              pool);
+  if (!flat) { fprintf(stderr, "flat: %s\n", MXGetLastError()); return 1; }
+  SymbolHandle fc = atomic1("FullyConnected", "num_hidden", "10", NULL,
+                            NULL, "fc1", flat);
+  if (!fc) { fprintf(stderr, "fc: %s\n", MXGetLastError()); return 1; }
+
+  SymbolHandle net = NULL;
+  {
+    CHECK(MXSymbolCreateAtomicSymbol("SoftmaxOutput", 0, NULL, NULL, &net));
+    const char *keys[] = {"data", "label"};
+    SymbolHandle args[] = {fc, label};
+    CHECK(MXSymbolCompose(net, "softmax", 2, keys, args));
+  }
+
+  /* ---- sanity: JSON round trip + listings ----------------------- */
+  const char *json = NULL;
+  CHECK(MXSymbolSaveToJSON(net, &json));
+  SymbolHandle reloaded = NULL;
+  CHECK(MXSymbolCreateFromJSON(json, &reloaded));
+  uint32_t n_args = 0;
+  const char **arg_names = NULL;
+  CHECK(MXSymbolListArguments(net, &n_args, &arg_names));
+  printf("args:");
+  for (uint32_t i = 0; i < n_args; ++i) printf(" %s", arg_names[i]);
+  printf("\n");
+
+  /* ---- infer shapes -------------------------------------------- */
+  const char *shape_keys[] = {"data", "softmax_label"};
+  uint32_t ind_ptr[] = {0, 4, 5};
+  uint32_t shape_data[] = {BATCH, 1, 16, 16, BATCH};
+  uint32_t arg_count = 0, out_count = 0, aux_count = 0;
+  CHECK(MXSymbolInferShape(net, 2, shape_keys, ind_ptr, shape_data,
+                           &arg_count, &out_count, &aux_count));
+  printf("inferred %u args, %u outputs, %u aux\n", arg_count, out_count,
+         aux_count);
+
+  /* ---- bind ----------------------------------------------------- */
+  ExecutorHandle exec = NULL;
+  CHECK(MXExecutorSimpleBind(net, /*cpu*/ 1, 0, "write", 2, shape_keys,
+                             ind_ptr, shape_data, &exec));
+
+  /* ---- init params host-side ----------------------------------- */
+  KVStoreHandle kv = NULL;
+  CHECK(MXKVStoreCreate("local", &kv));
+  CHECK(MXKVStoreSetUpdater(kv, sgd_updater, NULL));
+
+  NDArrayHandle weights[16], grads[16];
+  int keys_arr[16];
+  uint32_t n_params = 0;
+  for (uint32_t i = 0; i < n_args; ++i) {
+    if (strcmp(arg_names[i], "data") == 0 ||
+        strcmp(arg_names[i], "softmax_label") == 0)
+      continue;
+    NDArrayHandle w = NULL, g = NULL;
+    CHECK(MXExecutorArgArray(exec, arg_names[i], &w));
+    CHECK(MXExecutorGradArray(exec, arg_names[i], &g));
+    uint32_t ndim = 0, shape[8];
+    CHECK(MXNDArrayGetShape(w, &ndim, shape, 8));
+    uint64_t size = 1;
+    for (uint32_t d = 0; d < ndim; ++d) size *= shape[d];
+    float *buf = (float *)malloc(size * sizeof(float));
+    for (uint64_t j = 0; j < size; ++j) buf[j] = 0.2f * frand();
+    CHECK(MXNDArraySyncCopyFromCPU(w, buf, size));
+    free(buf);
+    weights[n_params] = w;
+    grads[n_params] = g;
+    keys_arr[n_params] = (int)n_params;
+    ++n_params;
+  }
+  CHECK(MXKVStoreInit(kv, n_params, keys_arr, weights));
+
+  /* ---- synthetic, learnable data: class = sign pattern ---------- */
+  float *x = (float *)malloc(BATCH * 256 * sizeof(float));
+  float *y = (float *)malloc(BATCH * sizeof(float));
+  for (int i = 0; i < BATCH; ++i) {
+    int cls = i % CLASSES;
+    y[i] = (float)cls;
+    for (int p = 0; p < 256; ++p)
+      x[i * 256 + p] = 0.1f * frand() + 0.2f * (float)((p + cls) % CLASSES == 0);
+  }
+
+  NDArrayHandle data_arr = NULL, label_arr = NULL;
+  CHECK(MXExecutorArgArray(exec, "data", &data_arr));
+  CHECK(MXExecutorArgArray(exec, "softmax_label", &label_arr));
+  CHECK(MXNDArraySyncCopyFromCPU(data_arr, x, BATCH * 256));
+  CHECK(MXNDArraySyncCopyFromCPU(label_arr, y, BATCH));
+
+  /* ---- training loop ------------------------------------------- */
+  float first_loss = 0.0f, last_loss = 0.0f;
+  float probs[BATCH * CLASSES];
+  for (int step = 0; step < STEPS; ++step) {
+    CHECK(MXExecutorForward(exec, 1));
+    CHECK(MXExecutorBackward(exec));
+    /* per-key push grad / pull updated weight back into the executor
+     * (the reference Module update_on_kvstore loop) */
+    for (uint32_t k = 0; k < n_params; ++k) {
+      CHECK(MXKVStorePush(kv, 1, &keys_arr[k], &grads[k], -(int)k));
+      CHECK(MXKVStorePull(kv, 1, &keys_arr[k], &weights[k], -(int)k));
+    }
+    NDArrayHandle out = NULL;
+    CHECK(MXExecutorOutput(exec, 0, &out));
+    CHECK(MXNDArraySyncCopyToCPU(out, probs, BATCH * CLASSES));
+    CHECK(MXNDArrayFree(out));
+    float loss = 0.0f;
+    for (int i = 0; i < BATCH; ++i) {
+      float p = probs[i * CLASSES + (int)y[i]];
+      loss += -logf(p > 1e-10f ? p : 1e-10f);
+    }
+    loss /= BATCH;
+    if (getenv("LENET_DEBUG")) printf("step %d loss %.5f\n", step, loss);
+    if (step == 0) first_loss = loss;
+    last_loss = loss;
+  }
+  printf("first_loss %.5f last_loss %.5f\n", first_loss, last_loss);
+
+  for (uint32_t k = 0; k < n_params; ++k) {
+    MXNDArrayFree(weights[k]);
+    MXNDArrayFree(grads[k]);
+  }
+  MXNDArrayFree(data_arr);
+  MXNDArrayFree(label_arr);
+  MXKVStoreFree(kv);
+  MXExecutorFree(exec);
+  MXSymbolFree(net);
+  MXSymbolFree(reloaded);
+  MXSymbolFree(data);
+  MXSymbolFree(label);
+  MXSymbolFree(conv);
+  MXSymbolFree(act);
+  MXSymbolFree(pool);
+  MXSymbolFree(flat);
+  MXSymbolFree(fc);
+  free(x);
+  free(y);
+
+  if (!(last_loss < first_loss * 0.8f)) {
+    fprintf(stderr, "loss did not decrease enough\n");
+    return 2;
+  }
+  printf("TRAIN OK\n");
+  return 0;
+}
