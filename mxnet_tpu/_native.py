@@ -88,6 +88,7 @@ def _bind(lib):
     lib.mxs_direct_free.argtypes = [ctypes.c_void_p]
     lib.mxs_pool_bytes.restype = ctypes.c_uint64
     lib.mxs_release_all.argtypes = []
+
     return lib
 
 
@@ -410,3 +411,69 @@ class NativeArena:
 
     def release_all(self):
         self._lib.mxs_release_all()
+
+
+# --------------------------------------------------------------------------
+# JPEG decode (parity: the reference's OpenCV/libjpeg decode inside OpenMP
+# workers, iter_image_recordio.cc:259-368 — runs without the GIL so the
+# decode thread pool actually scales)
+# --------------------------------------------------------------------------
+_JPEG_LIB = None
+_JPEG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "lib", "libmxtpu_jpeg.so")
+
+
+def _get_jpeg_lib():
+    """libmxtpu_jpeg.so is built separately from the core lib so a host
+    without libjpeg-dev keeps full engine/recordio/storage support."""
+    global _JPEG_LIB
+    if _JPEG_LIB is not None:
+        return _JPEG_LIB if _JPEG_LIB is not False else None
+    with _LIB_LOCK:
+        if _JPEG_LIB is not None:
+            return _JPEG_LIB if _JPEG_LIB is not False else None
+        if not os.path.isfile(_JPEG_PATH):
+            _build()  # `make all` builds it when libjpeg is present
+        try:
+            lib = ctypes.CDLL(_JPEG_PATH)
+            lib.mxj_dims.restype = ctypes.c_int
+            lib.mxj_dims.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32)]
+            lib.mxj_decode.restype = ctypes.c_int
+            lib.mxj_decode.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64]
+            _JPEG_LIB = lib
+        except (OSError, AttributeError):
+            _JPEG_LIB = False
+            return None
+        return _JPEG_LIB
+
+
+def decode_jpeg(buf) -> "np.ndarray | None":
+    """Decode a JPEG byte string to an RGB uint8 HWC array via libjpeg.
+
+    Returns None when native support is unavailable or the stream is not
+    decodable (callers fall back to PIL)."""
+    lib = _get_jpeg_lib()
+    if lib is None:
+        return None
+    raw = bytes(buf)
+    # borrow the bytes buffer directly (no copy); `raw` stays referenced
+    # for the duration of both calls
+    src = ctypes.cast(ctypes.c_char_p(raw), ctypes.POINTER(ctypes.c_uint8))
+    w = ctypes.c_uint32()
+    h = ctypes.c_uint32()
+    c = ctypes.c_uint32()
+    if lib.mxj_dims(src, len(raw), ctypes.byref(w), ctypes.byref(h),
+                    ctypes.byref(c)) != 0:
+        return None
+    out = np.empty((h.value, w.value, 3), np.uint8)
+    if lib.mxj_decode(src, len(raw),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                      out.nbytes) != 0:
+        return None
+    return out

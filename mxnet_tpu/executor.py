@@ -327,6 +327,8 @@ class Executor:
         self._pending = None  # (args_raw, aux_raw, key) of last train forward
         self._outputs_cache: Optional[List] = None
         self._monitor_callback = None
+        self._monitor_fn = None   # lazily-compiled internals tap
+        self._monitor_names = None
 
     # ------------------------------------------------------------------ build
     def _make_fwdbwd(self):
@@ -490,16 +492,24 @@ class Executor:
         self._monitor_callback = callback
 
     def _run_monitor(self, args, aux, key):
-        internals = self._symbol.get_internals()
-        if self._placed:
-            # internals share the same node objects, so the stored plan
-            # (keyed by id(node) / var name) places them identically —
-            # a flat _build_graph_fn would feed ops mixed-device operands
-            fn = _build_placed_fn(internals, *self._plan, self._ctx)
-        else:
-            fn = _build_graph_fn(internals)
-        outs, _ = fn(args, aux, key, False)
-        for name, val in zip(internals.list_outputs(), outs):
+        # compiled ONCE and cached: the reference's monitor is a near-free
+        # callback on already-computed outputs (executor.cc monitor), so
+        # re-tracing the whole graph in eager python per monitored batch
+        # (O(graph) interpreter overhead) is not acceptable here either
+        if self._monitor_fn is None:
+            internals = self._symbol.get_internals()
+            if self._placed:
+                # internals share the same node objects, so the stored plan
+                # (keyed by id(node) / var name) places them identically —
+                # a flat _build_graph_fn would feed ops mixed-device operands
+                self._monitor_fn = _build_placed_fn(internals, *self._plan,
+                                                    self._ctx)
+            else:
+                self._monitor_fn = jax.jit(_build_graph_fn(internals),
+                                           static_argnums=(3,))
+            self._monitor_names = internals.list_outputs()
+        outs, _ = self._monitor_fn(args, aux, key, False)
+        for name, val in zip(self._monitor_names, outs):
             self._monitor_callback(name, NDArray(val))
 
     # ------------------------------------------------------------------- misc

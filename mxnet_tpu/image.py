@@ -52,10 +52,21 @@ def imencode(img, quality=95, img_fmt=".jpg"):
 
 
 def imdecode_np(buf: bytes) -> np.ndarray:
-    """Decode bytes -> HWC uint8 RGB numpy (parity: cv::imdecode)."""
+    """Decode bytes -> HWC uint8 RGB numpy (parity: cv::imdecode).
+
+    JPEG streams go through the native libjpeg decoder (src/
+    jpeg_decode.cc) — it runs without the GIL, so ImageRecordIter's
+    decode threads scale like the reference's OpenMP workers.  Everything
+    else (PNG, raw) falls back to PIL."""
     if buf[:8] == _RAW_MAGIC:
         h, w, c = np.frombuffer(buf[8:20], np.int32)
         return np.frombuffer(buf[20:], np.uint8).reshape(h, w, c).copy()
+    if buf[:2] == b"\xff\xd8":  # JPEG SOI
+        from . import _native
+
+        out = _native.decode_jpeg(buf)
+        if out is not None:
+            return out
     from PIL import Image
 
     img = Image.open(_io.BytesIO(buf))

@@ -233,6 +233,64 @@ class _PSClient:
         self.num_servers = len(servers)
         self.bigarray_bound = int(os.environ.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND", str(1000 * 1000)))
+        self._servers = list(servers)
+        # heartbeat over DEDICATED connections: the request sockets can be
+        # parked server-side for a whole sync round (legitimately), which
+        # would starve liveness signals exactly when worker skew is worst
+        # (parity: ps-lite's separate heartbeat channel to the scheduler)
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self):
+        import socket
+        import time
+
+        interval = float(os.environ.get("MXTPU_PS_HEARTBEAT_S", "1.0"))
+        socks = [None] * self.num_servers
+        while not self._hb_stop.wait(interval):
+            for i, addr in enumerate(self._servers):
+                try:
+                    if socks[i] is None:
+                        host, port = addr.rsplit(":", 1)
+                        socks[i] = socket.create_connection(
+                            (host, int(port)), timeout=5)
+                    self._ps.send_msg(socks[i], {"cmd": "heartbeat",
+                                                 "rank": self.rank})
+                    self._ps.recv_msg(socks[i])
+                except OSError:
+                    try:
+                        if socks[i] is not None:
+                            socks[i].close()
+                    finally:
+                        socks[i] = None
+        for s in socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def dead_nodes(self, timeout):
+        """Union of stale worker ranks across all servers (fresh
+        connections — the request sockets may be parked)."""
+        import socket
+
+        dead = set()
+        for addr in self._servers:
+            try:
+                host, port = addr.rsplit(":", 1)
+                with socket.create_connection((host, int(port)),
+                                              timeout=10) as s:
+                    self._ps.send_msg(s, {"cmd": "dead_nodes",
+                                          "timeout": timeout})
+                    reply = self._ps.recv_msg(s)
+                    if reply is not None:  # None = clean EOF mid-shutdown
+                        dead.update(reply.get("dead", []))
+            except OSError:
+                continue
+        return sorted(dead)
 
     def rpc(self, server, msg):
         with self._locks[server]:
@@ -319,6 +377,7 @@ class _PSClient:
         return errors
 
     def close(self):
+        self._hb_stop.set()
         self._pool.shutdown(wait=False)
         for s in self._socks:
             try:
@@ -350,12 +409,17 @@ class KVStoreDist(KVStore):
                                         os.environ.get("DMLC_RANK", "0")))
         self._size = int(os.environ.get("MXTPU_NUM_WORKERS",
                                         os.environ.get("DMLC_NUM_WORKER", "1")))
+        # restart-after-crash flag (parity: kvstore_dist.h:35-39 — a
+        # recovered worker must NOT re-init or re-barrier: the servers
+        # already hold the model and the surviving workers are mid-epoch)
+        self._recovery = os.environ.get(
+            "MXTPU_KV_RECOVERY", os.environ.get("DMLC_RECOVERY", "")) == "1"
         self._shapes = {}
         self._client = None
         servers = os.environ.get("MXTPU_PS_SERVERS", "")
         if servers:
             self._client = _PSClient(servers.split(","), rank=self._rank)
-            if "async" not in kv_type:
+            if "async" not in kv_type and not self._recovery:
                 if self._rank == 0:
                     from .kvstore_server import K_SYNC_MODE
 
@@ -381,9 +445,12 @@ class KVStoreDist(KVStore):
         values = value if isinstance(value, (list, tuple)) else [value]
         for k, v in zip(keys, values):
             self._shapes[k] = (v.shape, np.dtype(v.dtype))
-            if self._rank == 0:
+            if self._rank == 0 and not self._recovery:
                 self._client.init(k, v.asnumpy())
-        self._client.barrier()
+        if not self._recovery:
+            # a recovered worker skips the init barrier: the other workers
+            # passed it long ago and will never arrive again
+            self._client.barrier()
 
     def push(self, key, value, priority=0):
         if self._client is None:
@@ -418,6 +485,8 @@ class KVStoreDist(KVStore):
     def set_optimizer(self, optimizer):
         if self._client is None:
             return super().set_optimizer(optimizer)
+        if self._recovery:
+            return  # servers already hold the optimizer from the first life
         # parity: worker 0 ships the optimizer to servers (kvstore.py
         # set_optimizer -> send_command_to_servers)
         if self._rank == 0:
@@ -445,12 +514,24 @@ class KVStoreDist(KVStore):
         except Exception:
             pass
 
+    def get_num_dead_node(self, node_id, timeout=60):
+        """Parity: KVStore::get_num_dead_node (kvstore_dist.h:151-160) —
+        count of worker ranks whose heartbeats went stale.  node_id is
+        accepted for signature parity; the TCP PS has a single worker
+        group."""
+        if self._client is None:
+            return 0
+        return len(self._client.dead_nodes(timeout))
+
     def _send_stop(self):
         if self._client is not None:
             client, self._client = self._client, None
             from .kvstore_server import K_STOP_SERVER
 
-            for server, exc in client.control_sequential(K_STOP_SERVER):
+            # body = our rank: a cleanly-stopped worker must not be
+            # mistaken for a dead one by the server's stop accounting
+            for server, exc in client.control_sequential(K_STOP_SERVER,
+                                                         client.rank):
                 logging.warning("kvstore: failed to stop server %d: %r",
                                 server, exc)
             client.close()

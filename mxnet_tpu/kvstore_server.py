@@ -38,6 +38,7 @@ import socketserver
 import struct
 import sys
 import threading
+import time
 
 import numpy as np
 
@@ -149,6 +150,33 @@ class _ServerState:
         self.stopped = False
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
+        # failure detection (parity: ps-lite heartbeats surfaced through
+        # KVStore::get_num_dead_node, kvstore_dist.h:151-160): workers
+        # beat via a dedicated connection; any ranked message refreshes.
+        self.heartbeats = {}       # rank -> time.monotonic() of last sign of life
+        self.stopped_ranks = set()  # ranks that sent a clean kStopServer
+        self.start_time = time.monotonic()
+
+    def dead_nodes(self, timeout):
+        """Worker ranks with no sign of life within ``timeout`` seconds.
+        Never-connected ranks count from server start; ranks that sent a
+        clean kStopServer are not dead — they are done (counting them
+        would double them against stop_count and shut the server down
+        while half the cluster still trains)."""
+        now = time.monotonic()
+        return [r for r in range(self.num_workers)
+                if r not in self.stopped_ranks
+                and now - self.heartbeats.get(r, self.start_time) > timeout]
+
+    def should_stop(self, dead_timeout):
+        """Every *live* worker has requested a stop (a crashed worker can
+        never send kStopServer; without this the server leaks forever —
+        round-1 advisor finding on _send_stop)."""
+        if self.stop_count >= self.num_workers:
+            return True
+        return (self.stop_count > 0 and
+                self.stop_count >= self.num_workers
+                - len(self.dead_nodes(dead_timeout)))
 
     def default_update(self, key, recv, stored):
         # parity: kvstore_dist_server.h:229-236 — without an optimizer the
@@ -167,7 +195,17 @@ class _Handler(socketserver.BaseRequestHandler):
             if msg is None:
                 return
             cmd = msg["cmd"]
-            if cmd == "init":
+            rank = msg.get("rank", -1)
+            if isinstance(rank, int) and rank >= 0:
+                with st.cond:
+                    st.heartbeats[rank] = time.monotonic()
+            if cmd == "heartbeat":
+                send_msg(sock, {"ok": True})
+            elif cmd == "dead_nodes":
+                with st.cond:
+                    dead = st.dead_nodes(float(msg.get("timeout", 60)))
+                send_msg(sock, {"dead": dead})
+            elif cmd == "init":
                 with st.cond:
                     st.store[msg["key"]] = np.array(msg["value"], copy=True)
                 send_msg(sock, {"ok": True})
@@ -273,9 +311,12 @@ class _Handler(socketserver.BaseRequestHandler):
                 st.updater = np_updater
             elif head == K_STOP_SERVER:
                 st.stop_count += 1
+                rank = body if isinstance(body, int) else -1
+                if rank >= 0:
+                    st.stopped_ranks.add(rank)
                 if st.stop_count >= st.num_workers:
                     st.stopped = True
-                    st.cond.notify_all()
+                st.cond.notify_all()
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -308,14 +349,22 @@ class KVStoreServer:
         self.state.stop_count = 0
 
     def run(self):
-        """Serve until every worker has sent kStopServer."""
+        """Serve until every live worker has sent kStopServer.
+
+        Crashed workers are detected via heartbeat staleness
+        (MXTPU_PS_DEAD_TIMEOUT_S, default 60s) so the server still exits
+        when the remaining workers stop."""
+        dead_timeout = float(os.environ.get("MXTPU_PS_DEAD_TIMEOUT_S", "60"))
         srv = _TCPServer((self.host, self.port), _Handler)
         srv.state = self.state
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()
         with self.state.cond:
             while not self.state.stopped:
-                self.state.cond.wait()
+                if self.state.should_stop(dead_timeout):
+                    self.state.stopped = True
+                    break
+                self.state.cond.wait(timeout=2.0)
         srv.shutdown()
         srv.server_close()
 

@@ -1,0 +1,98 @@
+/*
+ * Native JPEG decode for the data pipeline (parity: the reference decodes
+ * with OpenCV/libjpeg inside OpenMP workers, src/io/image_aug_default.cc
+ * + iter_image_recordio.cc:259-368 — decode never touches the Python
+ * interpreter, so a thread pool scales past the GIL).
+ *
+ * Exported (mxtpu.h):
+ *   mxj_dims(src, len, &w, &h, &c)          — header-only parse
+ *   mxj_decode(src, len, dst, cap)          — full RGB8 decode into dst
+ *
+ * Returns 0 on success, -1 on any libjpeg error (corrupt stream etc.);
+ * errors longjmp out of libjpeg and never abort the process.
+ */
+#include "mxtpu.h"
+
+#include <csetjmp>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr *err = reinterpret_cast<ErrorMgr *>(cinfo->err);
+  std::longjmp(err->jump, 1);
+}
+
+void emit_message(j_common_ptr, int) {}  // silence warnings
+
+}  // namespace
+
+extern "C" {
+
+int mxj_dims(const uint8_t *src, uint64_t len, uint32_t *w, uint32_t *h,
+             uint32_t *c) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  jerr.pub.emit_message = emit_message;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, src, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *w = cinfo.image_width;
+  *h = cinfo.image_height;
+  *c = 3;  // decode path always converts to RGB
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+int mxj_decode(const uint8_t *src, uint64_t len, uint8_t *dst,
+               uint64_t cap) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  jerr.pub.emit_message = emit_message;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, src, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const uint64_t stride =
+      static_cast<uint64_t>(cinfo.output_width) * cinfo.output_components;
+  if (static_cast<uint64_t>(cinfo.output_height) * stride > cap) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = dst + static_cast<uint64_t>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // extern "C"

@@ -84,6 +84,15 @@ void *mxr_writer_open(const char *path);
 int mxr_write(void *writer, const uint8_t *buf, uint64_t len);
 void mxr_writer_close(void *writer);
 
+/* ------------------------------------------------------------- jpeg decode */
+/* Header-only parse: fills w/h/c (c always 3: decode converts to RGB). */
+int mxj_dims(const uint8_t *src, uint64_t len, uint32_t *w, uint32_t *h,
+             uint32_t *c);
+/* Full RGB8 decode into dst (capacity cap bytes, needs w*h*3).  Both
+ * return 0 on success, -1 on malformed input.  Thread-safe, GIL-free. */
+int mxj_decode(const uint8_t *src, uint64_t len, uint8_t *dst,
+               uint64_t cap);
+
 /* ----------------------------------------------------------------- storage */
 /* Pooled aligned host allocator.  Freed blocks are recycled by
  * round-up-to-pow2 size class. */

@@ -86,12 +86,13 @@ ASYNC_WORKER = textwrap.dedent("""
 """)
 
 
-def _launch(script, n=2, s=2, timeout=240):
+def _launch(script, n=2, s=2, timeout=240, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["MXTPU_PLATFORM"] = "cpu"  # keep subprocesses off the accelerator
     env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "1000"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     path = os.path.join(os.environ.get("TMPDIR", "/tmp"),
                         f"dist_worker_{os.getpid()}.py")
     with open(path, "w") as f:
@@ -106,9 +107,62 @@ def _launch(script, n=2, s=2, timeout=240):
         os.unlink(path)
 
 
+CRASH_WORKER = textwrap.dedent("""
+    import os
+    import time
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create('dist_async')
+    shape = (3, 3)
+    kv.init('a', mx.nd.zeros(shape))
+    kv.barrier()
+    kv.push('a', mx.nd.ones(shape))
+    if kv.rank == 1:
+        # simulate a crash: no kStopServer, no atexit, sockets just die
+        os._exit(0)
+    # rank 0: the cluster must keep working without rank 1
+    for _ in range(3):
+        kv.push('a', mx.nd.ones(shape))
+        out = mx.nd.zeros(shape)
+        kv.pull('a', out=out)
+    # heartbeat staleness must surface the dead worker
+    # (MXTPU_PS_DEAD_TIMEOUT_S=3 in the launcher env)
+    deadline = time.monotonic() + 30
+    n_dead = 0
+    while time.monotonic() < deadline:
+        n_dead = kv.get_num_dead_node(0, timeout=3)
+        if n_dead == 1:
+            break
+        time.sleep(0.5)
+    assert n_dead == 1, n_dead
+
+    # recovery: a restarted worker joins with MXTPU_KV_RECOVERY=1 — init
+    # must neither overwrite server state nor wait on the init barrier
+    # (parity: kvstore_dist.h:35-39)
+    os.environ['MXTPU_KV_RECOVERY'] = '1'
+    kv2 = mx.kv.create('dist_async')
+    kv2.init('a', mx.nd.zeros(shape))   # would hang/zero the model if not
+    out = mx.nd.zeros(shape)
+    kv2.pull('a', out=out)
+    assert abs(out.asnumpy().sum()) > 0, "recovered init wiped the model"
+    print('worker', kv.rank, 'OK')
+""")
+
+
 def test_dist_sync_kvstore():
     _launch(SYNC_WORKER, n=2, s=2)
 
 
 def test_dist_async_kvstore():
     _launch(ASYNC_WORKER, n=2, s=1)
+
+
+def test_dist_async_survives_worker_crash():
+    """A crashed worker must not wedge the cluster: training continues,
+    get_num_dead_node reports it, and servers stop on the survivors'
+    request (parity: ps-lite heartbeat dead-node tracking,
+    kvstore_dist.h:151-160)."""
+    _launch(CRASH_WORKER, n=2, s=1,
+            extra_env={"MXTPU_PS_DEAD_TIMEOUT_S": "3",
+                       "MXTPU_PS_HEARTBEAT_S": "0.3"})

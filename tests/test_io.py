@@ -134,3 +134,30 @@ def test_prefetch_over_record_iter(tmp_path):
     base = ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16), batch_size=4)
     pre = mx.io.PrefetchingIter(base)
     assert len(list(pre)) == 4
+
+
+def test_native_jpeg_decode_matches_pil():
+    """The GIL-free libjpeg decoder (src/jpeg_decode.cc) must agree with
+    PIL on the same stream (±2/255 for IDCT implementation differences)."""
+    import io as _io
+
+    from PIL import Image
+
+    from mxnet_tpu import _native
+    from mxnet_tpu.image import imencode
+
+    if not _native.available():
+        pytest.skip("native lib unavailable")
+    rs = np.random.RandomState(0)
+    img = (rs.rand(37, 53, 3) * 255).astype(np.uint8)
+    payload = bytes(imencode(img, quality=95))
+    if payload[:2] != b"\xff\xd8":
+        pytest.skip("PIL unavailable for encoding")
+    native = _native.decode_jpeg(payload)
+    assert native is not None
+    ref = np.asarray(Image.open(_io.BytesIO(payload)).convert("RGB"))
+    assert native.shape == ref.shape
+    assert np.max(np.abs(native.astype(int) - ref.astype(int))) <= 2
+
+    # malformed stream: graceful None, not a crash
+    assert _native.decode_jpeg(b"\xff\xd8garbage") is None
