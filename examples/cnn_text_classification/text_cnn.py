@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""CNN text classification (parity: example/cnn_text_classification/).
+
+Kim-2014 architecture as in the reference's text_cnn.py: embedding ->
+parallel conv branches with filter widths 3/4/5 over the token axis ->
+max-over-time pooling -> concat -> dropout -> FC -> softmax.  Synthetic
+sentiment task: sentences containing "positive" token clusters vs
+"negative" ones.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+VOCAB, SEQ, EMBED = 120, 24, 16
+
+
+def build(batch):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                          name="embed")
+    # (N, 1, SEQ, EMBED) image-like layout, as the reference reshapes
+    x = sym.Reshape(embed, shape=(batch, 1, SEQ, EMBED))
+    pooled = []
+    for width in (3, 4, 5):
+        c = sym.Convolution(x, kernel=(width, EMBED), num_filter=8,
+                            name=f"conv{width}")
+        c = sym.Activation(c, act_type="relu")
+        p = sym.Pooling(c, kernel=(SEQ - width + 1, 1), pool_type="max",
+                        name=f"pool{width}")
+        pooled.append(sym.Flatten(p))
+    h = sym.Concat(*pooled, dim=1)
+    h = sym.Dropout(h, p=0.3)
+    fc = sym.FullyConnected(h, num_hidden=2, name="fc")
+    return sym.SoftmaxOutput(fc, label, name="softmax")
+
+
+def synth(rs, n):
+    x = rs.randint(20, VOCAB, (n, SEQ)).astype(np.float32)
+    y = rs.randint(0, 2, n).astype(np.float32)
+    for i in range(n):
+        # sentiment tokens: ids 1-9 positive, 10-18 negative
+        toks = rs.randint(1, 10, 4) if y[i] > 0 else rs.randint(10, 19, 4)
+        pos = rs.choice(SEQ, 4, replace=False)
+        x[i, pos] = toks
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=4)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+    xtr, ytr = synth(rs, 512)
+    xte, yte = synth(rs, 128)
+
+    mod = mx.mod.Module(build(args.batch),
+                        context=mx.context.default_accelerator_context())
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch, shuffle=True)
+    val = mx.io.NDArrayIter(xte, yte, batch_size=args.batch)
+    mod.fit(train, eval_data=val, num_epoch=args.epochs,
+            optimizer="adam", optimizer_params={"learning_rate": 2e-3},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch, 8))
+    score = mod.score(val, mx.metric.create("acc"))
+    acc = dict(score)["accuracy"]
+    print(f"val acc {acc:.3f}")
+    assert acc > 0.8, acc
+    print("TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

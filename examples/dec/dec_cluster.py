@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Deep Embedded Clustering (parity: example/dec/dec.py, Xie et al. 2016).
+
+Stage 1: pretrain an autoencoder on the data.  Stage 2: k-means in the
+embedding initializes cluster centroids; then the encoder is refined by
+matching the soft assignment q (Student-t kernel to centroids) to the
+sharpened target p = q^2 / freq, with KL(p||q) gradients flowing into
+both encoder and centroids.  The reference hand-codes dL/dz; here the
+loss is expressed symbolically and autodiff does the rest.  Synthetic
+Gaussian blobs stand in for MNIST; clustering accuracy must improve over
+the k-means initialization.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+DIM, EMBED, K = 20, 2, 3
+
+
+def encoder_sym():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="enc1")
+    net = sym.Activation(net, act_type="relu")
+    return sym.FullyConnected(net, num_hidden=EMBED, name="enc2")
+
+
+def autoencoder_sym():
+    z = encoder_sym()
+    net = sym.FullyConnected(z, num_hidden=32, name="dec1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=DIM, name="dec2")
+    return sym.LinearRegressionOutput(net, sym.Variable("rec_label"),
+                                      name="rec")
+
+
+def dec_sym(batch):
+    """KL(p||q) with q = Student-t soft assignment to centroid variables."""
+    z = encoder_sym()                            # (N, EMBED)
+    mu = sym.Variable("centroids")               # (K, EMBED)
+    p = sym.Variable("p_target")                 # (N, K)
+    zz = sym.Reshape(z, shape=(batch, 1, EMBED))
+    diff = sym.broadcast_sub(zz, sym.Reshape(mu, shape=(1, K, EMBED)))
+    dist2 = sym.sum(diff * diff, axis=2)         # (N, K)
+    qu = 1.0 / (1.0 + dist2)
+    q = sym.broadcast_div(qu, sym.sum(qu, axis=1, keepdims=True))
+    kl = sym.sum(p * (sym.log(p + 1e-8) - sym.log(q + 1e-8))) / batch
+    return sym.MakeLoss(kl, name="kl"), q
+
+
+def kmeans(z, k, rs, iters=20):
+    mu = z[rs.choice(len(z), k, replace=False)]
+    for _ in range(iters):
+        d = ((z[:, None] - mu[None]) ** 2).sum(-1)
+        a = d.argmin(1)
+        for j in range(k):
+            if (a == j).any():
+                mu[j] = z[a == j].mean(0)
+    return mu, a
+
+
+def cluster_acc(assign, y, k):
+    # best-match accuracy over label permutations (hungarian-lite: greedy)
+    acc = 0
+    for j in range(k):
+        if (assign == j).any():
+            acc += np.bincount(y[assign == j].astype(int),
+                               minlength=k).max()
+    return acc / len(y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=600)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+
+    # blobs in DIM-d space
+    centers = rs.randn(K, DIM) * 2.0
+    y = rs.randint(0, K, args.n)
+    x = (centers[y] + rs.randn(args.n, DIM) * 0.9).astype(np.float32)
+
+    ctx = mx.context.default_accelerator_context()
+    # ---- stage 1: autoencoder pretrain
+    mod = mx.mod.Module(autoencoder_sym(), data_names=("data",),
+                        label_names=("rec_label",), context=ctx)
+    it = mx.io.NDArrayIter({"data": x}, {"rec_label": x}, batch_size=60,
+                           shuffle=True)
+    mod.fit(it, num_epoch=30, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3},
+            initializer=mx.init.Xavier(), eval_metric="rmse")
+
+    # ---- embed + k-means init
+    args_p, _ = mod.get_params()
+    feat = mx.mod.Module(sym.Group([encoder_sym()]), data_names=("data",),
+                         label_names=(), context=ctx)
+    feat.bind([("data", (args.n, DIM))], None, for_training=False)
+    feat.set_params({k_: v for k_, v in args_p.items() if "enc" in k_}, {})
+    feat.forward(mx.io.DataBatch([mx.nd.array(x)], None), is_train=False)
+    z0 = feat.get_outputs()[0].asnumpy()
+    mu, assign0 = kmeans(z0.copy(), K, rs)
+    acc0 = cluster_acc(assign0, y, K)
+    print(f"k-means init acc {acc0:.3f}")
+
+    # ---- stage 2: DEC refinement
+    loss, _ = dec_sym(args.n)
+    ex = loss.simple_bind(ctx=ctx, grad_req="write", data=(args.n, DIM),
+                          centroids=(K, EMBED), p_target=(args.n, K))
+    for k_, v in args_p.items():
+        if "enc" in k_:
+            ex.arg_dict[k_][:] = v.asnumpy()
+    ex.arg_dict["centroids"][:] = mu
+    trainable = {k_: ex.arg_dict[k_] for k_ in ex.arg_dict
+                 if "enc" in k_ or k_ == "centroids"}
+    opt = mx.optimizer.create("adam", learning_rate=2e-3)
+    upd = mx.optimizer.get_updater(opt)
+
+    for it_ in range(40):
+        # soft assignment q from the current encoder/centroids (host side)
+        feat.set_params({k_: mx.nd.array(ex.arg_dict[k_].asnumpy())
+                         for k_ in ex.arg_dict if "enc" in k_}, {},
+                        allow_missing=True)
+        feat.forward(mx.io.DataBatch([mx.nd.array(x)], None), is_train=False)
+        z = feat.get_outputs()[0].asnumpy()
+        d2 = ((z[:, None] - ex.arg_dict["centroids"].asnumpy()[None]) ** 2).sum(-1)
+        qu = 1.0 / (1.0 + d2)
+        q = qu / qu.sum(1, keepdims=True)
+        f = q.sum(0)
+        p = (q ** 2 / f) / (q ** 2 / f).sum(1, keepdims=True)
+        ex.forward(is_train=True, data=x, p_target=p)
+        ex.backward()
+        for i, (k_, arr) in enumerate(sorted(trainable.items())):
+            upd(i, ex.grad_dict[k_], arr)
+
+    d2 = ((z[:, None] - ex.arg_dict["centroids"].asnumpy()[None]) ** 2).sum(-1)
+    acc1 = cluster_acc(d2.argmin(1), y, K)
+    print(f"DEC refined acc {acc1:.3f}")
+    assert acc1 >= acc0 - 0.02, (acc0, acc1)
+    print("DEC OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""FCN-xs semantic segmentation (parity: example/fcn-xs/).
+
+The reference fine-tunes VGG into FCN-32s/16s/8s: 1x1 "score" convs on
+intermediate feature maps, Deconvolution (bilinear-initialized) upsampling,
+Crop to input size, and skip fusion (fcn_xs.py + symbol_fcnxs.py).  This
+runs the same FCN-8s-shaped topology at toy scale on synthetic shape
+masks, trained with per-pixel multi_output SoftmaxOutput.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+IM, NCLS = 32, 3  # background, square, disk
+
+
+def build():
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")  # (N, H*W)
+    c1 = sym.Activation(sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                                        num_filter=16, name="conv1"),
+                        act_type="relu")
+    p1 = sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max")  # /2
+    c2 = sym.Activation(sym.Convolution(p1, kernel=(3, 3), pad=(1, 1),
+                                        num_filter=32, name="conv2"),
+                        act_type="relu")
+    p2 = sym.Pooling(c2, kernel=(2, 2), stride=(2, 2), pool_type="max")  # /4
+    c3 = sym.Activation(sym.Convolution(p2, kernel=(3, 3), pad=(1, 1),
+                                        num_filter=64, name="conv3"),
+                        act_type="relu")
+    p3 = sym.Pooling(c3, kernel=(2, 2), stride=(2, 2), pool_type="max")  # /8
+
+    # score heads (1x1 convs) at /8 and /4, like score_fr + score_pool4
+    score8 = sym.Convolution(p3, kernel=(1, 1), num_filter=NCLS,
+                             name="score8")
+    up4 = sym.Deconvolution(score8, kernel=(2, 2), stride=(2, 2),
+                            num_filter=NCLS, no_bias=True, name="up4")  # /4
+    score4 = sym.Convolution(p2, kernel=(1, 1), num_filter=NCLS,
+                             name="score4")
+    fuse = up4 + score4
+    up1 = sym.Deconvolution(fuse, kernel=(4, 4), stride=(4, 4),
+                            num_filter=NCLS, no_bias=True, name="up1")  # /1
+    flat = sym.Reshape(up1, shape=(0, NCLS, -1), name="score_flat")
+    return sym.SoftmaxOutput(flat, label, multi_output=True,
+                             normalization="valid", name="softmax")
+
+
+def synth(rs, n):
+    x = rs.rand(n, 3, IM, IM).astype(np.float32) * 0.2
+    y = np.zeros((n, IM, IM), np.float32)
+    yy, xx = np.mgrid[0:IM, 0:IM]
+    for i in range(n):
+        # a square of class 1
+        s = rs.randint(6, 12)
+        x0, y0 = rs.randint(0, IM - s, 2)
+        x[i, 0, y0:y0 + s, x0:x0 + s] += 0.8
+        y[i, y0:y0 + s, x0:x0 + s] = 1
+        # a disk of class 2
+        r = rs.randint(4, 7)
+        cx, cy = rs.randint(r, IM - r, 2)
+        mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= r * r
+        x[i, 1][mask] += 0.8
+        y[i][mask] = 2
+    return np.clip(x, 0, 1), y.reshape(n, -1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+
+    mod = mx.mod.Module(build(), context=mx.context.default_accelerator_context())
+    mod.bind([("data", (args.batch, 3, IM, IM))],
+             [("softmax_label", (args.batch, IM * IM))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5, "momentum": 0.9,
+                                         "rescale_grad": 1.0 / args.batch})
+    first = last = None
+    for step in range(args.steps):
+        x, y = synth(rs, args.batch)
+        batch = mx.io.DataBatch([mx.nd.array(x)], [mx.nd.array(y)])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        p = mod.get_outputs()[0].asnumpy()  # (N, NCLS, H*W)
+        picked = np.take_along_axis(p, y[:, None, :].astype(int), 1)[:, 0]
+        loss = -np.log(np.maximum(picked, 1e-8)).mean()
+        if step == 0:
+            first = loss
+        last = loss
+        if step % 10 == 0:
+            acc = (p.argmax(1) == y).mean()
+            print(f"step {step}: pixel loss {loss:.4f} acc {acc:.3f}")
+    print(f"first {first:.4f} last {last:.4f}")
+    assert last < first * 0.9
+    print("TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""NCE loss for large-vocabulary softmax (parity: example/nce-loss/).
+
+The reference trains word models where a full softmax is too wide:
+noise-contrastive estimation scores the true class plus k sampled noise
+classes with a shared embedding + bias, using LogisticRegressionOutput
+over the k+1 logits (nce.py NceOutput).  Same construction here: the
+loader samples negatives by unigram frequency; the graph embeds
+(label ∪ negatives), dots with the hidden state, and trains binary
+targets [1, 0...0].
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+VOCAB, EMBED, K = 500, 32, 8  # k = negatives per positive
+
+
+def build(batch):
+    data = sym.Variable("data")            # (N,) context word id
+    cand = sym.Variable("cand")            # (N, K+1) [target, negatives]
+    nce_label = sym.Variable("nce_label")  # (N, K+1) [1, 0, ...]
+    in_embed = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                             name="in_embed")         # (N, EMBED)
+    out_embed = sym.Embedding(cand, input_dim=VOCAB, output_dim=EMBED,
+                              name="out_embed")       # (N, K+1, EMBED)
+    out_bias = sym.Embedding(cand, input_dim=VOCAB, output_dim=1,
+                             name="out_bias")         # (N, K+1, 1)
+    h = sym.Reshape(in_embed, shape=(batch, 1, EMBED))
+    logits = sym.batch_dot(out_embed, h, transpose_b=True)  # (N, K+1, 1)
+    logits = sym.Reshape(logits + out_bias, shape=(batch, K + 1))
+    return sym.LogisticRegressionOutput(logits, nce_label, name="nce")
+
+
+def synth_corpus(rs, n):
+    """Skip-gram pairs from a Zipf corpus with strong co-occurrence."""
+    ctx = rs.zipf(1.5, n).clip(1, VOCAB - 1)
+    tgt = (ctx * 7 + 1) % VOCAB  # deterministic association to learn
+    return ctx.astype(np.float32), tgt.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=150)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+
+    net = build(args.batch)
+    ex = net.simple_bind(ctx=mx.context.default_accelerator_context(),
+                         grad_req="write", data=(args.batch,),
+                         cand=(args.batch, K + 1),
+                         nce_label=(args.batch, K + 1))
+    init = mx.init.Xavier()
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name.endswith(("weight",)):
+            init(name, arr)
+            params[name] = arr
+    opt = mx.optimizer.create("adam", learning_rate=0.01)
+    updater = mx.optimizer.get_updater(opt)
+    labels = np.zeros((args.batch, K + 1), np.float32)
+    labels[:, 0] = 1.0
+
+    first = last = None
+    for step in range(args.steps):
+        ctx, tgt = synth_corpus(rs, args.batch)
+        negs = rs.randint(1, VOCAB, (args.batch, K)).astype(np.float32)
+        cand = np.concatenate([tgt[:, None], negs], axis=1)
+        ex.forward(is_train=True, data=ctx, cand=cand, nce_label=labels)
+        ex.backward()
+        for i, (name, arr) in enumerate(sorted(params.items())):
+            updater(i, ex.grad_dict[name], arr)
+        p = ex.outputs[0].asnumpy()
+        loss = -(labels * np.log(np.maximum(p, 1e-8))
+                 + (1 - labels) * np.log(np.maximum(1 - p, 1e-8))).mean()
+        if step == 0:
+            first = loss
+        last = loss
+        if step % 50 == 0:
+            print(f"step {step}: nce loss {loss:.4f}")
+    print(f"first {first:.4f} last {last:.4f}")
+    assert last < first * 0.7
+    print("TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Neural style transfer (parity: example/neural-style/).
+
+The reference optimizes the INPUT image against a fixed conv net:
+content loss on deep features, style loss on Gram matrices of shallower
+features, gradients taken w.r.t. the image (inputs_need_grad / arg grad
+on 'data').  Same structure here with a small random-weight encoder
+(random conv features famously suffice for the loss geometry) and
+synthetic content/style images, so the demo is self-contained.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+IM = 48
+
+
+def encoder():
+    data = sym.Variable("data")
+    feats = []
+    net = data
+    for i, nf in enumerate((8, 16, 32)):
+        net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=nf,
+                              name=f"conv{i}")
+        net = sym.Activation(net, act_type="relu")
+        feats.append(net)
+        if i < 2:
+            net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                              pool_type="avg")
+    return feats  # two style layers + one content layer
+
+
+def style_content_loss(feats, style_grams, content_feat):
+    losses = []
+    for i, f in enumerate(feats[:2]):
+        flat = sym.Reshape(f, shape=(0, 0, -1))           # (N, C, HW)
+        gram = sym.batch_dot(flat, flat, transpose_b=True)  # (N, C, C)
+        target = sym.Variable(f"gram{i}")
+        losses.append(sym.mean(sym.square(gram - target)))
+    target_c = sym.Variable("content")
+    losses.append(0.1 * sym.mean(sym.square(feats[2] - target_c)))
+    total = losses[0] + losses[1] + losses[2]
+    return sym.MakeLoss(total, name="style_loss")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+
+    ctx = mx.context.default_accelerator_context()
+    feats = encoder()
+    loss = style_content_loss(feats, None, None)
+
+    # feature extraction pass: bind the bare encoder to compute targets
+    grp = sym.Group(feats)
+    fe = grp.simple_bind(ctx=ctx, grad_req="null", data=(1, 3, IM, IM))
+    init = mx.init.Xavier()
+    weights = {}
+    for name, arr in fe.arg_dict.items():
+        if name != "data":
+            init(name, arr)
+            weights[name] = arr.asnumpy()
+
+    yy, xx = np.mgrid[0:IM, 0:IM]
+    content_img = np.clip(
+        0.3 + 0.7 * ((xx + yy) % 16 < 8)[None, None].astype(np.float32)
+        + rs.rand(1, 3, IM, IM).astype(np.float32) * 0.1, 0, 1)
+    style_img = np.clip(
+        0.5 + 0.5 * np.sin(xx / 3.0)[None, None].astype(np.float32)
+        + rs.rand(1, 3, IM, IM).astype(np.float32) * 0.1, 0, 1)
+
+    def grams_and_content(img):
+        fe.forward(is_train=False, data=img)
+        outs = [o.asnumpy() for o in fe.outputs]
+        grams = []
+        for f in outs[:2]:
+            flat = f.reshape(f.shape[0], f.shape[1], -1)
+            grams.append(np.matmul(flat, flat.transpose(0, 2, 1)))
+        return grams, outs[2]
+
+    style_grams, _ = grams_and_content(style_img)
+    _, content_feat = grams_and_content(content_img)
+
+    ex = loss.simple_bind(ctx=ctx, grad_req={"data": "write"},
+                          data=(1, 3, IM, IM), gram0=style_grams[0].shape,
+                          gram1=style_grams[1].shape,
+                          content=content_feat.shape)
+    for name, w in weights.items():
+        ex.arg_dict[name][:] = w
+    ex.arg_dict["gram0"][:] = style_grams[0]
+    ex.arg_dict["gram1"][:] = style_grams[1]
+    ex.arg_dict["content"][:] = content_feat
+    img = content_img.copy()  # optimize starting from the content image
+
+    first = last = None
+    for step in range(args.steps):
+        ex.arg_dict["data"][:] = img
+        ex.forward(is_train=True)
+        ex.backward()
+        g = ex.grad_dict["data"].asnumpy()
+        img = np.clip(img - args.lr * g / (np.abs(g).mean() + 1e-8) * 0.01,
+                      0, 1)
+        val = float(ex.outputs[0].asnumpy())
+        if step == 0:
+            first = val
+        last = val
+        if step % 20 == 0:
+            print(f"step {step}: loss {val:.5f}")
+    print(f"first {first:.5f} last {last:.5f}")
+    assert last < first
+    print("STYLE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Faster R-CNN on synthetic detection data (parity: example/rcnn/).
+
+The reference's pipeline: conv backbone -> RPN (cls + bbox heads) ->
+Proposal op -> ROIPooling -> fast-rcnn heads, with anchor/proposal targets
+computed in the DATA LOADER (example/rcnn/rcnn/io/rpn.py AnchorLoader) —
+the graph itself stays static.  Same split here: targets are assigned
+host-side with numpy IoU; the compiled graph contains the backbone, both
+RPN losses, the Proposal op, ROIPooling and the head losses.
+
+Synthetic task: images contain 1-2 axis-aligned bright rectangles on
+noise; classes = rectangle aspect category.  Loss must fall.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.ops.vision import _generate_anchors  # noqa: E402
+
+IM, STRIDE, A0 = 64, 4, 9  # two 2x2 pools -> feature stride 4
+FEAT = IM // STRIDE
+POST = 16
+NUM_CLASSES = 3  # background, wide, tall
+
+
+def build_symbol(batch):
+    data = sym.Variable("data")
+    im_info = sym.Variable("im_info")
+    rpn_label = sym.Variable("rpn_label")          # (N, A0*FH*FW)
+    rpn_bbox_target = sym.Variable("rpn_bbox_target")  # (N, 4*A0, FH, FW)
+    rpn_bbox_weight = sym.Variable("rpn_bbox_weight")
+    roi_label = sym.Variable("roi_label")          # (N*POST,)
+
+    # backbone
+    net = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=16,
+                          name="conv1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                          name="conv2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                          name="conv3")
+    feat = sym.Activation(net, act_type="relu", name="feat")
+
+    # RPN heads
+    rpn = sym.Convolution(feat, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                          name="rpn_conv")
+    rpn = sym.Activation(rpn, act_type="relu")
+    rpn_cls = sym.Convolution(rpn, kernel=(1, 1), num_filter=2 * A0,
+                              name="rpn_cls_score")
+    rpn_bbox = sym.Convolution(rpn, kernel=(1, 1), num_filter=4 * A0,
+                               name="rpn_bbox_pred")
+
+    # rpn classification loss over (bg, fg) per anchor; label -1 ignored
+    rpn_cls_flat = sym.Reshape(rpn_cls, shape=(0, 2, -1), name="rpn_cls_flat")
+    rpn_cls_prob = sym.SoftmaxOutput(rpn_cls_flat, rpn_label, multi_output=True,
+                                     use_ignore=True, ignore_label=-1,
+                                     normalization="valid", name="rpn_cls_prob")
+    # rpn bbox smooth-l1, masked to fg anchors
+    rpn_bbox_loss = sym.smooth_l1(rpn_bbox_weight * (rpn_bbox - rpn_bbox_target),
+                                  scalar=3.0)
+    rpn_bbox_loss = sym.MakeLoss(sym.sum(rpn_bbox_loss) / batch,
+                                 name="rpn_bbox_loss")
+
+    # proposals (gradient-free path, like the reference)
+    rpn_cls_act = sym.SoftmaxActivation(rpn_cls_flat, mode="channel",
+                                        name="rpn_cls_act")
+    rpn_cls_act = sym.Reshape(rpn_cls_act, shape=(0, 2 * A0, FEAT, FEAT))
+    rois = sym.Proposal(sym.BlockGrad(rpn_cls_act), sym.BlockGrad(rpn_bbox),
+                        im_info, feature_stride=STRIDE,
+                        scales=(2, 4, 8), ratios=(0.5, 1, 2),
+                        rpn_pre_nms_top_n=64, rpn_post_nms_top_n=POST,
+                        threshold=0.7, rpn_min_size=4, name="rois")
+
+    # fast-rcnn head
+    pooled = sym.ROIPooling(feat, rois, pooled_size=(4, 4),
+                            spatial_scale=1.0 / STRIDE, name="roi_pool")
+    head = sym.FullyConnected(sym.Flatten(pooled), num_hidden=64, name="fc6")
+    head = sym.Activation(head, act_type="relu")
+    cls_score = sym.FullyConnected(head, num_hidden=NUM_CLASSES,
+                                   name="cls_score")
+    cls_prob = sym.SoftmaxOutput(cls_score, roi_label, use_ignore=True,
+                                 ignore_label=-1, normalization="valid",
+                                 name="cls_prob")
+    return sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, sym.BlockGrad(rois)])
+
+
+def synth_batch(rs, batch):
+    """Images with bright rectangles; returns data + gt boxes/classes."""
+    x = rs.rand(batch, 3, IM, IM).astype(np.float32) * 0.2
+    gt = []
+    for i in range(batch):
+        boxes = []
+        for _ in range(rs.randint(1, 3)):
+            wide = rs.randint(2)
+            w, h = (rs.randint(20, 32), rs.randint(8, 14)) if wide else \
+                   (rs.randint(8, 14), rs.randint(20, 32))
+            x1 = rs.randint(0, IM - w)
+            y1 = rs.randint(0, IM - h)
+            x[i, :, y1:y1 + h, x1:x1 + w] += 0.8
+            boxes.append([x1, y1, x1 + w - 1, y1 + h - 1, 1 + wide])
+        gt.append(np.array(boxes, np.float32))
+    return np.clip(x, 0, 1), gt
+
+
+def np_iou(a, b):
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    iw = np.maximum(ix2 - ix1 + 1, 0)
+    ih = np.maximum(iy2 - iy1 + 1, 0)
+    inter = iw * ih
+    ua = ((a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1))[:, None] + \
+         ((b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1))[None] - inter
+    return inter / np.maximum(ua, 1e-6)
+
+
+def anchor_targets(gt_list, anchors):
+    """RPN targets (parity: rcnn/io/rpn.py assign_anchor): fg iou>=0.5,
+    bg iou<0.3, rest ignored; bbox deltas for fg anchors."""
+    n = len(gt_list)
+    total = anchors.shape[0]
+    labels = np.full((n, total), -1, np.float32)
+    bbox_t = np.zeros((n, total, 4), np.float32)
+    bbox_w = np.zeros((n, total, 4), np.float32)
+    for i, gt in enumerate(gt_list):
+        iou = np_iou(anchors, gt[:, :4])
+        best = iou.max(axis=1)
+        arg = iou.argmax(axis=1)
+        labels[i, best < 0.3] = 0
+        fg = best >= 0.5
+        # guarantee at least one fg per gt (reference does the same)
+        for j in range(gt.shape[0]):
+            fg[iou[:, j].argmax()] = True
+        labels[i, fg] = 1
+        g = gt[arg[fg], :4]
+        a = anchors[fg]
+        aw = a[:, 2] - a[:, 0] + 1
+        ah = a[:, 3] - a[:, 1] + 1
+        acx = a[:, 0] + 0.5 * (aw - 1)
+        acy = a[:, 1] + 0.5 * (ah - 1)
+        gw = g[:, 2] - g[:, 0] + 1
+        gh = g[:, 3] - g[:, 1] + 1
+        gcx = g[:, 0] + 0.5 * (gw - 1)
+        gcy = g[:, 1] + 0.5 * (gh - 1)
+        bbox_t[i, fg] = np.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                                  np.log(gw / aw), np.log(gh / ah)], axis=1)
+        bbox_w[i, fg] = 1.0
+    return labels, bbox_t, bbox_w
+
+
+def roi_targets(rois, gt_list):
+    """Head classification targets for the proposals of the LAST forward
+    (parity: proposal_target.py): class of best-iou gt if iou>=0.5 else 0."""
+    labels = np.zeros((rois.shape[0],), np.float32)
+    for r in range(rois.shape[0]):
+        i = int(rois[r, 0])
+        gt = gt_list[i]
+        iou = np_iou(rois[r:r + 1, 1:5], gt[:, :4])[0]
+        j = iou.argmax()
+        labels[r] = gt[j, 4] if iou[j] >= 0.5 else 0.0
+    return labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+
+    base = _generate_anchors(STRIDE, (2, 4, 8), (0.5, 1, 2))
+    sx, sy = np.meshgrid(np.arange(FEAT) * STRIDE, np.arange(FEAT) * STRIDE)
+    shifts = np.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], 1)
+    anchors = (shifts[:, None].astype(np.float32) + base[None]).reshape(-1, 4)
+
+    net = build_symbol(args.batch)
+    ex = net.simple_bind(
+        ctx=mx.context.default_accelerator_context(), grad_req="write",
+        data=(args.batch, 3, IM, IM), im_info=(args.batch, 3),
+        rpn_label=(args.batch, A0 * FEAT * FEAT),
+        rpn_bbox_target=(args.batch, 4 * A0, FEAT, FEAT),
+        rpn_bbox_weight=(args.batch, 4 * A0, FEAT, FEAT),
+        roi_label=(args.batch * POST,))
+    init = mx.init.Xavier()
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name.endswith(("weight", "bias")) and "rpn_bbox_target" not in name:
+            init(name, arr)
+            params[name] = arr
+    opt = mx.optimizer.create("sgd", learning_rate=args.lr, momentum=0.9,
+                              rescale_grad=1.0 / args.batch)
+    updater = mx.optimizer.get_updater(opt)
+
+    im_info = np.array([[IM, IM, 1.0]] * args.batch, np.float32)
+    first = last = None
+    for step in range(args.steps):
+        x, gt = synth_batch(rs, args.batch)
+        labels, bt, bw = anchor_targets(gt, anchors)
+        # anchor layout in Proposal/loss: (H, W, A0) flattened; the rpn
+        # label reshape (N, 2, A0*FH*FW) maps channel-major — match it
+        lab = labels.reshape(args.batch, FEAT, FEAT, A0)
+        lab = lab.transpose(0, 3, 1, 2).reshape(args.batch, -1)
+        bt4 = bt.reshape(args.batch, FEAT, FEAT, A0, 4)
+        bt4 = bt4.transpose(0, 3, 4, 1, 2).reshape(args.batch, 4 * A0, FEAT, FEAT)
+        bw4 = bw.reshape(args.batch, FEAT, FEAT, A0, 4)
+        bw4 = bw4.transpose(0, 3, 4, 1, 2).reshape(args.batch, 4 * A0, FEAT, FEAT)
+        # proposal-target stage (parity: proposal_target.py): a cheap eval
+        # forward yields THIS batch's proposals, whose labels then feed
+        # the training forward — labels and rois describe the same images
+        ex.forward(is_train=False, data=x, im_info=im_info, rpn_label=lab,
+                   rpn_bbox_target=bt4, rpn_bbox_weight=bw4,
+                   roi_label=np.zeros((args.batch * POST,), np.float32))
+        rois = ex.outputs[3].asnumpy()
+        roi_labels = roi_targets(rois, gt)
+
+        ex.forward(is_train=True, data=x, im_info=im_info, rpn_label=lab,
+                   rpn_bbox_target=bt4, rpn_bbox_weight=bw4,
+                   roi_label=roi_labels)
+        ex.backward()
+        for i, (name, arr) in enumerate(sorted(params.items())):
+            updater(i, ex.grad_dict[name], arr)
+
+        probs = ex.outputs[0].asnumpy().reshape(args.batch, 2, -1)
+        mask = lab >= 0
+        fg = np.where(lab > 0, 1, 0)
+        picked = np.take_along_axis(probs, fg[:, None, :], axis=1)[:, 0]
+        loss = -np.log(np.maximum(picked[mask], 1e-8)).mean()
+        if step == 0:
+            first = loss
+        last = loss
+        if step % 5 == 0:
+            print(f"step {step}: rpn_cls_loss {loss:.4f}")
+    print(f"first {first:.4f} last {last:.4f}")
+    assert last < first, "rpn loss did not decrease"
+    print("TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

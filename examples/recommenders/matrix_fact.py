@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Matrix-factorization recommender (parity: example/recommenders/):
+user/item embeddings dotted into a rating prediction, LinearRegression
+loss — the reference's demo1-MF notebook as a script, on a synthetic
+low-rank rating matrix.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+USERS, ITEMS, RANK = 200, 150, 6
+
+
+def build():
+    user = sym.Variable("user")
+    item = sym.Variable("item")
+    score = sym.Variable("score_label")
+    u = sym.Embedding(user, input_dim=USERS, output_dim=RANK, name="user_embed")
+    v = sym.Embedding(item, input_dim=ITEMS, output_dim=RANK, name="item_embed")
+    pred = sym.sum(u * v, axis=1)
+    return sym.LinearRegressionOutput(pred, score, name="score")
+
+
+def synth(rs, n):
+    gu = rs.randn(USERS, RANK).astype(np.float32) * 0.7
+    gi = rs.randn(ITEMS, RANK).astype(np.float32) * 0.7
+    users = rs.randint(0, USERS, n)
+    items = rs.randint(0, ITEMS, n)
+    ratings = (gu[users] * gi[items]).sum(1) + rs.randn(n).astype(np.float32) * 0.1
+    return (users.astype(np.float32), items.astype(np.float32),
+            ratings.astype(np.float32))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=12)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+    users, items, ratings = synth(rs, 20000)
+
+    mod = mx.mod.Module(build(), data_names=("user", "item"),
+                        label_names=("score_label",),
+                        context=mx.context.default_accelerator_context())
+    it = mx.io.NDArrayIter({"user": users, "item": items},
+                           {"score_label": ratings},
+                           batch_size=args.batch, shuffle=True)
+    mod.fit(it, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.init.Normal(0.1),
+            eval_metric="rmse")
+    rmse = dict(mod.score(it, mx.metric.create("rmse")))["rmse"]
+    print(f"train rmse {rmse:.3f}")
+    assert rmse < 0.8, rmse
+    print("TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

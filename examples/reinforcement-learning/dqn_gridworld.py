@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""DQN (parity: example/reinforcement-learning/dqn/): Q-learning with an
+experience-replay buffer and a frozen target network, the reference's
+Atari recipe scaled to a self-contained grid world (agent walks a 5x5
+grid to the goal; reward 1 at goal, -0.02 per step).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+GRID, ACTIONS = 5, 4  # up/down/left/right
+
+
+class GridWorld:
+    def __init__(self, rs):
+        self.rs = rs
+        self.goal = (GRID - 1, GRID - 1)
+        self.reset()
+
+    def reset(self):
+        # random start (not the goal): denser reward signal early on
+        while True:
+            self.pos = (int(self.rs.randint(GRID)), int(self.rs.randint(GRID)))
+            if self.pos != self.goal:
+                break
+        return self.obs()
+
+    def obs(self):
+        o = np.zeros((2, GRID, GRID), np.float32)
+        o[0][self.pos] = 1.0
+        o[1][self.goal] = 1.0
+        return o
+
+    def step(self, a):
+        dr = [(-1, 0), (1, 0), (0, -1), (0, 1)][a]
+        r, c = self.pos
+        self.pos = (min(max(r + dr[0], 0), GRID - 1),
+                    min(max(c + dr[1], 0), GRID - 1))
+        done = self.pos == self.goal
+        return self.obs(), (1.0 if done else -0.02), done
+
+
+def q_net():
+    data = sym.Variable("data")
+    target = sym.Variable("target")     # (N, ACTIONS) regression target
+    net = sym.FullyConnected(sym.Flatten(data), num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    q = sym.FullyConnected(net, num_hidden=ACTIONS, name="qvals")
+    return sym.LinearRegressionOutput(q, target, name="q")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+    env = GridWorld(rs)
+    gamma, eps = 0.95, 1.0
+
+    ctx = mx.context.default_accelerator_context()
+    net = q_net()
+    ex = net.simple_bind(ctx=ctx, grad_req="write",
+                         data=(args.batch, 2, GRID, GRID),
+                         target=(args.batch, ACTIONS))
+    one = net.simple_bind(ctx=ctx, grad_req="null",
+                          data=(1, 2, GRID, GRID), target=(1, ACTIONS))
+    init = mx.init.Xavier()
+    # master (online) weights live OUTSIDE the executor: the executor's
+    # arg arrays get reloaded with target-net weights during Q(s')
+    # evaluation, so aliasing them as the online copy would wipe training
+    params = {}
+    for n, a in ex.arg_dict.items():
+        if n.endswith(("weight", "bias")):
+            init(n, a)
+            params[n] = mx.nd.array(a.asnumpy())
+    target_params = {n: a.asnumpy() for n, a in params.items()}
+    opt = mx.optimizer.create("adam", learning_rate=1e-3)
+    updater = mx.optimizer.get_updater(opt)
+
+    replay = []
+    steps_hist = []
+    zeros1 = np.zeros((1, ACTIONS), np.float32)
+    for ep in range(args.episodes):
+        s = env.reset()
+        total_steps = 0
+        # online weights change once per episode (after the updates below)
+        for n, arr in params.items():
+            one.arg_dict[n][:] = arr.asnumpy()
+        for _ in range(40):
+            if rs.rand() < eps:
+                a = rs.randint(ACTIONS)
+            else:
+                one.forward(is_train=False, data=s[None], target=zeros1)
+                a = int(one.outputs[0].asnumpy()[0].argmax())
+            s2, r, done = env.step(a)
+            replay.append((s, a, r, s2, done))
+            if len(replay) > 2000:
+                replay.pop(0)
+            s = s2
+            total_steps += 1
+            if done:
+                break
+        steps_hist.append(total_steps)
+        eps = max(0.05, eps * 0.985)
+
+        # several training batches per episode from replay
+        for _upd in range(4 if len(replay) >= args.batch else 0):
+            idx = rs.choice(len(replay), args.batch, replace=False)
+            bs = np.stack([replay[i][0] for i in idx])
+            bs2 = np.stack([replay[i][3] for i in idx])
+            # target net Q(s')
+            for n, arr in params.items():
+                ex.arg_dict[n][:] = target_params[n]
+            ex.forward(is_train=False, data=bs2,
+                       target=np.zeros((args.batch, ACTIONS), np.float32))
+            qn = ex.outputs[0].asnumpy()
+            # current Q(s) for target construction (online weights)
+            for n, arr in params.items():
+                ex.arg_dict[n][:] = arr.asnumpy()
+            ex.forward(is_train=False, data=bs,
+                       target=np.zeros((args.batch, ACTIONS), np.float32))
+            tgt = np.array(ex.outputs[0].asnumpy())
+            for j, i in enumerate(idx):
+                _, a, r, _, done = replay[i]
+                tgt[j, a] = r if done else r + gamma * qn[j].max()
+            ex.forward(is_train=True, data=bs, target=tgt)
+            ex.backward()
+            for i, (n, arr) in enumerate(sorted(params.items())):
+                updater(i, ex.grad_dict[n], arr)
+                ex.arg_dict[n][:] = arr.asnumpy()
+        if ep % 10 == 9:
+            target_params = {n: a.asnumpy() for n, a in params.items()}
+        if ep % 50 == 49:
+            print(f"ep {ep}: steps-to-goal (last 20 avg) "
+                  f"{np.mean(steps_hist[-20:]):.1f} eps {eps:.2f}")
+
+    early = np.mean(steps_hist[:20])
+    late = np.mean(steps_hist[-20:])
+    print(f"avg steps: first20 {early:.1f} last20 {late:.1f}")
+    assert late < early * 0.6, (early, late)
+    print("TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

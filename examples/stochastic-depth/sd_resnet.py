@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Stochastic depth (parity: example/stochastic-depth/): residual blocks
+are randomly DROPPED during training (the whole residual branch gated by
+a Bernoulli survival draw, scaled by survival probability at test time —
+Huang et al. 2016).  The reference implements the gate with a custom op;
+here the gate rides the Dropout primitive: dropping a (N,1,1,1) mask of
+ones gates the entire branch per sample and bakes in the 1/p rescale.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+
+def res_block(net, nf, death_rate, name):
+    branch = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=nf,
+                             name=f"{name}_conv1")
+    branch = sym.BatchNorm(branch, fix_gamma=False, name=f"{name}_bn1")
+    branch = sym.Activation(branch, act_type="relu")
+    branch = sym.Convolution(branch, kernel=(3, 3), pad=(1, 1), num_filter=nf,
+                             name=f"{name}_conv2")
+    if death_rate > 0:
+        # Bernoulli(1-death_rate) gate on the whole branch, per sample:
+        # Dropout of a ones-tensor broadcast over the branch.  Dropout's
+        # train-time 1/keep rescale realizes E[gate]=1, and at inference
+        # Dropout is identity — the survival-prob scaling of the paper.
+        gate = sym.Dropout(sym.sum(sym.slice_axis(branch, axis=1, begin=0,
+                                                  end=1) * 0.0,
+                                   axis=(1, 2, 3), keepdims=True) + 1.0,
+                           p=death_rate, name=f"{name}_gate")
+        branch = sym.broadcast_mul(branch, gate)
+    return net + branch
+
+
+def build(num_blocks=4, death_rate=0.3):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    net = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=16,
+                          name="conv0")
+    net = sym.Activation(net, act_type="relu")
+    for i in range(num_blocks):
+        # linearly increasing death rate over depth, as in the paper
+        rate = death_rate * (i + 1) / num_blocks
+        net = res_block(net, 16, rate, f"block{i}")
+    net = sym.Pooling(net, kernel=(8, 8), pool_type="avg", name="gap")
+    fc = sym.FullyConnected(sym.Flatten(net), num_hidden=4, name="fc")
+    return sym.SoftmaxOutput(fc, label, name="softmax")
+
+
+def synth(rs, n):
+    x = rs.rand(n, 3, 8, 8).astype(np.float32) * 0.3
+    y = rs.randint(0, 4, n).astype(np.float32)
+    for i in range(n):
+        q = int(y[i])
+        x[i, q % 3, (q // 2) * 4:(q // 2) * 4 + 4, (q % 2) * 4:(q % 2) * 4 + 4] += 0.7
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=6)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+    xtr, ytr = synth(rs, 1024)
+    xte, yte = synth(rs, 256)
+
+    mod = mx.mod.Module(build(), context=mx.context.default_accelerator_context())
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch, shuffle=True)
+    val = mx.io.NDArrayIter(xte, yte, batch_size=args.batch)
+    mod.fit(train, eval_data=val, num_epoch=args.epochs,
+            optimizer="adam", optimizer_params={"learning_rate": 2e-3},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    acc = dict(mod.score(val, mx.metric.create("acc")))["accuracy"]
+    print(f"val acc {acc:.3f}")
+    assert acc > 0.9, acc
+    print("TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

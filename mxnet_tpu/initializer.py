@@ -34,6 +34,8 @@ class Initializer:
             self._init_zero(name, arr)
         elif name.endswith("_moving_var"):
             self._init_one(name, arr)
+        elif name.endswith("_parameters"):
+            self._init_rnn_fused(name, arr)
         else:
             self._init_default(name, arr)
 
@@ -68,6 +70,14 @@ class Initializer:
 
     def _init_weight(self, name, arr):
         raise NotImplementedError
+
+    def _init_rnn_fused(self, name, arr):
+        # the RNN op's flat cuDNN-style parameter vector: per-matrix
+        # fan-in is unknowable from the 1-D shape, so use the cuDNN/
+        # PyTorch-style small uniform.  (The reference's initializer
+        # RAISES for this name; silently zeroing it kills gradient flow
+        # through stacked layers — regression caught by speech-demo.)
+        arr[:] = np.random.uniform(-0.07, 0.07, arr.shape).astype(np.float32)
 
     def _init_default(self, name, arr):
         arr[:] = 0.0

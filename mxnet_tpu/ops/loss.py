@@ -100,7 +100,10 @@ def _softmax_output(ctx, data, label, **attrs):
             valid = jnp.maximum(jnp.sum(mask.astype(grad.dtype)), 1.0)
             grad = grad / valid
         elif normalization == "valid":
-            grad = grad / out.shape[0]
+            # no ignore mask: every label is valid — divide by the TOTAL
+            # label count (softmax_output-inl.h kValid), not the batch;
+            # for multi_output that is N*d labels
+            grad = grad / float(label.size)
         return (scale * grad, jnp.zeros_like(label))
 
     fwd.defvjp(fwd_fwd, fwd_bwd)

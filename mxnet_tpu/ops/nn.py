@@ -112,6 +112,18 @@ def _deconvolution(ctx, data, weight, bias=None, **attrs):
     conv; adj/target_shape attrs for output sizing."""
     nd, kernel, stride, pad, dilate, num_filter, num_group, no_bias = _conv_attrs(attrs)
     adj = normalize_tuple(attrs.get("adj", (0,) * nd), nd, "adj")
+    if attrs.get("target_shape"):
+        # reference InferShape: adj = target - ((in-1)*s - 2p + d*(k-1)+1)
+        tgt = normalize_tuple(parse_attr(attrs["target_shape"]), nd,
+                              "target_shape")
+        adj = tuple(
+            int(t) - ((i - 1) * s - 2 * p + d * (k - 1) + 1)
+            for t, i, s, p, d, k in zip(tgt, data.shape[2:], stride, pad,
+                                        dilate, kernel))
+        if any(a < 0 or a >= s for a, s in zip(adj, stride)):
+            raise MXNetError(
+                f"Deconvolution: target_shape {tgt} unreachable from input "
+                f"{data.shape[2:]} with stride {stride}")
     dn = jax.lax.conv_dimension_numbers(
         data.shape, (data.shape[1], num_filter // num_group) + kernel, _conv_dim_numbers(nd)
     )
@@ -122,8 +134,12 @@ def _deconvolution(ctx, data, weight, bias=None, **attrs):
         if num_group == 1
         else _grouped_flip(weight, nd, num_group),
         window_strides=(1,) * nd,
+        # out = (in-1)*s - 2p + d*(k-1) + 1 + adj (deconvolution-inl.h
+        # InferShape); with lhs_dilation=s the dilated input is
+        # (in-1)*s + 1, so symmetric pads of d*(k-1)-p (+adj on the high
+        # side) land exactly there — no stride term in the padding
         padding=[
-            (d * (k - 1) - p, d * (k - 1) - p + a + s - 1)
+            (d * (k - 1) - p, d * (k - 1) - p + a)
             for k, p, s, d, a in zip(kernel, pad, stride, dilate, adj)
         ],
         lhs_dilation=stride,

@@ -508,6 +508,133 @@ def _multibox_detection(ctx, cls_prob, loc_pred, anchor, **attrs):
 
 
 # --------------------------------------------------------------------------
+# Proposal — the RPN -> RoI stage of Faster R-CNN
+# --------------------------------------------------------------------------
+def _generate_anchors(stride, scales, ratios):
+    """Base anchors around one stride cell (parity:
+    example/rcnn/rcnn/processing/generate_anchor.py)."""
+    base = np.array([0, 0, stride - 1, stride - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.array(anchors, np.float32)
+
+
+def _bbox_transform_inv(boxes, deltas):
+    """Apply (dx, dy, dw, dh) regression deltas to boxes (x1y1x2y2)."""
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1.0)
+    cy = boxes[:, 1] + 0.5 * (h - 1.0)
+    pcx = deltas[:, 0] * w + cx
+    pcy = deltas[:, 1] * h + cy
+    pw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * w
+    ph = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * h
+    return jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)],
+                     axis=1)
+
+
+@register(
+    "Proposal",
+    arg_names=("cls_prob", "bbox_pred", "im_info"),
+    aliases=("_contrib_Proposal",),
+)
+def _proposal(ctx, cls_prob, bbox_pred, im_info, **attrs):
+    """Parity: Proposal (example/rcnn operator / src/operator/contrib/
+    proposal-inl.h): slide base anchors over the feature grid, decode RPN
+    bbox deltas, clip to the image, drop tiny boxes, keep the
+    pre_nms_top_n highest-scoring, greedy-NMS, emit post_nms_top_n RoIs
+    as (batch_idx, x1, y1, x2, y2).
+
+    TPU-native shape discipline: every stage is fixed-size — filtering is
+    score masking, NMS is a fori_loop over the top-k rows of a dense IoU
+    matrix, and the output is always (N*post_nms_top_n, 5) with
+    suppressed slots filled by the highest-score survivor (RoIPooling of
+    a duplicate row is harmless, matching the reference's pad-with-top-1).
+    """
+    stride = int(parse_attr(attrs.get("feature_stride", 16)))
+    scales = _parse_floats(attrs.get("scales"), (8, 16, 32))
+    ratios = _parse_floats(attrs.get("ratios"), (0.5, 1, 2))
+    pre = int(parse_attr(attrs.get("rpn_pre_nms_top_n", 6000)))
+    post = int(parse_attr(attrs.get("rpn_post_nms_top_n", 300)))
+    nms_thresh = float(parse_attr(attrs.get("threshold", 0.7)))
+    min_size = float(parse_attr(attrs.get("rpn_min_size", 16)))
+
+    n, twice_a, fh, fw = cls_prob.shape
+    num_anchors = twice_a // 2
+    base = _generate_anchors(stride, scales, ratios)  # (A0, 4) static
+    sx, sy = np.meshgrid(np.arange(fw) * stride, np.arange(fh) * stride)
+    shifts = np.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()],
+                      axis=1).astype(np.float32)          # (HW, 4)
+    anchors = (shifts[:, None, :] + base[None, :, :]).reshape(-1, 4)
+    anchors = jnp.asarray(anchors)                         # (HW*A0, 4)
+    total = anchors.shape[0]
+    k = min(pre, total)
+
+    def one_sample(scores_map, deltas_map, info):
+        # scores: foreground half of cls_prob — (A0, H, W) -> (HW*A0,)
+        fg = scores_map[num_anchors:].reshape(num_anchors, fh, fw)
+        scores = fg.transpose(1, 2, 0).reshape(-1)
+        deltas = deltas_map.reshape(num_anchors, 4, fh, fw)
+        deltas = deltas.transpose(2, 3, 0, 1).reshape(-1, 4)
+        boxes = _bbox_transform_inv(anchors, deltas)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1.0),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1.0),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1.0),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1.0)], axis=1)
+        ms = min_size * info[2]
+        valid = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                 & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        scores = jnp.where(valid, scores, -1.0)
+
+        order = jnp.argsort(-scores)[:k]
+        boxes_s = boxes[order]
+        scores_s = scores[order]
+
+        def body(i, alive):
+            # one IoU row per step (O(k) memory) — a dense k x k matrix
+            # at the 6000-box default would cost ~144MB per sample
+            row = _iou_matrix(jax.lax.dynamic_slice(boxes_s, (i, 0),
+                                                    (1, 4)), boxes_s)[0]
+            sup = (row > nms_thresh) & (jnp.arange(k) > i)
+            si = jax.lax.dynamic_index_in_dim(scores_s, i, keepdims=False)
+            ai = jax.lax.dynamic_index_in_dim(alive, i, keepdims=False)
+            return jnp.where(ai & (si > 0) & sup, False, alive)
+
+        alive = jax.lax.fori_loop(0, k, body, jnp.ones((k,), bool))
+        keep_score = jnp.where(alive & (scores_s > 0), scores_s, -jnp.inf)
+        sel = jnp.argsort(-keep_score)[:post]
+        picked = boxes_s[sel]
+        ok = keep_score[sel] > -jnp.inf
+        # pad suppressed slots with the top survivor (index 0 of sel)
+        picked = jnp.where(ok[:, None], picked, picked[0][None, :])
+        if picked.shape[0] < post:
+            # fewer candidates than post_nms_top_n: keep the contract of a
+            # fixed (post, 4) output by repeating the top survivor
+            pad = jnp.broadcast_to(picked[0],
+                                   (post - picked.shape[0], 4))
+            picked = jnp.concatenate([picked, pad], axis=0)
+        return picked
+
+    rois = jax.vmap(one_sample)(cls_prob, bbox_pred, im_info)  # (N, post, 4)
+    batch_idx = jnp.repeat(jnp.arange(n, dtype=rois.dtype), post)
+    return jnp.concatenate([batch_idx[:, None], rois.reshape(-1, 4)],
+                           axis=1)
+
+
+# --------------------------------------------------------------------------
 # _CrossDeviceCopy — on TPU, GSPMD/jit inserts transfers; explicit op is
 # an identity marker (parity: src/operator/cross_device_copy.cc).
 # --------------------------------------------------------------------------

@@ -298,3 +298,38 @@ def test_vision_ops_in_symbol_graph():
                                        np.float32)
     out = exe.forward()[0].asnumpy()
     assert out.shape == (2, 2, 2, 2)
+
+
+def test_deconvolution_matches_conv_transpose():
+    """Deconvolution must be the exact adjoint of Convolution: its output
+    equals the input-gradient of the matching conv (the reference
+    implements it that way, deconvolution-inl.h), and its shape follows
+    (in-1)*s - 2p + k (regression: an extra stride-1 inflated outputs)."""
+    import jax
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 5, 5).astype(np.float32)
+    w = rs.rand(3, 4, 3, 3).astype(np.float32)  # (in_ch, out_ch/g, kh, kw)
+    stride, pad = (2, 2), (1, 1)
+
+    out = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                              stride=stride, pad=pad, num_filter=4,
+                              no_bias=True)
+    assert out.shape == (2, 4, 9, 9)  # (5-1)*2 - 2 + 3
+
+    # adjoint reference: vjp of the forward conv whose weight is w
+    # transposed to OIHW (out=3 filters taking 4 channels)
+    def conv(y):
+        # forward conv 4ch -> 3ch; its OIHW weight (3,4,3,3) IS w
+        return jax.lax.conv_general_dilated(
+            y, jnp.asarray(w),
+            window_strides=stride, padding=[pad, pad],
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                (2, 4, 9, 9), (3, 4, 3, 3), ("NCHW", "OIHW", "NCHW")))
+
+    y0 = jnp.zeros((2, 4, 9, 9), jnp.float32)
+    _, vjp = jax.vjp(conv, y0)
+    (adjoint,) = vjp(jnp.asarray(x))
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(adjoint),
+                               rtol=1e-4, atol=1e-4)
