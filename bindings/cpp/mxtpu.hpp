@@ -1,0 +1,262 @@
+/*
+ * mxtpu.hpp — header-only C++ frontend over the general C ABI
+ * (src/mxtpu_capi.h).
+ *
+ * Parity role: the reference's language bindings (R-package, scala JNI,
+ * cpp usage of c_api.h) all sit on the C ABI; this wrapper is the C++
+ * consumer demonstrating the same contract with RAII lifetime handling:
+ * Symbol composition, shape inference, executor training and kvstore
+ * updates without a line of Python in user code.
+ *
+ * Error model: throws mxtpu::Error carrying MXGetLastError().
+ */
+#ifndef MXTPU_HPP_
+#define MXTPU_HPP_
+
+#include <cstdint>
+#include <map>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxtpu_capi.h"
+
+namespace mxtpu {
+
+struct Error : std::runtime_error {
+  explicit Error(const std::string &where)
+      : std::runtime_error(where + ": " + MXGetLastError()) {}
+};
+
+inline void check(int rc, const char *where) {
+  if (rc != 0) throw Error(where);
+}
+
+enum class Device : int { kCPU = 1, kAccelerator = 2 };
+
+class NDArray {
+ public:
+  NDArray() = default;
+  NDArray(const std::vector<uint32_t> &shape, Device dev = Device::kCPU) {
+    check(MXNDArrayCreate(shape.data(),
+                          static_cast<uint32_t>(shape.size()),
+                          static_cast<int>(dev), 0, &h_),
+          "NDArrayCreate");
+    owned_ = true;
+  }
+  /* wrap a handle returned by executor lookups (owned: caller frees) */
+  static NDArray FromHandle(NDArrayHandle h) {
+    NDArray a;
+    a.h_ = h;
+    a.owned_ = true;
+    return a;
+  }
+  ~NDArray() { reset(); }
+  NDArray(NDArray &&o) noexcept : h_(o.h_), owned_(o.owned_) {
+    o.h_ = nullptr;
+    o.owned_ = false;
+  }
+  NDArray &operator=(NDArray &&o) noexcept {
+    reset();
+    h_ = o.h_;
+    owned_ = o.owned_;
+    o.h_ = nullptr;
+    o.owned_ = false;
+    return *this;
+  }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+
+  std::vector<uint32_t> Shape() const {
+    std::vector<uint32_t> buf(8);
+    uint32_t ndim = 0;
+    check(MXNDArrayGetShape(h_, &ndim,  buf.data(),
+                            static_cast<uint32_t>(buf.size())),
+          "GetShape");
+    if (ndim > buf.size()) {  // rank exceeded the guess: fetch again
+      buf.resize(ndim);
+      check(MXNDArrayGetShape(h_, &ndim, buf.data(),
+                              static_cast<uint32_t>(buf.size())),
+            "GetShape");
+    }
+    buf.resize(ndim);
+    return buf;
+  }
+  uint64_t Size() const {
+    auto s = Shape();
+    return std::accumulate(s.begin(), s.end(), uint64_t{1},
+                           std::multiplies<uint64_t>());
+  }
+  void CopyFrom(const std::vector<float> &data) {
+    check(MXNDArraySyncCopyFromCPU(h_, data.data(), data.size()),
+          "SyncCopyFromCPU");
+  }
+  std::vector<float> CopyTo() const {
+    std::vector<float> out(Size());
+    check(MXNDArraySyncCopyToCPU(h_, out.data(), out.size()),
+          "SyncCopyToCPU");
+    return out;
+  }
+  NDArrayHandle handle() const { return h_; }
+  /* detach without freeing — for handles borrowed inside callbacks */
+  void release() {
+    h_ = nullptr;
+    owned_ = false;
+  }
+
+ private:
+  void reset() {
+    if (owned_ && h_) MXNDArrayFree(h_);
+    h_ = nullptr;
+  }
+  NDArrayHandle h_ = nullptr;
+  bool owned_ = false;
+};
+
+class Symbol {
+ public:
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    check(MXSymbolCreateVariable(name.c_str(), &h), "CreateVariable");
+    return Symbol(h);
+  }
+  /* op + attrs; inputs applied immediately (Compose) */
+  static Symbol Op(const std::string &op, const std::string &name,
+                   const std::vector<Symbol *> &inputs,
+                   const std::map<std::string, std::string> &attrs = {}) {
+    std::vector<const char *> keys, vals;
+    for (auto &kv : attrs) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h = nullptr;
+    check(MXSymbolCreateAtomicSymbol(op.c_str(),
+                                     static_cast<uint32_t>(keys.size()),
+                                     keys.data(), vals.data(), &h),
+          "CreateAtomicSymbol");
+    std::vector<SymbolHandle> args;
+    for (auto *s : inputs) args.push_back(s->h_);
+    check(MXSymbolCompose(h, name.c_str(),
+                          static_cast<uint32_t>(args.size()), nullptr,
+                          args.data()),
+          "Compose");
+    return Symbol(h);
+  }
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    check(MXSymbolCreateFromJSON(json.c_str(), &h), "CreateFromJSON");
+    return Symbol(h);
+  }
+  std::string ToJSON() const {
+    const char *out = nullptr;
+    check(MXSymbolSaveToJSON(h_, &out), "SaveToJSON");
+    return out;
+  }
+  std::vector<std::string> ListArguments() const {
+    uint32_t n = 0;
+    const char **names = nullptr;
+    check(MXSymbolListArguments(h_, &n, &names), "ListArguments");
+    return {names, names + n};
+  }
+  ~Symbol() {
+    if (h_) MXSymbolFree(h_);
+  }
+  Symbol(Symbol &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Symbol &operator=(Symbol &&o) noexcept {
+    if (h_) MXSymbolFree(h_);
+    h_ = o.h_;
+    o.h_ = nullptr;
+    return *this;
+  }
+  Symbol(const Symbol &) = delete;
+  Symbol &operator=(const Symbol &) = delete;
+  SymbolHandle handle() const { return h_; }
+
+ private:
+  explicit Symbol(SymbolHandle h) : h_(h) {}
+  SymbolHandle h_ = nullptr;
+};
+
+class Executor {
+ public:
+  Executor(const Symbol &net, Device dev, const std::string &grad_req,
+           const std::map<std::string, std::vector<uint32_t>> &shapes) {
+    std::vector<const char *> keys;
+    std::vector<uint32_t> ind{0};
+    std::vector<uint32_t> data;
+    for (auto &kv : shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      ind.push_back(static_cast<uint32_t>(data.size()));
+    }
+    check(MXExecutorSimpleBind(net.handle(), static_cast<int>(dev), 0,
+                               grad_req.c_str(),
+                               static_cast<uint32_t>(keys.size()),
+                               keys.data(), ind.data(), data.data(), &h_),
+          "SimpleBind");
+  }
+  ~Executor() {
+    if (h_) MXExecutorFree(h_);
+  }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+
+  void Forward(bool is_train) {
+    check(MXExecutorForward(h_, is_train ? 1 : 0), "Forward");
+  }
+  void Backward() { check(MXExecutorBackward(h_), "Backward"); }
+  NDArray Output(uint32_t i) const {
+    NDArrayHandle out = nullptr;
+    check(MXExecutorOutput(h_, i, &out), "Output");
+    return NDArray::FromHandle(out);
+  }
+  NDArray Arg(const std::string &name) const {
+    NDArrayHandle out = nullptr;
+    check(MXExecutorArgArray(h_, name.c_str(), &out), "ArgArray");
+    return NDArray::FromHandle(out);
+  }
+  NDArray Grad(const std::string &name) const {
+    NDArrayHandle out = nullptr;
+    check(MXExecutorGradArray(h_, name.c_str(), &out), "GradArray");
+    return NDArray::FromHandle(out);
+  }
+
+ private:
+  ExecutorHandle h_ = nullptr;
+};
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    check(MXKVStoreCreate(type.c_str(), &h_), "KVStoreCreate");
+  }
+  ~KVStore() {
+    if (h_) MXKVStoreFree(h_);
+  }
+  KVStore(const KVStore &) = delete;
+  KVStore &operator=(const KVStore &) = delete;
+
+  void Init(int key, const NDArray &v) {
+    NDArrayHandle h = v.handle();
+    check(MXKVStoreInit(h_, 1, &key, &h), "KVStoreInit");
+  }
+  void Push(int key, const NDArray &v, int priority = 0) {
+    NDArrayHandle h = v.handle();
+    check(MXKVStorePush(h_, 1, &key, &h, priority), "KVStorePush");
+  }
+  void Pull(int key, NDArray *out, int priority = 0) {
+    NDArrayHandle h = out->handle();
+    check(MXKVStorePull(h_, 1, &key, &h, priority), "KVStorePull");
+  }
+  void SetUpdater(MXKVStoreUpdater fn, void *state) {
+    check(MXKVStoreSetUpdater(h_, fn, state), "SetUpdater");
+  }
+
+ private:
+  KVStoreHandle h_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_HPP_

@@ -53,6 +53,7 @@ from . import lr_scheduler
 from . import callback
 from . import io
 from . import recordio
+from . import filesystem
 from . import image
 from . import kvstore as kv
 from . import kvstore_server

@@ -409,15 +409,13 @@ class ImageRecordIter(DataIter):
         except Exception:
             self.records = []
         if not native_ok:
-            reader = MXRecordIO(path_imgrec, "r")
-            while True:
-                s = reader.read()
-                if s is None:
-                    break
-                self.records.append(s)
-            reader.close()
-            if num_parts > 1:
-                self.records = self.records[part_index::num_parts]
+            # byte-range sharding with record alignment (dmlc InputSplit
+            # parity) — works over any registered filesystem (mem://,
+            # s3:// adapters), unlike the local-only native scanner
+            from .filesystem import InputSplit
+
+            self.records = list(InputSplit(path_imgrec, part_index,
+                                           num_parts))
         self.shuffle = shuffle
         self.seed = seed
         self.order = list(range(len(self.records)))

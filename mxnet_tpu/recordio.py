@@ -35,11 +35,16 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        # URIs route through the filesystem registry (parity: dmlc
+        # Stream::Create) — local paths behave exactly as before, and
+        # mem:// / registered remote schemes work transparently
+        from .filesystem import open_uri
+
         if self.flag == "w":
-            self.fp = open(self.uri, "wb")
+            self.fp = open_uri(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fp = open(self.uri, "rb")
+            self.fp = open_uri(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("invalid flag " + self.flag)

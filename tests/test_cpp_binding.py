@@ -1,0 +1,42 @@
+"""C++ binding test: compile bindings/cpp/example_train.cc against
+libmxtpu_capi.so and require its training loop to converge — the C++
+analogue of the reference's cpp users over c_api.h (and of
+tests/test_c_api.py for plain C)."""
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "mxnet_tpu", "lib", "libmxtpu_capi.so")
+SRC = os.path.join(REPO, "bindings", "cpp", "example_train.cc")
+
+
+@pytest.fixture(scope="module")
+def capi_lib():
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make", "-C", os.path.join(REPO, "src"), "capi"],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+    return LIB
+
+
+def test_cpp_train(capi_lib, tmp_path):
+    exe = tmp_path / "cpp_train"
+    r = subprocess.run(
+        ["g++", "-std=c++17", SRC,
+         "-I", os.path.join(REPO, "src"),
+         "-I", os.path.join(REPO, "bindings", "cpp"),
+         str(capi_lib), "-o", str(exe),
+         f"-Wl,-rpath,{os.path.dirname(capi_lib)}"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ)
+    env["MXTPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([str(exe)], env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CPP TRAIN OK" in r.stdout
