@@ -169,7 +169,7 @@ def _bench(dev, kind):
     img_s = batch * iters / dt
     peak = _peak_flops(kind)
     mfu = (img_s * TRAIN_FLOPS_PER_IMG / peak) if peak else None
-    _emit({
+    payload = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "img/s",
@@ -178,7 +178,42 @@ def _bench(dev, kind):
         "batch": batch,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "model_tflops_per_sec": round(img_s * TRAIN_FLOPS_PER_IMG / 1e12, 2),
-    })
+    }
+
+    if os.environ.get("BENCH_EXTRAS", "1") == "1":
+        # secondary datapoint (inference b32; P100 baseline 713.17 img/s)
+        # under a watchdog: if its extra compile hangs, the ALREADY
+        # MEASURED training number must still reach stdout — losing the
+        # primary metric to an optional extra would repeat round 1's
+        # silent-timeout failure
+        state = {"done": False}
+
+        def extras_watchdog():
+            deadline = time.monotonic() + float(
+                os.environ.get("BENCH_EXTRAS_TIMEOUT_S", "240"))
+            while time.monotonic() < deadline:
+                if state["done"]:
+                    return
+                time.sleep(1.0)
+            if not state["done"]:
+                payload["extras_error"] = "inference extras timed out"
+                _emit(payload)
+                os._exit(0)
+
+        threading.Thread(target=extras_watchdog, daemon=True).start()
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from tools.benchmark_score import score
+
+            inf = score("resnet-50", 32, 20, "bf16")
+            payload["resnet50_infer_b32_imgs_per_sec"] = round(inf, 1)
+            payload["infer_vs_p100_baseline"] = round(inf / 713.17, 2)
+        except Exception as exc:  # noqa: BLE001
+            payload["extras_error"] = repr(exc)
+        finally:
+            state["done"] = True
+
+    _emit(payload)
     return 0
 
 

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Advantage actor-critic (parity: example/reinforcement-learning/a3c/ —
+the synchronous variant of the same estimator; the reference's a3c.py
+runs parallel workers feeding one set of weights, here K parallel
+environments step in lockstep).  Shared trunk with policy + value heads:
+the policy trains on advantage-weighted log-likelihood plus an entropy
+bonus, the value head on n-step bootstrapped returns — all expressed
+symbolically through MakeLoss, no custom ops.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+from dqn_gridworld import GRID, ACTIONS, GridWorld  # noqa: E402
+
+
+def ac_net(batch):
+    data = sym.Variable("data")
+    act = sym.Variable("action")        # (N,) taken actions
+    adv = sym.Variable("advantage")     # (N,) advantages
+    ret = sym.Variable("return_label")  # (N,) bootstrapped returns
+    mask = sym.Variable("mask")         # (N,) 1 for real samples
+    trunk = sym.FullyConnected(sym.Flatten(data), num_hidden=64, name="fc1")
+    trunk = sym.Activation(trunk, act_type="relu")
+    logits = sym.FullyConnected(trunk, num_hidden=ACTIONS, name="policy")
+    value = sym.FullyConnected(trunk, num_hidden=1, name="value")
+
+    logp = sym.log_softmax(logits)
+    onehot = sym.one_hot(act, depth=ACTIONS)
+    denom = sym.sum(mask) + 1e-8
+    pg_loss = -sym.sum(sym.sum(logp * onehot, axis=1) * adv * mask) / denom
+    entropy = -sym.sum(sym.broadcast_mul(sym.exp(logp) * logp,
+                                         sym.Reshape(mask, shape=(batch, 1)))) / denom
+    v_err = sym.Reshape(value, shape=(batch,)) - ret
+    v_loss = sym.sum(sym.square(v_err) * mask) / denom
+    total = pg_loss + 0.5 * v_loss - 0.05 * entropy
+    return sym.Group([sym.MakeLoss(total, name="loss"),
+                      sym.BlockGrad(sym.softmax(logits), name="pi"),
+                      sym.BlockGrad(value, name="v")])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=80)
+    ap.add_argument("--envs", type=int, default=16)
+    ap.add_argument("--horizon", type=int, default=30)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+    envs = [GridWorld(np.random.RandomState(100 + i))
+            for i in range(args.envs)]
+    gamma = 0.95
+    n_total = args.envs * args.horizon
+
+    ctx = mx.context.default_accelerator_context()
+    # two executors over shared weights: an acting one (batch = n_envs)
+    # and a training one (batch = envs*horizon) — the reference a3c
+    # similarly separates acting nets from the training update
+    zeros_small = {"action": np.zeros(args.envs, np.float32),
+                   "advantage": np.zeros(args.envs, np.float32),
+                   "return_label": np.zeros(args.envs, np.float32),
+                   "mask": np.zeros(args.envs, np.float32)}
+    act_ex = ac_net(args.envs).simple_bind(
+        ctx=ctx, grad_req="null", data=(args.envs, 2, GRID, GRID),
+        action=(args.envs,), advantage=(args.envs,),
+        return_label=(args.envs,), mask=(args.envs,))
+    train_ex = ac_net(n_total).simple_bind(
+        ctx=ctx, grad_req="write", data=(n_total, 2, GRID, GRID),
+        action=(n_total,), advantage=(n_total,), return_label=(n_total,),
+        mask=(n_total,))
+    init = mx.init.Xavier()
+    params = {n: a for n, a in train_ex.arg_dict.items()
+              if n.endswith(("weight", "bias"))}
+    for n, a in params.items():
+        init(n, a)
+    opt = mx.optimizer.create("adam", learning_rate=3e-3)
+    upd = mx.optimizer.get_updater(opt)
+
+    finish_hist = []
+    for it in range(args.iters):
+        for n, a in params.items():
+            act_ex.arg_dict[n][:] = a.asnumpy()
+        states = np.stack([e.reset() for e in envs])
+        obs = np.zeros((args.horizon, args.envs, 2, GRID, GRID), np.float32)
+        acts = np.zeros((args.horizon, args.envs), np.float32)
+        rews = np.zeros((args.horizon, args.envs), np.float32)
+        alive = np.ones((args.horizon, args.envs), np.float32)
+        done = np.zeros(args.envs, bool)
+        steps_used = np.full(args.envs, args.horizon, np.float32)
+        for t in range(args.horizon):
+            act_ex.forward(is_train=False, data=states, **zeros_small)
+            pi = act_ex.outputs[1].asnumpy()
+            obs[t] = states
+            alive[t] = ~done
+            for i, env in enumerate(envs):
+                if done[i]:
+                    continue
+                p = pi[i] / pi[i].sum()
+                a = int(rs.choice(ACTIONS, p=p))
+                s2, r, d = env.step(a)
+                acts[t, i] = a
+                rews[t, i] = r
+                states[i] = s2
+                if d:
+                    done[i] = True
+                    steps_used[i] = t + 1
+        finish_hist.append(steps_used.mean())
+
+        # bootstrapped returns per env (value of the final state if alive)
+        act_ex.forward(is_train=False, data=states, **zeros_small)
+        v_last = act_ex.outputs[2].asnumpy().reshape(-1)
+        returns = np.zeros_like(rews)
+        acc = np.where(done, 0.0, v_last)
+        for t in reversed(range(args.horizon)):
+            acc = rews[t] + gamma * acc * alive[t]
+            returns[t] = acc
+
+        flat = lambda a: a.reshape(n_total, *a.shape[2:])  # noqa: E731
+        data = flat(obs)
+        mask = flat(alive)
+        train_ex.forward(is_train=False, data=data,
+                         action=flat(acts), advantage=np.zeros(n_total, np.float32),
+                         return_label=flat(returns), mask=mask)
+        values = train_ex.outputs[2].asnumpy().reshape(-1)
+        adv = (flat(returns) - values) * mask
+        # normalize advantages over real samples (standard A2C stabilizer)
+        m = mask > 0
+        if m.any():
+            adv[m] = (adv[m] - adv[m].mean()) / (adv[m].std() + 1e-6)
+        train_ex.forward(is_train=True, data=data, action=flat(acts),
+                         advantage=adv, return_label=flat(returns),
+                         mask=mask)
+        train_ex.backward()
+        for i, (nname, arr) in enumerate(sorted(params.items())):
+            upd(i, train_ex.grad_dict[nname], arr)
+            train_ex.arg_dict[nname][:] = arr.asnumpy()
+        if it % 20 == 19:
+            print(f"iter {it}: mean steps-to-goal {np.mean(finish_hist[-10:]):.1f}")
+
+    early = np.mean(finish_hist[:10])
+    late = np.mean(finish_hist[-10:])
+    print(f"mean steps: first10 {early:.1f} last10 {late:.1f}")
+    assert late < early * 0.75, (early, late)
+    print("TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

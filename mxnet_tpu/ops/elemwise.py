@@ -258,3 +258,24 @@ def _clip(ctx, data, **attrs):
     return jnp.clip(
         data, float(parse_attr(attrs["a_min"])), float(parse_attr(attrs["a_max"]))
     )
+
+
+@register("softmax")
+def _softmax_op(ctx, data, **attrs):
+    """True softmax ACTIVATION over ``axis`` (default -1) with an honest
+    autodiff gradient — the modern op (src/operator/nn/softmax.cc in
+    later reference versions).  Deliberately registered under the
+    lowercase name so it wins over the deprecated capital-``Softmax``
+    alias of SoftmaxOutput, whose custom backward assumes an implicit
+    label and silently poisons any graph using softmax as an activation
+    (caught by the a2c example's dead policy gradient)."""
+    axis = int(parse_attr(attrs.get("axis", -1)))
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("log_softmax")
+def _log_softmax_op(ctx, data, **attrs):
+    """log(softmax(data)) computed stably (src/operator/nn/softmax.cc
+    log_softmax in later reference versions)."""
+    axis = int(parse_attr(attrs.get("axis", -1)))
+    return jax.nn.log_softmax(data, axis=axis)

@@ -124,3 +124,36 @@ def test_slice_channel_outputs():
     assert len(parts) == 3
     a, o, _ = parts.infer_shape(data=(2, 6, 4))
     assert o == [(2, 2, 4)] * 3
+
+
+def test_lowercase_softmax_is_true_activation():
+    """sym.softmax must be the honest activation with an autodiff
+    gradient — NOT the deprecated capital-Softmax alias of SoftmaxOutput,
+    whose custom backward assumes an implicit label and silently corrupts
+    gradients of any graph using softmax mid-graph (regression: a2c's
+    policy gradient was dead)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = sym.Variable("logits")
+    w = sym.Variable("w")
+    loss = sym.MakeLoss(sym.sum(sym.softmax(logits * w) * sym.softmax(logits)))
+    ex = loss.simple_bind(ctx=mx.cpu(), grad_req="write", logits=(3, 4), w=(3, 4))
+    rs = np.random.RandomState(0)
+    lg = rs.randn(3, 4).astype(np.float32)
+    wv = rs.randn(3, 4).astype(np.float32)
+    ex.forward(is_train=True, logits=lg, w=wv)
+    ex.backward()
+
+    def ref(lg, wv):
+        return (jax.nn.softmax(lg * wv, axis=-1)
+                * jax.nn.softmax(lg, axis=-1)).sum()
+
+    exp = jax.grad(ref, argnums=0)(jnp.asarray(lg), jnp.asarray(wv))
+    np.testing.assert_allclose(ex.grad_dict["logits"].asnumpy(),
+                               np.asarray(exp), rtol=1e-4, atol=1e-5)
+    # log_softmax too
+    out = mx.nd.log_softmax(mx.nd.array(lg))
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.asarray(jax.nn.log_softmax(jnp.asarray(lg))),
+                               rtol=1e-5, atol=1e-6)
