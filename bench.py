@@ -186,32 +186,46 @@ def _bench(dev, kind):
         # MEASURED training number must still reach stdout — losing the
         # primary metric to an optional extra would repeat round 1's
         # silent-timeout failure
-        state = {"done": False}
+        # exactly-one-emit: whichever of (main thread, watchdog) claims
+        # the flag first emits; the loser stays silent — otherwise a
+        # score() finishing inside the watchdog's final window could
+        # print the metric line twice
+        lock = threading.Lock()
+        state = {"emitted": False}
+
+        def claim():
+            with lock:
+                if state["emitted"]:
+                    return False
+                state["emitted"] = True
+                return True
 
         def extras_watchdog():
             deadline = time.monotonic() + float(
                 os.environ.get("BENCH_EXTRAS_TIMEOUT_S", "240"))
             while time.monotonic() < deadline:
-                if state["done"]:
+                if state["emitted"]:
                     return
                 time.sleep(1.0)
-            if not state["done"]:
+            if claim():
                 payload["extras_error"] = "inference extras timed out"
                 _emit(payload)
                 os._exit(0)
 
         threading.Thread(target=extras_watchdog, daemon=True).start()
+        extras = {}
         try:
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
             from tools.benchmark_score import score
 
             inf = score("resnet-50", 32, 20, "bf16")
-            payload["resnet50_infer_b32_imgs_per_sec"] = round(inf, 1)
-            payload["infer_vs_p100_baseline"] = round(inf / 713.17, 2)
+            extras["resnet50_infer_b32_imgs_per_sec"] = round(inf, 1)
+            extras["infer_vs_p100_baseline"] = round(inf / 713.17, 2)
         except Exception as exc:  # noqa: BLE001
-            payload["extras_error"] = repr(exc)
-        finally:
-            state["done"] = True
+            extras["extras_error"] = repr(exc)
+        if not claim():
+            return 0  # the watchdog already emitted the primary payload
+        payload.update(extras)
 
     _emit(payload)
     return 0

@@ -140,7 +140,6 @@ def main():
         train_ex.backward()
         for i, (nname, arr) in enumerate(sorted(params.items())):
             upd(i, train_ex.grad_dict[nname], arr)
-            train_ex.arg_dict[nname][:] = arr.asnumpy()
         if it % 20 == 19:
             print(f"iter {it}: mean steps-to-goal {np.mean(finish_hist[-10:]):.1f}")
 

@@ -136,7 +136,6 @@ def main():
             ex.backward()
             for i, (n, arr) in enumerate(sorted(params.items())):
                 updater(i, ex.grad_dict[n], arr)
-                ex.arg_dict[n][:] = arr.asnumpy()
         if ep % 10 == 9:
             target_params = {n: a.asnumpy() for n, a in params.items()}
         if ep % 50 == 49:

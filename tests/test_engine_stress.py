@@ -10,7 +10,6 @@ import random
 import threading
 import time
 
-import numpy as np
 import pytest
 
 from mxnet_tpu import _native
