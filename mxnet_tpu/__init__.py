@@ -54,6 +54,7 @@ from . import callback
 from . import io
 from . import recordio
 from . import filesystem
+from . import storage
 from . import image
 from . import kvstore as kv
 from . import kvstore_server

@@ -386,6 +386,8 @@ class NativeArena:
         if lib is None:
             raise RuntimeError("libmxtpu unavailable")
         self._lib = lib
+        self._ptr_of = {}  # id(view) -> raw pointer (free() needs it even
+                           # when free is the first call ever made)
 
     def alloc(self, shape, dtype=np.float32):
         dtype = np.dtype(dtype)
@@ -397,7 +399,6 @@ class NativeArena:
         arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)))
         arr = arr.reshape(shape)
         arr.flags.writeable = True
-        self._ptr_of = getattr(self, "_ptr_of", {})
         self._ptr_of[id(arr)] = ptr
         return arr
 

@@ -1,0 +1,82 @@
+"""Host storage pool surface (parity: include/mxnet/storage.h +
+src/storage/pooled_storage_manager.h and the MXStorageEmptyCache C API).
+
+Device memory belongs to PjRt/XLA on this stack; what the reference's
+pooled storage manager still buys on TPU is HOST staging — the per-batch
+buffers the data pipeline fills before `device_put`.  This module fronts
+the native size-class arena (src/storage.cc via _native.NativeArena):
+
+- ``staging_empty(shape, dtype)`` — pooled numpy buffer (recycled by
+  power-of-two size class on ``staging_free``)
+- ``pool_bytes()`` — bytes currently parked in free lists
+- ``release_all()`` — drop the pool (parity: MXStorageEmptyCache)
+
+``MXTPU_STORAGE_POOL=0`` disables pooling (plain numpy allocation), the
+analogue of the reference's MXNET_GPU_MEM_POOL_RESERVE escape hatch;
+numpy is also the automatic fallback when the native library is absent.
+
+NB: the built-in iterators do NOT route their batch buffers through this
+pool yet — ``nd.array``'s jnp conversion may alias aligned host memory
+on the CPU backend, so recycling a buffer whose jax array is still live
+would corrupt it.  Callers own the lifetime of what they stage here.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .base import get_env
+
+_ARENA = None
+_ARENA_LOCK = threading.Lock()
+_DISABLED = object()
+
+
+def _arena():
+    global _ARENA
+    if _ARENA is None:
+        with _ARENA_LOCK:
+            if _ARENA is None:  # racing first callers must share ONE
+                # arena: buffers freed through a second instance would
+                # never return to the pool
+                if get_env("MXTPU_STORAGE_POOL", 1, int) == 0:
+                    _ARENA = _DISABLED
+                else:
+                    try:
+                        from ._native import NativeArena, available
+
+                        _ARENA = NativeArena() if available() else _DISABLED
+                    except Exception:
+                        _ARENA = _DISABLED
+    return _ARENA
+
+
+def staging_empty(shape, dtype=np.float32):
+    """A host buffer from the pool (uninitialized, like np.empty)."""
+    if isinstance(shape, (int, np.integer)):
+        shape = (int(shape),)
+    a = _arena()
+    if a is _DISABLED:
+        return np.empty(shape, dtype)
+    return a.alloc(tuple(shape), np.dtype(dtype))
+
+
+def staging_free(arr):
+    """Return a staging_empty buffer to the pool (no-op for plain numpy)."""
+    a = _arena()
+    if a is not _DISABLED:
+        a.free(arr)
+
+
+def pool_bytes() -> int:
+    """Bytes held in the pool's free lists (0 when pooling is off)."""
+    a = _arena()
+    return 0 if a is _DISABLED else a.pool_bytes()
+
+
+def release_all():
+    """Drop every pooled block (parity: MXStorageEmptyCache)."""
+    a = _arena()
+    if a is not _DISABLED:
+        a.release_all()

@@ -3,12 +3,16 @@ smoke builds): fuse the runtime into one translation unit, compile it
 with a bare g++ line, and drive recordio + the engine through it."""
 import ctypes
 import os
+import shutil
 import subprocess
 import sys
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="g++ unavailable")
 
 
 def test_amalgamation_builds_and_runs(tmp_path):

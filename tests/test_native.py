@@ -222,3 +222,42 @@ def test_image_record_iter_uses_native_reader(tmp_path):
                          batch_size=4)
     batch = next(iter(it))
     assert batch.data[0].shape == (4, 3, 8, 8)
+
+
+def test_storage_module_pool_surface():
+    """mx.storage parity surface (storage.h + MXStorageEmptyCache):
+    pooled staging buffers recycle by size class, stats reflect it, and
+    release_all empties the pool.  (This file is native-gated, so the
+    pooled branch always runs here; the numpy fallback is covered
+    below by forcing the disabled state.)"""
+    import mxnet_tpu as mx
+
+    a = mx.storage.staging_empty((32, 32), np.float32)
+    a[:] = 1.0  # must be writable host memory
+    mx.storage.staging_free(a)
+    assert mx.storage.pool_bytes() >= 32 * 32 * 4
+    b = mx.storage.staging_empty((32, 32), np.float32)  # recycled
+    mx.storage.staging_free(b)
+    mx.storage.release_all()
+    assert mx.storage.pool_bytes() == 0
+    # int shape must behave identically to the numpy path
+    c = mx.storage.staging_empty(1024)
+    assert c.shape == (1024,)
+    mx.storage.staging_free(c)
+    # free() before any alloc is a documented no-op, never a crash
+    mx.storage.staging_free(np.empty((4,), np.float32))
+    mx.storage.release_all()
+
+
+def test_storage_module_disabled_fallback(monkeypatch):
+    """MXTPU_STORAGE_POOL=0 / missing native lib: plain numpy with the
+    same API shape and zeroed stats."""
+    from mxnet_tpu import storage
+
+    monkeypatch.setattr(storage, "_ARENA", storage._DISABLED)
+    a = storage.staging_empty((8, 8))
+    a[:] = 2.0
+    storage.staging_free(a)  # no-op
+    assert storage.pool_bytes() == 0
+    storage.release_all()
+    assert storage.staging_empty(16).shape == (16,)
