@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Three levels of the Module API on an MNIST-shaped MLP (parity:
+example/module/mnist_mlp.py).
+
+Level 1 — ``mod.fit(...)``: the high-level estimator loop.
+Level 2 — the intermediate API the fit loop is made of:
+``bind / init_params / init_optimizer / forward / backward / update``,
+which is what you drop down to for custom training schemes (GANs,
+RL, gradient surgery).
+Level 3 — checkpointing: ``save_checkpoint`` / ``Module.load`` with
+optimizer state, resuming mid-training.
+
+Runs on synthetic data so it works out of the box on one chip (or CPU
+with ``MXTPU_PLATFORM=cpu``)."""
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def mlp_symbol(num_classes=10):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu2", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+_PROJ = np.random.RandomState(42).normal(size=(784, 10)).astype(np.float32)
+
+
+def synthetic_mnist(num, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.uniform(0, 1, (num, 784)).astype(np.float32)
+    y = (x @ _PROJ).argmax(axis=1).astype(np.float32)
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Module API walkthrough")
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    x, y = synthetic_mnist(5000)
+    vx, vy = synthetic_mnist(1000, seed=1)
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(vx, vy, args.batch_size)
+
+    # ---- level 1: fit ---------------------------------------------------
+    mod = mx.mod.Module(mlp_symbol())
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    acc = mx.metric.Accuracy()
+    mod.score(val, acc)
+    logging.info("fit(): validation %s", acc.get())
+
+    # ---- level 2: the loop fit() is made of -----------------------------
+    train.reset()
+    mod2 = mx.mod.Module(mlp_symbol())
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label)
+    mod2.init_params(initializer=mx.init.Xavier())
+    mod2.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": args.lr})
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.num_epochs):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            mod2.forward(batch, is_train=True)
+            mod2.update_metric(metric, batch.label)
+            mod2.backward()
+            mod2.update()
+        logging.info("manual loop epoch %d: train %s", epoch, metric.get())
+
+    # ---- level 3: checkpoint / resume -----------------------------------
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "mnist_mlp")
+        mod2.save_checkpoint(prefix, args.num_epochs,
+                             save_optimizer_states=True)
+        resumed = mx.mod.Module.load(prefix, args.num_epochs,
+                                     load_optimizer_states=True)
+        resumed.bind(data_shapes=train.provide_data,
+                     label_shapes=train.provide_label)
+        resumed.init_optimizer(optimizer="sgd",
+                               optimizer_params={"learning_rate": args.lr})
+        train.reset()
+        for batch in train:
+            resumed.forward(batch, is_train=True)
+            resumed.backward()
+            resumed.update()
+        acc = mx.metric.Accuracy()
+        resumed.score(val, acc)
+        logging.info("resumed from checkpoint: validation %s", acc.get())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""How to write a custom DataIter (parity: example/python-howto/
+data_iter.py).
+
+A DataIter yields DataBatch objects and advertises its shapes through
+``provide_data`` / ``provide_label`` so ``Module.bind`` can allocate
+executors before the first batch arrives."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class SimpleIter(mx.io.DataIter):
+    """Generates batches from a user-supplied callable."""
+
+    def __init__(self, data_shapes, label_shapes, data_gen, label_gen,
+                 num_batches=10):
+        super().__init__()
+        self._provide_data = [mx.io.DataDesc(n, s) for n, s in data_shapes]
+        self._provide_label = [mx.io.DataDesc(n, s) for n, s in label_shapes]
+        self.num_batches = num_batches
+        self.data_gen = data_gen
+        self.label_gen = label_gen
+        self.cur_batch = 0
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        self.cur_batch = 0
+
+    def next(self):
+        if self.cur_batch >= self.num_batches:
+            raise StopIteration
+        self.cur_batch += 1
+        data = [mx.nd.array(self.data_gen(d.shape))
+                for d in self._provide_data]
+        label = [mx.nd.array(self.label_gen(d.shape))
+                 for d in self._provide_label]
+        return mx.io.DataBatch(data, label,
+                               pad=0, index=None,
+                               provide_data=self._provide_data,
+                               provide_label=self._provide_label)
+
+
+if __name__ == "__main__":
+    n, batch = 32, 16
+    rs = np.random.RandomState(0)
+    it = SimpleIter([("data", (batch, n))], [("softmax_label", (batch,))],
+                    lambda shape: rs.uniform(size=shape),
+                    lambda shape: rs.randint(0, 4, shape), num_batches=20)
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4),
+        name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    print("custom iterator drove fit() for 2 epochs")

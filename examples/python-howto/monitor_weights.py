@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Watch per-tensor statistics during training with mx.mon.Monitor
+(parity: example/python-howto/monitor_weights.py).
+
+The monitor taps every op output (and optionally weights) matching a
+regex each `interval` batches — the observability hook for diagnosing
+exploding/vanishing activations.  On TPU the taps are compiled once and
+fetched only on monitored steps (executor.py _run_monitor)."""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def norm_stat(d):
+    return mx.nd.norm(d) / np.sqrt(d.size)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(0)
+    x = rs.uniform(0, 1, (1000, 64)).astype(np.float32)
+    y = rs.randint(0, 10, 1000).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, 50, shuffle=True)
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Activation(
+                mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                      name="fc1", num_hidden=32),
+                name="relu1", act_type="relu"),
+            name="fc2", num_hidden=10),
+        name="softmax")
+
+    mon = mx.mon.Monitor(10, stat_func=norm_stat,
+                         pattern=".*weight|.*output", sort=True)
+    mod = mx.mod.Module(net)
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            monitor=mon,
+            batch_end_callback=mx.callback.Speedometer(50, 10))

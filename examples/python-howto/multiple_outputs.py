@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Expose intermediate tensors as extra outputs (parity:
+example/python-howto/multiple_outputs.py).
+
+Two mechanisms:
+1. ``mx.sym.Group([a, b])`` — bind a graph with several heads.
+2. ``net.get_internals()`` — list every internal output of an existing
+   symbol and re-bind a subgraph ending at any of them (the feature-
+   extraction idiom used by fine-tune.py and neural-style)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+if __name__ == "__main__":
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=32)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=10)
+    out = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    # 1. group two heads into one executor
+    group = mx.sym.Group([out, fc1])
+    print("group outputs:", group.list_outputs())
+    ex = group.simple_bind(ctx=mx.cpu(), data=(4, 16))
+    ex.arg_dict["data"][:] = np.random.uniform(size=(4, 16))
+    ex.forward(is_train=False)
+    print("softmax:", ex.outputs[0].shape, " fc1:", ex.outputs[1].shape)
+
+    # 2. carve a feature subgraph out of a finished network
+    internals = out.get_internals()
+    print("internals:", internals.list_outputs()[:8], "...")
+    feat = internals["relu1_output"]
+    fex = feat.simple_bind(ctx=mx.cpu(), data=(4, 16))
+    fex.arg_dict["data"][:] = np.random.uniform(size=(4, 16))
+    fex.forward(is_train=False)
+    print("relu1 features:", fex.outputs[0].shape)

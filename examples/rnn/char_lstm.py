@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Character-level LSTM language model + sampling (parity:
+example/rnn/old/char-rnn.ipynb / lstm.py — the classic char-rnn).
+
+Trains a stacked-LSTM next-character model on a text file (or a built-in
+synthetic grammar when no file is given), then samples new text one
+character at a time with a single-step executor — demonstrating train
+graph / step graph weight sharing.
+
+Usage::
+
+    python char_lstm.py --text /path/to/corpus.txt --num-epochs 5
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_cell(num_layers, num_hidden, dropout):
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden, prefix=f"lstm_l{i}_"))
+        if dropout > 0 and i < num_layers - 1:
+            stack.add(mx.rnn.DropoutCell(dropout, prefix=f"drop_l{i}_"))
+    return stack
+
+
+def state_vars(num_layers):
+    """Init-state symbols fed through the data iterator (the v0.9 idiom);
+    LSTMCell state order is [h, c]."""
+    syms, names = [], []
+    for i in range(num_layers):
+        for tag in ("h", "c"):
+            name = f"l{i}_init_{tag}"
+            syms.append(mx.sym.Variable(name))
+            names.append(name)
+    return syms, names
+
+
+def train_symbol(cell, begin_state, seq_len, vocab_size, num_embed,
+                 num_hidden):
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    outputs, _ = cell.unroll(seq_len, inputs=embed, begin_state=begin_state,
+                             merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))  # (N*T, H)
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+    label = mx.sym.Reshape(mx.sym.Variable("softmax_label"), shape=(-1,))
+    return mx.sym.SoftmaxOutput(pred, label, name="softmax")
+
+
+def step_symbol(cell, begin_state, vocab_size, num_embed):
+    """One-character step graph sharing weights with the train graph."""
+    data = mx.sym.Variable("data")  # (1, 1)
+    embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    outputs, states = cell.unroll(1, inputs=embed, begin_state=begin_state,
+                                  merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(0, -1))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+    return mx.sym.SoftmaxActivation(pred, name="prob"), states
+
+
+def synthetic_text(n=20000, seed=0):
+    """ab-alternating grammar with spaces — enough structure to learn."""
+    rs = np.random.RandomState(seed)
+    words, out = ["aba", "abba", "baab", "bab"], []
+    while sum(len(w) + 1 for w in out) < n:
+        out.append(words[rs.randint(len(words))])
+    return " ".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="char-rnn")
+    ap.add_argument("--text", type=str, default=None)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-hidden", type=int, default=128)
+    ap.add_argument("--num-embed", type=int, default=32)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--dropout", type=float, default=0.0)
+    ap.add_argument("--sample-len", type=int, default=120)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    text = (open(args.text).read() if args.text else synthetic_text())
+    chars = sorted(set(text))
+    vocab = {c: i for i, c in enumerate(chars)}
+    inv_vocab = {i: c for c, i in vocab.items()}
+    ids = np.array([vocab[c] for c in text], dtype=np.float32)
+    logging.info("corpus: %d chars, vocab %d", len(ids), len(vocab))
+
+    # slice the stream into (batch, seq_len) windows; labels are shift-by-1
+    n_win = (len(ids) - 1) // args.seq_len
+    data = ids[:n_win * args.seq_len].reshape(n_win, args.seq_len)
+    label = ids[1:n_win * args.seq_len + 1].reshape(n_win, args.seq_len)
+    state_arrays = {
+        f"l{i}_init_{tag}": np.zeros((n_win, args.num_hidden), np.float32)
+        for i in range(args.num_layers) for tag in ("h", "c")}
+    train = mx.io.NDArrayIter({"data": data, **state_arrays}, label,
+                              args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+
+    cell = build_cell(args.num_layers, args.num_hidden, args.dropout)
+    states, state_names = state_vars(args.num_layers)
+    net = train_symbol(cell, states, args.seq_len, len(vocab),
+                       args.num_embed, args.num_hidden)
+    mod = mx.mod.Module(net, data_names=["data"] + state_names)
+    mod.fit(train,
+            eval_metric=mx.metric.Perplexity(ignore_label=None),
+            optimizer="adam",
+            optimizer_params={"learning_rate": 0.003},
+            initializer=mx.init.Xavier(),
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+
+    # ---- sampling: 1-step executor fed by its own output ----------------
+    step_cell = build_cell(args.num_layers, args.num_hidden, 0.0)
+    step_states, state_names = state_vars(args.num_layers)
+    prob_sym, state_syms = step_symbol(step_cell, step_states, len(vocab),
+                                       args.num_embed)
+    group = mx.sym.Group([prob_sym] + list(state_syms))
+    arg_params, _ = mod.get_params()
+    shapes = {"data": (1, 1)}
+    for name in state_names:
+        shapes[name] = (1, args.num_hidden)
+    sampler = group.simple_bind(ctx=mx.current_context(), **shapes)
+    for name, arr in arg_params.items():
+        if name in sampler.arg_dict:
+            sampler.arg_dict[name][:] = arr
+
+    rs = np.random.RandomState(7)
+    cur = rs.randint(len(vocab))
+    out_chars = [inv_vocab[cur]]
+    for _ in range(args.sample_len):
+        sampler.arg_dict["data"][:] = np.array([[cur]], dtype=np.float32)
+        sampler.forward(is_train=False)
+        p = sampler.outputs[0].asnumpy().ravel()
+        cur = int(rs.choice(len(vocab), p=p / p.sum()))
+        out_chars.append(inv_vocab[cur])
+        for name, out in zip(state_names, sampler.outputs[1:]):
+            sampler.arg_dict[name][:] = out.asnumpy()
+    print("sample:", "".join(out_chars))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Use PyTorch layers inside an mxnet_tpu graph (parity: example/torch/
+torch_module.py — which embedded Lua-torch nn modules).
+
+``TorchModule`` runs the torch layer on the host behind the compiled XLA
+graph (pure_callback + custom VJP via torch autograd); its parameters
+are ordinary graph inputs, trained by the framework optimizer.  Here a
+torch ``Linear`` replaces the hidden layer of an MLP and trains to the
+same accuracy as the native version."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.plugins import torch_plugin as tp  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description="torch layer inside mxnet_tpu")
+    ap.add_argument("--batch-size", type=int, default=50)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(0)
+    x = rs.uniform(0, 1, (2000, 32)).astype(np.float32)
+    w = rs.normal(size=(32, 5)).astype(np.float32)
+    y = (x @ w).argmax(axis=1).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+
+    hidden = torch.nn.Linear(32, 64)
+    mid = tp.register_module(hidden)
+
+    data = mx.sym.Variable("data")
+    # torch params are plain graph inputs; their shapes come from the
+    # torch layer, so declare them for shape inference
+    tw = mx.sym.Variable("torch_weight", shape=(64, 32))
+    tb = mx.sym.Variable("torch_bias", shape=(64,))
+    net = mx.sym.TorchModule(data, tw, tb, module_id=mid, name="torch_fc")
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc_out", num_hidden=5)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net)
+    mod.fit(train,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Xavier(),
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    metric = mx.metric.Accuracy()
+    mod.score(train, metric)
+    logging.info("torch-hybrid MLP: train %s", metric.get())
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,7 @@ from . import module
 from . import module as mod
 from .module import Module, BucketingModule, SequentialModule, PythonModule
 from . import monitor
+from . import monitor as mon
 from .monitor import Monitor
 from . import rnn
 from . import operator

@@ -399,11 +399,13 @@ class NativeArena:
         arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)))
         arr = arr.reshape(shape)
         arr.flags.writeable = True
-        self._ptr_of[id(arr)] = ptr
+        # key by the stable buffer address: id(arr) can be reused by CPython
+        # after the view is collected, silently orphaning the native block
+        self._ptr_of[ptr] = ptr
         return arr
 
     def free(self, arr):
-        ptr = self._ptr_of.pop(id(arr), None)
+        ptr = self._ptr_of.pop(int(arr.ctypes.data), None)
         if ptr is not None:
             self._lib.mxs_free(ptr)
 

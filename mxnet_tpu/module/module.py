@@ -99,8 +99,14 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        outs = self._exec_group.execs[0].outputs
-        return list(zip(self.output_names, [o.shape for o in outs]))
+        # infer from the bound input shapes — must work before any forward
+        # (SequentialModule.bind chains on it while wiring sub-modules)
+        shapes = {}
+        for d in list(self._data_shapes) + list(self._label_shapes or []):
+            name, shape = (d.name, d.shape) if hasattr(d, "name") else (d[0], d[1])
+            shapes[name] = shape
+        _, out_shapes, _ = self._symbol.infer_shape_partial(**shapes)
+        return list(zip(self.output_names, out_shapes))
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,

@@ -229,10 +229,22 @@ def _batch_norm(ctx, data, gamma, beta, moving_mean, moving_var, **attrs):
     bshape = (1, -1) + (1,) * (data.ndim - 2)
 
     if ctx.is_train and not use_global:
-        mean = jnp.mean(data, axis=axes)
-        var = jnp.var(data, axis=axes)
-        new_mean = moving_mean * momentum + mean * (1 - momentum)
-        new_var = moving_var * momentum + var * (1 - momentum)
+        # single-pass moments: sum and sum-of-squares reduce in ONE fused
+        # read of the activation (f32 accumulation), halving the HBM
+        # traffic of the two-pass mean-then-centered-var formulation —
+        # the dominant cost of train-mode BN on TPU (profiled; same
+        # E[x^2]-E[x]^2 trick as mshadow's batch_norm forward)
+        n = 1.0
+        for ax in axes:
+            n *= data.shape[ax]
+        data32 = data.astype(jnp.float32)  # fused into the reduction reads
+        mean32 = jnp.sum(data32, axis=axes) / n
+        sqmean = jnp.sum(jnp.square(data32), axis=axes) / n
+        var32 = jnp.maximum(sqmean - jnp.square(mean32), 0.0)
+        mean = mean32.astype(data.dtype)
+        var = var32.astype(data.dtype)
+        new_mean = moving_mean * momentum + mean32 * (1 - momentum)
+        new_var = moving_var * momentum + var32 * (1 - momentum)
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
@@ -320,8 +332,11 @@ def _pooling(ctx, data, **attrs):
     window = (1, 1) + tuple(kernel)
     strides = (1, 1) + tuple(stride)
     if pool_type == "max":
-        init = -jnp.inf
-        out = jax.lax.reduce_window(data, init, jax.lax.max, window, strides, padding)
+        # NB: XLA's select-and-scatter backward measured FASTER on TPU than
+        # a 9-offset mask-trick custom VJP (strided scatters re-read dx at
+        # input resolution per offset) — keep the default VJP
+        out = jax.lax.reduce_window(data, -jnp.inf, jax.lax.max, window,
+                                    strides, padding)
     elif pool_type in ("avg", "sum"):
         out = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, padding)
         if pool_type == "avg":

@@ -234,19 +234,33 @@ class Symbol:
         Reference pipeline: nnvm InferShape pass (graph_executor.cc:404).
         Here: forward walk with per-op param hooks + jax.eval_shape.
         """
+        try:
+            return self._infer_and_collect(args, kwargs, partial=False)
+        except _InferIncomplete:
+            return None, None, None
+
+    def infer_shape_partial(self, *args, **kwargs):
+        """Parity: Symbol.infer_shape_partial — like infer_shape but
+        returns whatever is inferable (None for the rest) instead of
+        failing when some inputs are unknown."""
+        return self._infer_and_collect(args, kwargs, partial=True)
+
+    def _infer_and_collect(self, args, kwargs, partial):
         known = dict(kwargs)
         if args:
             for name, shape in zip(self.list_arguments(), args):
                 if shape is not None:
                     known[name] = shape
-        try:
-            shapes, _ = self._infer(known, {})
-        except _InferIncomplete:
-            n = len(self.list_arguments())
-            return None, None, None
+        shapes, _ = self._infer(known, {}, partial=partial)
+
+        def out_shape(node, idx):
+            if node.is_variable:  # variables are keyed by name, not node id
+                return shapes.get((node.name, "var"))
+            return shapes.get((id(node), idx))
+
         arg_shapes = [shapes.get((a, "var")) for a in self.list_arguments()]
         aux_shapes = [shapes.get((a, "var")) for a in self.list_auxiliary_states()]
-        out_shapes = [shapes.get((id(n), i)) for n, i in self._outputs]
+        out_shapes = [out_shape(n, i) for n, i in self._outputs]
         return arg_shapes, out_shapes, aux_shapes
 
     def infer_type(self, *args, **kwargs):
@@ -262,9 +276,12 @@ class Symbol:
         out_types = [np.float32 for _ in self._outputs]
         return arg_types, out_types, aux_types
 
-    def _infer(self, known_shapes: Dict[str, tuple], known_types: Dict[str, type]):
+    def _infer(self, known_shapes: Dict[str, tuple], known_types: Dict[str, type],
+               partial: bool = False):
         """Walk the graph computing avals; returns ({key: shape}, {key: dtype})
-        with keys (arg_name,'var') for variables and (id(node), out_idx)."""
+        with keys (arg_name,'var') for variables and (id(node), out_idx).
+        With partial=True, nodes that cannot be inferred are skipped
+        (their consumers skip too) instead of aborting the walk."""
         shapes: Dict = {}
         dtypes: Dict = {}
         avals: Dict = {}  # id(node) -> tuple of ShapeDtypeStruct
@@ -280,14 +297,7 @@ class Symbol:
             dt = np.dtype(known_types.get(name, np.float32))
             return jax.ShapeDtypeStruct(shape, dt)
 
-        for node in self.nodes:
-            if node.is_variable:
-                av = var_aval(node)
-                if av is not None:
-                    avals[id(node)] = (av,)
-                    shapes[(node.name, "var")] = av.shape
-                    dtypes[(node.name, "var")] = av.dtype
-                continue
+        def eval_node(node):
             od = ops.get(node.op)
             in_avals = []
             unknown_vars = []
@@ -326,6 +336,20 @@ class Symbol:
             for i, av in enumerate(out_avals):
                 shapes[(id(node), i)] = av.shape
                 dtypes[(id(node), i)] = av.dtype
+
+        for node in self.nodes:
+            if node.is_variable:
+                av = var_aval(node)
+                if av is not None:
+                    avals[id(node)] = (av,)
+                    shapes[(node.name, "var")] = av.shape
+                    dtypes[(node.name, "var")] = av.dtype
+                continue
+            try:
+                eval_node(node)
+            except _InferIncomplete:
+                if not partial:
+                    raise
         return shapes, dtypes
 
     # -------------------------------------------------------------- save/load

@@ -1,0 +1,69 @@
+"""One-off probe: chip peak sanity + conv layout comparison (NCHW vs NHWC).
+
+Times (a) a big bf16 matmul against the v5e's 197 TFLOP/s peak, (b) a
+ResNet-50-style conv tower forward+backward in NCHW vs NHWC dimension
+numbers, to find where the MFU is going.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=20):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else None
+    out = fn(*args)
+    jax.block_until_ready(out)
+    tic = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    # pull one byte to defeat any dispatch-side ack
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - tic) / iters
+
+
+def matmul_peak():
+    n = 8192
+    a = jnp.ones((n, n), jnp.bfloat16)
+    b = jnp.ones((n, n), jnp.bfloat16)
+    f = jax.jit(lambda x, y: x @ y)
+    dt = timeit(f, a, b)
+    tf = 2 * n**3 / dt / 1e12
+    print(f"matmul {n}x{n} bf16: {dt*1e3:.2f} ms, {tf:.1f} TFLOP/s")
+
+
+def conv_tower(layout):
+    # a mid-network ResNet block shape at batch 256
+    if layout == "NCHW":
+        dn = ("NCHW", "OIHW", "NCHW")
+        x = jnp.ones((256, 256, 28, 28), jnp.bfloat16)
+        w1 = jnp.ones((256, 256, 3, 3), jnp.bfloat16)
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+        x = jnp.ones((256, 28, 28, 256), jnp.bfloat16)
+        w1 = jnp.ones((3, 3, 256, 256), jnp.bfloat16)
+
+    def f(x, w):
+        def body(x):
+            for _ in range(8):
+                x = jax.lax.conv_general_dilated(
+                    x, w, (1, 1), "SAME", dimension_numbers=dn)
+                x = jax.nn.relu(x)
+            return jnp.sum(x.astype(jnp.float32))
+
+        l, g = jax.value_and_grad(body)(x)
+        return l, g
+
+    jf = jax.jit(f)
+    dt = timeit(jf, x, w1, iters=10)
+    flops = 8 * 2 * 256 * 28 * 28 * 256 * 256 * 9 * 3  # fwd+2bwd
+    print(f"conv tower {layout}: {dt*1e3:.2f} ms, {flops/dt/1e12:.1f} TFLOP/s model")
+
+
+if __name__ == "__main__":
+    print(jax.devices())
+    matmul_peak()
+    conv_tower("NCHW")
+    conv_tower("NHWC")

@@ -1,0 +1,66 @@
+"""One-off probe: capture an xprof trace of the ResNet-50 train step and
+print the top HLO ops by self time (framework_op_stats via xprof)."""
+import glob
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.trainer import FusedTrainer
+
+BATCH = 256
+LOGDIR = "/tmp/mxtpu_prof"
+
+
+def main():
+    net = models.get_symbol("resnet-50", num_classes=1000)
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.1, "momentum": 0.9,
+                                        "rescale_grad": 1.0 / BATCH},
+                      dtype=jnp.bfloat16)
+    tr.init(data=(BATCH, 3, 224, 224))
+    rs = np.random.RandomState(0)
+    batch = {"data": jax.device_put(
+        rs.uniform(0, 1, (BATCH, 3, 224, 224)).astype(np.float32)),
+        "softmax_label": jax.device_put(
+            rs.randint(0, 1000, BATCH).astype(np.float32))}
+
+    def fetch():
+        name = sorted(tr.params)[0]
+        return float(np.asarray(tr.params[name]).ravel()[0])
+
+    for _ in range(3):
+        tr.step(**batch)
+    fetch()
+
+    with jax.profiler.trace(LOGDIR):
+        for _ in range(5):
+            tr.step(**batch)
+        fetch()
+
+    xplanes = glob.glob(os.path.join(LOGDIR, "**", "*.xplane.pb"),
+                        recursive=True)
+    print("xplane files:", xplanes)
+    if not xplanes:
+        return
+    from xprof.convert import raw_to_tool_data as rtd
+
+    for tool in ("framework_op_stats", "hlo_stats"):
+        try:
+            data, _ = rtd.xspace_to_tool_data(xplanes, tool, {})
+            out = os.path.join(LOGDIR, tool + ".out")
+            mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+            with open(out, mode) as f:
+                f.write(data)
+            print("wrote", out, "bytes", len(data))
+        except Exception as exc:  # noqa: BLE001
+            print(tool, "failed:", repr(exc))
+
+
+if __name__ == "__main__":
+    main()

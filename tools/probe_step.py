@@ -1,0 +1,105 @@
+"""One-off probe: where does the ResNet-50 train step spend its time?
+
+Times forward-only, forward+backward, and the full FusedTrainer step at
+the same batch, plus XLA's own cost analysis of the compiled step.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.trainer import FusedTrainer
+
+BATCH = 256
+
+
+def timed(label, fn, fetch, iters=20):
+    fn()
+    fetch()
+    tic = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    fetch()
+    dt = (time.perf_counter() - tic) / iters
+    print(f"{label}: {dt*1e3:.2f} ms/iter, {BATCH/dt:.0f} img/s")
+    return dt
+
+
+def main():
+    net = models.get_symbol("resnet-50", num_classes=1000)
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.1, "momentum": 0.9,
+                                        "rescale_grad": 1.0 / BATCH},
+                      dtype=jnp.bfloat16)
+    tr.init(data=(BATCH, 3, 224, 224))
+    rs = np.random.RandomState(0)
+    batch = {"data": jax.device_put(
+        rs.uniform(0, 1, (BATCH, 3, 224, 224)).astype(np.float32)),
+        "softmax_label": jax.device_put(
+            rs.randint(0, 1000, BATCH).astype(np.float32))}
+
+    def fetch():
+        name = sorted(tr.params)[0]
+        return float(np.asarray(tr.params[name]).ravel()[0])
+
+    # full step
+    dt_full = timed("full step", lambda: tr.step(**batch), fetch)
+
+    # fwd-only (eval path, is_train False)
+    out_box = {}
+
+    def run_eval():
+        out_box["o"] = tr.eval(**batch)
+
+    def fetch_eval():
+        return float(np.asarray(out_box["o"][0]).ravel()[0])
+
+    dt_eval = timed("fwd only (eval)", run_eval, fetch_eval)
+
+    # fwd+bwd without optimizer: grads via value_and_grad of mean loss
+    graph_fn = tr._graph_fn
+    params32 = dict(tr.params)
+    aux = dict(tr.aux)
+    key = jax.random.PRNGKey(0)
+
+    def loss_fn(p, batch):
+        cp = {k: v.astype(jnp.bfloat16) for k, v in p.items()}
+        ca = {k: v.astype(jnp.bfloat16) for k, v in aux.items()}
+        args = dict(cp)
+        args["data"] = batch["data"].astype(jnp.bfloat16)
+        args["softmax_label"] = batch["softmax_label"]
+        outs, _ = graph_fn(args, ca, key, True)
+        return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+    gfn = jax.jit(jax.grad(loss_fn))
+    gbox = {}
+
+    def run_grad():
+        gbox["g"] = gfn(params32, batch)
+
+    def fetch_grad():
+        k = sorted(gbox["g"])[0]
+        return float(np.asarray(gbox["g"][k]).ravel()[0])
+
+    dt_grad = timed("fwd+bwd (no opt)", run_grad, fetch_grad)
+
+    # XLA cost analysis of the full compiled step
+    lowered = tr._step_fn.lower(tr.params, tr.aux, tr.opt_state,
+                                {k: v for k, v in batch.items()}, key)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    flops = ca.get("flops", float("nan"))
+    print(f"XLA flops/step: {flops/1e9:.1f} GFLOP "
+          f"({flops/BATCH/1e9:.2f} GFLOP/img)"
+          f" -> {flops/dt_full/1e12:.1f} TFLOP/s achieved")
+    for key_ in ("bytes accessed", "bytes accessed0{}", "utilization0{}"):
+        if key_ in ca:
+            print(f"  {key_}: {ca[key_]:.3e}")
+
+
+if __name__ == "__main__":
+    main()
