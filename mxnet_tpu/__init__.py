@@ -68,6 +68,8 @@ from .module import Module, BucketingModule, SequentialModule, PythonModule
 from . import monitor
 from . import monitor as mon
 from .monitor import Monitor
+from . import resource
+from .resource import ResourceRequest, ResourceManager
 from . import rnn
 from . import operator
 from . import profiler

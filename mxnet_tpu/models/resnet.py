@@ -57,7 +57,7 @@ def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9):
+           bottle_neck=True, bn_mom=0.9, stem="default"):
     data = sym.Variable("data")
     data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
                          name="bn_data")
@@ -66,6 +66,31 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
         body = sym.Convolution(data, num_filter=filter_list[0], kernel=(3, 3),
                                stride=(1, 1), pad=(1, 1), no_bias=True,
                                name="conv0")
+    elif stem == "s2d":
+        # space-to-depth stem (the MLPerf-ResNet trick): the 7x7/s2 conv on
+        # [N,C,H,W] is reparameterized as a 4x4/s1 conv on the input
+        # rearranged to [N,4C,H/2,W/2] — every weight of the original conv
+        # maps into the (front-zero-padded-to-8) 4x4x4C kernel, so the
+        # function class contains the original exactly.  C=3's terrible MXU
+        # tiling (padded 3->8 sublanes at 224x224) becomes C=12, and the
+        # stride-2 bwd-data conv at 224 resolution disappears.  Symmetric
+        # pad 2 + crop of the trailing row/col realizes the (2,1)
+        # asymmetric padding the reparameterization needs.
+        s2d = sym.Reshape(data, shape=(-1, nchannel, height // 2, 2,
+                                       width // 2, 2))
+        s2d = sym.transpose(s2d, axes=(0, 1, 3, 5, 2, 4))
+        s2d = sym.Reshape(s2d, shape=(-1, nchannel * 4, height // 2,
+                                      width // 2))
+        body = sym.Convolution(s2d, num_filter=filter_list[0], kernel=(4, 4),
+                               stride=(1, 1), pad=(2, 2), no_bias=True,
+                               name="conv0")
+        body = sym.slice(body, begin=(None, None, 0, 0),
+                         end=(None, None, height // 2, width // 2))
+        body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                             name="bn0")
+        body = sym.Activation(body, act_type="relu", name="relu0")
+        body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           pool_type="max")
     else:  # imagenet stem
         body = sym.Convolution(data, num_filter=filter_list[0], kernel=(7, 7),
                                stride=(2, 2), pad=(3, 3), no_bias=True,
@@ -130,4 +155,4 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224), **kwa
             raise ValueError(f"no experiments done on num_layers {num_layers}")
         units = units_map[num_layers]
     return resnet(units, num_stages, filter_list, num_classes, image_shape,
-                  bottle_neck)
+                  bottle_neck, stem=kwargs.get("stem", "default"))

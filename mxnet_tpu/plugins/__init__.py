@@ -8,7 +8,9 @@ its backing library is present:
   torch (parity: plugin/torch/).  Imported automatically when torch is
   installed.
 - WarpCTC is a built-in op (ops/ctc.py) — no plugin needed.
-- OpenCV-based image ops are covered by the PIL pipeline (image.py).
+- ``plugins.opencv_plugin`` — the plugin/opencv surface (imdecode,
+  resize, copyMakeBorder, crop/normalize helpers, ImageListIter) backed
+  by the framework's native/PIL decode instead of libopencv.
 - Caffe / SFrame plugins have no backing libraries in this environment;
   importing them raises with a clear message (the reference gates them
   behind build flags the same way).

@@ -1,0 +1,171 @@
+"""OpenCV-plugin equivalent (parity: plugin/opencv — imdecode / resize /
+copyMakeBorder NDArray functions plus the python augment helpers in
+plugin/opencv/opencv.py).
+
+The reference plugin shells out to libopencv; this image lacks cv2, so
+the kernels ride the framework's own decode path (native libjpeg in
+src/jpeg_decode.cc when built, PIL otherwise — mxnet_tpu/image.py) and
+numpy/PIL for geometry.  API names and flag conventions follow the
+reference so scripts written against ``mx.plugins.opencv`` port over,
+with ONE deliberate deviation: channel order is **RGB** (matching the
+rest of mxnet_tpu's image pipeline), not cv2's BGR — ported scripts
+must flip any BGR-ordered mean/std constants.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import image as _image
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+
+# cv2 flag parity
+INTER_NEAREST = 0
+INTER_LINEAR = 1
+INTER_CUBIC = 2
+BORDER_CONSTANT = 0
+BORDER_REPLICATE = 1
+
+_PIL_INTERP = {INTER_NEAREST: 0, INTER_LINEAR: 2, INTER_CUBIC: 3}
+
+
+def imdecode(str_img, flag=1):
+    """Decode a jpeg/png byte string into an HWC uint8 NDArray.
+    flag=1 color, flag=0 grayscale (cv2.imdecode convention)."""
+    raw = bytes(str_img)
+    img = _image.imdecode_np(raw)  # HWC uint8 (native libjpeg or PIL)
+    if flag == 0:
+        # ITU-R BT.601 luma over RGB-ordered channels
+        img = (img @ np.array([0.299, 0.587, 0.114]))[..., None]
+        img = img.astype(np.uint8)
+    return array(img)
+
+
+def resize(src, size, interpolation=INTER_LINEAR):
+    """Resize HWC image to `size` = (w, h) (cv2 size convention)."""
+    from PIL import Image
+
+    data = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    squeeze = data.shape[-1] == 1
+    pil = Image.fromarray(data.squeeze(-1) if squeeze else data.astype(np.uint8))
+    out = np.asarray(pil.resize(tuple(size),
+                                _PIL_INTERP.get(interpolation, 2)))
+    if squeeze:
+        out = out[..., None]
+    return array(out)
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=BORDER_CONSTANT,
+                   value=0):
+    """Pad an HWC image (cv2.copyMakeBorder)."""
+    data = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    pads = ((top, bot), (left, right), (0, 0))
+    if border_type == BORDER_CONSTANT:
+        out = np.pad(data, pads, constant_values=value)
+    elif border_type == BORDER_REPLICATE:
+        out = np.pad(data, pads, mode="edge")
+    else:
+        raise MXNetError(f"unsupported border_type {border_type}")
+    return array(out)
+
+
+def scale_down(src_size, size):
+    """Parity: opencv.py scale_down — fit (w,h) inside src_size."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interpolation=INTER_CUBIC):
+    """Crop [y0:y0+h, x0:x0+w], optionally resizing to `size`."""
+    data = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = array(data[y0:y0 + h, x0:x0 + w])
+    if size is not None and (w, h) != tuple(size):
+        out = resize(out, size, interpolation)
+    return out
+
+
+def random_crop(src, size, rng=None):
+    """Random crop to (w,h) (scaled down to fit), returns (img, (x0,y0,w,h))."""
+    rng = rng or np.random
+    h, w = (src.shape[0], src.shape[1])
+    new_w, new_h = scale_down((w, h), size)
+    x0 = int(rng.uniform(0, w - new_w + 1))
+    y0 = int(rng.uniform(0, h - new_h + 1))
+    out = fixed_crop(src, x0, y0, new_w, new_h, size)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(img - mean) / std in float32."""
+    data = src.asnumpy().astype(np.float32)
+    data -= np.asarray(mean, np.float32)
+    if std is not None:
+        data /= np.asarray(std, np.float32)
+    return array(data)
+
+
+def random_size_crop(src, size, min_area=0.25, ratio=(3.0 / 4.0, 4.0 / 3.0),
+                     rng=None):
+    """Inception-style area+aspect jittered crop; falls back to
+    random_crop when no candidate fits (parity: opencv.py)."""
+    rng = rng or np.random
+    h, w = src.shape[0], src.shape[1]
+    for _ in range(10):
+        area = h * w
+        target_area = rng.uniform(min_area, 1.0) * area
+        aspect = rng.uniform(*ratio)
+        new_w = int(round(np.sqrt(target_area * aspect)))
+        new_h = int(round(np.sqrt(target_area / aspect)))
+        if rng.uniform(0, 1) < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = int(rng.uniform(0, w - new_w + 1))
+            y0 = int(rng.uniform(0, h - new_h + 1))
+            return fixed_crop(src, x0, y0, new_w, new_h, size), \
+                (x0, y0, new_w, new_h)
+    return random_crop(src, size, rng)
+
+
+class ImageListIter:
+    """Minimal folder+list iterator (parity: opencv.py ImageListIter):
+    decodes with this module, yields NCHW float batches."""
+
+    def __init__(self, root, flist, batch_size, size, mean=None):
+        self.root = root
+        self.list = list(flist)
+        self.batch_size = batch_size
+        self.size = tuple(size)
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import os
+
+        if self.cur + self.batch_size > len(self.list):
+            raise StopIteration
+        batch = np.zeros((self.batch_size, 3, self.size[1], self.size[0]),
+                         np.float32)
+        for i in range(self.batch_size):
+            with open(os.path.join(self.root, self.list[self.cur + i]),
+                      "rb") as f:
+                img = imdecode(f.read())
+            img, _ = random_crop(img, self.size)
+            data = img.asnumpy().astype(np.float32)
+            if self.mean is not None:
+                data -= self.mean
+            batch[i] = data.transpose(2, 0, 1)
+        self.cur += self.batch_size
+        return array(batch)
+
+    next = __next__

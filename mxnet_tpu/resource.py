@@ -1,0 +1,167 @@
+"""Resource manager — per-context shared op resources.
+
+Parity: include/mxnet/resource.h (ResourceRequest/Resource/ResourceManager)
+and src/executor/attach_op_resource_pass.cc.  The reference hands ops two
+resource kinds:
+
+- ``kRandom``: a per-device PRNG stream.  Compiled ops here get pure,
+  replayable subkeys through ``OpCtx.rng()`` (ops/registry.py) — that path
+  IS the kRandom equivalent and needs no manager.  This module serves the
+  host-side consumers (custom ops, data pipeline) with seeded
+  ``numpy.random.Generator`` streams.
+- ``kTempSpace``: resizable scratch memory shared between ops to bound
+  allocator churn.  On TPU the compiled graph's scratch is XLA's problem
+  (buffer assignment), but host-side custom ops (operator.py CustomOp,
+  plugins) still want reusable pinned scratch: here temp space is backed
+  by the native host arena (src/storage.cc) when available, plain numpy
+  otherwise.  ``MXNET_EXEC_NUM_TEMP`` bounds the number of concurrently
+  cached spaces per context, like the reference's round-robin limit
+  (docs/how_to/env_var.md).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .base import MXNetError, get_env
+
+
+class ResourceRequest:
+    """Parity: ResourceRequest::Type (resource.h:18-36)."""
+
+    kRandom = "random"
+    kTempSpace = "temp_space"
+
+    def __init__(self, type):  # noqa: A002 - reference field name
+        if type not in (self.kRandom, self.kTempSpace):
+            raise MXNetError(f"unknown resource type {type!r}")
+        self.type = type
+
+    def __repr__(self):
+        return f"ResourceRequest({self.type})"
+
+
+class Resource:
+    """A granted resource (parity: resource.h Resource).
+
+    For kTempSpace, ``get_space(shape, dtype)`` returns scratch that is
+    REUSED across calls (contents undefined, like the reference's
+    workspace); for kRandom, ``generator()`` returns the seeded stream
+    and ``seed(n)`` reseeds it.
+    """
+
+    def __init__(self, req, ctx, slot):
+        self.req = req
+        self.ctx = ctx
+        self._slot = slot
+        self._lock = threading.Lock()
+        if req.type == ResourceRequest.kRandom:
+            self._gen = np.random.default_rng(0)
+        else:
+            self._buf = None  # grown on demand, never shrunk
+            self._buf_native = False
+
+    # ------------------------------------------------------------- kRandom
+    def generator(self):
+        if self.req.type != ResourceRequest.kRandom:
+            raise MXNetError("not a random resource")
+        return self._gen
+
+    def seed(self, seed):
+        self._gen = np.random.default_rng(seed)
+
+    # ---------------------------------------------------------- kTempSpace
+    def get_space(self, shape, dtype=np.float32):
+        """Scratch ndarray of `shape`; grows the backing block as needed.
+        Parity: Resource::get_space (resource.h:84-100)."""
+        if self.req.type != ResourceRequest.kTempSpace:
+            raise MXNetError("not a temp_space resource")
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+        with self._lock:
+            if self._buf is None or self._buf.nbytes < nbytes:
+                arena = _get_arena()
+                if self._buf is not None and arena is not None \
+                        and self._buf_native:
+                    arena.free(self._buf)  # recycle into the size-class pool
+                if arena is not None:
+                    try:
+                        self._buf = arena.alloc((nbytes,), np.uint8)
+                        self._buf_native = True
+                    except Exception:  # noqa: BLE001 — fallback contract
+                        self._buf = np.empty(nbytes, np.uint8)
+                        self._buf_native = False
+                else:
+                    self._buf = np.empty(nbytes, np.uint8)
+                    self._buf_native = False
+            flat = self._buf[:nbytes].view(dtype)
+        return flat[: int(np.prod(shape))].reshape(shape)
+
+
+_ARENA = None  # shared NativeArena handle; False = unavailable
+
+
+def _get_arena():
+    """Backing storage for temp spaces: the native host arena when built
+    (so grown-away blocks recycle through its pool), else None."""
+    global _ARENA
+    if _ARENA is False:
+        return None
+    if _ARENA is None:
+        try:
+            from . import _native
+
+            _ARENA = _native.NativeArena()
+        except Exception:  # noqa: BLE001 — graceful fallback is the contract
+            _ARENA = False
+            return None
+    return _ARENA
+
+
+class ResourceManager:
+    """Per-context resource registry (parity: ResourceManager::Get()).
+
+    Temp spaces are handed out round-robin over MXNET_EXEC_NUM_TEMP slots
+    (default 1, like the reference) so at most that many scratch blocks
+    exist per context.
+    """
+
+    _instance = None
+    _ilock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._temp = {}  # ctx str -> [Resource]
+        self._rand = {}  # ctx str -> Resource
+        self._rr = {}
+
+    @classmethod
+    def get(cls):
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def request(self, ctx, req):
+        if isinstance(req, str):
+            req = ResourceRequest(req)
+        key = str(ctx)
+        with self._lock:
+            if req.type == ResourceRequest.kRandom:
+                if key not in self._rand:
+                    self._rand[key] = Resource(req, ctx, 0)
+                return self._rand[key]
+            num = max(1, int(get_env("MXNET_EXEC_NUM_TEMP", 1)))
+            slots = self._temp.setdefault(key, [])
+            if len(slots) < num:
+                slots.append(Resource(req, ctx, len(slots)))
+                return slots[-1]
+            self._rr[key] = (self._rr.get(key, -1) + 1) % num
+            return slots[self._rr[key]]
+
+    def seed_random(self, seed):
+        """Parity: MXRandomSeed seeding every device's kRandom stream."""
+        with self._lock:
+            for r in self._rand.values():
+                r.seed(seed)

@@ -117,10 +117,12 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
                                    atol=atol or 1e-2, err_msg=name)
 
 
-def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4):
+def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4,
+                      arg_params=None):
     """Run the same symbol on several contexts and cross-check outputs+grads
     (parity: test_utils.check_consistency :650 — the cpu/gpu harness that
-    becomes cpu/tpu on this stack)."""
+    becomes cpu/tpu on this stack).  arg_params overrides the random fill
+    for specific args (e.g. integer Embedding indices)."""
     results = []
     for spec in ctx_list:
         ctx = spec["ctx"]
@@ -129,6 +131,9 @@ def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4):
         ex = sym.simple_bind(ctx, grad_req="write", **shapes)
         rs = np.random.RandomState(0)
         for k in sorted(ex.arg_dict):
+            if arg_params and k in arg_params:
+                ex.arg_dict[k][:] = np.asarray(arg_params[k], np.float32)
+                continue
             ex.arg_dict[k][:] = (rs.standard_normal(ex.arg_dict[k].shape) * scale).astype(np.float32)
         ex.forward(is_train=True)
         ex.backward([nd.ones(o.shape) for o in ex.outputs])

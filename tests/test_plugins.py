@@ -104,3 +104,53 @@ def test_torch_criterion():
 
 def test_plugin_flag():
     assert plugins.torch_available
+
+
+# --------------------------------------------------------------------------
+# opencv plugin (parity: plugin/opencv — PIL/native-backed here)
+# --------------------------------------------------------------------------
+def test_opencv_imdecode_resize_border():
+    from PIL import Image
+    import io as _io
+
+    from mxnet_tpu.plugins import opencv_plugin as cv
+
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 255, (24, 32, 3), dtype=np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+
+    dec = cv.imdecode(buf.getvalue())
+    assert dec.shape == (24, 32, 3)
+    assert np.array_equal(dec.asnumpy(), img)  # png is lossless
+
+    gray = cv.imdecode(buf.getvalue(), flag=0)
+    assert gray.shape == (24, 32, 1)
+
+    small = cv.resize(dec, (16, 12))
+    assert small.shape == (12, 16, 3)
+
+    padded = cv.copyMakeBorder(dec, 2, 3, 4, 5, value=7)
+    assert padded.shape == (24 + 5, 32 + 9, 3)
+    assert (padded.asnumpy()[:2] == 7).all()
+
+    rep = cv.copyMakeBorder(dec, 1, 0, 0, 0, border_type=cv.BORDER_REPLICATE)
+    assert np.array_equal(rep.asnumpy()[0], img[0])
+
+
+def test_opencv_crops_and_normalize():
+    from mxnet_tpu.plugins import opencv_plugin as cv
+
+    rs = np.random.RandomState(1)
+    img = mx.nd.array(rs.randint(0, 255, (40, 50, 3)).astype(np.uint8))
+    crop = cv.fixed_crop(img, 5, 3, 20, 30)
+    assert crop.shape == (30, 20, 3)
+    out, (x0, y0, w, h) = cv.random_crop(img, (16, 16),
+                                         rng=np.random.RandomState(2))
+    assert out.shape == (16, 16, 3)
+    out2, roi = cv.random_size_crop(img, (16, 16),
+                                    rng=np.random.RandomState(3))
+    assert out2.shape == (16, 16, 3)
+    norm = cv.color_normalize(img, mean=(1.0, 2.0, 3.0), std=(2.0, 2.0, 2.0))
+    expect = (img.asnumpy().astype(np.float32) - [1, 2, 3]) / 2.0
+    assert np.allclose(norm.asnumpy(), expect)

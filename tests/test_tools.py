@@ -7,6 +7,8 @@ import sys
 import numpy as np
 import pytest
 
+import mxnet_tpu as mx
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOLS = os.path.join(REPO, "tools")
 
@@ -85,3 +87,71 @@ def test_kill_dry_run():
                         "--dry-run", "no_such_process_pattern_xyz"],
                        capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stderr
+
+
+# --------------------------------------------------------------------------
+# caffe converter (parity: tools/caffe_converter — self-contained prototxt
+# parser here, no caffe protobuf needed)
+# --------------------------------------------------------------------------
+LENET_PROTOTXT = """
+name: "LeNet"  # comment survives
+layer { name: "data" type: "Input" top: "data"
+        input_param { shape { dim: 2 dim: 1 dim: 28 dim: 28 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+        convolution_param { num_output: 20 kernel_size: 5 stride: 1 } }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+        pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "relu1" type: "ReLU" bottom: "pool1" top: "pool1" }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+        inner_product_param { num_output: 64 } }
+layer { name: "relu2" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "drop" type: "Dropout" bottom: "ip1" top: "ip1"
+        dropout_param { dropout_ratio: 0.3 } }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+        inner_product_param { num_output: 10 } }
+layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+"""
+
+
+def test_caffe_converter_lenet(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import caffe_converter
+
+    net, inputs = caffe_converter.convert_symbol(LENET_PROTOTXT)
+    assert inputs == {"data": (2, 1, 28, 28)}
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 1, 28, 28))
+    rs = np.random.RandomState(0)
+    for k in ex.arg_dict:
+        ex.arg_dict[k][:] = rs.normal(0, 0.1, ex.arg_dict[k].shape)
+    ex.forward(is_train=False)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (2, 10)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+    # CLI writes loadable symbol json
+    proto = tmp_path / "lenet.prototxt"
+    proto.write_text(LENET_PROTOTXT)
+    rc = caffe_converter.main([str(proto), str(tmp_path / "lenet")])
+    assert rc == 0
+    loaded = mx.sym.load(str(tmp_path / "lenet-symbol.json"))
+    assert loaded.list_outputs() == net.list_outputs()
+
+
+def test_caffe_converter_eltwise_concat_bn():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import caffe_converter
+
+    proto = """
+    input: "data" input_dim: 1 input_dim: 4 input_dim: 8 input_dim: 8
+    layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+            convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+    layer { name: "bn1" type: "BatchNorm" bottom: "c1" top: "c1" }
+    layer { name: "sc1" type: "Scale" bottom: "c1" top: "c1" }
+    layer { name: "sum" type: "Eltwise" bottom: "c1" bottom: "data" top: "sum"
+            eltwise_param { operation: SUM } }
+    layer { name: "cat" type: "Concat" bottom: "sum" bottom: "data" top: "cat" }
+    """
+    net, inputs = caffe_converter.convert_symbol(proto)
+    assert inputs == {"data": (1, 4, 8, 8)}
+    _, out_shapes, _ = net.infer_shape(data=(1, 4, 8, 8))
+    assert out_shapes[0] == (1, 8, 8, 8)

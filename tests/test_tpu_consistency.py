@@ -58,13 +58,14 @@ def _run_family(body, timeout=900):
         from mxnet_tpu import sym
         from mxnet_tpu.test_utils import check_consistency
 
-        def CC(net, rtol=2e-2, atol=2e-2, **shapes):
+        def CC(net, rtol=2e-2, atol=2e-2, arg_params=None, **shapes):
             # fp32 on both sides; TPU matmuls run the fp32-parity policy
             # but conv reductions still differ at bf16-ulp scale, hence
             # the loose-but-meaningful tolerances (reference gpu suite
             # uses 1e-1 for fp16 entries)
             ctxs = [dict(ctx=mx.cpu(), **shapes), dict(ctx=mx.tpu(0), **shapes)]
-            check_consistency(net, ctxs, rtol=rtol, atol=atol)
+            check_consistency(net, ctxs, rtol=rtol, atol=atol,
+                              arg_params=arg_params)
     """) + textwrap.dedent(body) + '\nprint("FAMILY OK")\n'
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "MXTPU_PLATFORM", "XLA_FLAGS")}
@@ -119,4 +120,38 @@ def test_tpu_consistency_tensor_ops():
         CC(sym.exp(d) + sym.sqrt(sym.Variable('b') ** 2 + 1.0),
            data=(4, 6), b=(4, 6))
         CC(sym.dot(d, sym.Variable('b')), data=(6, 9), b=(9, 4))
+    """)
+
+
+def test_tpu_consistency_rnn_sequence():
+    _run_family("""
+        cell_net = sym.RNN(sym.Variable('data'), state_size=8, num_layers=1,
+                           mode='lstm', name='rnn')
+        CC(cell_net, data=(5, 2, 6))   # (T, N, C) fused RNN
+        d = sym.Variable('data')
+        CC(sym.SequenceReverse(d), data=(5, 3, 4))
+        CC(sym.SequenceMask(d, use_sequence_length=False, value=0.0),
+           data=(5, 3, 4))
+        CC(sym.SwapAxis(d, dim1=0, dim2=1), data=(5, 3, 4))
+        net = sym.Embedding(sym.Variable('data'), input_dim=11, output_dim=7,
+                            name='embed')
+        idx = np.random.RandomState(3).randint(0, 11, (4, 6))
+        CC(net, arg_params={'data': idx}, data=(4, 6))
+    """)
+
+
+def test_tpu_consistency_norm_reduce_losses():
+    _run_family("""
+        d = sym.Variable('data')
+        CC(sym.L2Normalization(d), data=(4, 9))
+        CC(sym.InstanceNorm(d, sym.Variable('gamma'), sym.Variable('beta'),
+                            name='in'), data=(3, 4, 6, 6), gamma=(4,), beta=(4,))
+        CC(sym.LRN(d, nsize=3), data=(2, 6, 8, 8))
+        CC(sym.softmax(d), data=(5, 11))
+        CC(sym.log_softmax(d), data=(5, 11))
+        CC(sym.mean(d, axis=(1, 2)), data=(3, 5, 7))
+        CC(sym.LinearRegressionOutput(sym.FullyConnected(d, num_hidden=1,
+                                                         name='fc'),
+                                      sym.Variable('label'), name='lro'),
+           data=(8, 5), label=(8, 1))
     """)

@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Caffe prototxt -> mxnet_tpu Symbol converter (parity:
+tools/caffe_converter/convert_symbol.py).
+
+The reference parses deploy.prototxt with caffe's protobuf bindings;
+neither caffe nor caffe.proto exists in this image, so this converter
+ships its own minimal prototxt (protobuf text-format) reader and maps
+the common layer types onto the symbol API:
+
+    Input/Data, Convolution, Deconvolution, InnerProduct, Pooling,
+    ReLU, Sigmoid, TanH, Dropout, LRN, BatchNorm (+Scale), Concat,
+    Eltwise (SUM/PROD/MAX), Flatten, Softmax, SoftmaxWithLoss
+
+Usage::
+
+    python caffe_converter.py deploy.prototxt out_prefix
+    # writes out_prefix-symbol.json
+
+or programmatically: ``net, inputs = convert_symbol(open(f).read())``.
+"""
+import json
+import re
+import sys
+
+# --------------------------------------------------------------------------
+# prototxt (protobuf text format) parser
+# --------------------------------------------------------------------------
+_TOKEN = re.compile(r"""
+    \s*(?:
+        (?P<comment>\#[^\n]*)
+      | (?P<brace>[{}])
+      | (?P<colon>:)
+      | (?P<string>"(?:[^"\\]|\\.)*")
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+      | (?P<number>-?\d+(?:\.\d*)?(?:[eE][+-]?\d+)?)
+    )""", re.VERBOSE)
+
+
+def _tokens(text):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m:
+            if text[pos:].strip() == "":
+                return
+            raise ValueError(f"prototxt parse error at: {text[pos:pos+40]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "comment" or kind is None:
+            continue
+        yield kind, m.group(kind)
+
+
+def parse_prototxt(text):
+    """Parse protobuf text format into nested dicts; repeated fields
+    become lists."""
+    toks = list(_tokens(text))
+    i = 0
+
+    def parse_block():
+        nonlocal i
+        out = {}
+        while i < len(toks):
+            kind, val = toks[i]
+            if kind == "brace" and val == "}":
+                i += 1
+                return out
+            if kind != "ident":
+                raise ValueError(f"expected field name, got {val!r}")
+            field = val
+            i += 1
+            kind, val = toks[i]
+            if kind == "colon":
+                i += 1
+                kind, val = toks[i]
+                if kind == "string":
+                    value = val[1:-1]
+                elif kind == "number":
+                    value = float(val) if ("." in val or "e" in val.lower()) \
+                        else int(val)
+                elif kind == "ident":
+                    value = {"true": True, "false": False}.get(val, val)
+                else:
+                    raise ValueError(f"bad value for {field}: {val!r}")
+                i += 1
+            elif kind == "brace" and val == "{":
+                i += 1
+                value = parse_block()
+            else:
+                raise ValueError(f"expected ':' or '{{' after {field}")
+            if field in out:
+                if not isinstance(out[field], list):
+                    out[field] = [out[field]]
+                out[field].append(value)
+            else:
+                out[field] = value
+        return out
+
+    return parse_block()
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _first_int(param, key, default):
+    v = param.get(key)
+    if v is None:
+        return default
+    return int(_as_list(v)[0])
+
+
+# --------------------------------------------------------------------------
+# layer -> symbol mapping
+# --------------------------------------------------------------------------
+def convert_symbol(prototxt_text):
+    """Returns (output Symbol, {input_name: shape_or_None})."""
+    import os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+        __file__)), ".."))
+    from mxnet_tpu import symbol as sym
+
+    net = parse_prototxt(prototxt_text)
+    layers = _as_list(net.get("layer")) or _as_list(net.get("layers"))
+    blobs = {}
+    inputs = {}
+
+    # old-style top-level input declaration
+    for name, dims in zip(_as_list(net.get("input")),
+                          _as_list(net.get("input_shape"))):
+        shape = tuple(int(d) for d in _as_list(dims.get("dim")))
+        blobs[name] = sym.Variable(name)
+        inputs[name] = shape
+    if "input" in net and "input_dim" in net:
+        name = _as_list(net["input"])[0]
+        dims = tuple(int(d) for d in _as_list(net["input_dim"]))
+        blobs[name] = sym.Variable(name)
+        inputs[name] = dims
+
+    last = None
+    for layer in layers:
+        ltype = str(layer.get("type"))
+        name = layer.get("name", ltype)
+        bottoms = [blobs[b] for b in _as_list(layer.get("bottom"))
+                   if b in blobs]
+        tops = _as_list(layer.get("top")) or [name]
+        data = bottoms[0] if bottoms else None
+
+        if ltype in ("Input", "Data", "MemoryData", "DummyData"):
+            shape = None
+            sp = layer.get("input_param", {}).get("shape") \
+                or layer.get("dummy_data_param", {}).get("shape")
+            if sp:
+                shape = tuple(int(d)
+                              for d in _as_list(_as_list(sp)[0].get("dim")))
+            out = sym.Variable(tops[0])
+            inputs[tops[0]] = shape
+        elif ltype == "Convolution":
+            p = layer.get("convolution_param", {})
+            k = _first_int(p, "kernel_size", 1)
+            out = sym.Convolution(
+                data, num_filter=int(p["num_output"]), kernel=(k, k),
+                stride=(_first_int(p, "stride", 1),) * 2,
+                pad=(_first_int(p, "pad", 0),) * 2,
+                num_group=int(p.get("group", 1)),
+                no_bias=not p.get("bias_term", True), name=name)
+        elif ltype == "Deconvolution":
+            p = layer.get("convolution_param", {})
+            k = _first_int(p, "kernel_size", 1)
+            out = sym.Deconvolution(
+                data, num_filter=int(p["num_output"]), kernel=(k, k),
+                stride=(_first_int(p, "stride", 1),) * 2,
+                pad=(_first_int(p, "pad", 0),) * 2,
+                no_bias=not p.get("bias_term", True), name=name)
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            out = sym.FullyConnected(sym.Flatten(data),
+                                     num_hidden=int(p["num_output"]),
+                                     no_bias=not p.get("bias_term", True),
+                                     name=name)
+        elif ltype == "Pooling":
+            p = layer.get("pooling_param", {})
+            pool = {0: "max", 1: "avg", "MAX": "max", "AVE": "avg"}.get(
+                p.get("pool", 0), "max")
+            if p.get("global_pooling"):
+                out = sym.Pooling(data, global_pool=True, kernel=(1, 1),
+                                  pool_type=pool, name=name)
+            else:
+                k = _first_int(p, "kernel_size", 1)
+                out = sym.Pooling(
+                    data, kernel=(k, k),
+                    stride=(_first_int(p, "stride", 1),) * 2,
+                    pad=(_first_int(p, "pad", 0),) * 2, pool_type=pool,
+                    # caffe pools are ceil-mode; 'full' is the parity
+                    pooling_convention="full", name=name)
+        elif ltype == "ReLU":
+            out = sym.Activation(data, act_type="relu", name=name)
+        elif ltype == "Sigmoid":
+            out = sym.Activation(data, act_type="sigmoid", name=name)
+        elif ltype == "TanH":
+            out = sym.Activation(data, act_type="tanh", name=name)
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            out = sym.Dropout(data, p=float(p.get("dropout_ratio", 0.5)),
+                              name=name)
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = sym.LRN(data, nsize=_first_int(p, "local_size", 5),
+                          alpha=float(p.get("alpha", 1e-4)),
+                          beta=float(p.get("beta", 0.75)), name=name)
+        elif ltype == "BatchNorm":
+            p = layer.get("batch_norm_param", {})
+            # fix_gamma=False: the gamma/beta of the caffe Scale layer that
+            # always follows BatchNorm live here (see Scale folding below)
+            out = sym.BatchNorm(
+                data, use_global_stats=bool(p.get("use_global_stats", True)),
+                eps=float(p.get("eps", 1e-5)), fix_gamma=False, name=name)
+        elif ltype == "Scale":
+            # caffe pairs BatchNorm with a Scale layer for gamma/beta;
+            # BatchNorm(fix_gamma=False) already carries them, so a Scale
+            # directly after a BatchNorm folds into it as identity here
+            out = data
+        elif ltype == "Concat":
+            p = layer.get("concat_param", {})
+            out = sym.Concat(*bottoms, dim=int(p.get("axis", 1)), name=name)
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op = p.get("operation", "SUM")  # str enum or numeric code
+            out = bottoms[0]
+            for b in bottoms[1:]:
+                if op in ("SUM", 1):
+                    out = out + b
+                elif op in ("PROD", 0):
+                    out = out * b
+                elif op in ("MAX", 2):
+                    out = sym.maximum(out, b)
+                else:
+                    raise ValueError(f"unknown Eltwise operation {op!r}")
+        elif ltype == "Flatten":
+            out = sym.Flatten(data, name=name)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            out = sym.SoftmaxOutput(data, name=name)
+        elif ltype in ("Accuracy", "Silence"):
+            continue
+        else:
+            raise ValueError(f"unsupported caffe layer type {ltype!r} "
+                             f"(layer {name})")
+        for top in tops:
+            blobs[top] = out
+        last = out
+    return last, inputs
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2:
+        print("usage: caffe_converter.py deploy.prototxt out_prefix")
+        return 1
+    with open(argv[0]) as f:
+        net, inputs = convert_symbol(f.read())
+    net.save(argv[1] + "-symbol.json")
+    print(json.dumps({"inputs": {k: list(v) if v else None
+                                 for k, v in inputs.items()},
+                      "outputs": net.list_outputs()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
