@@ -70,3 +70,12 @@ def shard_params(mesh: Mesh, params: dict, rules: Sequence[ShardingRule] = ()) -
                 break
         out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
     return out
+
+
+def shard_map(f=None, **kw):
+    """jax.shard_map with the old `check_rep` kwarg accepted (new API
+    spells it `check_vma`); shared by pipeline/moe/ring_attention."""
+    import jax
+
+    kw["check_vma"] = kw.pop("check_rep", kw.pop("check_vma", True))
+    return jax.shard_map(f, **kw) if f is not None else jax.shard_map(**kw)

@@ -1,0 +1,98 @@
+"""Expert parallelism — mixture-of-experts FFN with all_to_all dispatch.
+
+Absent from the reference (SURVEY §2.4 lists expert parallelism as a
+gap); on TPU it is a first-class strategy: experts live on an 'expert'
+mesh axis, tokens are routed by a learned gate, and two
+`jax.lax.all_to_all` collectives carry each token to its expert's device
+and back — the standard Switch-Transformer layout over ICI.
+
+Design (top-1 switch routing, dense dispatch):
+- tokens are sharded over the 'expert' axis ([tokens/world, d_model] per
+  device),
+- gate logits pick expert e*, tokens scatter into a [n_experts,
+  capacity, d_model] buffer (over-capacity tokens drop, like Switch),
+- all_to_all swaps the expert axis with the device axis so each device
+  holds ITS expert's tokens from every peer, runs the expert FFN as one
+  batched matmul (MXU-friendly), and the inverse all_to_all + combine
+  weights scatter results home.
+
+Everything is differentiable: gates get gradients through the combine
+weights, experts through their matmuls.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from .mesh import shard_map
+
+
+def init_moe_params(rng, d_model, d_hidden, n_experts, scale=0.02):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "gate_w": jax.random.normal(k1, (d_model, n_experts)) * scale,
+        "w_in": jax.random.normal(k2, (n_experts, d_model, d_hidden)) * scale,
+        "w_out": jax.random.normal(k3, (n_experts, d_hidden, d_model)) * scale,
+    }
+
+
+def moe_ffn(params, x, mesh: Mesh, axis_name: str = "expert",
+            capacity_factor: float = 1.25, activation=jax.nn.relu):
+    """Apply the expert-parallel FFN.
+
+    x: [tokens, d_model] sharded over `axis_name` on dim 0.
+    params: gate_w [d, E]; w_in [E, d, h] / w_out [E, h, d] sharded over
+    `axis_name` on dim 0 (one expert slice per device; E == axis size).
+    Returns (y [tokens, d_model], aux_loss) — aux_loss is the Switch
+    load-balancing loss, to be added to the task loss.
+    """
+    n_exp = mesh.shape[axis_name]
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None, None), P(axis_name, None, None),
+                       P(axis_name, None, None), P(axis_name, None)),
+             out_specs=(P(axis_name, None), P()),
+             check_rep=False)
+    def run(gate_w, w_in, w_out, xs):
+        nt = xs.shape[0]  # local tokens
+        cap = max(1, int(capacity_factor * nt / n_exp))
+        logits = xs @ gate_w                      # [nt, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)       # [nt]
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+        # position of each token within its expert's capacity bucket
+        onehot = jax.nn.one_hot(expert, n_exp, dtype=xs.dtype)  # [nt, E]
+        pos = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - onehot,
+                                  expert[:, None], axis=1)[:, 0]
+        keep = pos < cap                          # over-capacity drops
+
+        # dense dispatch tensor [nt, E, cap] (Switch/Mesh-TF style)
+        disp = (onehot[:, :, None] *
+                jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                               dtype=xs.dtype)[:, None, :] *
+                keep[:, None, None].astype(xs.dtype))
+        buf = jnp.einsum("tec,td->ecd", disp, xs)  # [E, cap, d]
+
+        # expert axis <-> device axis: after this, dim 0 indexes the PEER
+        # device the tokens came from, and every row belongs to MY expert
+        buf = jax.lax.all_to_all(buf, axis_name, 0, 0, tiled=False)
+        # buf: [world, cap, d] for my expert
+        w1, w2 = w_in[0], w_out[0]
+        h = activation(jnp.einsum("wcd,dh->wch", buf, w1))
+        y = jnp.einsum("wch,hd->wcd", h, w2)
+        y = jax.lax.all_to_all(y, axis_name, 0, 0, tiled=False)  # home again
+
+        # combine: weight by gate prob, scatter back to token order
+        out = jnp.einsum("tec,ecd->td", disp, y) * gate[:, None]
+
+        # Switch load-balancing loss: E * sum_e f_e * P_e
+        frac = jnp.mean(onehot, axis=0)           # fraction routed per expert
+        prob_mean = jnp.mean(probs, axis=0)
+        aux = n_exp * jnp.sum(frac * prob_mean)
+        aux = jax.lax.pmean(aux, axis_name)
+        return out, aux
+
+    return run(params["gate_w"], params["w_in"], params["w_out"], x)

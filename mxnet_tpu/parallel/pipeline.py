@@ -1,0 +1,109 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over a mesh axis.
+
+The reference approximates pipelining with ctx_group placement + the
+dependency engine's opportunistic overlap (docs/how_to/
+model_parallel_lstm.md); there is no scheduled-microbatch pipeline.
+TPU-native design goes further: stages live on a 'pipe' mesh axis, and
+one `shard_map`-wrapped `lax.scan` drives the classic GPipe schedule —
+each tick every device runs its stage on the activation `ppermute`d from
+the previous stage, so the whole pipeline (fill, steady state, drain) is
+ONE XLA program.  Backward falls out of jax autodiff: the transpose of
+ppermute is the reverse rotation, giving the mirror-image backward
+schedule for free.
+
+Shapes:
+- stage parameters are stacked on a leading stage axis and sharded over
+  'pipe' (each device holds its stage's slice),
+- the microbatched input is [n_micro, micro_batch, ...].
+
+`pipeline_apply` returns the last stage's outputs for every microbatch;
+losses/grads compose with jax.value_and_grad around it (see
+tests/test_pipeline_moe.py and __graft_entry__.dryrun_multichip §4).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from .mesh import shard_map
+
+
+def stack_stage_params(per_stage_params):
+    """[{name: array}, ...] -> {name: array stacked on axis 0} (all stages
+    must share parameter structure — the usual 'repeated block' layout)."""
+    names = per_stage_params[0].keys()
+    return {n: jnp.stack([p[n] for p in per_stage_params]) for n in names}
+
+
+def shard_stacked(mesh: Mesh, stacked, axis_name: str = "pipe"):
+    """Place each stage's parameter slice on its pipeline device."""
+    return {
+        n: jax.device_put(
+            v, NamedSharding(mesh, P(axis_name, *([None] * (v.ndim - 1)))))
+        for n, v in stacked.items()
+    }
+
+
+def pipeline_apply(stage_fn, stacked_params, micro_inputs, mesh: Mesh,
+                   axis_name: str = "pipe"):
+    """Run the GPipe schedule; returns [n_micro, ...] last-stage outputs.
+
+    stage_fn(params_slice, x, stage_index) -> y; every stage must map the
+    same activation shape to itself (classic equal-width pipeline).
+    stage_index arrives as a traced scalar — use jnp.where/lax.cond on it
+    for stage-dependent behavior.
+    """
+    n_stages = mesh.shape[axis_name]
+    n_micro = micro_inputs.shape[0]
+    ticks = n_micro + n_stages - 1
+
+    param_specs = {n: P(axis_name, *([None] * (v.ndim - 1)))
+                   for n, v in stacked_params.items()}
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(param_specs, P()),
+             out_specs=P(),
+             check_rep=False)
+    def run(params, xs):
+        # params: {name: [1, ...]} my stage's slice; xs: [n_micro, mb, ...]
+        my = {n: v[0] for n, v in params.items()}
+        stage = jax.lax.axis_index(axis_name)
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        act_shape = xs.shape[1:]
+
+        def tick(carry, t):
+            held = carry  # activation this device just produced
+            # rotate activations one stage forward; stage 0's incoming slot
+            # is then overwritten by the next microbatch (or zeros while
+            # draining)
+            incoming = jax.lax.ppermute(held, axis_name, fwd_perm)
+            feed = jnp.where(
+                t < n_micro,
+                jax.lax.dynamic_index_in_dim(
+                    xs, jnp.minimum(t, n_micro - 1), keepdims=False),
+                jnp.zeros(act_shape, xs.dtype))
+            x_in = jnp.where(stage == 0, feed, incoming)
+            y = stage_fn(my, x_in, stage)
+            # only the last stage's finished ticks are real outputs
+            out = jnp.where(stage == n_stages - 1, y,
+                            jnp.zeros_like(y))
+            return y, out
+
+        _, outs = jax.lax.scan(tick, jnp.zeros(act_shape, xs.dtype),
+                               jnp.arange(ticks))
+        # tick t on the last stage finishes microbatch t-(n_stages-1);
+        # gather those and share them with every stage (losses are
+        # computed replicated)
+        outs = outs[n_stages - 1:]
+        return jax.lax.psum(outs, axis_name)
+
+    return run(stacked_params, micro_inputs)
+
+
+def microbatch(x, n_micro):
+    """[batch, ...] -> [n_micro, batch/n_micro, ...]."""
+    if x.shape[0] % n_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible by {n_micro}")
+    return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])

@@ -1,0 +1,156 @@
+"""Pipeline (GPipe) and expert (MoE) parallelism tests on the virtual
+8-device CPU mesh (conftest.py sets xla_force_host_platform_device_count).
+
+Oracle strategy: the pipelined / expert-sharded computation must match the
+same math run densely on one device.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.parallel.mesh import create_mesh
+from mxnet_tpu.parallel import pipeline as pp
+from mxnet_tpu.parallel import moe as moe_mod
+
+N_STAGES = 4
+N_EXPERTS = 4
+
+
+def _stage_fn(params, x, stage):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_stage_params(rs, width, n_stages):
+    return [{"w": jnp.asarray(rs.normal(0, 0.3, (width, width)).astype(np.float32)),
+             "b": jnp.asarray(rs.normal(0, 0.1, width).astype(np.float32))}
+            for _ in range(n_stages)]
+
+
+def test_pipeline_matches_sequential():
+    rs = np.random.RandomState(0)
+    width, n_micro, mb = 8, 4, 2
+    mesh = create_mesh((N_STAGES,), ("pipe",),
+                       devices=jax.devices("cpu")[:N_STAGES])
+    per_stage = _make_stage_params(rs, width, N_STAGES)
+    stacked = pp.shard_stacked(mesh, pp.stack_stage_params(per_stage))
+    x = rs.normal(size=(n_micro * mb, width)).astype(np.float32)
+
+    outs = pp.pipeline_apply(_stage_fn, stacked, pp.microbatch(jnp.asarray(x), n_micro),
+                             mesh, "pipe")
+    got = np.asarray(outs).reshape(n_micro * mb, width)
+
+    ref = x
+    for p in per_stage:
+        ref = np.tanh(ref @ np.asarray(p["w"]) + np.asarray(p["b"]))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_training_step_matches_dense():
+    """Gradients through the pipeline == gradients of the dense stack."""
+    rs = np.random.RandomState(1)
+    width, n_micro, mb = 6, 4, 2
+    mesh = create_mesh((N_STAGES,), ("pipe",),
+                       devices=jax.devices("cpu")[:N_STAGES])
+    per_stage = _make_stage_params(rs, width, N_STAGES)
+    stacked = pp.stack_stage_params(per_stage)
+    sharded = pp.shard_stacked(mesh, stacked)
+    x = jnp.asarray(rs.normal(size=(n_micro * mb, width)).astype(np.float32))
+    y = jnp.asarray(rs.normal(size=(n_micro * mb, width)).astype(np.float32))
+
+    def pipe_loss(params):
+        out = pp.pipeline_apply(_stage_fn, params,
+                                pp.microbatch(x, n_micro), mesh, "pipe")
+        return jnp.mean((out.reshape(-1, width) - y) ** 2)
+
+    def dense_loss(params):
+        h = x
+        for s in range(N_STAGES):
+            h = _stage_fn({k: v[s] for k, v in params.items()}, h, s)
+        return jnp.mean((h - y) ** 2)
+
+    l1, g1 = jax.value_and_grad(pipe_loss)(sharded)
+    l2, g2 = jax.value_and_grad(dense_loss)(stacked)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for k in g2:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def _dense_moe(params, x, cap):
+    """Single-device oracle replicating top-1 routing with capacity drops."""
+    gate_w = np.asarray(params["gate_w"])
+    w_in = np.asarray(params["w_in"])
+    w_out = np.asarray(params["w_out"])
+    logits = x @ gate_w
+    probs = np.exp(logits - logits.max(1, keepdims=True))
+    probs /= probs.sum(1, keepdims=True)
+    expert = probs.argmax(1)
+    out = np.zeros_like(x)
+    counts = {e: 0 for e in range(w_in.shape[0])}
+    for t in range(x.shape[0]):
+        e = int(expert[t])
+        if counts[e] >= cap:
+            continue
+        counts[e] += 1
+        h = np.maximum(x[t] @ w_in[e], 0.0)
+        out[t] = (h @ w_out[e]) * probs[t, e]
+    return out
+
+
+def test_moe_matches_dense_oracle():
+    rs = np.random.RandomState(2)
+    d, hdim, per_dev = 8, 16, 6
+    mesh = create_mesh((N_EXPERTS,), ("expert",),
+                       devices=jax.devices("cpu")[:N_EXPERTS])
+    params = init_moe_params(rs, d, hdim)
+    x_np = rs.normal(size=(per_dev * N_EXPERTS, d)).astype(np.float32)
+    x = jax.device_put(jnp.asarray(x_np))
+
+    y, aux = moe_mod.moe_ffn(params, x, mesh, "expert", capacity_factor=1.25)
+
+    # IMPORTANT: capacity buckets fill per-device in the sharded impl;
+    # replicate that by running the oracle per device shard
+    got = np.asarray(y)
+    for dev in range(N_EXPERTS):
+        sl = slice(dev * per_dev, (dev + 1) * per_dev)
+        # per-device capacity is computed from local token count
+        local_cap = max(1, int(1.25 * per_dev / N_EXPERTS))
+        ref = _dense_moe(params, x_np[sl], local_cap)
+        np.testing.assert_allclose(got[sl], ref, rtol=1e-4, atol=1e-5)
+    assert np.isfinite(float(aux))
+
+
+def init_moe_params(rs, d, hdim):
+    return {
+        "gate_w": jnp.asarray(rs.normal(0, 0.5, (d, N_EXPERTS)).astype(np.float32)),
+        "w_in": jnp.asarray(rs.normal(0, 0.3, (N_EXPERTS, d, hdim)).astype(np.float32)),
+        "w_out": jnp.asarray(rs.normal(0, 0.3, (N_EXPERTS, hdim, d)).astype(np.float32)),
+    }
+
+
+def test_moe_trains():
+    """Gate + experts receive gradients; a few SGD steps reduce loss."""
+    rs = np.random.RandomState(3)
+    d, hdim, nt = 8, 16, 24
+    mesh = create_mesh((N_EXPERTS,), ("expert",),
+                       devices=jax.devices("cpu")[:N_EXPERTS])
+    params = init_moe_params(rs, d, hdim)
+    x = jnp.asarray(rs.normal(size=(nt, d)).astype(np.float32))
+    tgt = jnp.asarray(rs.normal(size=(nt, d)).astype(np.float32))
+
+    def loss_fn(p):
+        y, aux = moe_mod.moe_ffn(p, x, mesh, "expert")
+        return jnp.mean((y - tgt) ** 2) + 0.01 * aux
+
+    step = jax.jit(lambda p: (loss_fn(p), jax.grad(loss_fn)(p)))
+    losses = []
+    for _ in range(12):
+        l, g = step(params)
+        losses.append(float(l))
+        assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+        params = {k: v - 0.3 * g[k] for k, v in params.items()}
+    assert losses[-1] < losses[0]
+    # the gate must actually be learning (nonzero grads)
+    _, g = step(params)
+    assert float(jnp.abs(g["gate_w"]).max()) > 0
