@@ -7,10 +7,11 @@ aligned to record boundaries, so every worker reads only its slice of a
 dataset that may live on a remote store.
 
 Design here: a scheme registry mapping ``scheme://`` to a FileSystem
-implementation.  Local paths are built in; remote schemes (s3/hdfs/gs)
-raise a targeted error until an adapter is registered — this image has no
-egress, so the contract is exercised by an in-process ``mem://``
-filesystem in the tests, exactly how dmlc-core unit-tests InputSplit.
+implementation.  Built in: local paths, ``mem://`` (in-process, the
+dmlc-core unit-test pattern), and ``http(s)://`` byte-range reads — the
+access pattern of every object store (S3/GCS/WebHDFS all serve Range
+requests; point their presigned/REST URLs here).  Other schemes raise a
+targeted error until an adapter is registered.
 
 Byte-range splitting follows dmlc's recipe (input_split_base.cc): cut the
 total byte span into ``num_parts`` even ranges, then align each boundary
@@ -111,10 +112,144 @@ class MemFileSystem(FileSystem):
         return hits if hits else [pattern]
 
 
+class HttpFileSystem(FileSystem):
+    """HTTP(S) byte-range filesystem — the working model of every remote
+    object store the reference reaches through dmlc-core (S3, GCS, and
+    WebHDFS all expose exactly this Range interface; presigned URLs work
+    too, since size discovery falls back from HEAD to a 1-byte Range GET).
+    Reads are lazy and buffered: `read` fetches block_size-aligned spans
+    with a Range header, so small sequential reads (RecordIO headers)
+    cost one round trip per block, and InputSplit shards pull just their
+    slice of a remote file.  Servers that ignore Range (plain 200) are
+    handled by downloading the body once and serving reads from cache."""
+
+    def __init__(self, block_size: int = 1 << 20, timeout: float = 60.0):
+        self.block_size = block_size
+        self.timeout = timeout
+
+    class _RangeFile(io.RawIOBase):
+        def __init__(self, fs, url, size):
+            self._fs = fs
+            self._url = url
+            self._size = size
+            self._pos = 0
+            self._buf = b""       # last fetched block
+            self._buf_lo = 0
+            self._whole = None    # full body cache (non-Range servers)
+
+        def seekable(self):
+            return True
+
+        def readable(self):
+            return True
+
+        def seek(self, off, whence=io.SEEK_SET):
+            if whence == io.SEEK_SET:
+                self._pos = off
+            elif whence == io.SEEK_CUR:
+                self._pos += off
+            else:
+                self._pos = self._size + off
+            return self._pos
+
+        def tell(self):
+            return self._pos
+
+        def _fetch(self, lo, hi):
+            """[lo, hi) from the server; populates _whole on 200."""
+            import urllib.request
+
+            req = urllib.request.Request(self._url, headers={
+                "Range": f"bytes={lo}-{hi - 1}"})
+            with urllib.request.urlopen(req,
+                                        timeout=self._fs.timeout) as r:
+                data = r.read()
+                if r.status != 206:
+                    # server ignored Range: it sent the whole body — keep
+                    # it so later reads cost no further transfers
+                    self._whole = data
+                    return data[lo:hi]
+            return data
+
+        def read(self, n=-1):
+            if n is None or n < 0:
+                n = self._size - self._pos
+            n = min(n, self._size - self._pos)
+            if n <= 0:
+                return b""
+            if self._whole is not None:
+                out = self._whole[self._pos:self._pos + n]
+                self._pos += len(out)
+                return out
+            lo, hi = self._pos, self._pos + n
+            blo, bhi = self._buf_lo, self._buf_lo + len(self._buf)
+            if not (blo <= lo and hi <= bhi):
+                # block-aligned read-ahead: one round trip covers many
+                # small sequential reads (RecordIO header/payload/pad)
+                bs = max(self._fs.block_size, n)
+                fetch_lo = lo
+                fetch_hi = min(lo + bs, self._size)
+                self._buf = self._fetch(fetch_lo, fetch_hi)
+                self._buf_lo = fetch_lo
+                if self._whole is not None:
+                    return self.read(n)
+                blo = fetch_lo
+            out = self._buf[lo - blo:lo - blo + n]
+            self._pos += len(out)
+            return out
+
+    def open(self, path, mode="rb"):
+        if "w" in mode or "a" in mode:
+            raise MXNetError("http filesystem is read-only")
+        return self._RangeFile(self, path, self.size(path))
+
+    def size(self, path):
+        import urllib.error
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(path, method="HEAD")
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                cl = r.headers["Content-Length"]
+                if cl is not None:
+                    return int(cl)
+        except (urllib.error.URLError, OSError):
+            pass  # presigned URLs often sign GET only — fall through
+        try:
+            # 1-byte Range GET: Content-Range carries the total size
+            req = urllib.request.Request(path,
+                                         headers={"Range": "bytes=0-0"})
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                cr = r.headers.get("Content-Range")  # "bytes 0-0/12345"
+                if cr and "/" in cr:
+                    return int(cr.rsplit("/", 1)[1])
+                cl = r.headers.get("Content-Length")
+                if r.status == 200 and cl is not None:
+                    return int(cl)  # server sent the whole body
+        except (urllib.error.URLError, OSError) as exc:
+            raise MXNetError(f"http filesystem: cannot reach {path!r}: "
+                             f"{exc}") from exc
+        raise MXNetError(f"http filesystem: server for {path!r} reports "
+                         "no Content-Length/Content-Range; cannot do "
+                         "ranged reads over a chunked stream")
+
+    def exists(self, path):
+        try:
+            self.size(path)
+            return True
+        except MXNetError:
+            return False
+
+    def list(self, pattern):
+        return [pattern]  # no server-side listing over plain HTTP
+
+
 _REGISTRY: Dict[str, FileSystem] = {
     "": LocalFileSystem(),
     "file": LocalFileSystem(),
     "mem": MemFileSystem(),
+    "http": HttpFileSystem(),
+    "https": HttpFileSystem(),
 }
 
 

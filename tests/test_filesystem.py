@@ -147,3 +147,150 @@ def test_image_record_iter_over_memfs():
         assert n_batches >= len(it.records) // 3
     # byte-range shards partition the 12 records exactly, no dup/loss
     assert sorted(seen) == list(range(12))
+
+
+def test_http_filesystem_inputsplit(tmp_path):
+    """Remote byte-range sharding over a real network protocol: an
+    InputSplit pulls only its slice of a .rec served by loopback HTTP —
+    the S3/GCS access pattern without egress."""
+    import functools
+    import http.server
+    import threading
+
+    from mxnet_tpu.filesystem import InputSplit, get_filesystem
+
+    # build a local recordio file
+    rec_path = tmp_path / "data.rec"
+    w = recordio.MXRecordIO(str(rec_path), "w")
+    payloads = [bytes([i]) * (50 + 13 * i) for i in range(30)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    class RangeHandler(http.server.SimpleHTTPRequestHandler):
+        """SimpleHTTPRequestHandler ignores Range; object stores honor
+        it — emulate the 206 path so the test proves partial reads."""
+
+        def send_head(self):
+            rng = self.headers.get("Range")
+            if not rng:
+                return super().send_head()
+            path = self.translate_path(self.path)
+            data = open(path, "rb").read()
+            lo, hi = rng.split("=")[1].split("-")
+            lo, hi = int(lo), min(int(hi), len(data) - 1)
+            body = data[lo:hi + 1]
+            self.send_response(206)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Content-Range",
+                             f"bytes {lo}-{hi}/{len(data)}")
+            self.end_headers()
+            import io as _io
+            return _io.BytesIO(body)
+
+        def log_message(self, *a):
+            pass
+
+    handler = functools.partial(RangeHandler, directory=str(tmp_path))
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/data.rec"
+        fs = get_filesystem(url)
+        assert fs.exists(url)
+        assert fs.size(url) == rec_path.stat().st_size
+
+        seen = []
+        for part in range(3):
+            seen += list(InputSplit(url, part_index=part, num_parts=3,
+                                    split_type="recordio"))
+        assert sorted(seen, key=payloads.index) == payloads
+        assert len(seen) == len(payloads)
+
+        # ranged read really is partial: a 1-part split of part 2 reads
+        # only its byte range
+        f = fs.open(url)
+        f.seek(10)
+        chunk = f.read(16)
+        assert chunk == rec_path.read_bytes()[10:26]
+    finally:
+        srv.shutdown()
+
+
+def test_http_filesystem_server_without_range_support(tmp_path):
+    """A server that ignores Range (plain SimpleHTTPRequestHandler) must
+    still yield correct shards — the client slices the full body."""
+    import functools
+    import http.server
+    import threading
+
+    from mxnet_tpu.filesystem import InputSplit
+
+    rec_path = tmp_path / "d.rec"
+    w = recordio.MXRecordIO(str(rec_path), "w")
+    payloads = [bytes([i]) * 40 for i in range(10)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    handler = functools.partial(http.server.SimpleHTTPRequestHandler,
+                                directory=str(tmp_path))
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/d.rec"
+        seen = []
+        for part in range(2):
+            seen += list(InputSplit(url, part_index=part, num_parts=2))
+        assert seen == payloads
+    finally:
+        srv.shutdown()
+
+
+def test_http_filesystem_head_rejected(tmp_path):
+    """Presigned-URL pattern: server rejects HEAD (405) but serves Range
+    GETs — size discovery must fall back to a 1-byte Range request."""
+    import functools
+    import http.server
+    import threading
+
+    from mxnet_tpu.filesystem import get_filesystem
+
+    (tmp_path / "x.bin").write_bytes(bytes(range(100)))
+
+    class GetOnlyRange(http.server.SimpleHTTPRequestHandler):
+        def do_HEAD(self):
+            self.send_error(405)
+
+        def send_head(self):
+            rng = self.headers.get("Range")
+            if not rng:
+                return super().send_head()
+            data = open(self.translate_path(self.path), "rb").read()
+            lo, hi = rng.split("=")[1].split("-")
+            lo, hi = int(lo), min(int(hi), len(data) - 1)
+            body = data[lo:hi + 1]
+            self.send_response(206)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Content-Range", f"bytes {lo}-{hi}/{len(data)}")
+            self.end_headers()
+            import io as _io
+            return _io.BytesIO(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(
+        ("127.0.0.1", 0), functools.partial(GetOnlyRange,
+                                            directory=str(tmp_path)))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/x.bin"
+        fs = get_filesystem(url)
+        assert fs.size(url) == 100
+        f = fs.open(url)
+        f.seek(10)
+        assert f.read(5) == bytes(range(10, 15))
+        assert fs.exists(url)
+        assert not fs.exists(url + ".nope")
+    finally:
+        srv.shutdown()
