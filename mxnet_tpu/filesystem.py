@@ -126,6 +126,7 @@ class HttpFileSystem(FileSystem):
     def __init__(self, block_size: int = 1 << 20, timeout: float = 60.0):
         self.block_size = block_size
         self.timeout = timeout
+        self._size_cache: Dict[str, int] = {}
 
     class _RangeFile(io.RawIOBase):
         def __init__(self, fs, url, size):
@@ -207,12 +208,20 @@ class HttpFileSystem(FileSystem):
         import urllib.error
         import urllib.request
 
+        cached = self._size_cache.get(path)
+        if cached is not None:
+            return cached
+
+        def done(n):
+            self._size_cache[path] = n
+            return n
+
         try:
             req = urllib.request.Request(path, method="HEAD")
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 cl = r.headers["Content-Length"]
                 if cl is not None:
-                    return int(cl)
+                    return done(int(cl))
         except (urllib.error.URLError, OSError):
             pass  # presigned URLs often sign GET only — fall through
         try:
@@ -221,17 +230,18 @@ class HttpFileSystem(FileSystem):
                                          headers={"Range": "bytes=0-0"})
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 cr = r.headers.get("Content-Range")  # "bytes 0-0/12345"
-                if cr and "/" in cr:
-                    return int(cr.rsplit("/", 1)[1])
+                total = cr.rsplit("/", 1)[1] if cr and "/" in cr else None
+                if total and total != "*":  # '*' = RFC 7233 unknown length
+                    return done(int(total))
                 cl = r.headers.get("Content-Length")
                 if r.status == 200 and cl is not None:
-                    return int(cl)  # server sent the whole body
-        except (urllib.error.URLError, OSError) as exc:
+                    return done(int(cl))  # server sent the whole body
+        except (urllib.error.URLError, OSError, ValueError) as exc:
             raise MXNetError(f"http filesystem: cannot reach {path!r}: "
                              f"{exc}") from exc
         raise MXNetError(f"http filesystem: server for {path!r} reports "
-                         "no Content-Length/Content-Range; cannot do "
-                         "ranged reads over a chunked stream")
+                         "no usable Content-Length/Content-Range; cannot "
+                         "do ranged reads over a chunked stream")
 
     def exists(self, path):
         try:

@@ -84,7 +84,7 @@ class FusedTrainer:
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  optimizer="sgd", optimizer_params=None, mesh: Optional[Mesh] = None,
                  initializer=None, dtype=jnp.float32, sharding_rules=(),
-                 remat=None):
+                 remat=None, fixed_param_names=()):
         # rematerialization = the reference's MXNET_BACKWARD_DO_MIRROR
         # (recompute activations in backward, env_var.md:55-57) — on TPU
         # it is jax.checkpoint around the forward.  Default follows the
@@ -104,6 +104,11 @@ class FusedTrainer:
                              f"use Module for {optimizer}")
         self._init_state, self._update = _RULES[optimizer](opt_params)
         self._sharding_rules = tuple(sharding_rules)
+        # params excluded from the vjp: XLA prunes their whole gradient
+        # subgraph (Module parity: fixed_param_names; e.g. frozen trunks)
+        if isinstance(fixed_param_names, str):
+            fixed_param_names = (fixed_param_names,)
+        self._fixed = frozenset(fixed_param_names)
         self._initializer = initializer or Uniform(0.01)
         self._graph_fn = _build_graph_fn(symbol)
         self.params: Dict[str, jax.Array] = {}
@@ -130,7 +135,14 @@ class FusedTrainer:
         if self.mesh is not None:
             # tensor-parallel rules shard matching params; rest replicate
             self.params = shard_params(self.mesh, self.params, self._sharding_rules)
+        unknown = self._fixed - set(self.params)
+        if unknown:
+            raise MXNetError(f"fixed_param_names not in the model: "
+                             f"{sorted(unknown)} (have "
+                             f"{sorted(self.params)[:8]}...)")
         for name, raw in self.params.items():
+            if name in self._fixed:
+                continue
             self.opt_state[name] = tuple(
                 jax.device_put(s, raw.sharding) if self.mesh is not None else s
                 for s in self._init_state(raw)
@@ -151,6 +163,8 @@ class FusedTrainer:
         dtype = self.dtype
         data_names = self.data_names
         label_names = self.label_names
+
+        fixed = self._fixed
 
         def train_step(params, aux, opt_state, batch, key):
             compute_params = {
@@ -174,7 +188,9 @@ class FusedTrainer:
 
             if self.remat:
                 fwd = jax.checkpoint(fwd)
-            (outs, new_aux), vjp_fn = jax.vjp(fwd, compute_params)
+            trainable = {k: v for k, v in compute_params.items()
+                         if k not in fixed}
+            (outs, new_aux), vjp_fn = jax.vjp(fwd, trainable)
             head = [jnp.ones(o.shape, o.dtype) for o in outs]
             aux_cot = jax.tree_util.tree_map(jnp.zeros_like, new_aux)
             (grads,) = vjp_fn((head, aux_cot))
@@ -182,6 +198,9 @@ class FusedTrainer:
             new_params = {}
             new_opt = {}
             for k, w in params.items():
+                if k in fixed:
+                    new_params[k] = w
+                    continue
                 g = grads[k].astype(jnp.float32)
                 nw, ns = update(w, g, opt_state[k])
                 new_params[k] = nw

@@ -1,0 +1,202 @@
+"""Optimizer tests vs numpy oracles (parity model: tests/python/
+unittest/test_optimizer.py — every registered optimizer's update rule is
+cross-checked against an independent numpy implementation, plus the
+lr/wd multiplier, clipping, scheduler, and updater-state machinery)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+RS = np.random.RandomState(0)
+
+
+def _step(o, w0, g, steps=3, index=0):
+    w = mx.nd.array(w0.copy())
+    state = o.create_state(index, w)
+    for _ in range(steps):
+        o.update(index, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_momentum_oracle():
+    w0 = RS.normal(size=(5,)).astype(np.float32)
+    g = RS.normal(size=(5,)).astype(np.float32)
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.01,
+                   rescale_grad=0.5)
+    got = _step(o, w0, g, steps=4)
+    w, mom = w0.copy(), np.zeros_like(w0)
+    for _ in range(4):
+        gg = 0.5 * g + 0.01 * w
+        mom = 0.9 * mom - 0.1 * gg
+        w = w + mom
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_clip_gradient():
+    w0 = np.zeros(3, np.float32)
+    g = np.array([10.0, -10.0, 0.5], np.float32)
+    o = opt.create("sgd", learning_rate=1.0, clip_gradient=1.0)
+    got = _step(o, w0, g, steps=1)
+    np.testing.assert_allclose(got, [-1.0, 1.0, -0.5], rtol=1e-6)
+
+
+def test_nag_oracle():
+    w0 = RS.normal(size=(4,)).astype(np.float32)
+    g = RS.normal(size=(4,)).astype(np.float32)
+    o = opt.create("nag", learning_rate=0.05, momentum=0.8, wd=0.0)
+    got = _step(o, w0, g, steps=3)
+    w, mom = w0.copy(), np.zeros_like(w0)
+    for _ in range(3):
+        gg = g.copy()
+        mom = 0.8 * mom + gg
+        w = w - 0.05 * (gg + 0.8 * mom)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_bias_correction_oracle():
+    w0 = RS.normal(size=(6,)).astype(np.float32)
+    g = RS.normal(size=(6,)).astype(np.float32)
+    o = opt.create("adam", learning_rate=0.01)
+    got = _step(o, w0, g, steps=5)
+    w = w0.copy().astype(np.float64)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, 6):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        w = w - 0.01 * mhat / (np.sqrt(vhat) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_adagrad_oracle():
+    w0 = RS.normal(size=(4,)).astype(np.float32)
+    g = RS.normal(size=(4,)).astype(np.float32)
+    o = opt.create("adagrad", learning_rate=0.1)
+    got = _step(o, w0, g, steps=3)
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for _ in range(3):
+        h += g * g
+        w = w - 0.1 * g / np.sqrt(h + 1e-7)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop_runs_and_descends():
+    w0 = np.full(8, 5.0, np.float32)
+    # gradient of f(w)=0.5*w^2 is w — repeated updates must shrink |w|
+    o = opt.create("rmsprop", learning_rate=0.05)
+    w = mx.nd.array(w0)
+    state = o.create_state(0, w)
+    for _ in range(30):
+        o.update(0, w, w.copy(), state)
+    assert np.abs(w.asnumpy()).max() < 5.0
+
+
+def test_adadelta_and_dcasgd_descend():
+    for name in ("adadelta", "dcasgd"):
+        o = opt.create(name, learning_rate=0.1)
+        w = mx.nd.array(np.full(6, 3.0, np.float32))
+        state = o.create_state(0, w)
+        for _ in range(40):
+            o.update(0, w, w.copy(), state)
+        assert np.abs(w.asnumpy()).max() < 3.0, name
+
+
+def test_sgld_adds_noise_with_descent():
+    mx.random.seed(0)
+    o = opt.create("sgld", learning_rate=0.01)
+    w = mx.nd.array(np.zeros(2000, np.float32))
+    o.update(0, w, mx.nd.array(np.zeros(2000, np.float32)), None)
+    vals = w.asnumpy()
+    # pure noise step: mean ~0, std ~sqrt(lr)
+    assert abs(vals.mean()) < 0.02
+    assert abs(vals.std() - np.sqrt(0.01)) < 0.02
+
+
+def test_test_optimizer_is_deterministic_sgd():
+    # the reference's Test optimizer: plain w -= lr * rescale * grad
+    w0 = RS.normal(size=(4,)).astype(np.float32)
+    g = RS.normal(size=(4,)).astype(np.float32)
+    o = opt.create("test", rescale_grad=2.0)
+    got = _step(o, w0, g, steps=2)
+    assert not np.allclose(got, w0)
+
+
+def test_lr_wd_mult_and_idx2name():
+    # bias params get wd_mult 0 by default (reference set_wd_mult rule)
+    o = opt.create("sgd", learning_rate=1.0, wd=0.5,
+                   param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    w = mx.nd.array(np.ones(2, np.float32))
+    b = mx.nd.array(np.ones(2, np.float32))
+    zero_g = mx.nd.array(np.zeros(2, np.float32))
+    o.update(0, w, zero_g, None)
+    o.update(1, b, zero_g, None)
+    np.testing.assert_allclose(w.asnumpy(), [0.5, 0.5])  # decayed
+    np.testing.assert_allclose(b.asnumpy(), [1.0, 1.0])  # bias: no decay
+    # explicit lr_mult via set_lr_mult
+    o2 = opt.create("sgd", learning_rate=1.0,
+                    param_idx2name={0: "a", 1: "b"})
+    o2.set_lr_mult({"b": 0.0})
+    wa = mx.nd.array(np.zeros(1, np.float32))
+    wb = mx.nd.array(np.zeros(1, np.float32))
+    one_g = mx.nd.array(np.ones(1, np.float32))
+    o2.update(0, wa, one_g, None)
+    o2.update(1, wb, one_g, None)
+    assert wa.asnumpy()[0] != 0.0
+    assert wb.asnumpy()[0] == 0.0
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    o = opt.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.array(np.zeros(1, np.float32))
+    g = mx.nd.array(np.ones(1, np.float32))
+    deltas = []
+    prev = 0.0
+    for _ in range(6):
+        o.update(0, w, g, None)
+        cur = float(w.asnumpy()[0])
+        deltas.append(prev - cur)
+        prev = cur
+    # steps 1-2 at lr 1.0, 3-4 at 0.5, 5-6 at 0.25
+    np.testing.assert_allclose(deltas, [1.0, 1.0, 0.5, 0.5, 0.25, 0.25],
+                               rtol=1e-5)
+
+
+def test_multifactor_scheduler():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[2, 4], factor=0.1)
+    assert sched(1) == pytest.approx(0.01)
+    sched.base_lr = 1.0
+    assert sched(1) == pytest.approx(1.0)
+    assert sched(3) == pytest.approx(0.1)
+    assert sched(5) == pytest.approx(0.01)
+
+
+def test_get_updater_state_roundtrip(tmp_path):
+    # Updater carries per-index states and pickles them (Module
+    # save_optimizer_states path)
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    w = mx.nd.array(np.ones(3, np.float32))
+    g = mx.nd.array(np.ones(3, np.float32))
+    upd(0, g, w)
+    upd(0, g, w)
+    blob = upd.get_states()
+    o2 = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    upd2 = opt.get_updater(o2)
+    upd2.set_states(blob)
+    w2 = mx.nd.array(w.asnumpy())
+    upd(0, g, w)
+    upd2(0, g, w2)
+    np.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_registry_has_all_ten():
+    for name in ("sgd", "nag", "sgld", "ccsgd", "adam", "adagrad",
+                 "rmsprop", "adadelta", "dcasgd", "test"):
+        o = opt.create(name)
+        assert isinstance(o, opt.Optimizer), name

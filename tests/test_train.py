@@ -74,3 +74,35 @@ def test_adam_and_schedulers_converge():
             optimizer="adam",
             optimizer_params={"learning_rate": 1e-3, "lr_scheduler": sched})
     assert mod.score(val, "acc")[0][1] > 0.9
+
+
+def test_fused_trainer_fixed_param_names():
+    """Fixed params: unchanged by steps, no optimizer state, and the
+    trainable subset still learns (Module fixed_param_names parity on the
+    fused path)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.trainer import FusedTrainer
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Activation(
+                mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc1",
+                                      num_hidden=16),
+                act_type="relu"),
+            name="fc2", num_hidden=4),
+        name="softmax")
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.5},
+                      fixed_param_names=["fc1_weight", "fc1_bias"])
+    tr.init(data=(8, 10))
+    frozen_w = np.asarray(tr.params["fc1_weight"]).copy()
+    live_w = np.asarray(tr.params["fc2_weight"]).copy()
+    assert "fc1_weight" not in tr.opt_state
+    rs = np.random.RandomState(0)
+    for _ in range(3):
+        tr.step(data=rs.uniform(size=(8, 10)).astype(np.float32),
+                softmax_label=rs.randint(0, 4, 8).astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(tr.params["fc1_weight"]),
+                                  frozen_w)
+    assert not np.allclose(np.asarray(tr.params["fc2_weight"]), live_w)
