@@ -228,3 +228,99 @@ def random_seed(seed):
     from . import random as _random
 
     _random.seed(int(seed))
+
+
+# ------------------------------------------------------------ imperative
+def imperative_invoke(op_name, inputs, keys, vals):
+    """MXImperativeInvoke: run a registered op imperatively on NDArray
+    handles; returns the list of output NDArrays."""
+    from . import ndarray as nd
+    from . import ops as _ops
+
+    # only REGISTERED ops: a bare getattr would expose every module
+    # attribute (classes, helpers, np/jax) to the C ABI
+    if op_name not in _ops.list_ops():
+        raise ValueError(f"unknown imperative op {op_name!r}")
+    fn = getattr(nd, op_name, None)
+    if fn is None:
+        raise ValueError(f"op {op_name!r} has no imperative binding")
+    attrs = dict(zip(keys, vals))
+    out = fn(*inputs, **attrs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# -------------------------------------------------------------- data iter
+class _IterState:
+    __slots__ = ("it", "batch")
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def _parse_iter_val(v):
+    import ast
+
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def _iter_registry():
+    from . import io as mio
+
+    return {
+        "MNISTIter": mio.MNISTIter,
+        "CSVIter": mio.CSVIter,
+        "ImageRecordIter": mio.ImageRecordIter,
+    }
+
+
+def list_data_iters():
+    return sorted(_iter_registry())
+
+
+def data_iter_create(name, keys, vals):
+    reg = _iter_registry()
+    if name not in reg:
+        raise ValueError(f"unknown iterator {name!r}; have {sorted(reg)}")
+    kwargs = {k: _parse_iter_val(v) for k, v in zip(keys, vals)}
+    return _IterState(reg[name](**kwargs))
+
+
+def data_iter_next(state):
+    try:
+        state.batch = state.it.next()
+        return 1
+    except StopIteration:
+        state.batch = None
+        return 0
+
+
+def data_iter_before_first(state):
+    state.it.reset()
+    state.batch = None
+
+
+def _batch_part(state, part):
+    if state.batch is None:
+        raise ValueError("no current batch; call MXDataIterNext first")
+    arrs = getattr(state.batch, part)
+    if not arrs:
+        raise ValueError(f"batch has no {part}")
+    return arrs[0]
+
+
+def data_iter_data(state):
+    return _batch_part(state, "data")
+
+
+def data_iter_label(state):
+    return _batch_part(state, "label")
+
+
+def data_iter_pad(state):
+    if state.batch is None:
+        raise ValueError("no current batch; call MXDataIterNext first")
+    return int(state.batch.pad or 0)

@@ -6,7 +6,8 @@ examples.  Each get_symbol returns a Symbol ending in SoftmaxOutput named
 'softmax', matching the reference training scripts' expectations.
 """
 from . import (mlp, lenet, alexnet, vgg, googlenet, inception_bn,
-               inception_v3, inception_resnet, resnet, resnext, lstm, ssd)
+               inception_v3, inception_resnet, resnet, resnext, lstm, ssd,
+               transformer)
 
 
 def get_symbol(name, num_classes=1000, **kwargs):
@@ -23,6 +24,7 @@ def get_symbol(name, num_classes=1000, **kwargs):
         "resnet": resnet.get_symbol,
         "resnext": resnext.get_symbol,
         "ssd-vgg16": ssd.get_symbol_train,
+        "transformer-lm": transformer.get_symbol,
     }
     if name.startswith("resnet-"):
         return resnet.get_symbol(num_classes, num_layers=int(name.split("-")[1]), **kwargs)

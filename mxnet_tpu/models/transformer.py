@@ -1,0 +1,74 @@
+"""Decoder-only Transformer language model (beyond-reference: the
+reference's sequence story tops out at bucketed LSTMs, SURVEY.md §5.7).
+
+Built from the same symbol API as every other model-zoo entry, with the
+long-context pieces this framework treats as first-class: causal
+FlashAttention (Pallas kernel, ops/flash_attention.py) inside the block,
+LayerNorm/gelu (ops/nn.py), and — for sequence lengths beyond one chip —
+the same attention math is available sharded over a mesh via
+parallel/ring_attention.py.
+
+`transformer_lm(...)` returns the training symbol; pair it with
+FusedTrainer for the fused train step (examples/transformer-lm/).
+"""
+from .. import symbol as sym
+
+
+def _attention_block(h, seq_len, d_model, num_heads, name):
+    dh = d_model // num_heads
+    ln = sym.LayerNorm(h, name=f"{name}_ln1")
+    x2 = sym.Reshape(ln, shape=(-1, d_model))
+    qkv = sym.FullyConnected(x2, num_hidden=3 * d_model, name=f"{name}_qkv")
+    qkv = sym.Reshape(qkv, shape=(-1, seq_len, 3 * d_model))
+
+    def heads(idx):
+        p = sym.slice_axis(qkv, axis=2, begin=idx * d_model,
+                           end=(idx + 1) * d_model)
+        p = sym.Reshape(p, shape=(0, 0, num_heads, dh))
+        return sym.transpose(p, axes=(0, 2, 1, 3))  # (N, H, T, Dh)
+
+    att = sym.FlashAttention(heads(0), heads(1), heads(2),
+                             causal=True, name=f"{name}_attn")
+    att = sym.transpose(att, axes=(0, 2, 1, 3))
+    att = sym.Reshape(att, shape=(-1, d_model))
+    proj = sym.FullyConnected(att, num_hidden=d_model, name=f"{name}_proj")
+    proj = sym.Reshape(proj, shape=(-1, seq_len, d_model))
+    return h + proj
+
+
+def _ffn_block(h, seq_len, d_model, d_ff, name, dropout):
+    ln = sym.LayerNorm(h, name=f"{name}_ln2")
+    x2 = sym.Reshape(ln, shape=(-1, d_model))
+    f = sym.FullyConnected(x2, num_hidden=d_ff, name=f"{name}_ffn_in")
+    f = sym.Activation(f, act_type="gelu")
+    if dropout > 0:
+        f = sym.Dropout(f, p=dropout)
+    f = sym.FullyConnected(f, num_hidden=d_model, name=f"{name}_ffn_out")
+    f = sym.Reshape(f, shape=(-1, seq_len, d_model))
+    return h + f
+
+
+def transformer_lm(num_layers=4, num_heads=4, d_model=128, d_ff=None,
+                   seq_len=128, vocab_size=1000, dropout=0.0):
+    """Next-token LM: data (N, T) token ids, softmax_label (N, T)."""
+    if d_model % num_heads:
+        raise ValueError("d_model must divide by num_heads")
+    d_ff = d_ff or 4 * d_model
+    data = sym.Variable("data")
+    tok = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
+                        name="tok_embed")
+    pos = sym.Variable("pos_embed", shape=(1, seq_len, d_model))
+    h = sym.broadcast_add(tok, pos)
+    for i in range(num_layers):
+        h = _attention_block(h, seq_len, d_model, num_heads, f"layer{i}")
+        h = _ffn_block(h, seq_len, d_model, d_ff, f"layer{i}", dropout)
+    h = sym.LayerNorm(h, name="final_ln")
+    h = sym.Reshape(h, shape=(-1, d_model))
+    logits = sym.FullyConnected(h, num_hidden=vocab_size, name="lm_head")
+    label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
+    return sym.SoftmaxOutput(logits, label, name="softmax")
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    kwargs.setdefault("vocab_size", num_classes)
+    return transformer_lm(**kwargs)

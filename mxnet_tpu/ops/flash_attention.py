@@ -78,7 +78,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     l_safe = jnp.maximum(l, 1e-20)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    lse_ref[0, :, 0] = m + jnp.log(l_safe)
 
 
 # --------------------------------------------------------------------------
@@ -89,8 +89,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
     d = q.shape[-1]
 
     num_k = seq_len // block_k
@@ -132,8 +132,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
         s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -176,11 +176,15 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            # trailing singleton lane dim: Mosaic requires the last two
+            # block dims to be (8,128)-divisible or equal to the array
+            # dims — a 2D (1, block_q) lse block violates that on real
+            # TPUs (interpret mode never checks)
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3)
@@ -195,8 +199,8 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k,
                     axis=-1)                             # (b, h, t)
     q3, k3, v3 = (x.reshape(bh, t, d) for x in (q, k, v))
     do3 = do.reshape(bh, t, d)
-    lse3 = lse.reshape(bh, t)
-    delta3 = delta.reshape(bh, t)
+    lse3 = lse.reshape(bh, t, 1)
+    delta3 = delta.reshape(bh, t, 1)
 
     dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                                 block_q=block_q, block_k=block_k, seq_len=t)
@@ -208,8 +212,8 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k,
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -226,8 +230,8 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, t), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),

@@ -255,6 +255,35 @@ def _batch_norm(ctx, data, gamma, beta, moving_mean, moving_var, **attrs):
     return out, (jax.lax.stop_gradient(new_mean), jax.lax.stop_gradient(new_var))
 
 
+def _ln_params(attrs, data_shape, *rest):
+    axis = int(attrs.get("axis", -1))
+    return {"gamma": (data_shape[axis],), "beta": (data_shape[axis],)}
+
+
+@register(
+    "LayerNorm",
+    arg_names=("data", "gamma", "beta"),
+    param_names=("gamma", "beta"),
+    infer_params=_ln_params,
+)
+def _layer_norm(ctx, data, gamma, beta, **attrs):
+    """Beyond-reference (post-dates v0.9): last-axis normalization, the
+    transformer-era norm behind models/transformer.py.  Single-pass f32
+    moments like BatchNorm above."""
+    eps = float(parse_attr(attrs.get("eps", 1e-5)))
+    axis = int(parse_attr(attrs.get("axis", -1)))
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.maximum(
+        jnp.mean(jnp.square(x32), axis=axis, keepdims=True)
+        - jnp.square(mean), 0.0)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    out = out * gamma.reshape(bshape).astype(jnp.float32)         + beta.reshape(bshape).astype(jnp.float32)
+    return out.astype(data.dtype)
+
+
 def _in_params(attrs, data_shape, *rest):
     c = data_shape[1]
     return {"gamma": (c,), "beta": (c,)}
@@ -362,6 +391,8 @@ def _activation(ctx, data, **attrs):
         return jnp.tanh(data)
     if act == "softrelu":
         return jax.nn.softplus(data)
+    if act == "gelu":  # beyond-reference: transformer-era activation
+        return jax.nn.gelu(data)
     raise MXNetError(f"Activation: unknown act_type {act}")
 
 

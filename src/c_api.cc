@@ -36,14 +36,23 @@ PyObject *Impl() {
   return impl;
 }
 
-/* Call impl.<fn>(args...); returns new ref or nullptr (exception set). */
+/* Call impl.<fn>(args...); returns new ref or nullptr (exception set).
+ * CONSUMES args (every call site builds the tuple inline; leaking it
+ * would pin the incref'd handles inside forever). */
 PyObject *Call(const char *fn, PyObject *args) {
   PyObject *impl = Impl();
-  if (!impl) return nullptr;
+  if (!impl) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
   PyObject *f = PyObject_GetAttrString(impl, fn);
-  if (!f) return nullptr;
+  if (!f) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
   PyObject *r = PyObject_CallObject(f, args);
   Py_DECREF(f);
+  Py_XDECREF(args);
   return r;
 }
 
@@ -146,8 +155,7 @@ int MXNDArrayCreate(const uint32_t *shape, uint32_t ndim, int dev_type,
                                 PyLong_FromLong(dev_id));
   /* PyTuple_Pack INCREFs; drop our refs */
   for (int i = 0; i < 3; ++i) Py_DECREF(PyTuple_GetItem(args, i));
-  PyObject *r = Call("ndarray_create", args);
-  Py_DECREF(args);
+  PyObject *r = Call("ndarray_create", args);  // Call consumes args
   if (!r) return Fail("MXNDArrayCreate");
   *out = r;  // ownership to caller
   return 0;
@@ -584,5 +592,113 @@ int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size) {
   Py_DECREF(r);
   return 0;
 }
+
+int MXImperativeInvoke(const char *op, uint32_t num_inputs,
+                       NDArrayHandle *inputs, uint32_t num_params,
+                       const char **keys, const char **vals,
+                       uint32_t out_capacity, uint32_t *num_outputs,
+                       NDArrayHandle *outputs) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *r = Call("imperative_invoke",
+                     Py_BuildValue("(sNNN)", op,
+                                   HandleList(inputs, num_inputs),
+                                   StrList(keys, num_params),
+                                   StrList(vals, num_params)));
+  if (!r) return Fail("MXImperativeInvoke");
+  Py_ssize_t n = PyList_Size(r);
+  if (static_cast<uint32_t>(n) > out_capacity) {
+    Py_DECREF(r);
+    last_error = "MXImperativeInvoke: output buffer too small";
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(r, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  *num_outputs = static_cast<uint32_t>(n);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXListDataIters(uint32_t *out_size, const char ***out_names) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *r = Call("list_data_iters", PyTuple_New(0));
+  /* cached under a process-stable key (nullptr handle slot) */
+  return ReturnStrList(nullptr, r, out_size, out_names, "MXListDataIters");
+}
+
+int MXDataIterCreateIter(const char *name, uint32_t num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *r = Call("data_iter_create",
+                     Py_BuildValue("(sNN)", name, StrList(keys, num_param),
+                                   StrList(vals, num_param)));
+  if (!r) return Fail("MXDataIterCreateIter");
+  *out = r;
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *r = Call("data_iter_next",
+                     Py_BuildValue("(O)",
+                                   reinterpret_cast<PyObject *>(handle)));
+  if (!r) return Fail("MXDataIterNext");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *r = Call("data_iter_before_first",
+                     Py_BuildValue("(O)",
+                                   reinterpret_cast<PyObject *>(handle)));
+  if (!r) return Fail("MXDataIterBeforeFirst");
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+int IterPart(const char *fn, const char *where, DataIterHandle handle,
+             NDArrayHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *r = Call(fn, Py_BuildValue(
+      "(O)", reinterpret_cast<PyObject *>(handle)));
+  if (!r) return Fail(where);
+  *out = r;  // new NDArray handle, caller frees
+  return 0;
+}
+}  // namespace
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  return IterPart("data_iter_data", "MXDataIterGetData", handle, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  return IterPart("data_iter_label", "MXDataIterGetLabel", handle, out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *r = Call("data_iter_pad",
+                     Py_BuildValue("(O)",
+                                   reinterpret_cast<PyObject *>(handle)));
+  if (!r) return Fail("MXDataIterGetPadNum");
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) { return FreeHandle(handle); }
 
 }  // extern "C"

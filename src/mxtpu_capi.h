@@ -32,6 +32,7 @@ typedef void *NDArrayHandle;
 typedef void *SymbolHandle;
 typedef void *ExecutorHandle;
 typedef void *KVStoreHandle;
+typedef void *DataIterHandle;
 
 const char *MXGetLastError(void);
 int MXRandomSeed(int seed);
@@ -121,6 +122,31 @@ int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
                         void *updater_handle);
 int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
 int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+
+/* Imperative op entry (parity: MXImperativeInvoke, c_api_ndarray.cc:19).
+ * Runs a registered op on input NDArrays with string attrs; writes up to
+ * out_capacity new output handles and their count. */
+int MXImperativeInvoke(const char *op, uint32_t num_inputs,
+                       NDArrayHandle *inputs, uint32_t num_params,
+                       const char **keys, const char **vals,
+                       uint32_t out_capacity, uint32_t *num_outputs,
+                       NDArrayHandle *outputs);
+
+/* Data iterators (parity: MXListDataIters / MXDataIterCreateIter family).
+ * Iterators are created by registry name (MNISTIter, CSVIter,
+ * ImageRecordIter) with string kwargs, exactly like the reference's
+ * dmlc::Parameter-driven C iterators.  GetData/GetLabel return NEW
+ * NDArray handles (free with MXNDArrayFree). */
+int MXListDataIters(uint32_t *out_size, const char ***out_names);
+int MXDataIterCreateIter(const char *name, uint32_t num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+int MXDataIterFree(DataIterHandle handle);
 
 #ifdef __cplusplus
 }

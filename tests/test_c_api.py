@@ -39,3 +39,22 @@ def test_c_train_lenet(capi_lib, tmp_path):
     assert "TRAIN OK" in r.stdout
     # the composed graph must expose the expected parameter surface
     assert "conv1_weight" in r.stdout and "fc1_weight" in r.stdout
+
+
+def test_c_iter_invoke(capi_lib, tmp_path):
+    """Data-iterator + imperative-invoke ABI from pure C."""
+    exe = tmp_path / "iter_invoke"
+    src = os.path.join(REPO, "tests", "c", "iter_invoke.c")
+    r = subprocess.run(
+        ["gcc", src, "-I", os.path.join(REPO, "src"), str(capi_lib),
+         "-lm", "-o", str(exe), f"-Wl,-rpath,{os.path.dirname(capi_lib)}"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["MXTPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([str(exe)], env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ITER INVOKE OK" in r.stdout
