@@ -199,3 +199,50 @@ def test_tpu_flash_attention_kernel():
                        env=env, capture_output=True, text=True, timeout=900)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "FAMILY OK" in r.stdout
+
+
+def test_tpu_module_training_end_to_end():
+    """Module.fit on the REAL chip: LeNet on synthetic digits for a few
+    epochs must reach high train accuracy — validates the whole
+    executor/optimizer/metric path on hardware, not just op numerics."""
+    _gate()
+    script = """
+        import numpy as np
+        import mxnet_tpu as mx
+
+        rs = np.random.RandomState(0)
+        X = rs.uniform(0, 1, (512, 1, 28, 28)).astype(np.float32)
+        w = rs.normal(size=(784, 5)).astype(np.float32)
+        Y = (X.reshape(512, -1) @ w).argmax(1).astype(np.float32)
+
+        net = mx.sym.Variable("data")
+        net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=8)
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                             pool_type="max")
+        net = mx.sym.Flatten(net)
+        net = mx.sym.FullyConnected(net, num_hidden=64)
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=5)
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+        it = mx.io.NDArrayIter(X, Y, 64, shuffle=True)
+        mod = mx.mod.Module(net, context=mx.tpu(0))
+        mod.fit(it, num_epoch=6, optimizer="adam",
+                optimizer_params={"learning_rate": 0.003},
+                initializer=mx.init.Xavier())
+        acc = mx.metric.Accuracy()
+        it.reset()
+        mod.score(it, acc)
+        print("TPU train accuracy:", acc.get()[1])
+        assert acc.get()[1] > 0.9
+        print("FAMILY OK")
+    """
+    import textwrap
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "MXTPU_PLATFORM", "XLA_FLAGS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", textwrap.dedent(script)],
+                       env=env, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FAMILY OK" in r.stdout
