@@ -1,100 +1,139 @@
-"""Training callbacks (parity: python/mxnet/callback.py).
+"""Training callbacks.
 
-do_checkpoint (:39), module_checkpoint (:11), Speedometer (:89),
-log_train_metric (:62), ProgressBar.
+Parity surface: python/mxnet/callback.py in the reference — periodic
+checkpointing (do_checkpoint :39, module_checkpoint :11), throughput
+logging (Speedometer :89), metric logging (log_train_metric :62) and a
+console progress bar.  The implementations here are original; behavior
+notes:
+
+- ``Speedometer(auto_reset=True)`` (the reference default) reports
+  *per-interval* metric values — the metric is reset after each report so
+  successive lines show fresh windows, not cumulative-since-epoch numbers.
+- speed is computed from the actually elapsed batch count since the last
+  report (robust to callers that invoke the callback at uneven cadence),
+  where the reference assumes exactly ``frequent`` batches per window.
+
+Each callback receives a ``BatchEndParam``-style object with attributes
+``epoch``, ``nbatch``, ``eval_metric`` (mirroring the namedtuple built in
+python/mxnet/model.py).
 """
 from __future__ import annotations
 
 import logging
-import math
 import time
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Parity: callback.py:11 — epoch-end checkpoint callback for Module."""
-    period = int(max(1, period))
+    """Epoch-end checkpoint callback bound to a Module.
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+    Returns a callback for ``Module.fit(epoch_end_callback=...)`` that
+    writes ``prefix-symbol.json`` / ``prefix-NNNN.params`` (and optimizer
+    ``.states`` when requested) every ``period`` epochs.
+    """
+    every = max(1, int(period))
 
-    return _callback
+    def _save(epoch, sym=None, arg=None, aux=None):
+        done = epoch + 1
+        if done % every == 0:
+            mod.save_checkpoint(prefix, done, save_optimizer_states)
+
+    return _save
 
 
 def do_checkpoint(prefix, period=1):
-    """Parity: callback.py:39 — epoch-end checkpoint for FeedForward."""
+    """Epoch-end checkpoint callback for the legacy FeedForward path.
+
+    Unlike :func:`module_checkpoint` the symbol/params arrive through the
+    callback arguments, so this works with any estimator that passes them.
+    """
     from .model import save_checkpoint
 
-    period = int(max(1, period))
+    every = max(1, int(period))
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    def _save(epoch, sym, arg, aux):
+        done = epoch + 1
+        if done % every == 0:
+            save_checkpoint(prefix, done, sym, arg, aux)
 
-    return _callback
+    return _save
 
 
 def log_train_metric(period, auto_reset=False):
-    """Parity: callback.py:62."""
+    """Log the training metric every ``period`` batches.
 
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            for name, value in param.eval_metric.get_name_value():
-                logging.info(
-                    "Iter[%d] Batch[%d] Train-%s=%f", param.epoch, param.nbatch, name, value
-                )
-            if auto_reset:
-                param.eval_metric.reset()
+    With ``auto_reset`` the metric restarts after each log line, so values
+    cover only the batches since the previous line.
+    """
 
-    return _callback
+    def _log(param):
+        if param.nbatch % period != 0 or param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset:
+            param.eval_metric.reset_local()
+
+    return _log
 
 
 class Speedometer:
-    """Samples/sec logger (parity: callback.py:89)."""
+    """Batch-end callback printing samples/sec (and metric values).
 
-    def __init__(self, batch_size, frequent=50):
+    Parameters mirror the reference (callback.py:89): ``batch_size``,
+    ``frequent`` (report every N batches), ``auto_reset`` (default True —
+    reset the metric after each report so the printed values are
+    per-interval).
+    """
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self.auto_reset = auto_reset
+        self._mark = None  # (wall time, nbatch) at the last report/epoch start
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    for name, value in name_value:
-                        logging.info(
-                            "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
-                            param.epoch, count, speed, name, value,
-                        )
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed,
-                    )
-                self.tic = time.time()
+        now = time.time()
+        if self._mark is None or param.nbatch < self._mark[1]:
+            # first call of an epoch (nbatch restarted): open a new window
+            self._mark = (now, param.nbatch)
+            return
+        if param.nbatch % self.frequent != 0:
+            return
+        t0, b0 = self._mark
+        elapsed, nbatches = now - t0, param.nbatch - b0
+        if elapsed <= 0 or nbatches <= 0:
+            # degenerate window (e.g. epoch restarted at the same nbatch):
+            # re-mark so the next window doesn't span the gap
+            self._mark = (now, param.nbatch)
+            return
+        speed = nbatches * self.batch_size / elapsed
+        if param.eval_metric is not None:
+            parts = "".join(
+                "\tTrain-%s=%f" % nv
+                for nv in param.eval_metric.get_name_value())
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, param.nbatch, speed, parts)
+            if self.auto_reset:
+                # reset only the local window: the epoch-end Train-* log
+                # (base_module.fit -> get_global_name_value) must still
+                # cover the whole epoch
+                param.eval_metric.reset_local()
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, param.nbatch, speed)
+        self._mark = (now, param.nbatch)
 
 
 class ProgressBar:
-    """Parity: callback.py ProgressBar."""
+    """Console progress bar over a known total number of batches."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.length = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s", prog_bar, percents, "%")
+        frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
+        fill = int(self.length * frac + 0.5)
+        bar = "=" * fill + "-" * (self.length - fill)
+        logging.info("[%s] %d%%", bar, int(frac * 100 + 0.999))

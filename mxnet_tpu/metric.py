@@ -18,6 +18,18 @@ def check_label_shapes(labels, preds, shape=False):
 
 
 class EvalMetric:
+    """Base metric with a local/global accumulator split.
+
+    Subclasses only ever touch ``sum_metric``/``num_inst`` (the *local*
+    window).  ``reset_local()`` folds the window into carried totals and
+    clears it — so interval reporters (Speedometer auto_reset) can print
+    per-window values while ``get_global_name_value()`` still returns the
+    true since-``reset()`` aggregate for epoch-end logs.  (The v0.9.4
+    reference lacks this split and its epoch log after an auto_reset
+    Speedometer covers only the tail window; later MXNet added
+    reset_local/get_global, which is the behavior reproduced here.)
+    """
+
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
@@ -27,23 +39,65 @@ class EvalMetric:
         if self.num is None:
             self.num_inst = 0
             self.sum_metric = 0.0
+            self._carried_num = 0
+            self._carried_sum = 0.0
         else:
+            self.num_inst = [0] * self.num
+            self.sum_metric = [0.0] * self.num
+            self._carried_num = [0] * self.num
+            self._carried_sum = [0.0] * self.num
+
+    def reset_local(self):
+        """Fold the current window into the global totals and clear it."""
+        if self.num is None:
+            self._carried_num += self.num_inst
+            self._carried_sum += self.sum_metric
+            self.num_inst = 0
+            self.sum_metric = 0.0
+        else:
+            for i in range(self.num):
+                self._carried_num[i] += self.num_inst[i]
+                self._carried_sum[i] += self.sum_metric[i]
             self.num_inst = [0] * self.num
             self.sum_metric = [0.0] * self.num
 
     def update(self, labels, preds):
         raise NotImplementedError
 
+    def _value(self, s, n):
+        """Accumulators -> reported value; metrics with a non-mean readout
+        (e.g. Perplexity's exp) override THIS so local and global views
+        stay consistent."""
+        return s / n if n else float("nan")
+
     def get(self):
         if self.num is None:
-            value = self.sum_metric / self.num_inst if self.num_inst else float("nan")
-            return (self.name, value)
+            return (self.name, self._value(self.sum_metric, self.num_inst))
         names = [f"{self.name}_{i}" for i in range(self.num)]
-        values = [s / n if n else float("nan") for s, n in zip(self.sum_metric, self.num_inst)]
+        values = [self._value(s, n)
+                  for s, n in zip(self.sum_metric, self.num_inst)]
+        return (names, values)
+
+    def get_global(self):
+        if self.num is None:
+            return (self.name, self._value(self._carried_sum + self.sum_metric,
+                                           self._carried_num + self.num_inst))
+        names = [f"{self.name}_{i}" for i in range(self.num)]
+        values = [
+            self._value(cs + s, cn + n)
+            for cs, s, cn, n in zip(self._carried_sum, self.sum_metric,
+                                    self._carried_num, self.num_inst)
+        ]
         return (names, values)
 
     def get_name_value(self):
         name, value = self.get()
+        if not isinstance(name, list):
+            return [(name, value)]
+        return list(zip(name, value))
+
+    def get_global_name_value(self):
+        name, value = self.get_global()
         if not isinstance(name, list):
             return [(name, value)]
         return list(zip(name, value))
@@ -61,6 +115,10 @@ class CompositeEvalMetric(EvalMetric):
         for m in getattr(self, "metrics", []):
             m.reset()
 
+    def reset_local(self):
+        for m in self.metrics:
+            m.reset_local()
+
     def update(self, labels, preds):
         for m in self.metrics:
             m.update(labels, preds)
@@ -69,6 +127,14 @@ class CompositeEvalMetric(EvalMetric):
         names, values = [], []
         for m in self.metrics:
             n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return (names, values)
+
+    def get_global(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get_global()
             names.append(n)
             values.append(v)
         return (names, values)
@@ -205,10 +271,8 @@ class Perplexity(EvalMetric):
         self.sum_metric += loss
         self.num_inst += num
 
-    def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, float(np.exp(self.sum_metric / self.num_inst)))
+    def _value(self, s, n):
+        return float(np.exp(s / n)) if n else float("nan")
 
 
 class Torch(EvalMetric):

@@ -50,6 +50,7 @@ class BaseModule:
         if reset:
             eval_data.reset()
         eval_metric.reset()
+        nbatch = 0
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
@@ -65,7 +66,8 @@ class BaseModule:
                                    eval_metric=eval_metric, locals=locals())
             for cb in _as_list(score_end_callback):
                 cb(params)
-        return eval_metric.get_name_value()
+        # global view survives any auto_reset batch callback (see fit)
+        return eval_metric.get_global_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
@@ -153,7 +155,9 @@ class BaseModule:
                                            eval_metric=eval_metric, locals=locals())
                     for cb in _as_list(batch_end_callback):
                         cb(params)
-            for name, val in eval_metric.get_name_value():
+            # global view: correct even when a Speedometer(auto_reset=True)
+            # batch callback reset the metric's local window mid-epoch
+            for name, val in eval_metric.get_global_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
 

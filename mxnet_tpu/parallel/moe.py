@@ -63,16 +63,19 @@ def moe_ffn(params, x, mesh: Mesh, axis_name: str = "expert",
         expert = jnp.argmax(probs, axis=-1)       # [nt]
         gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
 
-        # position of each token within its expert's capacity bucket
-        onehot = jax.nn.one_hot(expert, n_exp, dtype=xs.dtype)  # [nt, E]
-        pos = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - onehot,
+        # position of each token within its expert's capacity bucket —
+        # bookkeeping stays integer: in xs.dtype (bf16) a cumsum over >256
+        # same-expert tokens loses exactness and two tokens silently share
+        # a capacity slot
+        onehot_i = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)  # [nt, E]
+        pos = jnp.take_along_axis(jnp.cumsum(onehot_i, axis=0) - onehot_i,
                                   expert[:, None], axis=1)[:, 0]
         keep = pos < cap                          # over-capacity drops
+        onehot = onehot_i.astype(xs.dtype)
 
         # dense dispatch tensor [nt, E, cap] (Switch/Mesh-TF style)
         disp = (onehot[:, :, None] *
-                jax.nn.one_hot(pos.astype(jnp.int32), cap,
-                               dtype=xs.dtype)[:, None, :] *
+                jax.nn.one_hot(pos, cap, dtype=xs.dtype)[:, None, :] *
                 keep[:, None, None].astype(xs.dtype))
         buf = jnp.einsum("tec,td->ecd", disp, xs)  # [E, cap, d]
 

@@ -46,12 +46,29 @@ def resize(src, size, interpolation=INTER_LINEAR):
     from PIL import Image
 
     data = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
-    squeeze = data.shape[-1] == 1
-    pil = Image.fromarray(data.squeeze(-1) if squeeze else data.astype(np.uint8))
-    out = np.asarray(pil.resize(tuple(size),
-                                _PIL_INTERP.get(interpolation, 2)))
-    if squeeze:
-        out = out[..., None]
+    interp = _PIL_INTERP.get(interpolation, 2)
+    if data.dtype == np.uint8:
+        squeeze = data.shape[-1] == 1
+        pil = Image.fromarray(data.squeeze(-1) if squeeze else data)
+        out = np.asarray(pil.resize(tuple(size), interp))
+        if squeeze:
+            out = out[..., None]
+    else:
+        # float input (e.g. color_normalize output, zero-centered): cv2
+        # preserves dtype, so resize channel-wise as mode-'F' planes —
+        # casting to uint8 here would truncate/wrap the values
+        if data.ndim == 2:
+            out = np.asarray(Image.fromarray(
+                data.astype(np.float32), mode="F").resize(tuple(size), interp))
+        else:
+            planes = [
+                np.asarray(Image.fromarray(
+                    data[..., c].astype(np.float32), mode="F").resize(
+                        tuple(size), interp))
+                for c in range(data.shape[-1])
+            ]
+            out = np.stack(planes, axis=-1)
+        out = out.astype(data.dtype)
     return array(out)
 
 

@@ -87,3 +87,84 @@ def test_print_summary(capsys):
     out = capsys.readouterr().out
     assert "fc1" in out and "Total params: 56" in out
     assert total == 6 * 8 + 8
+
+
+def test_speedometer_auto_reset_reports_per_interval(caplog):
+    """Speedometer(auto_reset=True) must reset the metric after each report
+    so successive lines cover fresh windows (reference callback.py:121)."""
+    import logging
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu.metric import Accuracy
+    from mxnet_tpu.module.base_module import BatchEndParam
+
+    metric = Accuracy()
+    spd = Speedometer(batch_size=4, frequent=2, auto_reset=True)
+    lab = nd.array(np.array([1.0, 1.0]))
+    right = nd.array(np.array([[0.1, 0.9], [0.1, 0.9]]))
+    wrong = nd.array(np.array([[0.9, 0.1], [0.9, 0.1]]))
+
+    with caplog.at_level(logging.INFO):
+        # batches 1-2 all correct -> first report 1.0
+        for b in (1, 2):
+            metric.update([lab], [right])
+            spd(BatchEndParam(epoch=0, nbatch=b, eval_metric=metric, locals=None))
+        assert "Train-accuracy=1.0" in caplog.text
+        caplog.clear()
+        # batches 3-4 all wrong: per-interval accuracy is 0.0 (cumulative 0.5)
+        for b in (3, 4):
+            metric.update([lab], [wrong])
+            spd(BatchEndParam(epoch=0, nbatch=b, eval_metric=metric, locals=None))
+        assert "Train-accuracy=0.0" in caplog.text
+
+
+def test_speedometer_no_auto_reset_is_cumulative(caplog):
+    import logging
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu.metric import Accuracy
+    from mxnet_tpu.module.base_module import BatchEndParam
+
+    metric = Accuracy()
+    spd = Speedometer(batch_size=4, frequent=2, auto_reset=False)
+    lab = nd.array(np.array([1.0, 1.0]))
+    right = nd.array(np.array([[0.1, 0.9], [0.1, 0.9]]))
+    wrong = nd.array(np.array([[0.9, 0.1], [0.9, 0.1]]))
+    with caplog.at_level(logging.INFO):
+        for b, pred in ((1, right), (2, right), (3, wrong), (4, wrong)):
+            metric.update([lab], [pred])
+            spd(BatchEndParam(epoch=0, nbatch=b, eval_metric=metric, locals=None))
+        assert "Train-accuracy=0.5" in caplog.text
+
+
+def test_metric_global_survives_local_reset():
+    """reset_local (Speedometer auto_reset) keeps the since-reset() global
+    aggregate intact for the epoch-end Train-* log."""
+    from mxnet_tpu.metric import Accuracy
+
+    m = Accuracy()
+    lab = nd.array(np.array([1.0, 1.0]))
+    right = nd.array(np.array([[0.1, 0.9], [0.1, 0.9]]))
+    wrong = nd.array(np.array([[0.9, 0.1], [0.9, 0.1]]))
+    m.update([lab], [right])
+    m.reset_local()
+    m.update([lab], [wrong])
+    assert m.get_name_value()[0][1] == 0.0          # local window
+    assert m.get_global_name_value()[0][1] == 0.5   # whole epoch
+    m.reset()
+    m.update([lab], [right])
+    assert m.get_global_name_value()[0][1] == 1.0   # reset() clears global
+
+
+def test_perplexity_global_applies_exp():
+    """Perplexity's exp readout must apply to the global view too (fit's
+    epoch-end log path uses get_global_name_value)."""
+    from mxnet_tpu.metric import Perplexity
+
+    m = Perplexity()
+    lab = nd.array(np.array([0.0, 1.0]))
+    pred = nd.array(np.array([[0.5, 0.5], [0.5, 0.5]]))
+    m.update([lab], [pred])
+    local = m.get_name_value()[0][1]
+    m.reset_local()
+    m.update([lab], [pred])
+    glob = m.get_global_name_value()[0][1]
+    assert abs(local - 2.0) < 1e-6 and abs(glob - 2.0) < 1e-6

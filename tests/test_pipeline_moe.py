@@ -154,3 +154,40 @@ def test_moe_trains():
     # the gate must actually be learning (nonzero grads)
     _, g = step(params)
     assert float(jnp.abs(g["gate_w"]).max()) > 0
+
+
+def test_moe_bf16_routing_exact():
+    """Routing bookkeeping must stay integer: with bf16 activations and
+    >256 tokens routed to one expert, a bf16 cumsum rounds slot positions
+    so two tokens collide into one capacity slot (summing their outputs).
+    Regression for the advisor finding on moe.py."""
+    d = 8
+    # cap = int(1.25*900/N_EXPERTS) = 281 > 256: bf16 represents integers
+    # exactly only up to 2^8, so pre-fix positions 256..281 collide while
+    # still inside capacity — the window the regression must cover
+    per_dev = 900
+    mesh = create_mesh((N_EXPERTS,), ("expert",),
+                       devices=jax.devices("cpu")[:N_EXPERTS])
+    eye = jnp.eye(d, dtype=jnp.float32)
+    params = {
+        # all tokens route to expert 0 with gate prob ~1
+        "gate_w": jnp.concatenate(
+            [jnp.full((d, 1), 50.0)] + [jnp.zeros((d, 1))] * (N_EXPERTS - 1),
+            axis=1),
+        "w_in": jnp.stack([eye] * N_EXPERTS),
+        "w_out": jnp.stack([eye] * N_EXPERTS),
+    }
+    rs = np.random.RandomState(5)
+    x_np = rs.uniform(0.5, 1.5, (per_dev * N_EXPERTS, d)).astype(np.float32)
+    x = jnp.asarray(x_np, jnp.bfloat16)
+
+    y, _ = moe_mod.moe_ffn(params, x, mesh, "expert", capacity_factor=1.25)
+    got = np.asarray(y.astype(jnp.float32))
+    cap = int(1.25 * per_dev / N_EXPERTS)
+    for dev in range(N_EXPERTS):
+        shard = got[dev * per_dev:(dev + 1) * per_dev]
+        # identity expert + gate ~1: kept tokens come back as themselves
+        np.testing.assert_allclose(shard[:cap], x_np[dev * per_dev:][:cap],
+                                   rtol=0.02, atol=0.02)
+        # over-capacity tokens drop to exactly zero
+        assert np.all(shard[cap:] == 0.0)

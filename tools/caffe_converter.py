@@ -141,11 +141,12 @@ def convert_symbol(prototxt_text):
         inputs[name] = dims
 
     last = None
+    blob_src = {}  # blob name -> producing layer type (for Scale folding)
     for layer in layers:
         ltype = str(layer.get("type"))
         name = layer.get("name", ltype)
-        bottoms = [blobs[b] for b in _as_list(layer.get("bottom"))
-                   if b in blobs]
+        bottom_names = [b for b in _as_list(layer.get("bottom")) if b in blobs]
+        bottoms = [blobs[b] for b in bottom_names]
         tops = _as_list(layer.get("top")) or [name]
         data = bottoms[0] if bottoms else None
 
@@ -221,7 +222,14 @@ def convert_symbol(prototxt_text):
         elif ltype == "Scale":
             # caffe pairs BatchNorm with a Scale layer for gamma/beta;
             # BatchNorm(fix_gamma=False) already carries them, so a Scale
-            # directly after a BatchNorm folds into it as identity here
+            # directly after a BatchNorm folds into it as identity here.
+            # A standalone Scale (learned per-channel affine elsewhere in
+            # the net) must NOT silently disappear.
+            if not bottom_names or blob_src.get(bottom_names[0]) != "BatchNorm":
+                raise ValueError(
+                    f"standalone Scale layer {name!r} (bottom produced by "
+                    f"{blob_src.get(bottom_names[0] if bottom_names else None)!r}) "
+                    "is unsupported: only Scale-after-BatchNorm folds away")
             out = data
         elif ltype == "Concat":
             p = layer.get("concat_param", {})
@@ -250,6 +258,10 @@ def convert_symbol(prototxt_text):
                              f"(layer {name})")
         for top in tops:
             blobs[top] = out
+            # record unconditionally: after an in-place BN->Scale pair the
+            # blob's producer becomes "Scale", so a SECOND Scale reading it
+            # fails the BatchNorm check instead of silently folding
+            blob_src[top] = ltype
         last = out
     return last, inputs
 

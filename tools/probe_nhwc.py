@@ -1,0 +1,141 @@
+"""Probe: ResNet-50 train-step throughput, NCHW vs NHWC lowering (pure JAX).
+
+Decides whether a channels-last executor pass is worth building: identical
+topology/params, only conv dimension_numbers + stat axes differ.  Run on the
+real chip:  python tools/probe_nhwc.py [batch ...]
+"""
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+# ResNet-50 (v1) stage spec: (n_blocks, channels)
+STAGES = [(3, 256), (4, 512), (6, 1024), (3, 2048)]
+
+
+def conv(x, w, stride, layout):
+    if layout == "NHWC":
+        dn = ("NHWC", "HWIO", "NHWC")
+    else:
+        dn = ("NCHW", "OIHW", "NCHW")
+    kh = w.shape[0] if layout == "NHWC" else w.shape[2]
+    pad = (kh - 1) // 2
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=jax.lax.conv_dimension_numbers(x.shape, w.shape, dn))
+
+
+def bn(x, gamma, beta, layout):
+    axes = (0, 1, 2) if layout == "NHWC" else (0, 2, 3)
+    shape = (1, 1, 1, -1) if layout == "NHWC" else (1, -1, 1, 1)
+    x32 = x.astype(jnp.float32)
+    n = x.size // x.shape[3 if layout == "NHWC" else 1]
+    mean = jnp.sum(x32, axes) / n
+    var = jnp.maximum(jnp.sum(jnp.square(x32), axes) / n - jnp.square(mean), 0.0)
+    out = (x32 - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + 1e-3)
+    return (out * gamma.reshape(shape) + beta.reshape(shape)).astype(x.dtype)
+
+
+def make_params(layout, rng):
+    def w(cin, cout, k):
+        arr = rng.normal(0, 0.05, (cout, cin, k, k)).astype(np.float32)
+        if layout == "NHWC":
+            arr = arr.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        return jnp.asarray(arr, jnp.bfloat16)
+
+    params = {"stem": w(3, 64, 7), "stem_g": jnp.ones(64), "stem_b": jnp.zeros(64)}
+    cin = 64
+    for si, (blocks, cout) in enumerate(STAGES):
+        mid = cout // 4
+        for bi in range(blocks):
+            p = f"s{si}b{bi}"
+            params[p + "c1"] = w(cin, mid, 1)
+            params[p + "c2"] = w(mid, mid, 3)
+            params[p + "c3"] = w(mid, cout, 1)
+            if cin != cout:
+                params[p + "proj"] = w(cin, cout, 1)
+            for j, c in (("1", mid), ("2", mid), ("3", cout)):
+                params[p + "g" + j] = jnp.ones(c)
+                params[p + "b" + j] = jnp.zeros(c)
+            cin = cout
+    params["fc"] = jnp.asarray(rng.normal(0, 0.01, (2048, 1000)), jnp.bfloat16)
+    return params
+
+
+def forward(params, x, layout):
+    x = conv(x, params["stem"], 2, layout)
+    x = jax.nn.relu(bn(x, params["stem_g"], params["stem_b"], layout))
+    window = (1, 3, 3, 1) if layout == "NHWC" else (1, 1, 3, 3)
+    strides = (1, 2, 2, 1) if layout == "NHWC" else (1, 1, 2, 2)
+    pads = [(0, 0), (1, 1), (1, 1), (0, 0)] if layout == "NHWC" else [(0, 0), (0, 0), (1, 1), (1, 1)]
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides, pads)
+    cin = 64
+    for si, (blocks, cout) in enumerate(STAGES):
+        for bi in range(blocks):
+            p = f"s{si}b{bi}"
+            stride = 2 if (bi == 0 and si > 0) else 1
+            sc = x
+            if cin != cout:
+                sc = conv(x, params[p + "proj"], stride, layout)
+            h = jax.nn.relu(bn(conv(x, params[p + "c1"], 1, layout),
+                               params[p + "g1"], params[p + "b1"], layout))
+            h = jax.nn.relu(bn(conv(h, params[p + "c2"], stride, layout),
+                               params[p + "g2"], params[p + "b2"], layout))
+            h = bn(conv(h, params[p + "c3"], 1, layout),
+                   params[p + "g3"], params[p + "b3"], layout)
+            x = jax.nn.relu(h + sc)
+            cin = cout
+    axes = (1, 2) if layout == "NHWC" else (2, 3)
+    x = jnp.mean(x.astype(jnp.float32), axis=axes)
+    return x.astype(jnp.bfloat16) @ params["fc"]
+
+
+def loss_fn(params, x, y, layout):
+    logits = forward(params, x, layout).astype(jnp.float32)
+    return jnp.mean(-jax.nn.log_softmax(logits)[jnp.arange(x.shape[0]), y])
+
+
+@partial(jax.jit, static_argnames=("layout",), donate_argnums=(0, 1))
+def train_step(params, mom, x, y, layout):
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, y, layout)
+    new_p, new_m = {}, {}
+    for k, g in grads.items():
+        m = mom[k] * 0.9 + g.astype(jnp.float32)
+        new_m[k] = m
+        new_p[k] = (params[k].astype(jnp.float32) - 0.1 * m).astype(params[k].dtype)
+    return new_p, new_m, loss
+
+
+def run(layout, batch, iters=30):
+    rng = np.random.RandomState(0)
+    params = make_params(layout, rng)
+    mom = {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()}
+    shape = (batch, 224, 224, 3) if layout == "NHWC" else (batch, 3, 224, 224)
+    x = jnp.asarray(rng.uniform(0, 1, shape), jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)
+    for _ in range(5):
+        params, mom, loss = train_step(params, mom, x, y, layout)
+    _ = float(np.asarray(loss))
+    tic = time.perf_counter()
+    for _ in range(iters):
+        params, mom, loss = train_step(params, mom, x, y, layout)
+    _ = float(np.asarray(loss))  # fetch real bytes: trustworthy barrier
+    dt = time.perf_counter() - tic
+    img_s = batch * iters / dt
+    mfu = img_s * 3 * 4.089e9 / 197e12
+    print(f"{layout} b{batch}: {img_s:8.1f} img/s   mfu={mfu:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices(), flush=True)
+    batches = [int(a) for a in sys.argv[1:]] or [128]
+    for b in batches:
+        for layout in ("NHWC", "NCHW"):
+            run(layout, b)
