@@ -27,6 +27,7 @@ shared on TPU is compilation + params, while XLA reuses buffers per-call.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional
 
 import jax
@@ -41,16 +42,120 @@ from .symbol import Symbol, _topo_order
 
 _GRAD_REQ = ("write", "add", "null")
 
+# ---------------------------------------------------------------------------
+# Channels-last (NHWC) execution pass.
+#
+# The public API is NCHW (reference parity) but TPU compute wants the
+# channel dim minor: XLA tiles the minor axis onto the 128-wide MXU/VPU
+# lanes, and a logically-NCHW conv graph makes layout assignment insert
+# transposes it cannot always elide (measured: ResNet-50 train step was
+# HBM-bound at 14% MFU).  This pass keeps weights/params in their logical
+# layouts and retraces the *activation* flow: 4D activations are
+# transposed to NHWC once where they enter a spatial chain (normally the
+# graph input) and back where they leave it (normally the global-pool /
+# Flatten boundary); spatial ops run with __layout__="NHWC" (ops/nn.py),
+# elementwise chains pass through untouched, and anything unknown falls
+# back to NCHW — the pass can only change op *layouts*, never op math.
+# Opt out with MXTPU_CONV_LAYOUT=NCHW.
+# ---------------------------------------------------------------------------
+_CL_SPATIAL = {"Convolution", "Pooling", "BatchNorm", "LRN"}
+_CL_UNARY = {
+    # single-tensor-input ops that commute with transpose
+    "abs", "arccos", "arccosh", "arcsin", "arcsinh", "arctan", "arctanh",
+    "ceil", "cos", "cosh", "degrees", "exp", "expm1", "fix", "floor",
+    "gamma", "gammaln", "log", "log10", "log1p", "log2", "negative",
+    "radians", "rint", "round", "rsqrt", "sign", "sin", "sinh", "sqrt",
+    "square", "tan", "tanh", "sigmoid", "relu", "_copy", "identity",
+    "BlockGrad", "stop_gradient", "Activation", "Dropout", "clip",
+    "smooth_l1",
+    "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+    "_div_scalar", "_rdiv_scalar", "_power_scalar", "_rpower_scalar",
+    "_maximum_scalar", "_minimum_scalar", "_hypot_scalar",
+}
+_CL_MULTI = {
+    # same-shape multi-tensor elementwise (incl. residual adds)
+    "elemwise_add", "_plus", "_add", "_Plus", "elemwise_sub", "_minus",
+    "_sub", "_Minus", "elemwise_mul", "_mul", "_Mul", "elemwise_div",
+    "_div", "_Div", "_power", "_Power", "_maximum", "_Maximum",
+    "_minimum", "_Minimum", "_hypot", "_grad_add",
+    "ElementWiseSum", "add_n", "_sum",
+}
+_CL_CHANNEL_AXIS = {"Concat": "dim", "concat": "dim",
+                    "SliceChannel": "axis", "split": "axis"}
 
-def _eval_node(node, topo_index, env, key, is_train):
-    """Evaluate one op node into env; returns {aux_name: new_val} updates."""
+
+def channels_last_default() -> bool:
+    return os.environ.get("MXTPU_CONV_LAYOUT", "NHWC").upper() != "NCHW"
+
+
+def _to_nhwc(x):
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def _to_nchw(x):
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def _cl_eligible(node, ins):
+    """Can this spatial op run channels-last on these traced inputs?"""
+    data = ins[0]
+    if data.ndim != 4:
+        return False
+    if node.op == "Convolution":
+        return len(ins) >= 2 and ins[1].ndim == 4  # 2D kernel only
+    return True
+
+
+def _cl_adapt(node, ins, lay):
+    """Pick the execution layout for one node (trace time, zero runtime
+    cost beyond the transposes actually emitted).  Returns
+    (adapted_inputs, attrs, out_is_nhwc)."""
+    from .base import parse_attr, parse_bool
+
+    name = node.op
+    inlay = [lay.get((id(src), oidx), False) for src, oidx in node.inputs]
+    attrs = node.attrs
+    if name in _CL_SPATIAL and _cl_eligible(node, ins):
+        data = ins[0] if inlay[0] else _to_nhwc(ins[0])
+        # remaining inputs (weights/stats) must arrive in their logical
+        # layouts — a computed weight coming off an NHWC activation chain
+        # (dynamic-filter nets) is converted back
+        rest = [(_to_nchw(x) if l else x)
+                for x, l in zip(ins[1:], inlay[1:])]
+        return [data] + rest, {**attrs, "__layout__": "NHWC"}, True
+    if name in _CL_UNARY and len(ins) == 1 and inlay[0]:
+        return ins, attrs, True
+    if name in _CL_MULTI and any(inlay) and all(x.ndim == 4 for x in ins):
+        return [x if l else _to_nhwc(x) for x, l in zip(ins, inlay)], attrs, True
+    if name in _CL_CHANNEL_AXIS and any(inlay) and all(x.ndim == 4 for x in ins):
+        axis_key = _CL_CHANNEL_AXIS[name]
+        axis = int(parse_attr(attrs.get(axis_key, 1)))
+        squeeze = (parse_bool(attrs.get("squeeze_axis", False))
+                   if name in ("SliceChannel", "split") else False)
+        if axis == 1 and not squeeze:
+            ins = [x if l else _to_nhwc(x) for x, l in zip(ins, inlay)]
+            return ins, {**attrs, axis_key: 3}, True
+    # fallback: this op runs NCHW — convert whatever arrived channels-last
+    return [(_to_nchw(x) if l else x) for x, l in zip(ins, inlay)], attrs, False
+
+
+def _eval_node(node, topo_index, env, key, is_train, lay=None):
+    """Evaluate one op node into env; returns {aux_name: new_val} updates.
+
+    ``lay`` (entry -> is_nhwc) enables the channels-last pass; None keeps
+    plain NCHW evaluation (the placed/segment path).
+    """
     od = ops.get(node.op)
     ins = [env[id(src)][oidx] for src, oidx in node.inputs]
+    attrs = node.attrs
+    out_nhwc = False
+    if lay is not None:
+        ins, attrs, out_nhwc = _cl_adapt(node, ins, lay)
     octx = ops.OpCtx(
         is_train=is_train,
         key=jax.random.fold_in(key, topo_index) if od.needs_rng else None,
     )
-    res = od.fn(octx, *ins, **node.attrs)
+    res = od.fn(octx, *ins, **attrs)
     aux_updates = {}
     if od.aux_names:
         res, updates = res
@@ -60,21 +165,30 @@ def _eval_node(node, topo_index, env, key, is_train):
     if not isinstance(res, tuple):
         res = (res,)
     env[id(node)] = res
+    if lay is not None:
+        for k in range(len(res)):
+            lay[(id(node), k)] = out_nhwc
     return aux_updates
 
 
-def _build_graph_fn(symbol: Symbol):
+def _build_graph_fn(symbol: Symbol, channels_last: Optional[bool] = None):
     """Build f(arg_dict, aux_dict, key, is_train) -> (outputs, new_aux_dict).
 
     This is the tracing equivalent of GraphExecutor::InitCachedOps
     (graph_executor.cc:518-648): one closure per graph, evaluated under
-    jax.jit so every node fuses into a single XLA program.
+    jax.jit so every node fuses into a single XLA program.  With
+    ``channels_last`` (default from MXTPU_CONV_LAYOUT) 4D activation
+    chains execute NHWC; graph outputs are always converted back to the
+    logical NCHW layout.
     """
+    if channels_last is None:
+        channels_last = channels_last_default()
     out_entries = list(symbol._outputs)
     topo = _topo_order([n for n, _ in out_entries])
 
     def fn(arg_vals: Dict, aux_vals: Dict, key, is_train: bool):
         env = {}
+        lay = {} if channels_last else None
         new_aux = dict(aux_vals)
         for i, node in enumerate(topo):
             if node.is_variable:
@@ -83,8 +197,12 @@ def _build_graph_fn(symbol: Symbol):
                 else:
                     env[id(node)] = (arg_vals[node.name],)
                 continue
-            new_aux.update(_eval_node(node, i, env, key, is_train))
-        outputs = [env[id(n)][i] for n, i in out_entries]
+            new_aux.update(_eval_node(node, i, env, key, is_train, lay))
+        outputs = [
+            _to_nchw(env[id(n)][i]) if lay and lay.get((id(n), i))
+            else env[id(n)][i]
+            for n, i in out_entries
+        ]
         return outputs, new_aux
 
     return fn

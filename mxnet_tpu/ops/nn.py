@@ -72,21 +72,39 @@ def _convolution(ctx, data, weight, bias=None, **attrs):
     """Parity: Convolution (src/operator/convolution-inl.h).
 
     weight layout (num_filter, C/group, *kernel) == reference OIHW.
+
+    ``__layout__="NHWC"`` (injected by the executor's channels-last pass,
+    2D convs only) runs the conv with NHWC activations — the TPU-native
+    layout: XLA tiles the minor channel dim straight onto the MXU/VPU
+    lanes instead of inserting layout-assignment transposes around every
+    op.  The weight stays logically OIHW (checkpoint parity) and is
+    transposed to HWIO inside the op; XLA folds that into the kernel's
+    constant/parameter layout.
     """
     nd, kernel, stride, pad, dilate, num_filter, num_group, no_bias = _conv_attrs(attrs)
-    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dim_numbers(nd))
+    precision = mxu_precision(data, weight)
+    if attrs.get("__layout__") == "NHWC" and nd == 2:
+        kernel_arr = jnp.transpose(weight, (2, 3, 1, 0))  # OIHW -> HWIO
+        dn = jax.lax.conv_dimension_numbers(
+            data.shape, kernel_arr.shape, ("NHWC", "HWIO", "NHWC"))
+        bias_shape = (1,) * (nd + 1) + (-1,)
+    else:
+        kernel_arr = weight
+        dn = jax.lax.conv_dimension_numbers(
+            data.shape, weight.shape, _conv_dim_numbers(nd))
+        bias_shape = (1, -1) + (1,) * nd
     out = jax.lax.conv_general_dilated(
         data,
-        weight,
+        kernel_arr,
         window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        precision=mxu_precision(data, weight),
+        precision=precision,
     )
     if bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bias_shape)
     return out
 
 
@@ -225,8 +243,14 @@ def _batch_norm(ctx, data, gamma, beta, moving_mean, moving_var, **attrs):
 
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
-    axes = (0,) + tuple(range(2, data.ndim))
-    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    if attrs.get("__layout__") == "NHWC":
+        # channels-last execution (executor layout pass): stats reduce over
+        # all-but-minor axes, which XLA fuses into the producing conv
+        axes = tuple(range(data.ndim - 1))
+        bshape = (1,) * (data.ndim - 1) + (-1,)
+    else:
+        axes = (0,) + tuple(range(2, data.ndim))
+        bshape = (1, -1) + (1,) * (data.ndim - 2)
 
     if ctx.is_train and not use_global:
         # single-pass moments: sum and sum-of-squares reduce in ONE fused
@@ -336,8 +360,10 @@ def _pooling(ctx, data, **attrs):
     reference's mshadow pool (count-include-pad).
     """
     nd = data.ndim - 2
+    nhwc = attrs.get("__layout__") == "NHWC"
+    spatial0 = 1 if nhwc else 2  # first spatial axis under this layout
     if parse_bool(attrs.get("global_pool", False)):
-        kernel = data.shape[2:]
+        kernel = data.shape[spatial0:spatial0 + nd]
         stride = (1,) * nd
         pad = (0,) * nd
     else:
@@ -347,19 +373,25 @@ def _pooling(ctx, data, **attrs):
     pool_type = attrs.get("pool_type", "max")
     convention = attrs.get("pooling_convention", "valid")
 
-    padding = [(0, 0), (0, 0)]
+    spatial_pads = []
     for i in range(nd):
         lo = pad[i]
         hi = pad[i]
         if convention == "full":
-            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            size = data.shape[spatial0 + i] + 2 * pad[i] - kernel[i]
             rem = size % stride[i]
             if rem != 0:
                 hi += stride[i] - rem  # ceil-mode: extend right edge
-        padding.append((lo, hi))
+        spatial_pads.append((lo, hi))
 
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
+    if nhwc:
+        padding = [(0, 0)] + spatial_pads + [(0, 0)]
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+    else:
+        padding = [(0, 0), (0, 0)] + spatial_pads
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
     if pool_type == "max":
         # NB: XLA's select-and-scatter backward measured FASTER on TPU than
         # a 9-offset mask-trick custom VJP (strided scatters re-read dx at
@@ -460,11 +492,15 @@ def _lrn(ctx, data, **attrs):
     knorm = float(parse_attr(attrs.get("knorm", 2.0)))
     nsize = int(parse_attr(attrs["nsize"]))
     half = nsize // 2
+    ch_axis = data.ndim - 1 if attrs.get("__layout__") == "NHWC" else 1
     sq = jnp.square(data)
-    window = (1, nsize) + (1,) * (data.ndim - 2)
+    window = [1] * data.ndim
+    window[ch_axis] = nsize
     strides = (1,) * data.ndim
-    padding = [(0, 0), (half, nsize - 1 - half)] + [(0, 0)] * (data.ndim - 2)
-    ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window, strides, padding)
+    padding = [(0, 0)] * data.ndim
+    padding[ch_axis] = (half, nsize - 1 - half)
+    ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window), strides,
+                                 padding)
     return data * jnp.power(knorm + alpha / nsize * ssum, -beta)
 
 

@@ -72,6 +72,41 @@ def shard_params(mesh: Mesh, params: dict, rules: Sequence[ShardingRule] = ()) -
     return out
 
 
+def megatron_rules(model_axis: str = "model", shard_embed: bool = True):
+    """Megatron-style tensor-parallel sharding rules for the transformer
+    zoo (models/transformer.py naming).
+
+    The classic layout (Shoeybi et al.): attention qkv and FFN-in are
+    *column*-parallel (split the output features: weight rows, since
+    FullyConnected weights are (out, in)), their biases split with them;
+    the attention out-projection and FFN-out are *row*-parallel (split
+    the input features: weight columns) with replicated biases — GSPMD
+    then inserts exactly one all-reduce after each row-parallel matmul,
+    matching Megatron's f/g collectives.  The LM head and token embedding
+    shard over the vocab dim.
+
+    Returns a tuple of ShardingRule for FusedTrainer(sharding_rules=...)
+    / shard_params.  No reference analogue: SURVEY.md §2.4 marks TP
+    absent upstream.
+    """
+    rules = [
+        # attention: qkv column-parallel, out-projection row-parallel
+        ShardingRule(r".*_qkv_weight$", (model_axis, None)),
+        ShardingRule(r".*_qkv_bias$", (model_axis,)),
+        ShardingRule(r".*_proj_weight$", (None, model_axis)),
+        # FFN: in column-parallel, out row-parallel
+        ShardingRule(r".*_ffn_in_weight$", (model_axis, None)),
+        ShardingRule(r".*_ffn_in_bias$", (model_axis,)),
+        ShardingRule(r".*_ffn_out_weight$", (None, model_axis)),
+        # LM head: vocab-dim column-parallel
+        ShardingRule(r"lm_head_weight$", (model_axis, None)),
+        ShardingRule(r"lm_head_bias$", (model_axis,)),
+    ]
+    if shard_embed:
+        rules.append(ShardingRule(r"tok_embed_weight$", (model_axis, None)))
+    return tuple(rules)
+
+
 def shard_map(f=None, **kw):
     """jax.shard_map with the old `check_rep` kwarg accepted (new API
     spells it `check_vma`); shared by pipeline/moe/ring_attention."""

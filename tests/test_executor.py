@@ -207,3 +207,61 @@ def test_monitor_callback():
     ex.set_monitor_callback(lambda name, arr: seen.append(name))
     ex.forward(is_train=False)
     assert any("fc_output" in s for s in seen)
+
+
+def test_channels_last_pass_matches_nchw():
+    """The NHWC execution pass (default) and the legacy NCHW lowering
+    (MXTPU_CONV_LAYOUT=NCHW escape hatch) must agree: same graph, same
+    inputs, outputs + gradients equal to float tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.executor import _build_graph_fn
+
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                          name="c1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, num_filter=4, kernel=(1, 1), name="c2")
+    net = (net * 0.5) + (net * 0.5)  # elementwise chain stays NHWC
+    net = sym.Concat(net, net, dim=1)
+    net = sym.Flatten(net)
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=3, name="fc"),
+                            sym.Variable("softmax_label"), name="softmax")
+
+    shapes = {"data": (2, 3, 8, 8)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+    rs = np.random.RandomState(3)
+    args = {n: jnp.asarray(rs.normal(0, 0.5, s).astype(np.float32))
+            for n, s in zip(net.list_arguments(), arg_shapes)}
+    args["softmax_label"] = jnp.asarray(rs.randint(0, 3, 2).astype(np.float32))
+    aux = {n: jnp.asarray((np.ones if n.endswith("_var") else np.zeros)(s, np.float32))
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+    key = jax.random.PRNGKey(0)
+
+    def run(channels_last):
+        fn = _build_graph_fn(net, channels_last=channels_last)
+        grad_names = [n for n in net.list_arguments()
+                      if n not in ("data", "softmax_label")]
+
+        def loss(ga):
+            merged = dict(args); merged.update(ga)
+            outs, new_aux = fn(merged, aux, key, True)
+            return jnp.sum(outs[0] * outs[0]), (outs[0], new_aux)
+
+        (l, (out, new_aux)), grads = jax.value_and_grad(
+            loss, has_aux=True)({k: args[k] for k in grad_names})
+        return out, grads, new_aux
+
+    out_cl, g_cl, aux_cl = run(True)
+    out_ref, g_ref, aux_ref = run(False)
+    np.testing.assert_allclose(np.asarray(out_cl), np.asarray(out_ref),
+                               rtol=1e-5, atol=1e-6)
+    for k in g_ref:
+        np.testing.assert_allclose(np.asarray(g_cl[k]), np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+    for k in aux_ref:
+        np.testing.assert_allclose(np.asarray(aux_cl[k]), np.asarray(aux_ref[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
