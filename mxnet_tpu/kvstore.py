@@ -416,9 +416,26 @@ class KVStoreDist(KVStore):
             "MXTPU_KV_RECOVERY", os.environ.get("DMLC_RECOVERY", "")) == "1"
         self._shapes = {}
         self._client = None
+        # Comm/compute overlap (the SURVEY §3.4 contract: per-key comm
+        # scheduled as soon as its grad is ready, overlapping the rest of
+        # backward): push/pull RPCs run as priority-ordered tasks on the
+        # native host engine (src/engine.cc), one engine var per key so
+        # a key's pull serializes after its own push.  Pulls resolve
+        # lazily — the out array's next read waits (_Chunk.host_waiter).
+        # MXTPU_PS_ASYNC=0 or MXNET_ENGINE_TYPE=NaiveEngine forces the
+        # synchronous path.
+        self._engine = None
+        self._key_vars = {}
         servers = os.environ.get("MXTPU_PS_SERVERS", "")
         if servers:
             self._client = _PSClient(servers.split(","), rank=self._rank)
+            if (os.environ.get("MXTPU_PS_ASYNC", "1") == "1"
+                    and os.environ.get("MXNET_ENGINE_TYPE",
+                                       "") != "NaiveEngine"):
+                from ._native import NativeEngine, available
+
+                if available():
+                    self._engine = NativeEngine()
             if "async" not in kv_type and not self._recovery:
                 if self._rank == 0:
                     from .kvstore_server import K_SYNC_MODE
@@ -428,6 +445,17 @@ class KVStoreDist(KVStore):
             import atexit
 
             atexit.register(self._send_stop)
+
+    def _var(self, key):
+        v = self._key_vars.get(key)
+        if v is None:
+            v = self._engine.new_var()
+            self._key_vars[key] = v
+        return v
+
+    def _wait_outstanding(self):
+        if self._engine is not None:
+            self._engine.wait_all()
 
     @property
     def rank(self):
@@ -466,7 +494,28 @@ class KVStoreDist(KVStore):
                 merged = v
             if k not in self._shapes:
                 self._shapes[k] = (merged.shape, np.dtype(merged.dtype))
-            self._client.push(k, merged.asnumpy())
+            if self._engine is None:
+                self._client.push(k, merged.asnumpy())
+                continue
+            # snapshot the immutable jax.Array NOW: the caller may mutate
+            # the NDArray right after push() returns (zero the grad, next
+            # backward), and reading lazily on the worker would send THAT.
+            # _read also resolves any pending engine write on the value (a
+            # just-pulled array) on this thread — a lazy read would have
+            # the push task wait on its own var.  Neither blocks: the
+            # device->host fetch is np.asarray on the worker.
+            raw = merged._read()
+
+            def _do_push(k=k, raw=raw):
+                from . import profiler as _prof
+
+                with _prof.span(f"kvstore_push[{k}]", category="kvstore"):
+                    # the device->host fetch happens HERE, on the engine
+                    # worker — the caller thread never blocks on the RPC
+                    self._client.push(k, np.asarray(raw))
+
+            self._engine.push(_do_push, mutable_vars=[self._var(k)],
+                              priority=priority)
 
     def pull(self, key, out=None, priority=0):
         if self._client is None:
@@ -477,10 +526,30 @@ class KVStoreDist(KVStore):
             outs = [out]
         for k, o in zip(keys, outs):
             shape, dtype = self._shapes[k]
-            val = self._client.pull(k, shape, dtype)
             targets = o if isinstance(o, (list, tuple)) else [o]
+            if self._engine is None:
+                val = self._client.pull(k, shape, dtype)
+                for oo in targets:
+                    oo._set(val)
+                continue
+
+            def _do_pull(k=k, shape=shape, dtype=dtype, targets=targets):
+                from . import profiler as _prof
+
+                with _prof.span(f"kvstore_pull[{k}]", category="kvstore"):
+                    val = self._client.pull(k, shape, dtype)
+                    for oo in targets:
+                        oo._set(val)
+
+            var = self._var(k)  # serializes after this key's pushes
+            self._engine.push(_do_pull, mutable_vars=[var],
+                              priority=priority)
+            eng = self._engine
             for oo in targets:
-                oo._set(val)
+                # WaitToRead: the next read of the out array blocks until
+                # the engine-scheduled write landed
+                oo._chunk.host_waiter = (
+                    lambda eng=eng, var=var: eng.wait_for_var(var))
 
     def set_optimizer(self, optimizer):
         if self._client is None:
@@ -501,6 +570,7 @@ class KVStoreDist(KVStore):
 
     def barrier(self):
         if self._client is not None:
+            self._wait_outstanding()  # in-flight pushes precede the barrier
             self._client.barrier()
             return
         # with a live jax.distributed backend this is a cross-host sync
@@ -525,6 +595,10 @@ class KVStoreDist(KVStore):
 
     def _send_stop(self):
         if self._client is not None:
+            try:
+                self._wait_outstanding()
+            except Exception as exc:  # noqa: BLE001 — still stop the servers
+                logging.warning("kvstore: outstanding comm failed: %r", exc)
             client, self._client = self._client, None
             from .kvstore_server import K_STOP_SERVER
 

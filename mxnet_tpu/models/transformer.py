@@ -18,16 +18,19 @@ def _attention_block(h, seq_len, d_model, num_heads, name):
     dh = d_model // num_heads
     ln = sym.LayerNorm(h, name=f"{name}_ln1")
     x2 = sym.Reshape(ln, shape=(-1, d_model))
-    qkv = sym.FullyConnected(x2, num_hidden=3 * d_model, name=f"{name}_qkv")
-    qkv = sym.Reshape(qkv, shape=(-1, seq_len, 3 * d_model))
 
-    def heads(idx):
-        p = sym.slice_axis(qkv, axis=2, begin=idx * d_model,
-                           end=(idx + 1) * d_model)
-        p = sym.Reshape(p, shape=(0, 0, num_heads, dh))
+    # separate q/k/v projections (not one fused 3*d_model FC): under
+    # Megatron TP each (d_model, d_model) weight row-shards cleanly on
+    # the 'model' axis, whereas a fused qkv shard boundary would cut
+    # through the packed q|k|v layout and force GSPMD to re-gather the
+    # activation before the head split (parallel/mesh.py megatron_rules)
+    def heads(proj_name):
+        p = sym.FullyConnected(x2, num_hidden=d_model, name=proj_name)
+        p = sym.Reshape(p, shape=(-1, seq_len, num_heads, dh))
         return sym.transpose(p, axes=(0, 2, 1, 3))  # (N, H, T, Dh)
 
-    att = sym.FlashAttention(heads(0), heads(1), heads(2),
+    att = sym.FlashAttention(heads(f"{name}_q"), heads(f"{name}_k"),
+                             heads(f"{name}_v"),
                              causal=True, name=f"{name}_attn")
     att = sym.transpose(att, axes=(0, 2, 1, 3))
     att = sym.Reshape(att, shape=(-1, d_model))

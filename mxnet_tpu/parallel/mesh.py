@@ -90,9 +90,11 @@ def megatron_rules(model_axis: str = "model", shard_embed: bool = True):
     absent upstream.
     """
     rules = [
-        # attention: qkv column-parallel, out-projection row-parallel
-        ShardingRule(r".*_qkv_weight$", (model_axis, None)),
-        ShardingRule(r".*_qkv_bias$", (model_axis,)),
+        # attention: q/k/v column-parallel, out-projection row-parallel
+        # (separate projections so the shard boundary never cuts a packed
+        # q|k|v layout — models/transformer.py)
+        ShardingRule(r".*_(q|k|v)_weight$", (model_axis, None)),
+        ShardingRule(r".*_(q|k|v)_bias$", (model_axis,)),
         ShardingRule(r".*_proj_weight$", (None, model_axis)),
         # FFN: in column-parallel, out row-parallel
         ShardingRule(r".*_ffn_in_weight$", (model_axis, None)),

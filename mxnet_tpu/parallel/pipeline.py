@@ -11,14 +11,37 @@ ONE XLA program.  Backward falls out of jax autodiff: the transpose of
 ppermute is the reverse rotation, giving the mirror-image backward
 schedule for free.
 
+Schedule & memory profile:
+- bubble: (S-1)/(S-1+M) of ticks are fill/drain for S stages and M
+  microbatches (`bubble_fraction`); amortize with M >> S.
+- activation memory: the autodiff of the scan saves each tick's stage
+  activations, i.e. the GPipe profile (O(M) per stage).  1F1B's memory
+  advantage (O(S) in-flight microbatches) is obtained here the XLA way:
+  pass ``remat=True`` to checkpoint each stage invocation so backward
+  recomputes stage activations tick by tick — the scan carry is then the
+  only live activation, at ~1/3 extra stage FLOPs (same trade the
+  reference exposes as MXNET_BACKWARD_DO_MIRROR, env_var.md:55-57).
+- input/output replication: the microbatched input is replicated to all
+  stages and outputs are psum-shared (losses are computed replicated) —
+  per-device feed memory is O(batch), same order as data-parallel
+  training; the per-stage *weights and activations* are what pipelining
+  shards.  For feeds too big to replicate, stream microbatches from host
+  with a prefetching iterator instead of staging the whole batch.
+
+Real models: stages don't need to be single layers.  The usual layout is
+embed/head OUTSIDE the pipeline (computed with plain GSPMD sharding) and
+the repeated trunk inside, `blocks_per_stage` blocks per device via
+`stacked_blocks_stage` (tests/test_pipeline_moe.py pipelines a 4-block
+transformer LM; examples/model-parallel-lstm/lstm_pipeline.py pipelines
+the reference's model-parallel LSTM-PTB workload with one LSTM layer per
+stage).
+
 Shapes:
 - stage parameters are stacked on a leading stage axis and sharded over
   'pipe' (each device holds its stage's slice),
-- the microbatched input is [n_micro, micro_batch, ...].
-
-`pipeline_apply` returns the last stage's outputs for every microbatch;
-losses/grads compose with jax.value_and_grad around it (see
-tests/test_pipeline_moe.py and __graft_entry__.dryrun_multichip §4).
+- the microbatched input is [n_micro, micro_batch, ...],
+- every stage maps the activation shape to itself (equal-width trunk;
+  width changes belong outside the pipelined region).
 """
 from __future__ import annotations
 
@@ -46,18 +69,47 @@ def shard_stacked(mesh: Mesh, stacked, axis_name: str = "pipe"):
     }
 
 
+def bubble_fraction(n_stages: int, n_micro: int) -> float:
+    """Fraction of pipeline ticks spent filling/draining (idle bubble):
+    (S-1)/(S-1+M).  GPipe and 1F1B share this bubble; they differ only in
+    activation memory (see module docstring)."""
+    return (n_stages - 1) / (n_stages - 1 + n_micro)
+
+
+def stacked_blocks_stage(block_fn):
+    """Build a stage_fn running `blocks_per_stage` identical blocks.
+
+    block_fn(block_params, x) -> y.  The per-stage parameter slice must
+    carry a leading block axis on every leaf ({name: [B, ...]}); the
+    blocks run sequentially via lax.scan.  With stack_stage_params the
+    full tree is {name: [n_stages, B, ...]} — L = n_stages*B total
+    blocks, the standard "repeated trunk" pipeline layout.
+    """
+
+    def stage_fn(params, x, stage):
+        def body(h, blk):
+            return block_fn(blk, h), None
+
+        y, _ = jax.lax.scan(body, x, params)
+        return y
+
+    return stage_fn
+
+
 def pipeline_apply(stage_fn, stacked_params, micro_inputs, mesh: Mesh,
-                   axis_name: str = "pipe"):
+                   axis_name: str = "pipe", remat: bool = False):
     """Run the GPipe schedule; returns [n_micro, ...] last-stage outputs.
 
     stage_fn(params_slice, x, stage_index) -> y; every stage must map the
     same activation shape to itself (classic equal-width pipeline).
     stage_index arrives as a traced scalar — use jnp.where/lax.cond on it
-    for stage-dependent behavior.
+    for stage-dependent behavior.  remat=True recomputes stage
+    activations in backward (1F1B's memory profile; module docstring).
     """
     n_stages = mesh.shape[axis_name]
     n_micro = micro_inputs.shape[0]
     ticks = n_micro + n_stages - 1
+    fn = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
 
     param_specs = {n: P(axis_name, *([None] * (v.ndim - 1)))
                    for n, v in stacked_params.items()}
@@ -85,7 +137,7 @@ def pipeline_apply(stage_fn, stacked_params, micro_inputs, mesh: Mesh,
                     xs, jnp.minimum(t, n_micro - 1), keepdims=False),
                 jnp.zeros(act_shape, xs.dtype))
             x_in = jnp.where(stage == 0, feed, incoming)
-            y = stage_fn(my, x_in, stage)
+            y = fn(my, x_in, stage)
             # only the last stage's finished ticks are real outputs
             out = jnp.where(stage == n_stages - 1, y,
                             jnp.zeros_like(y))

@@ -166,3 +166,100 @@ def test_dist_async_survives_worker_crash():
     _launch(CRASH_WORKER, n=2, s=1,
             extra_env={"MXTPU_PS_DEAD_TIMEOUT_S": "3",
                        "MXTPU_PS_HEARTBEAT_S": "0.3"})
+
+
+def test_push_returns_before_server_ack():
+    """Comm/compute overlap (SURVEY §3.4): KVStoreDist.push must enqueue
+    the RPC on the native host engine and return immediately; the pull's
+    result must still be ordered after the push (same key var) and land
+    lazily at the out array's next read."""
+    import threading
+    import time
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _native
+    from mxnet_tpu.kvstore import KVStoreDist
+
+    if not _native.available():
+        pytest.skip("native engine library unavailable")
+
+    class SlowClient:
+        """PS client double: acks pushes after a visible delay."""
+
+        def __init__(self):
+            self.store = {}
+            self.push_acked = threading.Event()
+
+        def push(self, key, arr):
+            time.sleep(0.4)
+            self.store[key] = self.store.get(key, 0) + arr
+            self.push_acked.set()
+
+        def pull(self, key, shape, dtype):
+            return np.asarray(self.store[key], dtype)
+
+        def barrier(self):
+            pass
+
+    kv = KVStoreDist("dist_sync")  # no MXTPU_PS_SERVERS -> no transport
+    kv._client = SlowClient()
+    kv._engine = _native.NativeEngine()
+
+    grad = mx.nd.ones((4, 5))
+    t0 = time.perf_counter()
+    kv.push("w", grad, priority=-1)
+    returned = time.perf_counter() - t0
+    assert returned < 0.2, f"push blocked for {returned:.3f}s"
+    assert not kv._client.push_acked.is_set(), \
+        "push must return BEFORE the server ack"
+
+    out = mx.nd.zeros((4, 5))
+    kv.pull("w", out=out, priority=-1)
+    # value lands at the read (WaitToRead semantics), ordered after push
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+    assert kv._client.push_acked.is_set()
+    kv._engine.wait_all()
+
+
+def test_async_comm_emits_profiler_spans():
+    """The engine-scheduled push/pull record kvstore spans so traces show
+    comm overlapping compute."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _native, profiler
+    from mxnet_tpu.kvstore import KVStoreDist
+
+    if not _native.available():
+        pytest.skip("native engine library unavailable")
+
+    class Client:
+        def __init__(self):
+            self.store = {}
+
+        def push(self, key, arr):
+            self.store[key] = arr
+
+        def pull(self, key, shape, dtype):
+            return np.asarray(self.store[key], dtype)
+
+        def barrier(self):
+            pass
+
+    kv = KVStoreDist("dist_sync")
+    kv._client = Client()
+    kv._engine = _native.NativeEngine()
+    profiler.profiler_set_state("run")
+    try:
+        kv.push("p", mx.nd.ones((2, 2)))
+        out = mx.nd.zeros((2, 2))
+        kv.pull("p", out=out)
+        out.asnumpy()
+        kv._engine.wait_all()
+        names = [e["name"] for e in profiler._events]
+    finally:
+        profiler.profiler_set_state("stop")
+    assert any("kvstore_push[p]" in n for n in names), names
+    assert any("kvstore_pull[p]" in n for n in names), names

@@ -241,9 +241,10 @@ def test_transformer_tp_matches_dense_oracle():
                                    rtol=1e-5, atol=1e-5,
                                    err_msg=f"param {k} diverged under TP")
     # the rules actually sharded things (not a replicated no-op)
-    qkv = tp.params["layer0_qkv_weight"]
-    assert not qkv.sharding.is_fully_replicated
-    assert qkv.addressable_shards[0].data.shape[0] == qkv.shape[0] // 4
+    for pname in ("layer0_q_weight", "layer0_k_weight", "layer0_v_weight"):
+        w = tp.params[pname]
+        assert not w.sharding.is_fully_replicated
+        assert w.addressable_shards[0].data.shape[0] == w.shape[0] // 4
 
 
 def test_transformer_dp_tp_mesh_trains():
