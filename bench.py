@@ -213,21 +213,36 @@ def _bench(dev, kind):
                 os._exit(0)
 
         threading.Thread(target=extras_watchdog, daemon=True).start()
+        deadline = time.monotonic() + float(
+            os.environ.get("BENCH_EXTRAS_TIMEOUT_S", "480")) - 20.0
         extras = {}
         try:
-            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-            from tools.benchmark_score import score
-
-            inf = score("resnet-50", 32, 20, "bf16")
+            # inference: reuse the ALREADY-COMPILED trainer's params with
+            # its eval graph — one forward-only compile, no separate
+            # predictor build (round-2 extras timed out rebuilding one)
+            infer_iters = 30
+            warm = tr.eval(data=staged[0]["data"])  # compile
+            # barrier on the warmup's OWN output: params have no data
+            # dependency on an eval, so fetch_barrier() would let the
+            # warmup execution bleed into the timed window
+            float(np.asarray(warm[0]).ravel()[0])
+            itic = time.perf_counter()
+            for i in range(infer_iters):
+                out = tr.eval(data=staged[i % len(staged)]["data"])
+            float(np.asarray(out[0]).ravel()[0])
+            idt = time.perf_counter() - itic
+            inf = batch * infer_iters / idt
             extras["resnet50_infer_b32_imgs_per_sec"] = round(inf, 1)
             extras["infer_vs_p100_baseline"] = round(inf / 713.17, 2)
         except Exception as exc:  # noqa: BLE001
             extras["extras_error"] = repr(exc)
         try:
             # large-batch train: the chip's best-case throughput (the b32
-            # headline stays baseline-comparable; this shows the ceiling)
+            # headline stays baseline-comparable; this shows the ceiling).
+            # Needs a fresh compile for the new shape — only start it when
+            # enough budget remains for compile (~60s) + measurement.
             big = int(os.environ.get("BENCH_LARGE_BATCH", "256"))
-            if big > batch:
+            if big > batch and time.monotonic() < deadline - 120:
                 big_tr = FusedTrainer(
                     net, optimizer="sgd",
                     optimizer_params={"lr": 0.1, "momentum": 0.9,
@@ -238,21 +253,25 @@ def _bench(dev, kind):
                     0, 1, (big, 3, 224, 224)).astype(np.float32)),
                     "softmax_label": jax.device_put(
                         rs.randint(0, 1000, big).astype(np.float32))}
-                for _ in range(3):
-                    big_tr.step(**bdata)
+                big_tr.step(**bdata)  # compile
                 bname = sorted(big_tr.params)[0]
                 float(np.asarray(big_tr.params[bname]).ravel()[0])
+                big_tr.step(**bdata)  # settle
+                float(np.asarray(big_tr.params[bname]).ravel()[0])
+                biters = 12
                 btic = time.perf_counter()
-                for _ in range(20):
+                for _ in range(biters):
                     big_tr.step(**bdata)
                 float(np.asarray(big_tr.params[bname]).ravel()[0])
                 bdt = time.perf_counter() - btic
-                big_img_s = big * 20 / bdt
+                big_img_s = big * biters / bdt
                 extras["resnet50_train_b%d_imgs_per_sec" % big] = round(
                     big_img_s, 1)
                 if peak:
                     extras["mfu_b%d" % big] = round(
                         big_img_s * TRAIN_FLOPS_PER_IMG / peak, 4)
+            elif big > batch:
+                extras["large_batch_skipped"] = "insufficient extras budget"
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))
         if not claim():

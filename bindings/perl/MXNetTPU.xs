@@ -1,0 +1,114 @@
+/* Perl XS glue for the C predict ABI (libmxtpu_predict.so).
+ *
+ * Parity model: the reference's language bindings are thin wrappers over
+ * the same C API (SURVEY.md Appendix B — R-package/src glue, matlab
+ * model.m, amalgamation/jni/predictor.cc).  This is the predict-only
+ * binding in the one extra interpreter this image ships (perl): XS calls
+ * MXPredCreate/SetInput/Forward/GetOutputShape/GetOutput/Free directly.
+ */
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxtpu.h"
+
+MODULE = MXNetTPU  PACKAGE = MXNetTPU
+
+PROTOTYPES: DISABLE
+
+IV
+_create(sym_json, params_sv, key, shape_ref)
+        const char *sym_json
+        SV *params_sv
+        const char *key
+        SV *shape_ref
+    CODE:
+        STRLEN plen;
+        const char *pbytes = SvPVbyte(params_sv, plen);
+        AV *shape_av = (AV *)SvRV(shape_ref);
+        int ndim = (int)av_len(shape_av) + 1;
+        if (ndim <= 0 || ndim > 8)
+            croak("MXNetTPU: input shape must have 1..8 dims");
+        unsigned indptr[2] = {0, (unsigned)ndim};
+        unsigned shape[8];
+        int i;
+        for (i = 0; i < ndim; i++)
+            shape[i] = (unsigned)SvUV(*av_fetch(shape_av, i, 0));
+        const char *keys[1];
+        keys[0] = key;
+        void *h = NULL;
+        if (MXPredCreate(sym_json, pbytes, (int)plen, 1, 0, 1, keys,
+                         indptr, shape, &h) != 0)
+            croak("MXPredCreate: %s", MXPredGetLastError());
+        RETVAL = PTR2IV(h);
+    OUTPUT:
+        RETVAL
+
+void
+_set_input(handle, key, data_ref)
+        IV handle
+        const char *key
+        SV *data_ref
+    CODE:
+        AV *av = (AV *)SvRV(data_ref);
+        unsigned n = (unsigned)av_len(av) + 1;
+        float *buf = (float *)malloc(n * sizeof(float));
+        unsigned i;
+        for (i = 0; i < n; i++)
+            buf[i] = (float)SvNV(*av_fetch(av, i, 0));
+        int rc = MXPredSetInput(INT2PTR(void *, handle), key, buf, n);
+        free(buf);
+        if (rc != 0)
+            croak("MXPredSetInput: %s", MXPredGetLastError());
+
+void
+_forward(handle)
+        IV handle
+    CODE:
+        if (MXPredForward(INT2PTR(void *, handle)) != 0)
+            croak("MXPredForward: %s", MXPredGetLastError());
+
+SV *
+_output_shape(handle, index)
+        IV handle
+        UV index
+    CODE:
+        unsigned *shape = NULL;
+        unsigned ndim = 0;
+        if (MXPredGetOutputShape(INT2PTR(void *, handle),
+                                 (uint32_t)index, &shape, &ndim) != 0)
+            croak("MXPredGetOutputShape: %s", MXPredGetLastError());
+        AV *av = newAV();
+        unsigned i;
+        for (i = 0; i < ndim; i++)
+            av_push(av, newSVuv(shape[i]));
+        RETVAL = newRV_noinc((SV *)av);
+    OUTPUT:
+        RETVAL
+
+SV *
+_output(handle, index, total)
+        IV handle
+        UV index
+        UV total
+    CODE:
+        float *buf = (float *)malloc(total * sizeof(float));
+        if (MXPredGetOutput(INT2PTR(void *, handle), (uint32_t)index,
+                            buf, (uint32_t)total) != 0) {
+            free(buf);
+            croak("MXPredGetOutput: %s", MXPredGetLastError());
+        }
+        AV *av = newAV();
+        UV i;
+        for (i = 0; i < total; i++)
+            av_push(av, newSVnv((double)buf[i]));
+        free(buf);
+        RETVAL = newRV_noinc((SV *)av);
+    OUTPUT:
+        RETVAL
+
+void
+_free(handle)
+        IV handle
+    CODE:
+        MXPredFree(INT2PTR(void *, handle));

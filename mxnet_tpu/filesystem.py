@@ -158,12 +158,8 @@ class HttpFileSystem(FileSystem):
 
         def _fetch(self, lo, hi):
             """[lo, hi) from the server; populates _whole on 200."""
-            import urllib.request
-
-            req = urllib.request.Request(self._url, headers={
-                "Range": f"bytes={lo}-{hi - 1}"})
-            with urllib.request.urlopen(req,
-                                        timeout=self._fs.timeout) as r:
+            with self._fs._urlopen(self._url, headers={
+                    "Range": f"bytes={lo}-{hi - 1}"}) as r:
                 data = r.read()
                 if r.status != 206:
                     # server ignored Range: it sent the whole body — keep
@@ -199,6 +195,18 @@ class HttpFileSystem(FileSystem):
             self._pos += len(out)
             return out
 
+    # auth hook: subclasses (S3/GS) rewrite the URI to a concrete endpoint
+    # URL and inject auth headers; the base class is a pass-through
+    def _prepare(self, uri, headers, method):
+        return uri, headers
+
+    def _urlopen(self, uri, headers=None, method="GET"):
+        import urllib.request
+
+        url, hdrs = self._prepare(uri, dict(headers or {}), method)
+        req = urllib.request.Request(url, headers=hdrs, method=method)
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
     def open(self, path, mode="rb"):
         if "w" in mode or "a" in mode:
             raise MXNetError("http filesystem is read-only")
@@ -206,7 +214,6 @@ class HttpFileSystem(FileSystem):
 
     def size(self, path):
         import urllib.error
-        import urllib.request
 
         cached = self._size_cache.get(path)
         if cached is not None:
@@ -217,8 +224,7 @@ class HttpFileSystem(FileSystem):
             return n
 
         try:
-            req = urllib.request.Request(path, method="HEAD")
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with self._urlopen(path, method="HEAD") as r:
                 cl = r.headers["Content-Length"]
                 if cl is not None:
                     return done(int(cl))
@@ -226,9 +232,7 @@ class HttpFileSystem(FileSystem):
             pass  # presigned URLs often sign GET only — fall through
         try:
             # 1-byte Range GET: Content-Range carries the total size
-            req = urllib.request.Request(path,
-                                         headers={"Range": "bytes=0-0"})
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with self._urlopen(path, headers={"Range": "bytes=0-0"}) as r:
                 cr = r.headers.get("Content-Range")  # "bytes 0-0/12345"
                 total = cr.rsplit("/", 1)[1] if cr and "/" in cr else None
                 if total and total != "*":  # '*' = RFC 7233 unknown length
@@ -254,12 +258,142 @@ class HttpFileSystem(FileSystem):
         return [pattern]  # no server-side listing over plain HTTP
 
 
+_EMPTY_SHA256 = (
+    "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855")
+
+
+def _sigv4_headers(method, host, path, headers, access_key, secret_key,
+                   region, amzdate, session_token=None, service="s3"):
+    """AWS Signature Version 4 for a bodyless request (GET/HEAD).
+
+    Pure-stdlib signing of the canonical request -> string-to-sign ->
+    derived key chain, per the SigV4 spec; returns the full header dict
+    including Authorization.  Split out from S3FileSystem so it can be
+    pinned against the published AWS test vector (test_filesystem.py).
+    """
+    import hashlib
+    import hmac
+    from urllib.parse import quote
+
+    hdrs = dict(headers)
+    hdrs["x-amz-date"] = amzdate
+    hdrs["x-amz-content-sha256"] = _EMPTY_SHA256
+    if session_token:
+        hdrs["x-amz-security-token"] = session_token
+    hdrs["host"] = host
+
+    canon_uri = quote(path, safe="/~")
+    items = sorted((k.lower(), " ".join(str(v).split()))
+                   for k, v in hdrs.items())
+    signed = ";".join(k for k, _ in items)
+    canon_headers = "".join(f"{k}:{v}\n" for k, v in items)
+    canonical = "\n".join([method, canon_uri, "", canon_headers, signed,
+                           _EMPTY_SHA256])
+    datestamp = amzdate[:8]
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amzdate, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def _hmac(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k = _hmac(_hmac(_hmac(k, region), service), "aws4_request")
+    sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    hdrs["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed}, Signature={sig}")
+    del hdrs["host"]  # urllib sets Host itself; it was only for signing
+    return hdrs
+
+
+class S3FileSystem(HttpFileSystem):
+    """s3://bucket/key with AWS SigV4 request signing (parity: dmlc-core's
+    USE_S3 InputSplit backend, make/config.mk:138-146; credentials come
+    from the same env vars the reference documents in
+    docs/how_to/env_var.md — AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY).
+
+    Also honored: AWS_SESSION_TOKEN, AWS_REGION/AWS_DEFAULT_REGION
+    (default us-east-1), and S3_ENDPOINT (custom/on-prem endpoint,
+    path-style addressing — also how the tests point the signer at a
+    local double).  Unsigned public-bucket access works when no
+    credentials are set.  Read-only, like the reference's S3 reader;
+    listing requires a full URI (no server-side wildcard).
+    """
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+
+    def _creds(self):
+        env = os.environ
+        return (env.get("AWS_ACCESS_KEY_ID"),
+                env.get("AWS_SECRET_ACCESS_KEY"),
+                env.get("AWS_SESSION_TOKEN"),
+                env.get("AWS_REGION",
+                        env.get("AWS_DEFAULT_REGION", "us-east-1")))
+
+    def _prepare(self, uri, headers, method):
+        from urllib.parse import quote, urlsplit
+
+        parts = urlsplit(uri)
+        bucket, key = parts.netloc, parts.path.lstrip("/")
+        endpoint = os.environ.get("S3_ENDPOINT")
+        if endpoint:
+            endpoint = endpoint.rstrip("/")
+            base = endpoint if "://" in endpoint else "https://" + endpoint
+            ep = urlsplit(base)
+            host = ep.netloc
+            # any endpoint path prefix (S3 behind a reverse-proxy subpath)
+            # must be part of the SIGNED canonical URI too, or the server
+            # rejects with SignatureDoesNotMatch
+            path = f"{ep.path}/{bucket}/{key}"
+            url = f"{ep.scheme}://{ep.netloc}" + quote(path, safe="/~")
+        else:
+            _, _, _, region = self._creds()
+            host = f"{bucket}.s3.{region}.amazonaws.com"
+            path = "/" + key
+            url = f"https://{host}" + quote(path, safe="/~")
+        ak, sk, tok, region = self._creds()
+        if ak and sk:
+            import datetime as _dt
+
+            amzdate = _dt.datetime.now(_dt.timezone.utc).strftime(
+                "%Y%m%dT%H%M%SZ")
+            headers = _sigv4_headers(method, host, path, headers, ak, sk,
+                                     region, amzdate, tok)
+        return url, headers
+
+
+class GSFileSystem(HttpFileSystem):
+    """gs://bucket/object over the GCS XML/JSON endpoint with a bearer
+    token (GS_OAUTH2_TOKEN or GOOGLE_OAUTH_ACCESS_TOKEN env; unset =
+    unauthenticated access to public objects).  GS_ENDPOINT overrides the
+    endpoint for test doubles / emulators."""
+
+    def _prepare(self, uri, headers, method):
+        from urllib.parse import quote, urlsplit
+
+        parts = urlsplit(uri)
+        bucket, key = parts.netloc, parts.path.lstrip("/")
+        base = os.environ.get("GS_ENDPOINT",
+                              "https://storage.googleapis.com").rstrip("/")
+        url = base + quote(f"/{bucket}/{key}", safe="/~")
+        token = os.environ.get("GS_OAUTH2_TOKEN",
+                               os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN"))
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        return url, headers
+
+
 _REGISTRY: Dict[str, FileSystem] = {
     "": LocalFileSystem(),
     "file": LocalFileSystem(),
     "mem": MemFileSystem(),
     "http": HttpFileSystem(),
     "https": HttpFileSystem(),
+    "s3": S3FileSystem(),
+    "gs": GSFileSystem(),
 }
 
 

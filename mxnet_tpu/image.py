@@ -340,23 +340,46 @@ class ImageIter(DataIter):
         return img.transpose(2, 0, 1)  # HWC -> CHW
 
     def next(self):
-        batch_data = np.zeros((self.batch_size,) + self.data_shape, np.float32)
-        batch_label = np.zeros((self.batch_size, self.label_width), np.float32)
+        from . import storage
+
+        # pooled staging (parity: pooled_storage_manager.h recycling):
+        # np.empty from the arena + explicit fill beats np.zeros'ing the
+        # whole batch buffer every iteration; stage_to_device copies into
+        # the jax array and recycles the buffer immediately
+        batch_data = storage.staging_empty(
+            (self.batch_size,) + self.data_shape, np.float32)
+        batch_label = storage.staging_empty(
+            (self.batch_size, self.label_width), np.float32)
         i = 0
         pad = 0
+        staged = False
         try:
-            while i < self.batch_size:
-                label, raw = self.next_sample()
-                batch_data[i] = self._process(raw)
-                lab = np.atleast_1d(np.asarray(label, np.float32))
-                batch_label[i, : self.label_width] = lab[: self.label_width]
-                i += 1
-        except StopIteration:
-            if i == 0:
-                raise
-            pad = self.batch_size - i
-        label_out = batch_label[:, 0] if self.label_width == 1 else batch_label
-        return DataBatch([nd.array(batch_data)], [nd.array(label_out)], pad=pad)
+            try:
+                while i < self.batch_size:
+                    label, raw = self.next_sample()
+                    batch_data[i] = self._process(raw)
+                    lab = np.atleast_1d(np.asarray(label, np.float32))
+                    batch_label[i, : self.label_width] = \
+                        lab[: self.label_width]
+                    i += 1
+            except StopIteration:
+                if i == 0:
+                    raise
+                pad = self.batch_size - i
+                batch_data[i:] = 0.0
+                batch_label[i:] = 0.0
+            label_np = (batch_label[:, 0] if self.label_width == 1
+                        else batch_label)
+            label_arr = nd.array(label_np.copy())  # explicit copy off pool
+            data_arr = nd.NDArray(storage.stage_to_device(batch_data))
+            staged = True
+            return DataBatch([data_arr], [label_arr], pad=pad)
+        finally:
+            # pool blocks only return via staging_free — a decode error
+            # escaping here (bad JPEG) must not leak the batch buffer
+            if not staged:
+                storage.staging_free(batch_data)
+            storage.staging_free(batch_label)
 
 
 class ImageRecordIter(DataIter):
@@ -454,6 +477,8 @@ class ImageRecordIter(DataIter):
         return img.transpose(2, 0, 1), lab
 
     def next(self):
+        from . import storage
+
         if self.cur >= len(self.order):
             raise StopIteration
         idxs = self.order[self.cur : self.cur + self.batch_size]
@@ -461,9 +486,25 @@ class ImageRecordIter(DataIter):
         if pad:
             idxs = idxs + self.order[:pad]  # wrap-around padding
         self.cur += self.batch_size
-        results = list(self.pool.map(self._decode_one,
-                                     [self.records[i] for i in idxs]))
-        data = np.stack([r[0] for r in results])
-        labels = np.stack([r[1] for r in results])
-        label_out = labels[:, 0] if self.label_width == 1 else labels[:, : self.label_width]
-        return DataBatch([nd.array(data)], [nd.array(label_out)], pad=pad)
+        # decode/augment on the thread pool; workers write straight into
+        # the pooled staging buffer (copy-on-stage recycles it below)
+        data = storage.staging_empty((self.batch_size,) + self.data_shape,
+                                     np.float32)
+        labels = np.empty((self.batch_size, self.label_width), np.float32)
+
+        def work(slot, rec):
+            img, lab = self._decode_one(rec)
+            data[slot] = img
+            n = min(self.label_width, lab.size)
+            labels[slot, :n] = lab[:n]
+            labels[slot, n:] = 0.0
+
+        try:
+            list(self.pool.map(work, range(len(idxs)),
+                               [self.records[i] for i in idxs]))
+        except Exception:
+            storage.staging_free(data)  # decode error must not leak block
+            raise
+        label_out = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch([nd.NDArray(storage.stage_to_device(data))],
+                         [nd.array(label_out)], pad=pad)

@@ -538,8 +538,20 @@ def load(fname: str) -> Symbol:
 
 
 def load_json(json_str: str) -> Symbol:
-    """Parity: MXSymbolCreateFromJSON."""
+    """Parity: MXSymbolCreateFromJSON.
+
+    Reference/nnvm-format JSON (node ``param`` dicts, backward_source_id,
+    node_row_ptr — anything not written by this package's tojson) routes
+    through interop.load_symbol_json, which also applies the legacy
+    upgrades (aux-input injection etc.)."""
     data = json.loads(json_str)
+    if "nodes" in data and data["nodes"] and (
+            "node_row_ptr" in data
+            or any("param" in n or "backward_source_id" in n
+                   for n in data["nodes"])):
+        from .interop import load_symbol_json
+
+        return load_symbol_json(json_str)
     nodes: List[_Node] = []
     for jn in data["nodes"]:
         if jn["op"] == "null":

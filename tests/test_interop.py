@@ -1,0 +1,162 @@
+"""Reference-checkpoint interop: the binary .params format and legacy
+symbol JSON (incl. the pre-0.9 upgrades) load into this framework.
+
+Format spec: reference src/ndarray/ndarray.cc:593-694 (NDArray list:
+magic 0x112 | reserved | arrays | names) and src/nnvm/legacy_json_util.cc
+(param->attrs, missing-aux-input injection)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import interop, ndarray as nd, symbol as sym
+
+
+def _legacy_mlp_json():
+    """A pre-0.9-style symbol JSON (op params under 'param', annotations
+    under 'attr', BatchNorm WITHOUT aux inputs, 2-element input refs)."""
+    return json.dumps({
+        "nodes": [
+            {"op": "null", "param": {}, "name": "data", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "fc1_weight", "inputs": [],
+             "backward_source_id": -1,
+             "attr": {"lr_mult": "0.2"}},
+            {"op": "null", "param": {}, "name": "fc1_bias", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "FullyConnected",
+             "param": {"no_bias": "False", "num_hidden": "8"},
+             "name": "fc1", "inputs": [[0, 0], [1, 0], [2, 0]],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "bn_gamma", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "bn_beta", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "BatchNorm", "param": {"eps": "0.001"},
+             "name": "bn", "inputs": [[3, 0], [4, 0], [5, 0]],
+             "backward_source_id": -1},
+            {"op": "Activation", "param": {"act_type": "relu"},
+             "name": "relu1", "inputs": [[6, 0]],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "softmax_label",
+             "inputs": [], "backward_source_id": -1},
+            {"op": "SoftmaxOutput", "param": {},
+             "name": "softmax", "inputs": [[7, 0], [8, 0]],
+             "backward_source_id": -1},
+        ],
+        "arg_nodes": [0, 1, 2, 4, 5, 8],
+        "heads": [[9, 0]],
+    })
+
+
+def test_legacy_symbol_json_upgrades_and_runs():
+    s = interop.load_symbol_json(_legacy_mlp_json())
+    # the 0.8->0.9 upgrade injected default-named aux variables
+    assert s.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert "fc1_weight" in s.list_arguments()
+    exe = s.simple_bind(data=(2, 6), softmax_label=(2,))
+    exe.arg_dict["data"][:] = np.random.RandomState(0).rand(2, 6)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (2, 8)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+
+def test_symbol_load_sniffs_reference_format(tmp_path):
+    """sym.load on a reference-format file routes through interop."""
+    p = tmp_path / "legacy-symbol.json"
+    p.write_text(_legacy_mlp_json())
+    s = sym.load(str(p))
+    assert "bn_moving_var" in s.list_auxiliary_states()
+
+
+def test_params_binary_roundtrip(tmp_path):
+    rs = np.random.RandomState(1)
+    arg = {"fc1_weight": nd.array(rs.rand(8, 6).astype(np.float32)),
+           "fc1_bias": nd.array(np.arange(8, dtype=np.float32)),
+           "codes": nd.array(rs.randint(0, 200, (3, 4)).astype(np.uint8)),
+           "ids": nd.array(rs.randint(0, 9, (5,)).astype(np.int32)),
+           "half": nd.array(rs.rand(2, 2).astype(np.float16))}
+    aux = {"bn_moving_mean": nd.array(rs.rand(8).astype(np.float32))}
+    f = str(tmp_path / "model-0003.params")
+    interop.save_params(f, arg, aux)
+
+    arg2, aux2 = interop.load_params(f)
+    assert set(arg2) == set(arg) and set(aux2) == set(aux)
+    for k in arg:
+        assert arg2[k].asnumpy().dtype == arg[k].asnumpy().dtype
+        np.testing.assert_array_equal(arg2[k].asnumpy(), arg[k].asnumpy())
+    np.testing.assert_array_equal(aux2["bn_moving_mean"].asnumpy(),
+                                  aux["bn_moving_mean"].asnumpy())
+
+
+def test_nd_load_sniffs_reference_magic(tmp_path):
+    f = str(tmp_path / "blob.params")
+    interop.save_params(f, {"w": nd.array(np.ones((2, 3)))}, {})
+    d = nd.load(f)
+    assert list(d) == ["arg:w"]
+    np.testing.assert_array_equal(d["arg:w"].asnumpy(), np.ones((2, 3)))
+
+
+def test_full_reference_checkpoint_loads_into_module(tmp_path):
+    """End-to-end: a reference-format checkpoint (legacy JSON + binary
+    params) loads via interop.load_checkpoint and predicts with the
+    stored weights."""
+    prefix = str(tmp_path / "legacy")
+    with open(prefix + "-symbol.json", "w") as f:
+        f.write(_legacy_mlp_json())
+    rs = np.random.RandomState(3)
+    arg = {"fc1_weight": nd.array(rs.rand(8, 6).astype(np.float32)),
+           "fc1_bias": nd.array(rs.rand(8).astype(np.float32)),
+           "bn_gamma": nd.array(np.ones(8, np.float32)),
+           "bn_beta": nd.array(np.zeros(8, np.float32))}
+    aux = {"bn_moving_mean": nd.array(np.zeros(8, np.float32)),
+           "bn_moving_var": nd.array(np.ones(8, np.float32))}
+    interop.save_params(prefix + "-0007.params", arg, aux)
+
+    s, arg2, aux2 = interop.load_checkpoint(prefix, 7)
+    exe = s.simple_bind(data=(4, 6), softmax_label=(4,))
+    exe.copy_params_from({k: v for k, v in arg2.items()},
+                         {k: v for k, v in aux2.items()},
+                         allow_extra_params=True)
+    x = rs.rand(4, 6).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    got = exe.forward(is_train=False)[0].asnumpy()
+    # oracle: fc + eval-mode bn (identity with zero-mean/unit-var stats)
+    # + relu + softmax
+    h = x @ arg["fc1_weight"].asnumpy().T + arg["fc1_bias"].asnumpy()
+    h = h / np.sqrt(1.0 + 1e-3)
+    h = np.maximum(h, 0)
+    e = np.exp(h - h.max(1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(1, keepdims=True),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.skipif(not os.path.exists(
+    "/root/reference/tests/python/unittest/save_000800.json"),
+    reason="reference fixture not present")
+def test_reference_own_legacy_fixture_loads():
+    """The reference repo's own 0.8-era JSON fixture (the file its
+    legacy_json_util tests use) loads, upgrades, and runs here."""
+    s = interop.load_symbol(
+        "/root/reference/tests/python/unittest/save_000800.json")
+    assert s.list_auxiliary_states() == ["batchnorm0_moving_mean",
+                                         "batchnorm0_moving_var"]
+    exe = s.simple_bind(data=(2, 100), softmax_label=(2,))
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (2, 10)
+
+
+def test_scalar_params_do_not_desync_stream(tmp_path):
+    """A 0-d array must not desync the reader (the reference format
+    treats ndim==0 as 'none array' with no body): scalars store as (1,)
+    and everything after them still loads exactly."""
+    f = str(tmp_path / "s.params")
+    interop.save_params(
+        f, {"scalar": nd.array(np.float32(3.5).reshape(())),
+            "w": nd.array(np.arange(4, dtype=np.float32).reshape(2, 2))}, {})
+    arg, _ = interop.load_params(f)
+    np.testing.assert_array_equal(arg["scalar"].asnumpy(), [3.5])
+    np.testing.assert_array_equal(arg["w"].asnumpy(),
+                                  [[0.0, 1.0], [2.0, 3.0]])

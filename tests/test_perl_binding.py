@@ -1,0 +1,105 @@
+"""Perl XS binding over the C predict ABI — a second real external
+consumer of libmxtpu_predict.so (parity model: the reference's
+language bindings are thin wrappers over the same C API; SURVEY.md
+Appendix B calls them proof the C ABI is the real product)."""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "mxnet_tpu", "lib", "libmxtpu_predict.so")
+
+PERL_CLIENT = """
+use strict; use warnings;
+use lib "%(blib)s/lib", "%(blib)s/arch";
+use MXNetTPU;
+my $p = MXNetTPU::Predictor->new(
+    symbol_file => "%(prefix)s-symbol.json",
+    params_file => "%(prefix)s-0000.params",
+    input_key   => "data",
+    input_shape => [4, 8]);
+my @x = map { $_ / 32.0 } 0 .. 31;
+my $out = $p->predict([@x]);
+my $shape = $p->output_shape;
+print "shape: @{$shape}\\n";
+printf "%%.6f\\n", $_ for @$out;
+"""
+
+
+def _have_perl_toolchain():
+    if shutil.which("perl") is None or shutil.which("make") is None:
+        return False
+    r = subprocess.run(["perl", "-MExtUtils::MakeMaker", "-e", "1"],
+                       capture_output=True)
+    return r.returncode == 0
+
+
+@pytest.mark.skipif(not _have_perl_toolchain(),
+                    reason="perl + MakeMaker not available")
+def test_perl_binding_matches_python_predictor(tmp_path):
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make", "-C", os.path.join(REPO, "src"),
+                            "predict"], capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+
+    # build the XS extension out-of-tree
+    build = tmp_path / "perl"
+    shutil.copytree(os.path.join(REPO, "bindings", "perl"), build)
+    env = dict(os.environ, MXTPU_REPO=REPO)
+    r = subprocess.run(["perl", "Makefile.PL"], cwd=build, env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(["make"], cwd=build, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # checkpoint + python-side oracle
+    import mxnet_tpu as mx
+    from mxnet_tpu import predict, sym
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=6)
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(net, name="fc2", num_hidden=3), name="softmax")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 8))
+    init = mx.init.Xavier()
+    arg_params = {}
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            init(name, arr)
+            arg_params[name] = arr
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 0, net, arg_params, {})
+
+    x = (np.arange(32, dtype=np.float32) / 32.0).reshape(4, 8)
+    p = predict.create(prefix, 0, {"data": (4, 8)})
+    p.set_input("data", x)
+    p.forward()
+    expected = np.asarray(p.get_output(0))
+
+    script = tmp_path / "client.pl"
+    script.write_text(PERL_CLIENT % {"blib": str(build / "blib"),
+                                     "prefix": prefix})
+    run_env = dict(os.environ)
+    run_env["MXTPU_PLATFORM"] = "cpu"
+    run_env["JAX_PLATFORMS"] = "cpu"
+    run_env["PYTHONPATH"] = REPO + os.pathsep + run_env.get("PYTHONPATH", "")
+    # one retry: the client embeds CPython + XLA inside perl, and a
+    # heavily loaded machine (full-suite runs) can starve its first
+    # compile
+    for attempt in (1, 2):
+        r = subprocess.run(["perl", str(script)], env=run_env,
+                           capture_output=True, text=True, timeout=300)
+        if r.returncode == 0:
+            break
+    assert r.returncode == 0, (
+        f"perl client rc={r.returncode}\nstdout: {r.stdout}\n"
+        f"stderr: {r.stderr}")
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "shape: 4 3", lines[0]
+    got = np.array([float(v) for v in lines[1:]]).reshape(4, 3)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)

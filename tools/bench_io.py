@@ -116,6 +116,15 @@ def main():
         print("decode(threads=%d): %.0f img/s" % (args.threads, dec_rate))
         pipe_rate = bench_pipeline(path, args.threads, args.size)
         print("pipeline(threads=%d): %.0f img/s" % (args.threads, pipe_rate))
+        # the same pipeline with the host staging arena disabled — shows
+        # what pooled batch buffers buy (storage.py stage_to_device)
+        from mxnet_tpu import storage
+
+        print("pipeline_pool_bytes: %d" % storage.pool_bytes())
+        with storage.pooling_disabled():
+            nopool_rate = bench_pipeline(path, args.threads, args.size)
+        print("pipeline_no_pool(threads=%d): %.0f img/s" %
+              (args.threads, nopool_rate))
         target = 1000.0
         print("target_1k_met: %s" % ("yes" if dec_rate >= target else "no"))
 
