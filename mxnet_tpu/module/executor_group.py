@@ -65,7 +65,21 @@ class DataParallelExecutorGroup:
             if d not in unique:
                 unique.append(d)
         if self.batch_size % len(unique) != 0:
-            unique = unique[:1]  # uneven split: fall back to single device
+            # GSPMD shards the batch evenly, so an uneven request uses the
+            # LARGEST device count dividing the batch — and says so (the
+            # reference's _split_input_slice gave devices uneven slices;
+            # silently dropping to one device is not acceptable either way)
+            n = len(unique)
+            while self.batch_size % n:
+                n -= 1
+            import logging
+
+            (logger or logging.getLogger()).warning(
+                "batch size %d not divisible by %d devices; data-parallel "
+                "group uses %d device(s) — pad the batch or adjust "
+                "batch_size for full utilization",
+                self.batch_size, len(unique), n)
+            unique = unique[:n]
         self.mesh = Mesh(np.array(unique), ("data",))
         self._data_sharding = NamedSharding(self.mesh, P("data"))
         self._repl_sharding = NamedSharding(self.mesh, P())
