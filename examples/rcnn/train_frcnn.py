@@ -30,7 +30,12 @@ POST = 16
 NUM_CLASSES = 3  # background, wide, tall
 
 
-def build_symbol(batch):
+def build_symbol(batch, train_rois=False):
+    """train_rois=True: the head pools an externally supplied `rois`
+    variable — the reference's proposal_target flow, where training rois
+    are the RPN proposals WITH the gt boxes appended so the head always
+    sees foreground samples (example/rcnn proposal_target.py).  False:
+    the head consumes the in-graph Proposal output (inference/eval)."""
     data = sym.Variable("data")
     im_info = sym.Variable("im_info")
     rpn_label = sym.Variable("rpn_label")          # (N, A0*FH*FW)
@@ -75,11 +80,15 @@ def build_symbol(batch):
     rpn_cls_act = sym.SoftmaxActivation(rpn_cls_flat, mode="channel",
                                         name="rpn_cls_act")
     rpn_cls_act = sym.Reshape(rpn_cls_act, shape=(0, 2 * A0, FEAT, FEAT))
-    rois = sym.Proposal(sym.BlockGrad(rpn_cls_act), sym.BlockGrad(rpn_bbox),
-                        im_info, feature_stride=STRIDE,
-                        scales=(2, 4, 8), ratios=(0.5, 1, 2),
-                        rpn_pre_nms_top_n=64, rpn_post_nms_top_n=POST,
-                        threshold=0.7, rpn_min_size=4, name="rois")
+    if train_rois:
+        rois = sym.BlockGrad(sym.Variable("rois"), name="rois")
+    else:
+        rois = sym.Proposal(sym.BlockGrad(rpn_cls_act),
+                            sym.BlockGrad(rpn_bbox),
+                            im_info, feature_stride=STRIDE,
+                            scales=(2, 4, 8), ratios=(0.5, 1, 2),
+                            rpn_pre_nms_top_n=64, rpn_post_nms_top_n=POST,
+                            threshold=0.7, rpn_min_size=4, name="rois")
 
     # fast-rcnn head
     pooled = sym.ROIPooling(feat, rois, pooled_size=(4, 4),
@@ -125,9 +134,15 @@ def np_iou(a, b):
     return inter / np.maximum(ua, 1e-6)
 
 
-def anchor_targets(gt_list, anchors):
+def anchor_targets(gt_list, anchors, rpn_batch=64, fg_fraction=0.5,
+                   rs=None):
     """RPN targets (parity: rcnn/io/rpn.py assign_anchor): fg iou>=0.5,
-    bg iou<0.3, rest ignored; bbox deltas for fg anchors."""
+    bg iou<0.3, rest ignored; bbox deltas for fg anchors.  Like the
+    reference, a fixed-size anchor batch is SAMPLED per image (up to
+    fg_fraction foreground) and everything else ignored — without this
+    the ~100:1 bg:fg imbalance drowns the foreground gradient and the
+    RPN only ever learns the class prior."""
+    rs = rs or np.random
     n = len(gt_list)
     total = anchors.shape[0]
     labels = np.full((n, total), -1, np.float32)
@@ -143,6 +158,18 @@ def anchor_targets(gt_list, anchors):
         for j in range(gt.shape[0]):
             fg[iou[:, j].argmax()] = True
         labels[i, fg] = 1
+        # subsample the anchor batch (assign_anchor num_batch/fg_fraction)
+        fg_idx = np.where(labels[i] == 1)[0]
+        n_fg = min(len(fg_idx), int(rpn_batch * fg_fraction))
+        if len(fg_idx) > n_fg:
+            off = rs.choice(fg_idx, len(fg_idx) - n_fg, replace=False)
+            labels[i, off] = -1
+        bg_idx = np.where(labels[i] == 0)[0]
+        n_bg = rpn_batch - n_fg
+        if len(bg_idx) > n_bg:
+            off = rs.choice(bg_idx, len(bg_idx) - n_bg, replace=False)
+            labels[i, off] = -1
+        fg = labels[i] == 1
         g = gt[arg[fg], :4]
         a = anchors[fg]
         aw = a[:, 2] - a[:, 0] + 1
@@ -172,26 +199,73 @@ def roi_targets(rois, gt_list):
     return labels
 
 
+def evaluate(ex, rs, args, im_info, n_batches=8):
+    """Detection mAP over held-out synthetic batches (parity:
+    example/rcnn test/eval flow): proposals are the boxes, the head's
+    softmax picks class+score, the shared VOC metric ranks them."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", "ssd"))
+    from eval_metric import VOC07MApMetric
+
+    m = VOC07MApMetric()
+    for _ in range(n_batches):
+        x, gt = synth_batch(rs, args.batch)
+        zero = np.zeros
+        ex.forward(is_train=False, data=x, im_info=im_info,
+                   rpn_label=zero((args.batch, A0 * FEAT * FEAT), np.float32),
+                   rpn_bbox_target=zero((args.batch, 4 * A0, FEAT, FEAT),
+                                        np.float32),
+                   rpn_bbox_weight=zero((args.batch, 4 * A0, FEAT, FEAT),
+                                        np.float32),
+                   roi_label=zero((args.batch * POST,), np.float32))
+        rois = ex.outputs[3].asnumpy()              # (B*POST, 5)
+        probs = ex.outputs[2].asnumpy()             # (B*POST, C)
+        cls = probs.argmax(1).astype(np.float32)
+        score = probs.max(1)
+        dets = np.full((args.batch, POST, 6), -1.0, np.float32)
+        counts = [0] * args.batch
+        for r in range(rois.shape[0]):
+            b = int(rois[r, 0])
+            if cls[r] == 0:                         # background
+                continue
+            dets[b, counts[b]] = [cls[r], score[r], *rois[r, 1:5]]
+            counts[b] += 1
+        labels = np.full((args.batch, 4, 5), -1.0, np.float32)
+        for b, g in enumerate(gt):
+            for j, row in enumerate(g):
+                labels[b, j] = [row[4], row[0], row[1], row[2], row[3]]
+        m.update([labels], [dets])
+    return m.get()[1]
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=2)
-    ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--eval", action="store_true",
+                    help="compute detection mAP after training")
     args = ap.parse_args()
     rs = np.random.RandomState(0)
+    mx.random.seed(0)  # deterministic Xavier init
 
     base = _generate_anchors(STRIDE, (2, 4, 8), (0.5, 1, 2))
     sx, sy = np.meshgrid(np.arange(FEAT) * STRIDE, np.arange(FEAT) * STRIDE)
     shifts = np.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], 1)
     anchors = (shifts[:, None].astype(np.float32) + base[None]).reshape(-1, 4)
 
-    net = build_symbol(args.batch)
+    # TRAIN graph: head pools host-supplied rois (proposals + gt boxes,
+    # the reference's proposal_target flow).  PROPOSAL/EVAL graph: head
+    # pools the in-graph Proposal output.  Both share the same parameter
+    # NDArrays, so one update serves both.
+    net = build_symbol(args.batch, train_rois=True)
     ex = net.simple_bind(
         ctx=mx.context.default_accelerator_context(), grad_req="write",
-        data=(args.batch, 3, IM, IM), im_info=(args.batch, 3),
+        data=(args.batch, 3, IM, IM),
         rpn_label=(args.batch, A0 * FEAT * FEAT),
         rpn_bbox_target=(args.batch, 4 * A0, FEAT, FEAT),
         rpn_bbox_weight=(args.batch, 4 * A0, FEAT, FEAT),
+        rois=(args.batch * POST, 5),
         roi_label=(args.batch * POST,))
     init = mx.init.Xavier()
     params = {}
@@ -199,6 +273,18 @@ def main():
         if name.endswith(("weight", "bias")) and "rpn_bbox_target" not in name:
             init(name, arr)
             params[name] = arr
+
+    eval_net = build_symbol(args.batch, train_rois=False)
+    eval_args = {}
+    for name in eval_net.list_arguments():
+        if name in ex.arg_dict:
+            eval_args[name] = ex.arg_dict[name]  # SHARED NDArray
+        else:
+            shp = {"data": (args.batch, 3, IM, IM),
+                   "im_info": (args.batch, 3)}.get(name)
+            eval_args[name] = mx.nd.zeros(shp) if shp else mx.nd.zeros((1,))
+    eval_ex = eval_net.bind(ctx=mx.context.default_accelerator_context(),
+                            args=eval_args, args_grad=None, grad_req="null")
     opt = mx.optimizer.create("sgd", learning_rate=args.lr, momentum=0.9,
                               rescale_grad=1.0 / args.batch)
     updater = mx.optimizer.get_updater(opt)
@@ -207,7 +293,7 @@ def main():
     first = last = None
     for step in range(args.steps):
         x, gt = synth_batch(rs, args.batch)
-        labels, bt, bw = anchor_targets(gt, anchors)
+        labels, bt, bw = anchor_targets(gt, anchors, rs=rs)
         # anchor layout in Proposal/loss: (H, W, A0) flattened; the rpn
         # label reshape (N, 2, A0*FH*FW) maps channel-major — match it
         lab = labels.reshape(args.batch, FEAT, FEAT, A0)
@@ -216,18 +302,23 @@ def main():
         bt4 = bt4.transpose(0, 3, 4, 1, 2).reshape(args.batch, 4 * A0, FEAT, FEAT)
         bw4 = bw.reshape(args.batch, FEAT, FEAT, A0, 4)
         bw4 = bw4.transpose(0, 3, 4, 1, 2).reshape(args.batch, 4 * A0, FEAT, FEAT)
-        # proposal-target stage (parity: proposal_target.py): a cheap eval
-        # forward yields THIS batch's proposals, whose labels then feed
-        # the training forward — labels and rois describe the same images
-        ex.forward(is_train=False, data=x, im_info=im_info, rpn_label=lab,
-                   rpn_bbox_target=bt4, rpn_bbox_weight=bw4,
-                   roi_label=np.zeros((args.batch * POST,), np.float32))
-        rois = ex.outputs[3].asnumpy()
+        # proposal-target stage (parity: proposal_target.py): the eval
+        # graph yields THIS batch's proposals; gt boxes are APPENDED
+        # (overwriting the tail rows) so the head always sees foreground
+        # samples, exactly as the reference's sampler guarantees
+        eval_ex.forward(is_train=False, data=x, im_info=im_info,
+                        rpn_label=lab, rpn_bbox_target=bt4,
+                        rpn_bbox_weight=bw4,
+                        roi_label=np.zeros((args.batch * POST,), np.float32))
+        rois = eval_ex.outputs[3].asnumpy().copy()
+        for i in range(args.batch):
+            for j, g in enumerate(gt[i]):
+                rois[i * POST + POST - 1 - j] = [i, g[0], g[1], g[2], g[3]]
         roi_labels = roi_targets(rois, gt)
 
-        ex.forward(is_train=True, data=x, im_info=im_info, rpn_label=lab,
+        ex.forward(is_train=True, data=x, rpn_label=lab,
                    rpn_bbox_target=bt4, rpn_bbox_weight=bw4,
-                   roi_label=roi_labels)
+                   rois=rois, roi_label=roi_labels)
         ex.backward()
         for i, (name, arr) in enumerate(sorted(params.items())):
             updater(i, ex.grad_dict[name], arr)
@@ -245,6 +336,9 @@ def main():
     print(f"first {first:.4f} last {last:.4f}")
     assert last < first, "rpn loss did not decrease"
     print("TRAIN OK")
+    if args.eval:
+        mAP = evaluate(eval_ex, rs, args, im_info)
+        print(f"mAP: {mAP:.4f}")
 
 
 if __name__ == "__main__":

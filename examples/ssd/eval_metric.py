@@ -1,0 +1,127 @@
+"""Detection mAP metrics (parity surface: example/ssd/evaluate/
+eval_metric.py — MApMetric + VOC07MApMetric).
+
+Original implementation of the standard VOC protocol: per-class
+ranked-detection matching against ground truth at an IoU threshold,
+precision/recall curve, AP by continuous integration (MApMetric) or the
+VOC-2007 11-point interpolation (VOC07MApMetric).
+
+update(labels, preds):
+- preds:  [batch, num_det, 6] rows (cls_id, score, x1, y1, x2, y2);
+  cls_id < 0 marks padding (MultiBoxDetection output layout).
+- labels: [batch, num_gt, 5] rows (cls_id, x1, y1, x2, y2); cls_id < 0
+  marks padding.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from mxnet_tpu.metric import EvalMetric
+
+
+def _iou(box, boxes):
+    x1 = np.maximum(box[0], boxes[:, 0])
+    y1 = np.maximum(box[1], boxes[:, 1])
+    x2 = np.minimum(box[2], boxes[:, 2])
+    y2 = np.minimum(box[3], boxes[:, 3])
+    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    area = (box[2] - box[0]) * (box[3] - box[1])
+    areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = area + areas - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+class MApMetric(EvalMetric):
+    """Mean average precision over detection outputs."""
+
+    def __init__(self, iou_thresh=0.5, class_names=None, name="mAP"):
+        self.iou_thresh = iou_thresh
+        self.class_names = class_names
+        super().__init__(name)
+
+    def reset(self):
+        # (class, score, matched) per detection + gt counts per class
+        self._records = []
+        self._gt_counts = {}
+        super().reset()
+
+    def update(self, labels, preds):
+        for lab, pred in zip(labels, preds):
+            lab = np.asarray(lab.asnumpy() if hasattr(lab, "asnumpy")
+                             else lab)
+            pred = np.asarray(pred.asnumpy() if hasattr(pred, "asnumpy")
+                              else pred)
+            for b in range(lab.shape[0]):
+                self._update_one(lab[b], pred[b])
+        # keep the base accumulators coherent for get_global composition
+        self.num_inst = 1
+        self.sum_metric = 0.0
+
+    def _update_one(self, gts, dets):
+        gts = gts[gts[:, 0] >= 0]
+        dets = dets[dets[:, 0] >= 0]
+        for c in np.unique(gts[:, 0]).astype(int):
+            self._gt_counts[c] = self._gt_counts.get(c, 0) + int(
+                (gts[:, 0] == c).sum())
+        order = np.argsort(-dets[:, 1]) if len(dets) else []
+        taken = np.zeros(len(gts), bool)
+        for di in order:
+            d = dets[di]
+            c = int(d[0])
+            cand = np.where(gts[:, 0] == c)[0]
+            matched = False
+            if len(cand):
+                ious = _iou(d[2:6], gts[cand, 1:5])
+                best = int(np.argmax(ious))
+                # VOC protocol: match against the overall-best gt; if that
+                # gt is already claimed by a higher-scored detection, this
+                # one is a false positive (no re-matching to runner-ups)
+                if (ious[best] >= self.iou_thresh
+                        and not taken[cand[best]]):
+                    taken[cand[best]] = True
+                    matched = True
+            self._records.append((c, float(d[1]), matched))
+
+    def _average_precision(self, rec, prec):
+        # continuous AP: integrate the precision envelope
+        mrec = np.concatenate([[0.0], rec, [1.0]])
+        mpre = np.concatenate([[0.0], prec, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def get(self):
+        aps = []
+        names = []
+        for c, n_gt in sorted(self._gt_counts.items()):
+            recs = sorted((r for r in self._records if r[0] == c),
+                          key=lambda r: -r[1])
+            if n_gt == 0:
+                continue
+            tp = np.cumsum([1.0 if m else 0.0 for _, _, m in recs])
+            fp = np.cumsum([0.0 if m else 1.0 for _, _, m in recs])
+            rec = tp / n_gt if len(recs) else np.array([0.0])
+            prec = (tp / np.maximum(tp + fp, 1e-12)
+                    if len(recs) else np.array([0.0]))
+            aps.append(self._average_precision(rec, prec))
+            names.append(self.class_names[c] if self.class_names else str(c))
+        value = float(np.mean(aps)) if aps else float("nan")
+        return (self.name, value)
+
+    def get_global(self):  # detection records already span the epoch
+        return self.get()
+
+
+class VOC07MApMetric(MApMetric):
+    """mAP with the VOC-2007 11-point interpolation."""
+
+    def __init__(self, iou_thresh=0.5, class_names=None, name="VOC07_mAP"):
+        super().__init__(iou_thresh, class_names, name)
+
+    def _average_precision(self, rec, prec):
+        ap = 0.0
+        for t in np.linspace(0, 1, 11):
+            mask = rec >= t
+            ap += (float(np.max(prec[mask])) if mask.any() else 0.0) / 11.0
+        return ap

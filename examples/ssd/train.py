@@ -36,6 +36,30 @@ def synthetic_detections(num, size, max_boxes, num_classes, seed=5):
     return imgs, labels
 
 
+def evaluate(arg_dict, args, imgs, labels):
+    """VOC mAP over the deploy graph (parity: example/ssd/evaluate/) —
+    MultiBoxDetection decodes + NMSes, the metric ranks detections."""
+    from eval_metric import MApMetric, VOC07MApMetric
+
+    from mxnet_tpu import nd
+
+    deploy = ssd.get_symbol(num_classes=args.num_classes)
+    b = args.batch_size
+    dex = deploy.simple_bind(ctx=None,
+                             data=(b, 3, args.data_size, args.data_size))
+    for name, arr in arg_dict.items():
+        if name in dex.arg_dict and name != "data":
+            dex.arg_dict[name][:] = arr.asnumpy()
+    m, m07 = MApMetric(), VOC07MApMetric()
+    for i in range(0, len(imgs) - b + 1, b):
+        dex.arg_dict["data"][:] = imgs[i:i + b]
+        det = dex.forward(is_train=False)[0]
+        lab = nd.array(labels[i:i + b])
+        m.update([lab], [det])
+        m07.update([lab], [det])
+    return m.get()[1], m07.get()[1]
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=8)
@@ -43,6 +67,9 @@ if __name__ == "__main__":
     ap.add_argument("--data-size", type=int, default=300)
     ap.add_argument("--num-steps", type=int, default=5)
     ap.add_argument("--lr", type=float, default=0.001)
+    ap.add_argument("--eval", action="store_true",
+                    help="compute VOC mAP with the deploy graph after "
+                         "training")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -73,5 +100,9 @@ if __name__ == "__main__":
         outs = [o.asnumpy() for o in ex.outputs]
         logging.info("step %d  outputs %s", step,
                      [tuple(o.shape) for o in outs])
+    if args.eval:
+        mAP, mAP07 = evaluate(ex.arg_dict, args, imgs, labels)
+        logging.info("eval: mAP=%.4f  VOC07_mAP=%.4f", mAP, mAP07)
+        print("mAP: %.4f" % mAP)
     logging.info("done — deploy graph: models.ssd.get_symbol() adds "
                  "softmax + NMS MultiBoxDetection")
