@@ -270,3 +270,24 @@ def test_transformer_dp_tp_mesh_trains():
     for _ in range(14):
         outs = tr.step(data=X, softmax_label=Y)
     assert nll(outs) < first - 0.1, (nll(outs), first)
+
+
+def test_long_context_lm_example():
+    """Ring-attention LM training as a workload: sharded grads match the
+    dense oracle and training converges at a context sharded over the
+    mesh (examples/transformer-lm/train_long_context.py)."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "transformer-lm",
+                      "train_long_context.py"),
+         "--self-test", "--steps", "6", "--seq-len", "256"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ring-sharded grads == dense oracle" in r.stdout
+    assert "converged" in r.stdout
