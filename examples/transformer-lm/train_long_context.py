@@ -118,6 +118,8 @@ def main(argv=None):
 
     if args.seq_len % args.n_devices:
         ap.error("--seq-len must divide by --n-devices")
+    if args.self_test and 64 % args.n_devices:
+        ap.error("--self-test shards T=64: --n-devices must divide 64")
     if args.d_model % args.heads:
         ap.error("--d-model must divide by --heads")
     platform = os.environ.get("MXTPU_LC_PLATFORM", "cpu")

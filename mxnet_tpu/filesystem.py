@@ -158,14 +158,12 @@ class HttpFileSystem(FileSystem):
 
         def _fetch(self, lo, hi):
             """[lo, hi) from the server; populates _whole on 200."""
-            with self._fs._urlopen(self._url, headers={
-                    "Range": f"bytes={lo}-{hi - 1}"}) as r:
-                data = r.read()
-                if r.status != 206:
-                    # server ignored Range: it sent the whole body — keep
-                    # it so later reads cost no further transfers
-                    self._whole = data
-                    return data[lo:hi]
+            data, partial = self._fs._fetch_range(self._url, lo, hi)
+            if not partial:
+                # server ignored the range: it sent the whole body — keep
+                # it so later reads cost no further transfers
+                self._whole = data
+                return data[lo:hi]
             return data
 
         def read(self, n=-1):
@@ -199,6 +197,15 @@ class HttpFileSystem(FileSystem):
     # URL and inject auth headers; the base class is a pass-through
     def _prepare(self, uri, headers, method):
         return uri, headers
+
+    # range hook: how [lo, hi) is expressed on the wire.  HTTP object
+    # stores use a Range header; WebHDFS uses offset/length query params.
+    # Returns (bytes, is_partial) — is_partial False means the whole body
+    # arrived (server ignored the range).
+    def _fetch_range(self, uri, lo, hi):
+        with self._urlopen(uri, headers={
+                "Range": f"bytes={lo}-{hi - 1}"}) as r:
+            return r.read(), r.status == 206
 
     def _urlopen(self, uri, headers=None, method="GET"):
         import urllib.request
@@ -386,6 +393,78 @@ class GSFileSystem(HttpFileSystem):
         return url, headers
 
 
+class WebHdfsFileSystem(HttpFileSystem):
+    """hdfs://namenode[:port]/path over the WebHDFS REST API (the
+    transport dmlc-core's libhdfs-free deployments use; parity for the
+    reference's USE_HDFS InputSplit backend without a JVM).
+
+    Ranged reads map to ``op=OPEN&offset=&length=`` (the namenode's 307
+    redirect to a datanode is followed by urllib); size comes from
+    ``op=GETFILESTATUS``.  Auth: ``HADOOP_USER_NAME`` adds the simple
+    ``user.name`` query credential; ``WEBHDFS_TOKEN`` adds a delegation
+    token.  ``WEBHDFS_ENDPOINT`` overrides the namenode address (also
+    how tests point at a loopback double); default port 9870.
+    """
+
+    def _base(self, parts):
+        ep = os.environ.get("WEBHDFS_ENDPOINT")
+        if ep:
+            ep = ep.rstrip("/")
+            return ep if "://" in ep else "http://" + ep
+        host = parts.netloc or "localhost"
+        if ":" not in host:
+            host += ":9870"
+        return f"http://{host}"
+
+    def _url(self, uri, op, extra=""):
+        from urllib.parse import quote, urlsplit
+
+        parts = urlsplit(uri)
+        auth = ""
+        user = os.environ.get("HADOOP_USER_NAME")
+        if user:
+            auth += "&user.name=" + quote(user, safe="")
+        token = os.environ.get("WEBHDFS_TOKEN")
+        if token:
+            auth += "&delegation=" + quote(token, safe="")
+        return (f"{self._base(parts)}/webhdfs/v1"
+                f"{quote(parts.path, safe='/~')}?op={op}{extra}{auth}")
+
+    def _fetch_range(self, uri, lo, hi):
+        url = self._url(uri, "OPEN", f"&offset={lo}&length={hi - lo}")
+        with self._urlopen(url) as r:
+            return r.read(), True  # OPEN always returns exactly the span
+
+    def size(self, path):
+        import json as _json
+
+        cached = self._size_cache.get(path)
+        if cached is not None:
+            return cached
+        try:
+            with self._urlopen(self._url(path, "GETFILESTATUS")) as r:
+                st = _json.loads(r.read().decode())
+            n = int(st["FileStatus"]["length"])
+        except Exception as exc:  # noqa: BLE001
+            raise MXNetError(
+                f"webhdfs: cannot stat {path!r}: {exc}") from exc
+        self._size_cache[path] = n
+        return n
+
+    def list(self, pattern):
+        import json as _json
+
+        try:
+            with self._urlopen(self._url(pattern, "LISTSTATUS")) as r:
+                st = _json.loads(r.read().decode())
+            entries = st["FileStatuses"]["FileStatus"]
+            base = pattern.rstrip("/")
+            return [base if e["pathSuffix"] == "" else
+                    f"{base}/{e['pathSuffix']}" for e in entries]
+        except Exception:
+            return [pattern]  # not listable: treat as a single file
+
+
 _REGISTRY: Dict[str, FileSystem] = {
     "": LocalFileSystem(),
     "file": LocalFileSystem(),
@@ -394,6 +473,8 @@ _REGISTRY: Dict[str, FileSystem] = {
     "https": HttpFileSystem(),
     "s3": S3FileSystem(),
     "gs": GSFileSystem(),
+    "hdfs": WebHdfsFileSystem(),
+    "webhdfs": WebHdfsFileSystem(),
 }
 
 
