@@ -451,18 +451,41 @@ class WebHdfsFileSystem(HttpFileSystem):
         self._size_cache[path] = n
         return n
 
-    def list(self, pattern):
+    def _liststatus(self, diruri):
         import json as _json
 
+        with self._urlopen(self._url(diruri, "LISTSTATUS")) as r:
+            st = _json.loads(r.read().decode())
+        return st["FileStatuses"]["FileStatus"]
+
+    def list(self, pattern):
+        import fnmatch
+        import urllib.error
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(pattern)
+        if any(c in parts.path for c in "*?["):
+            # glob: LISTSTATUS the parent dir and fnmatch basenames (dmlc
+            # wildcard semantics; one level, like dmlc's InputSplit)
+            parent, _, leaf = parts.path.rstrip("/").rpartition("/")
+            base = f"{parts.scheme}://{parts.netloc}{parent}"
+            try:
+                entries = self._liststatus(base)
+            except (urllib.error.URLError, OSError, KeyError,
+                    ValueError) as exc:
+                raise MXNetError(
+                    f"webhdfs: cannot list {base!r} for pattern "
+                    f"{pattern!r}: {exc}") from exc
+            hits = sorted(f"{base}/{e['pathSuffix']}" for e in entries
+                          if fnmatch.fnmatch(e["pathSuffix"], leaf))
+            return hits if hits else [pattern]
         try:
-            with self._urlopen(self._url(pattern, "LISTSTATUS")) as r:
-                st = _json.loads(r.read().decode())
-            entries = st["FileStatuses"]["FileStatus"]
-            base = pattern.rstrip("/")
-            return [base if e["pathSuffix"] == "" else
-                    f"{base}/{e['pathSuffix']}" for e in entries]
+            entries = self._liststatus(pattern)
         except Exception:
-            return [pattern]  # not listable: treat as a single file
+            return [pattern]  # plain file (or unlistable): single entry
+        base = pattern.rstrip("/")
+        return [base if e["pathSuffix"] == "" else
+                f"{base}/{e['pathSuffix']}" for e in entries]
 
 
 _REGISTRY: Dict[str, FileSystem] = {

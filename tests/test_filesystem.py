@@ -483,7 +483,7 @@ def test_webhdfs_filesystem(tmp_path, monkeypatch):
         w.write(p)
     w.close()
     raw = open(root / "data.rec", "rb").read()
-    seen = {"users": set(), "redirected": 0}
+    seen = {"users": set(), "tokens": set(), "redirected": 0}
 
     class NN(http.server.SimpleHTTPRequestHandler):
         def do_GET(self):
@@ -491,10 +491,28 @@ def test_webhdfs_filesystem(tmp_path, monkeypatch):
             q = {k: v[0] for k, v in parse_qs(parts.query).items()}
             if "user.name" in q:
                 seen["users"].add(q["user.name"])
+            if "delegation" in q:
+                seen["tokens"].add(q["delegation"])
             rel = parts.path[len("/webhdfs/v1/"):]
             fpath = root / rel.split("/", 1)[1] if "/" in rel else None
             op = q.get("op")
-            if op == "GETFILESTATUS":
+            if op == "LISTSTATUS":
+                if fpath is not None and fpath.is_file():
+                    # real WebHDFS: LISTSTATUS on a file returns the file
+                    # itself with an empty pathSuffix
+                    stats = [{"pathSuffix": "", "type": "FILE",
+                              "length": fpath.stat().st_size}]
+                else:
+                    stats = [{"pathSuffix": q.name, "type": "FILE",
+                              "length": q.stat().st_size}
+                             for q in sorted(root.iterdir())]
+                body = _json.dumps(
+                    {"FileStatuses": {"FileStatus": stats}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif op == "GETFILESTATUS":
                 body = _json.dumps({"FileStatus": {
                     "length": fpath.stat().st_size, "type": "FILE"}}).encode()
                 self.send_response(200)
@@ -529,6 +547,7 @@ def test_webhdfs_filesystem(tmp_path, monkeypatch):
         monkeypatch.setenv("WEBHDFS_ENDPOINT",
                            f"http://127.0.0.1:{srv.server_address[1]}")
         monkeypatch.setenv("HADOOP_USER_NAME", "hduser")
+        monkeypatch.setenv("WEBHDFS_TOKEN", "tok/with+chars")
         fs = WebHdfsFileSystem()
         uri = "hdfs://nn/cluster/data.rec"
         assert fs.size(uri) == len(raw)
@@ -537,6 +556,13 @@ def test_webhdfs_filesystem(tmp_path, monkeypatch):
         assert f.read(16) == raw[40:56]
         assert seen["redirected"] > 0       # namenode redirect followed
         assert seen["users"] == {"hduser"}  # credential on every request
+        assert seen["tokens"] == {"tok/with+chars"}  # pct-decoded intact
+
+        # glob expansion via LISTSTATUS + fnmatch
+        assert fs.list("hdfs://nn/cluster/*.rec") == [
+            "hdfs://nn/cluster/data.rec"]
+        assert fs.list("hdfs://nn/cluster/*.nope") == [
+            "hdfs://nn/cluster/*.nope"]
 
         got = []
         for part in range(3):

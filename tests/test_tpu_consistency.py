@@ -246,3 +246,21 @@ def test_tpu_module_training_end_to_end():
                        env=env, capture_output=True, text=True, timeout=900)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "FAMILY OK" in r.stdout
+
+
+def test_tpu_consistency_channels_last_chain():
+    """A residual conv-bn-relu-concat chain: the channels-last executor
+    pass (default) must agree cpu-vs-chip through layout boundaries."""
+    _run_family("""
+        d = sym.Variable('data')
+        h = sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                            name='c1')
+        h = sym.BatchNorm(h, fix_gamma=False, name='b1')
+        h = sym.Activation(h, act_type='relu')
+        h2 = sym.Convolution(h, kernel=(1, 1), num_filter=8, name='c2')
+        h = h + h2                       # NHWC elementwise residual
+        h = sym.Concat(h, h2, dim=1)     # NHWC channel concat
+        h = sym.Pooling(h, global_pool=True, kernel=(1, 1), pool_type='avg')
+        net = sym.FullyConnected(sym.Flatten(h), num_hidden=4, name='fc')
+        CC(net, data=(2, 3, 12, 12))
+    """)
