@@ -260,3 +260,82 @@ class FusedTrainer:
     def get_params(self):
         return ({k: NDArray(v) for k, v in self.params.items()},
                 {k: NDArray(v) for k, v in self.aux.items()})
+
+    # ------------------------------------------------------------ checkpoints
+    def _gather(self, v):
+        """Full host value of a (possibly sharded) array.  On multi-host
+        meshes arrays span non-addressable devices, so gather across
+        processes first."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            v = multihost_utils.process_allgather(v, tiled=True)
+        return np.asarray(v)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Write ``prefix-symbol.json`` + ``prefix-%04d.params`` — the
+        Module checkpoint format, loadable by Module/FeedForward — plus a
+        FusedTrainer-format ``.states`` file (flat per-key slot arrays +
+        the step counter; NOT Module's pickled-updater format) when
+        ``save_optimizer_states``."""
+        from . import ndarray as nd_mod
+        from .model import save_checkpoint as _save
+
+        arg = {k: NDArray(self._gather(v)) for k, v in self.params.items()}
+        aux = {k: NDArray(self._gather(v)) for k, v in self.aux.items()}
+        _save(prefix, epoch, self.symbol, arg, aux)
+        if save_optimizer_states:
+            flat = {"__step__": NDArray(np.array([self._step], np.int64))}
+            for k, states in self.opt_state.items():
+                for i, s in enumerate(states):
+                    flat[f"{k}:{i}"] = NDArray(self._gather(s))
+            nd_mod.save("%s-%04d.states" % (prefix, epoch), flat)
+
+    def load_checkpoint(self, prefix, epoch, load_optimizer_states=False):
+        """Restore params/aux (and optimizer state + step counter) saved
+        by save_checkpoint into this INITIALIZED trainer, re-applying the
+        trainer's shardings.  Missing files or key mismatches raise —
+        silently training on reset state is worse than failing."""
+        from . import ndarray as nd_mod
+        from .base import MXNetError
+        from .model import load_checkpoint as _load
+
+        if not self.params:
+            raise MXNetError("load_checkpoint: call init() first (the "
+                             "trainer's shapes/shardings come from init)")
+        _, arg, aux = _load(prefix, epoch)
+        missing = set(self.params) - set(arg)
+        if missing:
+            raise MXNetError(f"checkpoint {prefix!r} lacks params "
+                             f"{sorted(missing)[:5]}...")
+        for k, v in arg.items():
+            if k in self.params:
+                raw = jnp.asarray(v.asnumpy())
+                self.params[k] = (jax.device_put(raw, self.params[k].sharding)
+                                  if self.mesh is not None else raw)
+        for k, v in aux.items():
+            if k in self.aux:
+                raw = jnp.asarray(v.asnumpy())
+                self.aux[k] = (jax.device_put(raw, self.aux[k].sharding)
+                               if self.mesh is not None else raw)
+        if load_optimizer_states:
+            spath = "%s-%04d.states" % (prefix, epoch)
+            flat = nd_mod.load(spath)  # missing file raises, like Module
+            step = flat.pop("__step__", None)
+            if step is not None:
+                self._step = int(step.asnumpy()[0])
+            for k in list(self.opt_state):
+                states = []
+                for i in range(len(self.opt_state[k])):
+                    arr = flat.get(f"{k}:{i}")
+                    if arr is None:
+                        raise MXNetError(
+                            f"optimizer state {k}:{i} missing from {spath!r} "
+                            "(different optimizer, or a truncated save?)")
+                    raw = jnp.asarray(arr.asnumpy())
+                    if self.mesh is not None:
+                        raw = jax.device_put(raw,
+                                             self.opt_state[k][i].sharding)
+                    states.append(raw)
+                self.opt_state[k] = tuple(states)
+        return self
