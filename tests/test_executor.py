@@ -265,3 +265,36 @@ def test_channels_last_pass_matches_nchw():
     for k in aux_ref:
         np.testing.assert_allclose(np.asarray(aux_cl[k]), np.asarray(aux_ref[k]),
                                    rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_channels_last_resnet_has_two_activation_transposes():
+    """Static guarantee of the NHWC pass on the flagship graph: every
+    conv runs channels-last and the activation flow converts layout
+    exactly twice (graph input, global-pool exit) — a fallback regression
+    (an op dropping out of the NHWC chain) would add transposes here."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import models
+    from mxnet_tpu.executor import _build_graph_fn
+
+    net = models.get_symbol("resnet-18", num_classes=10,
+                            image_shape=(3, 32, 32))
+    fn = _build_graph_fn(net, channels_last=True)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=(2, 3, 32, 32))
+    args = {n: jnp.zeros(s, jnp.float32)
+            for n, s in zip(net.list_arguments(), arg_shapes)}
+    aux = {n: jnp.zeros(s, jnp.float32)
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+    jaxpr = jax.make_jaxpr(
+        lambda a, x: fn(a, x, jax.random.PRNGKey(0), True))(args, aux)
+    eqns = jaxpr.jaxpr.eqns
+    convs = [e for e in eqns if e.primitive.name == "conv_general_dilated"]
+    assert convs and all(
+        e.params["dimension_numbers"].lhs_spec[1] == 3 for e in convs)
+    act_transposes = [
+        e for e in eqns if e.primitive.name == "transpose"
+        and tuple(e.params["permutation"]) in ((0, 2, 3, 1), (0, 3, 1, 2))]
+    assert len(act_transposes) == 2, (
+        f"{len(act_transposes)} activation-layout transposes; an op fell "
+        "out of the channels-last chain")
