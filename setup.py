@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Package build for mxnet_tpu (parity: tools/pip_package in the
+reference — the C ABI libraries ship as package data, like the
+reference wheel bundles libmxnet.so).
+
+    python setup.py bdist_wheel      # wheel incl. native libs
+    python setup.py sdist            # source dist
+
+The native libraries are rebuilt from src/ with `make -C src` when
+absent; the wheel simply packages whatever is in mxnet_tpu/lib/.
+"""
+import glob
+import os
+import subprocess
+
+from setuptools import find_packages, setup
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _ensure_native_libs():
+    """Build the C ABI libraries when absent (fresh clone: mxnet_tpu/lib
+    is generated, not tracked)."""
+    libdir = os.path.join(HERE, "mxnet_tpu", "lib")
+    if glob.glob(os.path.join(libdir, "*.so")):
+        return
+    makefile = os.path.join(HERE, "src", "Makefile")
+    if os.path.exists(makefile):
+        subprocess.run(["make", "-C", os.path.join(HERE, "src")],
+                       check=True)
+    if not glob.glob(os.path.join(libdir, "*.so")):
+        raise RuntimeError(
+            "mxnet_tpu/lib/*.so missing and `make -C src` did not produce "
+            "them; build the native runtime before packaging")
+
+
+_ensure_native_libs()
+
+
+def _readme():
+    path = os.path.join(HERE, "README.md")
+    if os.path.exists(path):
+        with open(path) as f:
+            return f.read()
+    return ""
+
+
+setup(
+    name="mxnet-tpu",
+    version="0.9.4",  # tracks the reference surface this package mirrors
+    description="TPU-native deep learning framework with the MXNet "
+                "v0.9 API surface (JAX/XLA/Pallas compute, C++ runtime)",
+    long_description=_readme(),
+    long_description_content_type="text/markdown",
+    packages=find_packages(include=["mxnet_tpu", "mxnet_tpu.*"]),
+    package_data={"mxnet_tpu": ["lib/*.so"]},
+    include_package_data=True,
+    python_requires=">=3.10",
+    install_requires=["numpy", "jax"],
+    extras_require={
+        "io": ["pillow"],
+        "viz": ["graphviz"],
+    },
+)
