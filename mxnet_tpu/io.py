@@ -9,7 +9,6 @@ with a thread prefetcher feeding device transfers.
 """
 from __future__ import annotations
 
-import collections
 import gzip
 import os
 import struct

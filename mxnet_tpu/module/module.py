@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import logging
 
-import numpy as np
 
 from .. import ndarray as nd
 from .. import optimizer as opt

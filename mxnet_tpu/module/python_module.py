@@ -6,7 +6,6 @@ from __future__ import annotations
 
 import logging
 
-import numpy as np
 
 from .. import ndarray as nd
 from .base_module import BaseModule

@@ -14,10 +14,8 @@ the reference's CustomOpProp callbacks.
 """
 from __future__ import annotations
 
-import functools
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .base import MXNetError

@@ -20,7 +20,6 @@ otherwise, and direct calls with ragged shapes raise.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -14,7 +14,6 @@ import logging
 import math
 from typing import Dict, Optional
 
-import numpy as np
 
 from . import ndarray as nd
 from .base import MXNetError

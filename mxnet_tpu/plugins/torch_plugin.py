@@ -17,7 +17,6 @@ or symbolically with variables for each torch parameter.
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,6 @@ import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from . import ops

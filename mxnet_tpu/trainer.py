@@ -20,7 +20,6 @@ test_dtype.py-style casting.
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Dict, Optional
 
 import jax

@@ -2,7 +2,6 @@
 plot_network graphviz rendering + print_summary table)."""
 from __future__ import annotations
 
-import json
 
 from .base import MXNetError
 from .symbol import Symbol
