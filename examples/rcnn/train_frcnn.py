@@ -247,7 +247,7 @@ def main():
                     help="compute detection mAP after training")
     args = ap.parse_args()
     rs = np.random.RandomState(0)
-    mx.random.seed(0)  # deterministic Xavier init
+    np.random.seed(0)  # deterministic Xavier init (initializers use np.random)
 
     base = _generate_anchors(STRIDE, (2, 4, 8), (0.5, 1, 2))
     sx, sy = np.meshgrid(np.arange(FEAT) * STRIDE, np.arange(FEAT) * STRIDE)

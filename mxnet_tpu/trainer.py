@@ -83,7 +83,7 @@ class FusedTrainer:
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  optimizer="sgd", optimizer_params=None, mesh: Optional[Mesh] = None,
                  initializer=None, dtype=jnp.float32, sharding_rules=(),
-                 remat=None, fixed_param_names=()):
+                 remat=None, fixed_param_names=(), clip_global_norm=None):
         # rematerialization = the reference's MXNET_BACKWARD_DO_MIRROR
         # (recompute activations in backward, env_var.md:55-57) — on TPU
         # it is jax.checkpoint around the forward.  Default follows the
@@ -108,6 +108,16 @@ class FusedTrainer:
         if isinstance(fixed_param_names, str):
             fixed_param_names = (fixed_param_names,)
         self._fixed = frozenset(fixed_param_names)
+        # global-norm gradient clipping (beyond the per-element
+        # clip_gradient the optimizer kernels apply): rescale the WHOLE
+        # gradient tree when ||g||_2 exceeds the threshold — the standard
+        # transformer-training guard
+        if clip_global_norm is not None and not float(clip_global_norm) > 0:
+            raise ValueError("clip_global_norm must be > 0 (a negative "
+                             "threshold would flip gradient signs; 0 would "
+                             "silently disable clipping)")
+        self._clip_global_norm = (None if clip_global_norm is None
+                                  else float(clip_global_norm))
         self._initializer = initializer or Uniform(0.01)
         self._graph_fn = _build_graph_fn(symbol)
         self.params: Dict[str, jax.Array] = {}
@@ -194,14 +204,22 @@ class FusedTrainer:
             aux_cot = jax.tree_util.tree_map(jnp.zeros_like, new_aux)
             (grads,) = vjp_fn((head, aux_cot))
 
+            f32_grads = {k: grads[k].astype(jnp.float32)
+                         for k in params if k not in fixed}
+            if self._clip_global_norm is not None:
+                gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                     for g in f32_grads.values()))
+                scale = jnp.minimum(1.0, self._clip_global_norm
+                                    / jnp.maximum(gnorm, 1e-12))
+                f32_grads = {k: g * scale for k, g in f32_grads.items()}
+
             new_params = {}
             new_opt = {}
             for k, w in params.items():
                 if k in fixed:
                     new_params[k] = w
                     continue
-                g = grads[k].astype(jnp.float32)
-                nw, ns = update(w, g, opt_state[k])
+                nw, ns = update(w, f32_grads[k], opt_state[k])
                 new_params[k] = nw
                 new_opt[k] = ns
             return new_params, new_aux, new_opt, outs

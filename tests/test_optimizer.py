@@ -200,3 +200,43 @@ def test_registry_has_all_ten():
                  "rmsprop", "adadelta", "dcasgd", "test"):
         o = opt.create(name)
         assert isinstance(o, opt.Optimizer), name
+
+
+def test_fused_trainer_clip_global_norm():
+    """clip_global_norm rescales the WHOLE gradient tree: with a tiny
+    threshold the applied update equals g * (thresh/||g||) for every
+    param (verified against an unclipped run's gradients)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import sym
+    from mxnet_tpu.trainer import FusedTrainer
+
+    rs = np.random.RandomState(0)
+    X = rs.normal(0, 5, (8, 6)).astype(np.float32)  # big grads
+    Y = rs.randint(0, 3, 8).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.SoftmaxOutput(sym.FullyConnected(data, num_hidden=3, name="fc"),
+                            sym.Variable("softmax_label"), name="softmax")
+
+    def run(clip):
+        np.random.seed(3)  # initializers draw from numpy's global RNG
+        tr = FusedTrainer(net, optimizer="sgd", optimizer_params={"lr": 1.0},
+                          clip_global_norm=clip)
+        tr.init(data=(8, 6), softmax_label=(8,))
+        before = {k: np.asarray(v) for k, v in tr.params.items()}
+        tr.step(data=X, softmax_label=Y)
+        return before, {k: np.asarray(v) for k, v in tr.params.items()}
+
+    b0, a0 = run(None)          # unclipped: update = -lr * g
+    g = {k: b0[k] - a0[k] for k in b0}
+    gnorm = np.sqrt(sum((v ** 2).sum() for v in g.values()))
+    thresh = float(gnorm) / 4.0
+    b1, a1 = run(thresh)
+    for k in g:
+        np.testing.assert_allclose(b1[k] - a1[k], g[k] / 4.0,
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+    # threshold above the norm: no rescale
+    b2, a2 = run(float(gnorm) * 10)
+    for k in g:
+        np.testing.assert_allclose(b2[k] - a2[k], g[k], rtol=1e-4,
+                                   atol=1e-6)
