@@ -1,0 +1,42 @@
+"""Shared functional transformer pieces for the parallel-LM examples
+(train_long_context.py, train_moe.py): LayerNorm, dense causal
+attention, and weight-init helpers — one copy so numerics fixes reach
+every workload."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def layer_norm(x, g, b, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * g + b
+
+
+def causal_attention(q, k, v, n_heads):
+    """Dense causal attention on [B, T, D] projections."""
+    B, T, D = q.shape
+    dh = D // n_heads
+    sh = lambda a: a.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
+    qh, kh, vh = sh(q), sh(k), sh(v)
+    scores = (qh @ kh.transpose(0, 1, 3, 2)) / np.sqrt(dh)
+    scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores, -1e9)
+    out = jax.nn.softmax(scores, -1) @ vh
+    return out.transpose(0, 2, 1, 3).reshape(B, T, D)
+
+
+def glorot(rs, *shape, scale=0.08):
+    return jnp.asarray(rs.normal(0, scale, shape).astype(np.float32))
+
+
+def zeros(*shape):
+    return jnp.zeros(shape, jnp.float32)
+
+
+def attention_block_params(rs, D, scale=0.08):
+    """ln + q/k/v + out-projection parameter set for one block."""
+    return {"ln1_g": jnp.ones(D), "ln1_b": zeros(D),
+            "q_w": glorot(rs, D, D, scale=scale),
+            "k_w": glorot(rs, D, D, scale=scale),
+            "v_w": glorot(rs, D, D, scale=scale),
+            "proj_w": glorot(rs, D, D, scale=scale), "proj_b": zeros(D)}

@@ -33,6 +33,7 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
+from common import layer_norm as _ln  # noqa: E402
 from mxnet_tpu.parallel.mesh import create_mesh  # noqa: E402
 from mxnet_tpu.parallel.ring_attention import ring_attention  # noqa: E402
 
@@ -52,12 +53,6 @@ def init_params(rs, n_layers, D, H, vocab):
     return {"embed": g(vocab, D), "head": g(D, vocab),
             "blocks": jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *blocks)}
-
-
-def _ln(x, g, b):
-    m = x.mean(-1, keepdims=True)
-    v = ((x - m) ** 2).mean(-1, keepdims=True)
-    return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
 
 
 def forward(params, X, n_heads, mesh=None):

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Mixture-of-experts LM training — expert parallelism as a WORKLOAD.
+
+Each transformer block's FFN is an expert-parallel MoE layer
+(parallel/moe.py): tokens route to their top-k experts via gate logits,
+ride two all_to_all collectives to the expert's device and back, and the
+load-balancing loss keeps experts busy.  Attention/LayerNorm stay dense.
+top_k=1 is Switch; --top-k 2 is the GShard/Mixtral configuration.
+
+Run on the virtual mesh (no hardware needed):
+  XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+      python train_moe.py [--top-k 2]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+
+if os.environ.get("MXTPU_LC_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from common import (attention_block_params, causal_attention, glorot,  # noqa: E402
+                    layer_norm as _ln, zeros)
+from mxnet_tpu.parallel import moe as moe_mod  # noqa: E402
+from mxnet_tpu.parallel.mesh import create_mesh  # noqa: E402
+
+
+def init_params(rs, n_layers, D, n_experts, vocab):
+    blocks = []
+    for _ in range(n_layers):
+        b = attention_block_params(rs, D)
+        b.update({
+            "ln2_g": jnp.ones(D), "ln2_b": zeros(D),
+            # expert-parallel FFN (one expert slice per device)
+            "gate_w": glorot(rs, D, n_experts),
+            "w_in": glorot(rs, n_experts, D, 4 * D),
+            "w_out": glorot(rs, n_experts, 4 * D, D)})
+        blocks.append(b)
+    return {"embed": glorot(rs, vocab, D), "head": glorot(rs, D, vocab),
+            "blocks": blocks}
+
+
+def forward(params, X, n_heads, mesh, top_k):
+    B, T = X.shape
+    h = params["embed"][X]
+    D = h.shape[-1]
+    aux_total = 0.0
+
+    for p in params["blocks"]:
+        x = _ln(h, p["ln1_g"], p["ln1_b"])
+        att = causal_attention(x @ p["q_w"].T, x @ p["k_w"].T,
+                               x @ p["v_w"].T, n_heads)
+        h = h + att @ p["proj_w"].T + p["proj_b"]
+
+        x = _ln(h, p["ln2_g"], p["ln2_b"])
+        moe_params = {"gate_w": p["gate_w"], "w_in": p["w_in"],
+                      "w_out": p["w_out"]}
+        y, aux = moe_mod.moe_ffn(moe_params, x.reshape(B * T, D), mesh,
+                                 "expert", top_k=top_k,
+                                 activation=jax.nn.gelu)
+        aux_total = aux_total + aux
+        h = h + y.reshape(B, T, D)
+    return h @ params["head"], aux_total
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-experts", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--d-model", type=int, default=32)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--top-k", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--aux-weight", type=float, default=0.01)
+    args = ap.parse_args(argv)
+
+    platform = os.environ.get("MXTPU_LC_PLATFORM", "cpu")
+    mesh = create_mesh((args.n_experts,), ("expert",),
+                       devices=jax.devices(platform)[:args.n_experts])
+    rs = np.random.RandomState(0)
+    params = init_params(rs, args.layers, args.d_model, args.n_experts,
+                         args.vocab)
+    X = jnp.asarray(rs.randint(0, args.vocab,
+                               (args.batch, args.seq_len)).astype(np.int32))
+    Y = (X * 5 + 3) % args.vocab
+
+    def loss_fn(p):
+        logits, aux = forward(p, X, args.heads, mesh, args.top_k)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(lp, Y[..., None], axis=-1).mean()
+        return nll + args.aux_weight * aux, (nll, aux)
+
+    step = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+    first = None
+    for i in range(args.steps):
+        (loss, (nll, aux)), grads = step(params)
+        params = jax.tree_util.tree_map(lambda w, d: w - args.lr * d,
+                                        params, grads)
+        if first is None:
+            first = float(nll)
+        if i % 5 == 0 or i == args.steps - 1:
+            print("step %3d  nll %.4f  balance_aux %.4f  (top-%d of %d "
+                  "experts)" % (i, float(nll), float(aux), args.top_k,
+                                args.n_experts))
+    if args.steps > 1:
+        assert float(nll) < first, (first, float(nll))
+    print("converged: nll %.3f -> %.3f with %d-expert MoE FFNs"
+          % (first, float(nll), args.n_experts))
+
+
+if __name__ == "__main__":
+    main()

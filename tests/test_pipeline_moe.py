@@ -394,3 +394,21 @@ def test_moe_top2_trains():
         losses.append(float(l))
         params = {k: v - 0.3 * g[k] for k, v in params.items()}
     assert losses[-1] < losses[0]
+
+
+def test_moe_lm_example_converges():
+    """Expert parallelism as a workload: the MoE-FFN transformer LM
+    (examples/transformer-lm/train_moe.py) trains with top-2 routing."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "transformer-lm", "train_moe.py"),
+         "--steps", "8"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "converged" in r.stdout
