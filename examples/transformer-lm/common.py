@@ -14,14 +14,15 @@ def layer_norm(x, g, b, eps=1e-5):
 
 
 def causal_attention(q, k, v, n_heads):
-    """Dense causal attention on [B, T, D] projections."""
+    """Causal attention on [B, T, D] projections via the package's
+    attention dispatcher (flash kernels where eligible, lax fallback —
+    the same path train_long_context.py's dense oracle uses)."""
+    from mxnet_tpu.parallel.ring_attention import attention
+
     B, T, D = q.shape
     dh = D // n_heads
     sh = lambda a: a.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
-    qh, kh, vh = sh(q), sh(k), sh(v)
-    scores = (qh @ kh.transpose(0, 1, 3, 2)) / np.sqrt(dh)
-    scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores, -1e9)
-    out = jax.nn.softmax(scores, -1) @ vh
+    out = attention(sh(q), sh(k), sh(v), causal=True)
     return out.transpose(0, 2, 1, 3).reshape(B, T, D)
 
 

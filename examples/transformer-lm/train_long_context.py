@@ -39,18 +39,19 @@ from mxnet_tpu.parallel.ring_attention import ring_attention  # noqa: E402
 
 
 def init_params(rs, n_layers, D, H, vocab):
-    g = lambda *s: jnp.asarray(rs.normal(0, 0.06, s).astype(np.float32))
-    z = lambda *s: jnp.zeros(s, jnp.float32)
+    from common import attention_block_params, glorot, zeros
+
     blocks = []
     for _ in range(n_layers):
-        blocks.append({
-            "ln1_g": jnp.ones(D), "ln1_b": z(D),
-            "q_w": g(D, D), "k_w": g(D, D), "v_w": g(D, D),
-            "proj_w": g(D, D), "proj_b": z(D),
-            "ln2_g": jnp.ones(D), "ln2_b": z(D),
-            "fi_w": g(4 * D, D), "fi_b": z(4 * D),
-            "fo_w": g(D, 4 * D), "fo_b": z(D)})
-    return {"embed": g(vocab, D), "head": g(D, vocab),
+        b = attention_block_params(rs, D, scale=0.06)
+        b.update({"ln2_g": jnp.ones(D), "ln2_b": zeros(D),
+                  "fi_w": glorot(rs, 4 * D, D, scale=0.06),
+                  "fi_b": zeros(4 * D),
+                  "fo_w": glorot(rs, D, 4 * D, scale=0.06),
+                  "fo_b": zeros(D)})
+        blocks.append(b)
+    return {"embed": glorot(rs, vocab, D, scale=0.06),
+            "head": glorot(rs, D, vocab, scale=0.06),
             "blocks": jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *blocks)}
 

@@ -85,6 +85,11 @@ def main(argv=None):
     ap.add_argument("--aux-weight", type=float, default=0.01)
     args = ap.parse_args(argv)
 
+    if args.d_model % args.heads:
+        ap.error("--d-model must divide by --heads")
+    if (args.batch * args.seq_len) % args.n_experts:
+        ap.error("--batch * --seq-len must divide by --n-experts "
+                 "(tokens shard over the expert mesh)")
     platform = os.environ.get("MXTPU_LC_PLATFORM", "cpu")
     mesh = create_mesh((args.n_experts,), ("expert",),
                        devices=jax.devices(platform)[:args.n_experts])

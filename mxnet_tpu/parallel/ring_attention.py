@@ -50,20 +50,23 @@ def _stream_block(q, k, v, m, l, o, scale, mask=None):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
-                   causal: bool = False, scale: float = None):
+                   causal: bool = False, scale: float = None,
+                   batch_axis: str = None):
     """Exact attention over sequence-sharded q/k/v.
 
     q, k, v: (B, H, T_global, D) arrays sharded over T on `axis_name`.
-    Returns output with the same sharding.
+    Returns output with the same sharding.  ``batch_axis`` additionally
+    shards B over a second mesh axis — the standard dp x sp long-context
+    layout (each data-parallel replica runs its own ring).
     """
-    b, h, t, d = q.shape
+    d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     n = mesh.shape[axis_name]
-    t_local = t // n
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
 
     def local_fn(q, k, v):
-        # q/k/v here are the local shards (B, H, T/n, D)
+        # q/k/v here are the local shards (B_local, H, T/n, D)
+        b, h, t_local, _ = q.shape
         idx = jax.lax.axis_index(axis_name)
         m0 = jnp.full((b, h, t_local), -jnp.inf, q.dtype)
         l0 = jnp.zeros((b, h, t_local), q.dtype)

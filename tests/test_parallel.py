@@ -291,3 +291,24 @@ def test_long_context_lm_example():
     assert r.returncode == 0, r.stdout + r.stderr
     assert "ring-sharded grads == dense oracle" in r.stdout
     assert "converged" in r.stdout
+
+
+def test_ring_attention_dp_sp_mesh():
+    """dp x sp: batch sharded over 'data' AND sequence over 'seq' — each
+    data replica runs its own K/V ring; must match full attention."""
+    from mxnet_tpu.parallel.ring_attention import attention, ring_attention
+
+    mesh = create_mesh((2, 4), ("data", "seq"),
+                       devices=jax.devices("cpu")[:8])
+    rs = np.random.RandomState(9)
+    b, h, t, d = 4, 2, 32, 8
+    q, k, v = (jnp.asarray(rs.normal(size=(b, h, t, d)).astype(np.float32))
+               for _ in range(3))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh, P("data", None, "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    got = ring_attention(qs, ks, vs, mesh, "seq", causal=True,
+                         batch_axis="data")
+    want = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=1e-5)
