@@ -15,6 +15,23 @@ from ..base import parse_attr
 from .registry import register
 
 
+_REQUIRED = object()
+
+
+def _scalar(attrs, name, default=_REQUIRED):
+    """Scalar hyperparameter: a traced jax array passes through (so lr
+    schedules can feed a jitted train step without recompiling); strings
+    and numbers parse to float.  Missing required attrs raise by name."""
+    val = attrs.get(name, default)
+    if val is _REQUIRED:
+        raise KeyError(f"optimizer update requires attr {name!r}")
+    if val is None:
+        return None
+    if hasattr(val, "dtype") and hasattr(val, "shape"):
+        return val
+    return float(parse_attr(val))
+
+
 def _prep_grad(grad, weight, attrs):
     rescale = float(parse_attr(attrs.get("rescale_grad", 1.0)))
     clip = parse_attr(attrs.get("clip_gradient", None))
@@ -27,7 +44,7 @@ def _prep_grad(grad, weight, attrs):
 
 @register("sgd_update", arg_names=("weight", "grad"))
 def _sgd_update(ctx, weight, grad, **attrs):
-    lr = float(parse_attr(attrs["lr"]))
+    lr = _scalar(attrs, "lr")
     return weight - lr * _prep_grad(grad, weight, attrs)
 
 
@@ -39,7 +56,7 @@ def _sgd_update(ctx, weight, grad, **attrs):
 )
 def _sgd_mom_update(ctx, weight, grad, mom, **attrs):
     """mom = momentum*mom - lr*grad';  weight += mom (optimizer_op-inl.h)."""
-    lr = float(parse_attr(attrs["lr"]))
+    lr = _scalar(attrs, "lr")
     momentum = float(parse_attr(attrs.get("momentum", 0.0)))
     g = _prep_grad(grad, weight, attrs)
     new_mom = momentum * mom - lr * g
@@ -53,7 +70,7 @@ def _sgd_mom_update(ctx, weight, grad, mom, **attrs):
     output_names=("weight", "mean", "var"),
 )
 def _adam_update(ctx, weight, grad, mean, var, **attrs):
-    lr = float(parse_attr(attrs["lr"]))
+    lr = _scalar(attrs, "lr")
     beta1 = float(parse_attr(attrs.get("beta1", 0.9)))
     beta2 = float(parse_attr(attrs.get("beta2", 0.999)))
     eps = float(parse_attr(attrs.get("epsilon", 1e-8)))
@@ -71,7 +88,7 @@ def _adam_update(ctx, weight, grad, mean, var, **attrs):
     output_names=("weight", "n"),
 )
 def _rmsprop_update(ctx, weight, grad, n, **attrs):
-    lr = float(parse_attr(attrs["lr"]))
+    lr = _scalar(attrs, "lr")
     gamma1 = float(parse_attr(attrs.get("gamma1", 0.95)))
     eps = float(parse_attr(attrs.get("epsilon", 1e-8)))
     clip_weights = parse_attr(attrs.get("clip_weights", None))

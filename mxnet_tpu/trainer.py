@@ -34,37 +34,39 @@ from .initializer import Uniform
 from .ndarray import NDArray
 
 
-# pure update rules reusing the fused optimizer kernels from ops/optimizer_ops
+# pure update rules reusing the fused optimizer kernels from ops/optimizer_ops;
+# `lr` arrives per-call (a traced scalar, so schedules don't recompile)
 def _sgd_rule(opt_params):
     momentum = opt_params.get("momentum", 0.0)
-    attrs = {k: opt_params[k] for k in ("lr", "wd", "rescale_grad", "clip_gradient")
+    attrs = {k: opt_params[k] for k in ("wd", "rescale_grad", "clip_gradient")
              if k in opt_params}
 
     def init_state(w):
         return (jnp.zeros_like(w),) if momentum else ()
 
-    def update(w, g, state):
+    def update(w, g, state, lr):
         octx = ops.OpCtx()
         if momentum:
             new_w, new_m = ops.get("sgd_mom_update").fn(
-                octx, w, g, state[0], momentum=momentum, **attrs)
+                octx, w, g, state[0], momentum=momentum, lr=lr, **attrs)
             return new_w, (new_m,)
-        return ops.get("sgd_update").fn(octx, w, g, **attrs), ()
+        return ops.get("sgd_update").fn(octx, w, g, lr=lr, **attrs), ()
 
     return init_state, update
 
 
 def _adam_rule(opt_params):
-    attrs = {k: opt_params[k] for k in ("lr", "wd", "rescale_grad",
+    attrs = {k: opt_params[k] for k in ("wd", "rescale_grad",
                                         "clip_gradient", "beta1", "beta2",
                                         "epsilon") if k in opt_params}
 
     def init_state(w):
         return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(w, g, state):
+    def update(w, g, state, lr):
         octx = ops.OpCtx()
-        new_w, m, v = ops.get("adam_update").fn(octx, w, g, state[0], state[1], **attrs)
+        new_w, m, v = ops.get("adam_update").fn(octx, w, g, state[0],
+                                                state[1], lr=lr, **attrs)
         return new_w, (m, v)
 
     return init_state, update
@@ -83,7 +85,8 @@ class FusedTrainer:
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  optimizer="sgd", optimizer_params=None, mesh: Optional[Mesh] = None,
                  initializer=None, dtype=jnp.float32, sharding_rules=(),
-                 remat=None, fixed_param_names=(), clip_global_norm=None):
+                 remat=None, fixed_param_names=(), clip_global_norm=None,
+                 lr_scheduler=None):
         # rematerialization = the reference's MXNET_BACKWARD_DO_MIRROR
         # (recompute activations in backward, env_var.md:55-57) — on TPU
         # it is jax.checkpoint around the forward.  Default follows the
@@ -98,6 +101,13 @@ class FusedTrainer:
         self.dtype = jnp.dtype(dtype)
         opt_params = dict(optimizer_params or {})
         opt_params.setdefault("lr", opt_params.pop("learning_rate", 0.01))
+        # lr schedule (parity: lr_scheduler.py's role in optimizer.py):
+        # callable(num_update) -> lr, evaluated on the host each step and
+        # fed to the jitted step as a traced scalar — no recompilation
+        self._base_lr = float(opt_params.pop("lr"))
+        self._lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and hasattr(lr_scheduler, "base_lr"):
+            lr_scheduler.base_lr = self._base_lr
         if optimizer not in _RULES:
             raise ValueError(f"FusedTrainer supports {sorted(_RULES)}; "
                              f"use Module for {optimizer}")
@@ -175,7 +185,7 @@ class FusedTrainer:
 
         fixed = self._fixed
 
-        def train_step(params, aux, opt_state, batch, key):
+        def train_step(params, aux, opt_state, batch, key, lr):
             compute_params = {
                 k: v.astype(dtype) if v.dtype == jnp.float32 else v
                 for k, v in params.items()
@@ -219,7 +229,7 @@ class FusedTrainer:
                 if k in fixed:
                     new_params[k] = w
                     continue
-                nw, ns = update(w, f32_grads[k], opt_state[k])
+                nw, ns = update(w, f32_grads[k], opt_state[k], lr)
                 new_params[k] = nw
                 new_opt[k] = ns
             return new_params, new_aux, new_opt, outs
@@ -262,12 +272,20 @@ class FusedTrainer:
                 out[k] = raw
         return out
 
+    def current_lr(self):
+        """The learning rate the NEXT step will apply."""
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler(self._step + 1))
+        return self._base_lr
+
     def step(self, **batch):
         """Run one fused train step; returns outputs (list of jax arrays)."""
+        lr = np.float32(self.current_lr())  # single source of lr truth
         self._step += 1
         key = jax.random.fold_in(_random.current_key(), self._step)
         self.params, self.aux, self.opt_state, outs = self._step_fn(
-            self.params, self.aux, self.opt_state, self._shard_batch(batch), key)
+            self.params, self.aux, self.opt_state, self._shard_batch(batch),
+            key, lr)
         return outs
 
     def eval(self, **batch):
