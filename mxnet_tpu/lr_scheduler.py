@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import logging
+import math
 
 
 class LRScheduler:
@@ -57,3 +58,28 @@ class MultiFactorScheduler(LRScheduler):
             else:
                 return self.base_lr
         return self.base_lr
+
+
+class WarmupCosineScheduler(LRScheduler):
+    """Linear warmup to base_lr, then cosine decay to ``final_lr`` over
+    ``total_steps`` (beyond-reference: the transformer-era schedule;
+    the v0.9.4 reference ships only Factor/MultiFactor).  Stateless in
+    num_update, so checkpoint resume lands on the exact same curve."""
+
+    def __init__(self, total_steps, warmup_steps=0, final_lr=0.0):
+        super().__init__()
+        if total_steps < 1:
+            raise ValueError("total_steps must be >= 1")
+        if not 0 <= warmup_steps < total_steps:
+            raise ValueError("need 0 <= warmup_steps < total_steps")
+        self.total_steps = int(total_steps)
+        self.warmup_steps = int(warmup_steps)
+        self.final_lr = float(final_lr)
+
+    def __call__(self, num_update):
+        if self.warmup_steps and num_update <= self.warmup_steps:
+            return self.base_lr * num_update / self.warmup_steps
+        t = min(num_update, self.total_steps) - self.warmup_steps
+        span = self.total_steps - self.warmup_steps
+        cos = 0.5 * (1.0 + math.cos(math.pi * t / span))
+        return self.final_lr + (self.base_lr - self.final_lr) * cos

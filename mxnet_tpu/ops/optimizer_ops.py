@@ -28,6 +28,9 @@ def _scalar(attrs, name, default=_REQUIRED):
     if val is None:
         return None
     if hasattr(val, "dtype") and hasattr(val, "shape"):
+        if getattr(val, "ndim", 0) != 0:
+            raise ValueError(f"attr {name!r} must be a scalar, got shape "
+                             f"{val.shape}")
         return val
     return float(parse_attr(val))
 

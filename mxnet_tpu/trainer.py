@@ -31,6 +31,7 @@ from . import ops
 from . import random as _random
 from .executor import _build_graph_fn
 from .initializer import Uniform
+from .base import MXNetError
 from .ndarray import NDArray
 
 

@@ -291,3 +291,22 @@ def test_fused_trainer_lr_scheduler_no_recompile():
                                    rtol=1e-5, atol=1e-7, err_msg=k)
     # the traced-lr design must not retrace per step
     assert tr._step_fn._cache_size() == 1
+
+
+def test_warmup_cosine_scheduler_curve():
+    """Linear warmup then cosine decay; stateless in num_update (resume
+    lands on the same curve)."""
+    from mxnet_tpu.lr_scheduler import WarmupCosineScheduler
+
+    s = WarmupCosineScheduler(total_steps=100, warmup_steps=10,
+                              final_lr=0.01)
+    s.base_lr = 1.0
+    assert abs(s(1) - 0.1) < 1e-9 and abs(s(10) - 1.0) < 1e-9  # warmup
+    assert abs(s(55) - (0.01 + 0.99 * 0.5)) < 1e-9             # midpoint
+    assert abs(s(100) - 0.01) < 1e-9                           # floor
+    assert abs(s(500) - 0.01) < 1e-9                           # clamps
+    # stateless: a fresh scheduler agrees even after out-of-order queries
+    s2 = WarmupCosineScheduler(total_steps=100, warmup_steps=10,
+                               final_lr=0.01)
+    s2.base_lr = 1.0
+    assert s2(40) == s(40)
