@@ -52,15 +52,26 @@ def _ffn_block(h, seq_len, d_model, d_ff, name, dropout):
 
 
 def transformer_lm(num_layers=4, num_heads=4, d_model=128, d_ff=None,
-                   seq_len=128, vocab_size=1000, dropout=0.0):
-    """Next-token LM: data (N, T) token ids, softmax_label (N, T)."""
+                   seq_len=128, vocab_size=1000, dropout=0.0,
+                   ignore_label=None, max_len=None):
+    """Next-token LM: data (N, T) token ids, softmax_label (N, T).
+
+    ignore_label masks padding out of the loss/gradient, and max_len
+    sizes the positional table independently of this bucket's seq_len —
+    together they make the symbol bucketing-ready (BucketingModule
+    shares one pos_embed across all sequence-length buckets)."""
     if d_model % num_heads:
         raise ValueError("d_model must divide by num_heads")
     d_ff = d_ff or 4 * d_model
+    max_len = max_len or seq_len
+    if max_len < seq_len:
+        raise ValueError("max_len must be >= seq_len")
     data = sym.Variable("data")
     tok = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
                         name="tok_embed")
-    pos = sym.Variable("pos_embed", shape=(1, seq_len, d_model))
+    pos = sym.Variable("pos_embed", shape=(1, max_len, d_model))
+    if max_len != seq_len:
+        pos = sym.slice_axis(pos, axis=1, begin=0, end=seq_len)
     h = sym.broadcast_add(tok, pos)
     for i in range(num_layers):
         h = _attention_block(h, seq_len, d_model, num_heads, f"layer{i}")
@@ -69,7 +80,11 @@ def transformer_lm(num_layers=4, num_heads=4, d_model=128, d_ff=None,
     h = sym.Reshape(h, shape=(-1, d_model))
     logits = sym.FullyConnected(h, num_hidden=vocab_size, name="lm_head")
     label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
-    return sym.SoftmaxOutput(logits, label, name="softmax")
+    loss_kw = {}
+    if ignore_label is not None:
+        loss_kw = {"use_ignore": True, "ignore_label": ignore_label,
+                   "normalization": "valid"}
+    return sym.SoftmaxOutput(logits, label, name="softmax", **loss_kw)
 
 
 def get_symbol(num_classes=1000, **kwargs):

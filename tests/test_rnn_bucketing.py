@@ -170,3 +170,23 @@ def test_fused_trainer_remat_matches():
         outs[remat] = {k: np.asarray(v) for k, v in tr.params.items()}
     for k in outs[False]:
         assert np.allclose(outs[False][k], outs[True][k], atol=1e-5), k
+
+
+def test_bucketed_transformer_example():
+    """BucketingModule drives the transformer family: shared pos_embed
+    across length buckets, padding masked by ignore_label, one compile
+    (examples/transformer-lm/train_bucketing.py)."""
+    import os
+    import re
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, MXTPU_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "transformer-lm",
+                      "train_bucketing.py"), "--num-epochs", "2"],
+        capture_output=True, text=True, timeout=580, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    m = re.search(r"final train Perplexity: ([0-9.]+)", r.stdout)
+    assert m and float(m.group(1)) < 5.0, r.stdout
