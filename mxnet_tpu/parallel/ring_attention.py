@@ -97,15 +97,18 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
-                      causal: bool = False, scale: float = None):
+                      causal: bool = False, scale: float = None,
+                      batch_axis: str = None):
     """DeepSpeed-Ulysses-style SP: all-to-all (seq->heads), full local
-    attention, all-to-all back.  Requires H % mesh.shape[axis] == 0."""
-    b, h, t, d = q.shape
+    attention, all-to-all back.  Requires H % mesh.shape[axis] == 0.
+    ``batch_axis`` additionally shards B over a second mesh axis (dp x
+    sp; the all-to-alls stay within each data replica's 'seq' group)."""
+    h, d = q.shape[1], q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     n = mesh.shape[axis_name]
     if h % n != 0:
         raise ValueError(f"heads {h} not divisible by seq-par degree {n}")
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
 
     def local_fn(q, k, v):
         # local: (B, H, T/n, D) -> a2a -> (B, H/n, T, D)

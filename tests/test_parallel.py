@@ -312,3 +312,24 @@ def test_ring_attention_dp_sp_mesh():
     want = attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=1e-5)
+
+
+def test_ulysses_attention_dp_sp_mesh():
+    """dp x sp Ulysses: the head/seq all-to-alls stay within each data
+    replica's seq group; must match full attention."""
+    from mxnet_tpu.parallel.ring_attention import attention, ulysses_attention
+
+    mesh = create_mesh((2, 4), ("data", "seq"),
+                       devices=jax.devices("cpu")[:8])
+    rs = np.random.RandomState(11)
+    b, h, t, d = 4, 4, 32, 8
+    q, k, v = (jnp.asarray(rs.normal(size=(b, h, t, d)).astype(np.float32))
+               for _ in range(3))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh, P("data", None, "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    got = ulysses_attention(qs, ks, vs, mesh, "seq", causal=True,
+                            batch_axis="data")
+    want = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=1e-5)
