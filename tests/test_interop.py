@@ -160,3 +160,60 @@ def test_scalar_params_do_not_desync_stream(tmp_path):
     np.testing.assert_array_equal(arg["scalar"].asnumpy(), [3.5])
     np.testing.assert_array_equal(arg["w"].asnumpy(),
                                   [[0.0, 1.0], [2.0, 3.0]])
+
+
+def test_fine_tune_from_reference_checkpoint(tmp_path):
+    """The complete migration journey: a reference-FORMAT checkpoint
+    (legacy param-dict symbol JSON + binary .params) feeds the stock
+    fine-tune example unchanged — load sniffing + interop close the
+    loop for users switching from the reference."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    net = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=8,
+                          stride=(2, 2), name="conv1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Flatten(net, name="flatten")
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=5, name="fc"),
+                            name="softmax")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3, 32, 32))
+    init = mx.init.Xavier()
+    arg_params = {}
+    for n_, a_ in ex.arg_dict.items():
+        if n_ not in ("data", "softmax_label"):
+            init(n_, a_)
+            arg_params[n_] = a_
+    # legacy-format symbol JSON (per-node 'param' dicts, 2-elem inputs)
+    nodes, index = [], {}
+    for i, node in enumerate(net.nodes):
+        index[id(node)] = i
+        if node.is_variable:
+            nodes.append({"op": "null", "param": {}, "name": node.name,
+                          "inputs": [], "backward_source_id": -1})
+        else:
+            nodes.append({"op": node.op,
+                          "param": {k: str(v) for k, v in node.attrs.items()},
+                          "name": node.name,
+                          "inputs": [[index[id(s)], oi]
+                                     for s, oi in node.inputs],
+                          "backward_source_id": -1})
+    prefix = str(tmp_path / "m")
+    with open(prefix + "-symbol.json", "w") as f:
+        json.dump({"nodes": nodes,
+                   "arg_nodes": [i for i, n in enumerate(net.nodes)
+                                 if n.is_variable],
+                   "heads": [[len(nodes) - 1, 0]]}, f)
+    interop.save_params(prefix + "-0000.params", arg_params, {})
+
+    env = dict(os.environ, MXTPU_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "image-classification",
+                      "fine-tune.py"),
+         "--pretrained-model", prefix, "--pretrained-epoch", "0",
+         "--num-classes", "3", "--num-epochs", "1", "--batch-size", "16"],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Validation-accuracy" in r.stdout + r.stderr
