@@ -297,6 +297,78 @@ class FusedTrainer:
         return ({k: NDArray(v) for k, v in self.params.items()},
                 {k: NDArray(v) for k, v in self.aux.items()})
 
+    # ------------------------------------------------------------------- fit
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            validation_metric=None, num_epoch=1, batch_end_callback=None,
+            epoch_end_callback=None, logger=None):
+        """Module.fit-shaped loop on the fused step (the whole-step-
+        compiled perf path): per-batch metric updates, Speedometer-style
+        callbacks, per-epoch eval — without hand-rolling the loop.
+
+        Calls init() from the first batch's shapes if needed.  Returns
+        self.  The metric sees the step's outputs (same contract as
+        Module.update_metric)."""
+        import logging as _logging
+
+        from . import metric as metric_mod
+        from .module.base_module import BatchEndParam, _as_list
+
+        log = logger or _logging.getLogger()
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            if validation_metric is None and eval_data is not None:
+                validation_metric = metric_mod.create(eval_metric)
+            eval_metric = metric_mod.create(eval_metric)
+        if validation_metric is not None and not isinstance(
+                validation_metric, metric_mod.EvalMetric):
+            validation_metric = metric_mod.create(validation_metric)
+        if eval_data is not None and validation_metric is None:
+            raise ValueError(
+                "pass validation_metric when eval_metric is a metric "
+                "instance (instances hold state; eval needs its own)")
+        import time as _time
+
+        train_names = ([d[0] for d in train_data.provide_data]
+                       + [l[0] for l in train_data.provide_label])
+        eval_names = ([d[0] for d in eval_data.provide_data]
+                      if eval_data is not None else None)
+        for epoch in range(num_epoch):
+            tic = _time.time()
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                feed = dict(zip(train_names,
+                                list(batch.data) + list(batch.label)))
+                if not self.params:
+                    self.init(**{k: tuple(v.shape)
+                                 for k, v in feed.items()})
+                outs = self.step(**feed)
+                eval_metric.update(batch.label, [NDArray(o) for o in outs])
+                if batch_end_callback is not None:
+                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=eval_metric,
+                                           locals=None)
+                    for cb in _as_list(batch_end_callback):
+                        cb(params)
+            for name, val in eval_metric.get_global_name_value():
+                log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            log.info("Epoch[%d] Time cost=%.3f", epoch,
+                     _time.time() - tic)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg, aux)
+            if eval_data is not None:
+                vm = validation_metric
+                vm.reset()
+                eval_data.reset()
+                for batch in eval_data:
+                    feed = dict(zip(eval_names, list(batch.data)))
+                    outs = self.eval(**feed)
+                    vm.update(batch.label, [NDArray(o) for o in outs])
+                for name, val in vm.get_global_name_value():
+                    log.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+        return self
+
     # ------------------------------------------------------------ checkpoints
     def _gather(self, v):
         """Full host value of a (possibly sharded) array.  On multi-host
