@@ -39,35 +39,42 @@ from .ndarray import NDArray
 # `lr` arrives per-call (a traced scalar, so schedules don't recompile)
 def _sgd_rule(opt_params):
     momentum = opt_params.get("momentum", 0.0)
-    attrs = {k: opt_params[k] for k in ("wd", "rescale_grad", "clip_gradient")
+    base_wd = float(opt_params.get("wd", 0.0))
+    attrs = {k: opt_params[k] for k in ("rescale_grad", "clip_gradient")
              if k in opt_params}
 
     def init_state(w):
         return (jnp.zeros_like(w),) if momentum else ()
 
-    def update(w, g, state, lr):
+    def update(w, g, state, lr, wd_mult=1.0):
         octx = ops.OpCtx()
+        wd = base_wd * wd_mult
         if momentum:
             new_w, new_m = ops.get("sgd_mom_update").fn(
-                octx, w, g, state[0], momentum=momentum, lr=lr, **attrs)
+                octx, w, g, state[0], momentum=momentum, lr=lr, wd=wd,
+                **attrs)
             return new_w, (new_m,)
-        return ops.get("sgd_update").fn(octx, w, g, lr=lr, **attrs), ()
+        return ops.get("sgd_update").fn(octx, w, g, lr=lr, wd=wd,
+                                        **attrs), ()
 
     return init_state, update
 
 
 def _adam_rule(opt_params):
-    attrs = {k: opt_params[k] for k in ("wd", "rescale_grad",
+    base_wd = float(opt_params.get("wd", 0.0))
+    attrs = {k: opt_params[k] for k in ("rescale_grad",
                                         "clip_gradient", "beta1", "beta2",
                                         "epsilon") if k in opt_params}
 
     def init_state(w):
         return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(w, g, state, lr):
+    def update(w, g, state, lr, wd_mult=1.0):
         octx = ops.OpCtx()
         new_w, m, v = ops.get("adam_update").fn(octx, w, g, state[0],
-                                                state[1], lr=lr, **attrs)
+                                                state[1], lr=lr,
+                                                wd=base_wd * wd_mult,
+                                                **attrs)
         return new_w, (m, v)
 
     return init_state, update
@@ -130,6 +137,20 @@ class FusedTrainer:
         self._clip_global_norm = (None if clip_global_norm is None
                                   else float(clip_global_norm))
         self._initializer = initializer or Uniform(0.01)
+        # per-param multipliers (reference parity: optimizer.py
+        # set_lr_mult/set_wd_mult) — static per param, folding into the
+        # compile.  Like set_wd_mult, params not named *_weight/*_gamma
+        # (biases, norm betas) default to NO weight decay; explicit
+        # __wd_mult__/__lr_mult__ Variable attrs override.
+        self._lr_mult, self._wd_mult = {}, {}
+        for name in symbol.list_arguments():
+            if not (name.endswith("_weight") or name.endswith("_gamma")):
+                self._wd_mult[name] = 0.0
+        for name, attr in symbol.attr_dict().items():
+            if "__lr_mult__" in attr:
+                self._lr_mult[name] = float(attr["__lr_mult__"])
+            if "__wd_mult__" in attr:
+                self._wd_mult[name] = float(attr["__wd_mult__"])
         self._graph_fn = _build_graph_fn(symbol)
         self.params: Dict[str, jax.Array] = {}
         self.aux: Dict[str, jax.Array] = {}
@@ -230,7 +251,9 @@ class FusedTrainer:
                 if k in fixed:
                     new_params[k] = w
                     continue
-                nw, ns = update(w, f32_grads[k], opt_state[k], lr)
+                nw, ns = update(w, f32_grads[k], opt_state[k],
+                                lr * self._lr_mult.get(k, 1.0),
+                                self._wd_mult.get(k, 1.0))
                 new_params[k] = nw
                 new_opt[k] = ns
             return new_params, new_aux, new_opt, outs

@@ -310,3 +310,50 @@ def test_warmup_cosine_scheduler_curve():
                                final_lr=0.01)
     s2.base_lr = 1.0
     assert s2(40) == s(40)
+
+
+def test_fused_trainer_lr_wd_mult():
+    """Variable __lr_mult__/__wd_mult__ attrs apply on the fused path
+    (reference parity: optimizer.py set_lr_mult/set_wd_mult): lr_mult=0
+    freezes a param, wd_mult=0 exempts it from decay."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import sym
+    from mxnet_tpu.trainer import FusedTrainer
+
+    rs = np.random.RandomState(0)
+    X = rs.normal(size=(8, 4)).astype(np.float32)
+    Y = rs.randint(0, 2, 8).astype(np.float32)
+    data = sym.Variable("data")
+    w_frozen = sym.Variable("fc1_weight", lr_mult=0.0)
+    h = sym.FullyConnected(data, weight=w_frozen, num_hidden=4, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    out = sym.SoftmaxOutput(
+        sym.FullyConnected(h, num_hidden=2, name="fc2"),
+        sym.Variable("softmax_label"), name="softmax")
+
+    np.random.seed(1)
+    tr = FusedTrainer(out, optimizer="sgd",
+                      optimizer_params={"lr": 0.5, "wd": 0.1})
+    tr.init(data=(8, 4), softmax_label=(8,))
+    before = {k: np.asarray(v) for k, v in tr.params.items()}
+    tr.step(data=X, softmax_label=Y)
+    after = {k: np.asarray(v) for k, v in tr.params.items()}
+
+    # lr_mult=0: frozen
+    np.testing.assert_array_equal(before["fc1_weight"], after["fc1_weight"])
+    # others moved
+    assert not np.allclose(before["fc2_weight"], after["fc2_weight"])
+    # wd_mult=0 on fc2_bias: with a zero-gradient-ish check, compare
+    # against an explicit no-wd oracle for the bias column
+    np.random.seed(1)
+    tr2 = FusedTrainer(out, optimizer="sgd",
+                       optimizer_params={"lr": 0.5, "wd": 0.0})
+    tr2.init(data=(8, 4), softmax_label=(8,))
+    tr2.step(data=X, softmax_label=Y)
+    np.testing.assert_allclose(after["fc2_bias"],
+                               np.asarray(tr2.params["fc2_bias"]),
+                               rtol=1e-5, atol=1e-7)
+    # fc2_weight DID receive decay (differs from the no-wd run)
+    assert not np.allclose(after["fc2_weight"],
+                           np.asarray(tr2.params["fc2_weight"]))
