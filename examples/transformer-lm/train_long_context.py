@@ -5,11 +5,14 @@ as a WORKLOAD, not just an op.
 The reference's long-sequence story tops out at bucketed LSTMs
 (SURVEY.md §5.7); here the full training step runs with activations
 sharded over a 'seq' mesh axis: every matmul/LayerNorm/FFN operates on
-its local sequence shard, and attention is exact ring attention
-(parallel/ring_attention.py) — K/V shards rotate via ppermute while each
-device streams its online-softmax accumulation, so the (T, T) score
-matrix never materializes and max context scales linearly with the
-number of devices.
+its local sequence shard, and attention is exact sequence-parallel
+attention (parallel/ring_attention.py).  --impl ring (default): K/V
+shards rotate via ppermute while each device streams its online-softmax
+accumulation, so the (T, T) score matrix never materializes and max
+context scales linearly with the number of devices.  --impl ulysses:
+head/sequence all-to-alls — each device attends over the FULL sequence
+for H/n heads (scores materialize per device; cheaper collectives,
+requires heads % n == 0).
 
 Run on the virtual mesh (no hardware needed):
   XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -35,7 +38,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 from common import layer_norm as _ln  # noqa: E402
 from mxnet_tpu.parallel.mesh import create_mesh  # noqa: E402
-from mxnet_tpu.parallel.ring_attention import ring_attention  # noqa: E402
+from mxnet_tpu.parallel.ring_attention import (  # noqa: E402
+    ring_attention, ulysses_attention)
 
 
 def init_params(rs, n_layers, D, H, vocab):
@@ -56,9 +60,10 @@ def init_params(rs, n_layers, D, H, vocab):
                 lambda *xs: jnp.stack(xs), *blocks)}
 
 
-def forward(params, X, n_heads, mesh=None):
+def forward(params, X, n_heads, mesh=None, impl="ring"):
     """[B, T] ids -> [B, T, vocab] logits.  With a mesh, attention runs
-    ring-sharded over 'seq'; everything else is local to the shard."""
+    sequence-sharded over 'seq' (impl: ring | ulysses); everything else
+    is local to the shard."""
     B, T = X.shape
     h = params["embed"][X]
     D = h.shape[-1]
@@ -68,7 +73,8 @@ def forward(params, X, n_heads, mesh=None):
         sh = lambda a: a.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
         q, k, v = sh(q), sh(k), sh(v)
         if mesh is not None:
-            o = ring_attention(q, k, v, mesh, "seq", causal=True)
+            sp = ring_attention if impl == "ring" else ulysses_attention
+            o = sp(q, k, v, mesh, "seq", causal=True)
         else:
             from mxnet_tpu.parallel.ring_attention import attention
 
@@ -87,9 +93,9 @@ def forward(params, X, n_heads, mesh=None):
     return h @ params["head"]
 
 
-def make_loss(n_heads, mesh=None):
+def make_loss(n_heads, mesh=None, impl="ring"):
     def loss_fn(params, X, Y):
-        logits = forward(params, X, n_heads, mesh)
+        logits = forward(params, X, n_heads, mesh, impl)
         lp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.take_along_axis(lp, Y[..., None], axis=-1).mean()
 
@@ -108,6 +114,8 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--steps", type=int, default=15)
     ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--impl", choices=("ring", "ulysses"), default="ring",
+                    help="sequence-parallel attention strategy")
     ap.add_argument("--self-test", action="store_true",
                     help="check sharded grads == dense oracle at T=64")
     args = ap.parse_args(argv)
@@ -116,6 +124,8 @@ def main(argv=None):
         ap.error("--seq-len must divide by --n-devices")
     if args.self_test and 64 % args.n_devices:
         ap.error("--self-test shards T=64: --n-devices must divide 64")
+    if args.impl == "ulysses" and args.heads % args.n_devices:
+        ap.error("--impl ulysses needs --heads divisible by --n-devices")
     if args.d_model % args.heads:
         ap.error("--d-model must divide by --heads")
     platform = os.environ.get("MXTPU_LC_PLATFORM", "cpu")
@@ -135,7 +145,7 @@ def main(argv=None):
     if args.self_test:
         Xs, Ys = batch(64)
         l_ring, g_ring = jax.jit(jax.value_and_grad(
-            make_loss(args.heads, mesh)))(params, Xs, Ys)
+            make_loss(args.heads, mesh, args.impl)))(params, Xs, Ys)
         l_ref, g_ref = jax.jit(jax.value_and_grad(
             make_loss(args.heads, None)))(params, np.asarray(Xs),
                                           np.asarray(Ys))
@@ -146,9 +156,10 @@ def main(argv=None):
                                        np.asarray(ref_flat[path]),
                                        rtol=2e-4, atol=1e-5,
                                        err_msg=str(path))
-        print("self-test: ring-sharded grads == dense oracle")
+        print("self-test: %s-sharded grads == dense oracle" % args.impl)
 
-    step = jax.jit(jax.value_and_grad(make_loss(args.heads, mesh)))
+    step = jax.jit(jax.value_and_grad(make_loss(args.heads, mesh,
+                                                args.impl)))
     X, Y = batch(args.seq_len)
     first = None
     for i in range(args.steps):
@@ -158,9 +169,13 @@ def main(argv=None):
         if first is None:
             first = float(loss)
         if i % 5 == 0 or i == args.steps - 1:
-            print("step %3d  T=%d  loss %.4f  (per-device KV: T/%d = %d)"
-                  % (i, args.seq_len, float(loss), args.n_devices,
-                     args.seq_len // args.n_devices))
+            shard_note = ("per-device KV: T/%d = %d" % (
+                args.n_devices, args.seq_len // args.n_devices)
+                if args.impl == "ring" else
+                "per-device heads: H/%d = %d, full-T KV" % (
+                    args.n_devices, args.heads // args.n_devices))
+            print("step %3d  T=%d  loss %.4f  (%s)"
+                  % (i, args.seq_len, float(loss), shard_note))
     if args.steps > 1:
         assert float(loss) < first, (first, float(loss))
     print("converged: %.3f -> %.3f at context %d over %d devices"
