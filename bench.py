@@ -233,7 +233,12 @@ def _bench(dev, kind):
             idt = time.perf_counter() - itic
             inf = batch * infer_iters / idt
             extras["resnet50_infer_b32_imgs_per_sec"] = round(inf, 1)
-            extras["infer_vs_p100_baseline"] = round(inf / 713.17, 2)
+            # methodology: the train symbol's eval forward reusing staged
+            # train batches, NOT the predictor ABI path earlier rounds'
+            # benchmark_score measured — keyed distinctly so round-over-
+            # round ratios aren't misread as apples-to-apples
+            extras["eval_forward_vs_p100_infer_baseline"] = round(
+                inf / 713.17, 2)
         except Exception as exc:  # noqa: BLE001
             extras["extras_error"] = repr(exc)
         try:

@@ -40,10 +40,21 @@ class MApMetric(EvalMetric):
         super().__init__(name)
 
     def reset(self):
-        # (class, score, matched) per detection + gt counts per class
+        # (class, score, matched) per detection + gt counts per class;
+        # epoch-wide accumulators plus a current-window copy so the
+        # reset_local() protocol works: Speedometer(auto_reset=True)
+        # reads per-interval mAP from get(), epoch mAP from get_global()
         self._records = []
         self._gt_counts = {}
+        self._win_records = []
+        self._win_gt_counts = {}
         super().reset()
+
+    def reset_local(self):
+        self._win_records = []
+        self._win_gt_counts = {}
+        # base accumulators stay untouched (zero) — mAP is computed from
+        # ranked records, not from sum_metric/num_inst
 
     def update(self, labels, preds):
         for lab, pred in zip(labels, preds):
@@ -53,16 +64,14 @@ class MApMetric(EvalMetric):
                               else pred)
             for b in range(lab.shape[0]):
                 self._update_one(lab[b], pred[b])
-        # keep the base accumulators coherent for get_global composition
-        self.num_inst = 1
-        self.sum_metric = 0.0
 
     def _update_one(self, gts, dets):
         gts = gts[gts[:, 0] >= 0]
         dets = dets[dets[:, 0] >= 0]
         for c in np.unique(gts[:, 0]).astype(int):
-            self._gt_counts[c] = self._gt_counts.get(c, 0) + int(
-                (gts[:, 0] == c).sum())
+            n = int((gts[:, 0] == c).sum())
+            self._gt_counts[c] = self._gt_counts.get(c, 0) + n
+            self._win_gt_counts[c] = self._win_gt_counts.get(c, 0) + n
         order = np.argsort(-dets[:, 1]) if len(dets) else []
         taken = np.zeros(len(gts), bool)
         for di in order:
@@ -81,6 +90,7 @@ class MApMetric(EvalMetric):
                     taken[cand[best]] = True
                     matched = True
             self._records.append((c, float(d[1]), matched))
+            self._win_records.append((c, float(d[1]), matched))
 
     def _average_precision(self, rec, prec):
         # continuous AP: integrate the precision envelope
@@ -91,11 +101,10 @@ class MApMetric(EvalMetric):
         idx = np.where(mrec[1:] != mrec[:-1])[0]
         return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
 
-    def get(self):
+    def _map_over(self, records, gt_counts):
         aps = []
-        names = []
-        for c, n_gt in sorted(self._gt_counts.items()):
-            recs = sorted((r for r in self._records if r[0] == c),
+        for c, n_gt in sorted(gt_counts.items()):
+            recs = sorted((r for r in records if r[0] == c),
                           key=lambda r: -r[1])
             if n_gt == 0:
                 continue
@@ -105,12 +114,14 @@ class MApMetric(EvalMetric):
             prec = (tp / np.maximum(tp + fp, 1e-12)
                     if len(recs) else np.array([0.0]))
             aps.append(self._average_precision(rec, prec))
-            names.append(self.class_names[c] if self.class_names else str(c))
-        value = float(np.mean(aps)) if aps else float("nan")
-        return (self.name, value)
+        return float(np.mean(aps)) if aps else float("nan")
 
-    def get_global(self):  # detection records already span the epoch
-        return self.get()
+    def get(self):  # current window (since the last reset_local)
+        return (self.name, self._map_over(self._win_records,
+                                          self._win_gt_counts))
+
+    def get_global(self):  # full epoch
+        return (self.name, self._map_over(self._records, self._gt_counts))
 
 
 class VOC07MApMetric(MApMetric):

@@ -539,17 +539,29 @@ class KVStoreDist(KVStore):
                 with _prof.span(f"kvstore_pull[{k}]", category="kvstore"):
                     val = self._client.pull(k, shape, dtype)
                     for oo in targets:
-                        oo._set(val)
+                        oo._set(val, _from_engine=True)
 
-            var = self._var(k)  # serializes after this key's pushes
-            self._engine.push(_do_pull, mutable_vars=[var],
-                              priority=priority)
             eng = self._engine
+            # each out chunk carries its own write-serialization var:
+            # pulls of DIFFERENT keys into the same out array would
+            # otherwise run under disjoint per-key vars and land in
+            # nondeterministic order on the threaded engine
+            ovars = []
+            for oo in targets:
+                if oo._chunk.engine_var is None:
+                    oo._chunk.engine_var = eng.new_var()
+                ovars.append(oo._chunk.engine_var)
+            var = self._var(k)  # serializes after this key's pushes
+            self._engine.push(_do_pull, mutable_vars=[var] + ovars,
+                              priority=priority)
             for oo in targets:
                 # WaitToRead: the next read of the out array blocks until
-                # the engine-scheduled write landed
+                # every scheduled write to it landed.  WaitForVar enqueues
+                # a marker behind all ops on the chunk's var, so a single
+                # waiter replaces any previous one — no chain to grow.
                 oo._chunk.host_waiter = (
-                    lambda eng=eng, var=var: eng.wait_for_var(var))
+                    lambda eng=eng, ov=oo._chunk.engine_var:
+                        eng.wait_for_var(ov))
 
     def set_optimizer(self, optimizer):
         if self._client is None:

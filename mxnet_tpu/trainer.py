@@ -352,8 +352,15 @@ class FusedTrainer:
 
         train_names = ([d[0] for d in train_data.provide_data]
                        + [l[0] for l in train_data.provide_label])
+        # feed the eval iterator's REAL labels when it provides them: the
+        # zeros placeholder in eval_step only works for shape-agnostic
+        # label consumers (e.g. Reshape(-1) loss heads); a fixed-shape
+        # label consumer would fail or mis-trace with it
+        eval_label_names = ([l[0] for l in getattr(eval_data, "provide_label",
+                                                   None) or []]
+                            if eval_data is not None else [])
         eval_names = ([d[0] for d in eval_data.provide_data]
-                      if eval_data is not None else None)
+                      + eval_label_names if eval_data is not None else None)
         for epoch in range(num_epoch):
             tic = _time.time()
             eval_metric.reset()
@@ -385,7 +392,10 @@ class FusedTrainer:
                 vm.reset()
                 eval_data.reset()
                 for batch in eval_data:
-                    feed = dict(zip(eval_names, list(batch.data)))
+                    feed = dict(zip(eval_names,
+                                    list(batch.data)
+                                    + (list(batch.label)
+                                       if eval_label_names else [])))
                     outs = self.eval(**feed)
                     vm.update(batch.label, [NDArray(o) for o in outs])
                 for name, val in vm.get_global_name_value():
@@ -439,6 +449,12 @@ class FusedTrainer:
         if missing:
             raise MXNetError(f"checkpoint {prefix!r} lacks params "
                              f"{sorted(missing)[:5]}...")
+        missing_aux = set(self.aux) - set(aux)
+        if missing_aux:
+            # same contract as params: silently keeping init values for
+            # e.g. BatchNorm moving stats is worse than failing
+            raise MXNetError(f"checkpoint {prefix!r} lacks aux states "
+                             f"{sorted(missing_aux)[:5]}...")
         for k, v in arg.items():
             if k in self.params:
                 raw = jnp.asarray(v.asnumpy())

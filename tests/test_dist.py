@@ -263,3 +263,60 @@ def test_async_comm_emits_profiler_spans():
         profiler.profiler_set_state("stop")
     assert any("kvstore_push[p]" in n for n in names), names
     assert any("kvstore_pull[p]" in n for n in names), names
+
+
+def test_async_pull_write_ordering():
+    """Engine-scheduled pulls into the SAME out array must land in push
+    order even for DIFFERENT keys (per-chunk write-serialization var),
+    and a host-side write must not be clobbered by a still-pending pull
+    (NDArray._set resolves the chunk's host_waiter first)."""
+    import time
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _native
+    from mxnet_tpu.kvstore import KVStoreDist
+
+    if not _native.available():
+        pytest.skip("native engine library unavailable")
+
+    class Client:
+        """First key's pull is slow: without per-chunk ordering it would
+        land after (and clobber) the second key's value."""
+
+        def __init__(self):
+            self.store = {}
+
+        def push(self, key, arr):
+            self.store[key] = arr
+
+        def pull(self, key, shape, dtype):
+            if key == "slow":
+                time.sleep(0.25)
+            return np.asarray(self.store[key], dtype)
+
+        def barrier(self):
+            pass
+
+    kv = KVStoreDist("dist_sync")
+    kv._client = Client()
+    kv._engine = _native.NativeEngine()
+    kv.push("slow", mx.nd.ones((2, 2)))
+    kv.push("fast", mx.nd.ones((2, 2)) * 2)
+    kv._engine.wait_all()
+
+    # different keys, same out array: program order must win
+    out = mx.nd.zeros((2, 2))
+    kv.pull("slow", out=out, priority=-1)
+    kv.pull("fast", out=out, priority=-1)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+    # host write while a pull is in flight: the pull lands first, the
+    # host write survives
+    out2 = mx.nd.zeros((2, 2))
+    kv.pull("slow", out=out2, priority=-1)
+    out2[:] = 5.0
+    np.testing.assert_allclose(out2.asnumpy(), 5.0)
+    kv._engine.wait_all()
+    np.testing.assert_allclose(out2.asnumpy(), 5.0)
