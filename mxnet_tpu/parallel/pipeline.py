@@ -159,3 +159,364 @@ def microbatch(x, n_micro):
     if x.shape[0] % n_micro:
         raise ValueError(f"batch {x.shape[0]} not divisible by {n_micro}")
     return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+
+# ===========================================================================
+# Heterogeneous pipeline: per-stage parameter trees, shape-changing stage
+# boundaries, and a 1F1B training schedule.
+#
+# The stacked-array pipeline above requires every stage to share one
+# parameter structure and one activation shape — fine for a repeated
+# trunk, wrong for a real model whose first stage embeds tokens and whose
+# last stage projects to the vocabulary.  This section removes both
+# restrictions:
+#
+# * per-stage pytrees: stages hand in arbitrary (and different) parameter
+#   trees.  Internally the UNION of all stages' leaves is stacked on a
+#   leading stage axis and sharded over the pipe axis — each device
+#   materializes real values for its own stage's leaves and zeros for the
+#   others (zeros cost memory: keep per-stage-exclusive leaves small or
+#   shard them further, e.g. vocab-shard a large embedding over 'pipe'
+#   and all_gather it inside the stage).  Stage dispatch is a
+#   `lax.switch` on the device's pipe index — SPMD-legal because the
+#   branches contain no collectives.
+#
+# * shape-changing boundaries: inter-stage activations are flattened per
+#   sample and padded to the widest boundary, so stage i may map
+#   [mb, T, D] -> [mb, T, 4D] (or an LSTM pipeline may narrow its hidden
+#   width per layer).  `ppermute` moves one uniform [mb, F] buffer; each
+#   stage statically slices/reshapes its true input and pads its output.
+#
+# * 1F1B schedule (`make_pipeline_train_step`): one fused XLA program
+#   scans T = M + 2S - 1 ticks; at tick t, stage s runs the forward of
+#   microbatch t-s and the backward of microbatch t+s-(2S-1) (each when
+#   in range).  Forward activations rotate s->s+1 and backward cotangents
+#   rotate s->s-1 every tick.  Per-stage activation memory is a
+#   2S+1-deep stash of boundary INPUTS (backward recomputes the stage,
+#   remat-style, via jax.vjp at the bwd tick) — O(S) in-flight
+#   microbatches versus the O(M) residuals autodiff keeps for the GPipe
+#   scan, at the standard one-extra-forward remat cost.  The bubble is
+#   the same (S-1)-tick fill/drain at each end; `tools/
+#   pipeline_memory.py` prints the measured memory table.
+# ===========================================================================
+
+
+def _tree_paths(tree):
+    """Pytree -> (ordered path-key list, {path: leaf}, treedef)."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    leaves, treedef = tree_flatten_with_path(tree)
+    keys = [keystr(p) for p, _ in leaves]
+    return keys, dict(zip(keys, (v for _, v in leaves))), treedef
+
+
+class UnionMeta:
+    """Bookkeeping for per-stage trees embedded in one stacked union."""
+
+    def __init__(self, per_stage_params):
+        self.n_stages = len(per_stage_params)
+        self.stage_keys = []   # per stage: ordered leaf path keys
+        self.stage_defs = []   # per stage: treedef
+        self.union = {}        # path -> (shape, dtype)
+        for tree in per_stage_params:
+            keys, leaves, treedef = _tree_paths(tree)
+            self.stage_keys.append(keys)
+            self.stage_defs.append(treedef)
+            for k in keys:
+                sig = (tuple(leaves[k].shape), jnp.result_type(leaves[k]))
+                if k in self.union and self.union[k] != sig:
+                    raise ValueError(
+                        f"leaf {k!r} has shape/dtype {sig} on one stage but "
+                        f"{self.union[k]} on another; same-named leaves must "
+                        "match across stages (rename stage-specific layers)")
+                self.union[k] = sig
+
+    def stage_tree(self, stage, union_slice):
+        """{path: leaf} union slice -> stage's own pytree."""
+        from jax.tree_util import tree_unflatten
+
+        keys = self.stage_keys[stage]
+        return tree_unflatten(self.stage_defs[stage],
+                              [union_slice[k] for k in keys])
+
+    def embed_grads(self, stage, grads_tree, like):
+        """Stage's grad pytree -> union-slice dict (zeros elsewhere)."""
+        from jax.tree_util import tree_leaves
+
+        out = {k: jnp.zeros_like(v) for k, v in like.items()}
+        for k, g in zip(self.stage_keys[stage], tree_leaves(grads_tree)):
+            out[k] = g.astype(like[k].dtype)
+        return out
+
+
+def union_stack(per_stage_params, mesh=None, axis_name="pipe"):
+    """Per-stage trees -> ({path: [S, ...] stacked array}, UnionMeta).
+
+    Leaves absent from a stage are zero-filled at that stage's index.
+    With ``mesh`` the stacked arrays are placed sharded over the pipe
+    axis so each device holds only its stage's slice.
+    """
+    meta = UnionMeta(per_stage_params)
+    stage_leaves = [_tree_paths(tree)[1] for tree in per_stage_params]
+    stacked = {}
+    for k, (shape, dtype) in meta.union.items():
+        stacked[k] = jnp.stack([
+            leaves[k] if k in leaves else jnp.zeros(shape, dtype)
+            for leaves in stage_leaves])
+    if mesh is not None:
+        stacked = shard_stacked(mesh, stacked, axis_name)
+    return stacked, meta
+
+
+def union_unstack(stacked, meta):
+    """Stacked union -> list of per-stage pytrees (host-side interop)."""
+    return [meta.stage_tree(s, {k: v[s] for k, v in stacked.items()})
+            for s in range(meta.n_stages)]
+
+
+def _boundary_chain(stage_fns, meta, stacked, xs_local_sds):
+    """Abstract-eval the stage chain; returns (in_sds, out_sds) per stage
+    under LOCAL (per-device) batch shapes."""
+    in_sds, out_sds = [], []
+    cur = xs_local_sds
+    for s, fn in enumerate(stage_fns):
+        params_aval = meta.stage_tree(s, {
+            k: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+            for k, v in stacked.items()})
+        in_sds.append(cur)
+        cur = jax.eval_shape(fn, params_aval, cur)
+        out_sds.append(cur)
+    return in_sds, out_sds
+
+
+def _flat_len(sds):
+    n = 1
+    for d in sds.shape[1:]:
+        n *= d
+    return n
+
+
+def _boundary_setup(stage_fns, meta, stacked, xs_shape, xs_dtype, S, dp):
+    """Shared trace-time setup: abstract-eval the stage chain under local
+    batch shapes and size the flat boundary buffer.
+
+    Returns (in_sds, out_sds, F, bdt): per-stage in/out ShapeDtypeStructs,
+    the padded per-sample boundary width, and the buffer dtype."""
+    xs_local = jax.ShapeDtypeStruct((xs_shape[1] // dp,) + xs_shape[2:],
+                                    xs_dtype)
+    in_sds, out_sds = _boundary_chain(stage_fns, meta, stacked, xs_local)
+    bdtypes = {s.dtype for s in out_sds[:-1]}
+    if len(bdtypes) > 1:
+        raise ValueError(f"boundary activations mix dtypes {bdtypes}")
+    F = max((_flat_len(s) for s in out_sds[:-1]), default=1)
+    bdt = out_sds[0].dtype if S > 1 else jnp.float32
+    return in_sds, out_sds, F, bdt
+
+
+def _flatpad(y, F):
+    flat = y.reshape(y.shape[0], -1)
+    return jnp.pad(flat, ((0, 0), (0, F - flat.shape[1])))
+
+
+def _unflat(buf, sds):
+    n = _flat_len(sds)
+    return buf[:, :n].reshape(sds.shape).astype(sds.dtype)
+
+
+def pipeline_apply_tree(stage_fns, stacked, meta, micro_inputs,
+                        mesh: Mesh, axis_name: str = "pipe",
+                        data_axis=None):
+    """Forward GPipe pass with per-stage trees + shape-changing stages.
+
+    Returns [n_micro, mb, ...] last-stage outputs.  Differentiable: grads
+    of a loss on the result flow back through scan+switch+ppermute with
+    the GPipe (all-forward-then-all-backward) memory profile; use
+    `make_pipeline_train_step` for the O(S)-memory 1F1B schedule.
+    """
+    S = mesh.shape[axis_name]
+    if len(stage_fns) != S:
+        raise ValueError(f"{len(stage_fns)} stage fns for {S}-way pipe axis")
+    M = micro_inputs.shape[0]
+    dp = mesh.shape[data_axis] if data_axis else 1
+    ticks = M + S - 1
+
+    in_sds, out_sds, F, bdt = _boundary_setup(
+        stage_fns, meta, stacked, micro_inputs.shape, micro_inputs.dtype,
+        S, dp)
+    y_sds = out_sds[-1]
+
+    branches = []
+    for i, fn in enumerate(stage_fns):
+        def br(sl, buf_in, x0, i=i, fn=fn):
+            p = meta.stage_tree(i, sl)
+            x = x0 if i == 0 else _unflat(buf_in, in_sds[i])
+            y = fn(p, x)
+            if i == S - 1:
+                return jnp.zeros((y.shape[0], F), bdt), y
+            return _flatpad(y, F).astype(bdt), jnp.zeros(y_sds.shape,
+                                                         y_sds.dtype)
+        branches.append(br)
+
+    pspecs = {k: P(axis_name, *([None] * (len(sig[0]))))
+              for k, sig in meta.union.items()}
+    xspec = (P(None, data_axis) if data_axis else P())
+
+    @partial(shard_map, mesh=mesh, in_specs=(pspecs, xspec),
+             out_specs=xspec, check_rep=False)
+    def run(params, xs):
+        sl = {k: v[0] for k, v in params.items()}
+        stage = jax.lax.axis_index(axis_name)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        mb = xs.shape[1]
+
+        def tick(buf_in, t):
+            m = jnp.clip(t - stage, 0, M - 1)
+            x0 = jax.lax.dynamic_index_in_dim(xs, m, keepdims=False)
+            flat_out, y = jax.lax.switch(stage, branches, sl, buf_in, x0)
+            ok = (t - stage >= 0) & (t - stage < M)
+            out = jnp.where(ok & (stage == S - 1), y,
+                            jnp.zeros_like(y))
+            return jax.lax.ppermute(flat_out, axis_name, fwd_perm), out
+
+        _, outs = jax.lax.scan(tick, jnp.zeros((mb, F), bdt),
+                               jnp.arange(ticks))
+        outs = outs[S - 1:]  # last stage finishes microbatch t-(S-1)
+        return jax.lax.psum(outs, axis_name)
+
+    return run(stacked, micro_inputs)
+
+
+def make_pipeline_train_step(stage_fns, loss_fn, meta, mesh: Mesh,
+                             axis_name: str = "pipe", data_axis=None):
+    """Build the fused 1F1B train step.
+
+    stage_fns[i](params_i, x) -> y; loss_fn(y_last, labels) -> scalar
+    (mean over its microbatch).  Returns step(stacked, xs, labels) ->
+    (loss, grads) where grads is a stacked union dict sharded like the
+    params (stage s's grads live on stage s's devices; zeros for leaves a
+    stage doesn't own) — feed it straight to a sharded optimizer update,
+    or `union_unstack` it for host-side use.
+
+    Schedule: tick t runs fwd(microbatch t-s) and bwd(microbatch
+    t+s-(2S-1)) on stage s; boundary inputs are stashed (depth 2S+1) and
+    each backward recomputes its stage via jax.vjp — O(S) activation
+    memory, GPipe-equivalent bubble, one extra stage forward per
+    microbatch (remat trade).
+    """
+    S = mesh.shape[axis_name]
+    if len(stage_fns) != S:
+        raise ValueError(f"{len(stage_fns)} stage fns for {S}-way pipe axis")
+    dp = mesh.shape[data_axis] if data_axis else 1
+    D = 2 * S + 1  # stash depth: max fwd->bwd gap is 2(S-1)+1 ticks
+
+    def step(stacked, xs, labels):
+        M = xs.shape[0]
+        ticks = M + 2 * S - 1
+        in_sds, out_sds, F, bdt = _boundary_setup(
+            stage_fns, meta, stacked, xs.shape, xs.dtype, S, dp)
+
+        fwd_branches, bwd_branches = [], []
+        for i, fn in enumerate(stage_fns):
+            def fbr(sl, buf_in, x0, lab, i=i, fn=fn):
+                p = meta.stage_tree(i, sl)
+                x = x0 if i == 0 else _unflat(buf_in, in_sds[i])
+                y = fn(p, x)
+                if i == S - 1:
+                    return (jnp.zeros((x.shape[0], F), bdt),
+                            loss_fn(y, lab).astype(jnp.float32))
+                return _flatpad(y, F).astype(bdt), jnp.float32(0.0)
+
+            def bbr(sl, x_stash, x0, lab, dy, i=i, fn=fn):
+                p = meta.stage_tree(i, sl)
+                x = x0 if i == 0 else _unflat(x_stash, in_sds[i])
+                if i == S - 1:
+                    # loss seeds its own cotangent: 1/M for the
+                    # mean-over-microbatches total
+                    def g(pp, xx):
+                        return loss_fn(fn(pp, xx), lab)
+                    _, vjpf = jax.vjp(g, p, x)
+                    dparams, dx = vjpf(jnp.float32(1.0 / M))
+                else:
+                    _, vjpf = jax.vjp(fn, p, x)
+                    dparams, dx = vjpf(_unflat(dy, out_sds[i]))
+                dunion = meta.embed_grads(i, dparams, sl)
+                if i == 0:
+                    dxf = jnp.zeros((x.shape[0], F), bdt)
+                else:
+                    dxf = _flatpad(dx, F).astype(bdt)
+                return dunion, dxf
+
+            fwd_branches.append(fbr)
+            bwd_branches.append(bbr)
+
+        pspecs = {k: P(axis_name, *([None] * len(sig[0])))
+                  for k, sig in meta.union.items()}
+        dspec = (P(None, data_axis) if data_axis else P())
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(pspecs, dspec, dspec),
+                 out_specs=(P(), pspecs),
+                 check_rep=False)
+        def run(params, xs, labels):
+            sl = {k: v[0] for k, v in params.items()}
+            stage = jax.lax.axis_index(axis_name)
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+            mb = xs.shape[1]
+
+            def tick(carry, t):
+                buf_in, dy_in, stash, gacc, loss_acc = carry
+                # ---- forward slot: microbatch t - stage
+                fm = t - stage
+                do_f = (fm >= 0) & (fm < M)
+                mf = jnp.clip(fm, 0, M - 1)
+                x0 = jax.lax.dynamic_index_in_dim(xs, mf, keepdims=False)
+                lf = jax.lax.dynamic_index_in_dim(labels, mf, keepdims=False)
+                flat_out, lc = jax.lax.switch(stage, fwd_branches,
+                                              sl, buf_in, x0, lf)
+                flat_out = jnp.where(do_f, flat_out,
+                                     jnp.zeros_like(flat_out))
+                loss_acc = loss_acc + jnp.where(
+                    do_f & (stage == S - 1), lc, 0.0)
+                # stash this stage's INPUT for the bwd recompute; slot D
+                # is a scratch row so out-of-range ticks clobber nothing
+                slot = jnp.where(do_f, mf % D, D)
+                stash = jax.lax.dynamic_update_index_in_dim(
+                    stash, buf_in, slot, 0)
+                # ---- backward slot: microbatch t + stage - (2S-1)
+                bm = t + stage - (2 * S - 1)
+                do_b = (bm >= 0) & (bm < M)
+                mbk = jnp.clip(bm, 0, M - 1)
+                x0b = jax.lax.dynamic_index_in_dim(xs, mbk, keepdims=False)
+                lb = jax.lax.dynamic_index_in_dim(labels, mbk,
+                                                  keepdims=False)
+                x_st = jax.lax.dynamic_index_in_dim(stash, mbk % D,
+                                                    keepdims=False)
+                dun, dx = jax.lax.switch(stage, bwd_branches,
+                                         sl, x_st, x0b, lb, dy_in)
+                gacc = jax.tree_util.tree_map(
+                    lambda a, d: a + jnp.where(do_b, d,
+                                               jnp.zeros_like(d)),
+                    gacc, dun)
+                dx = jnp.where(do_b, dx, jnp.zeros_like(dx))
+                return ((jax.lax.ppermute(flat_out, axis_name, fwd_perm),
+                         jax.lax.ppermute(dx, axis_name, bwd_perm),
+                         stash, gacc, loss_acc), None)
+
+            init = (jnp.zeros((mb, F), bdt), jnp.zeros((mb, F), bdt),
+                    jnp.zeros((D + 1, mb, F), bdt),
+                    {k: jnp.zeros_like(v) for k, v in sl.items()},
+                    jnp.float32(0.0))
+            (_, _, _, gacc, loss_acc), _ = jax.lax.scan(
+                tick, init, jnp.arange(ticks))
+
+            loss = jax.lax.psum(loss_acc, axis_name) / M
+            if data_axis:
+                loss = jax.lax.pmean(loss, data_axis)
+                gacc = {k: jax.lax.pmean(v, data_axis)
+                        for k, v in gacc.items()}
+            return loss, {k: v[None] for k, v in gacc.items()}
+
+        return run(stacked, xs, labels)
+
+    return jax.jit(step)

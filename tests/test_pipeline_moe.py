@@ -412,3 +412,202 @@ def test_moe_lm_example_converges():
         capture_output=True, text=True, timeout=600, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "converged" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous 1F1B pipeline: per-stage trees, shape-changing boundaries,
+# embed + head INSIDE the pipeline (VERDICT r3 #4).
+# ---------------------------------------------------------------------------
+
+def _lm_stages(rs, S, D, vocab, blocks_per_stage=1):
+    """Full transformer LM split into S pipeline stages: stage 0 owns the
+    embedding, stage S-1 owns the final norm + LM head, every stage owns
+    `blocks_per_stage` transformer blocks — per-stage trees differ."""
+
+    def blocks_tree(n):
+        one = [_tblock_params(rs, D) for _ in range(n)]
+        return {k: jnp.stack([b[k] for b in one]) for k in one[0]}
+
+    params, fns = [], []
+
+    def trunk(p, h):
+        def body(h, blk):
+            return _tblock(blk, h), None
+        h, _ = jax.lax.scan(body, h, p)
+        return h
+
+    for s in range(S):
+        tree = {"blocks": blocks_tree(blocks_per_stage)}
+        if s == 0:
+            tree["embed"] = jnp.asarray(
+                rs.normal(0, 0.1, (vocab, D)).astype(np.float32))
+
+            def fn(p, ids):
+                return trunk(p["blocks"], p["embed"][ids.astype(jnp.int32)])
+        elif s == S - 1:
+            tree["lnf_g"] = jnp.ones(D)
+            tree["lnf_b"] = jnp.zeros(D)
+            tree["head"] = jnp.asarray(
+                rs.normal(0, 0.1, (D, vocab)).astype(np.float32))
+
+            def fn(p, h):
+                h = trunk(p["blocks"], h)
+                m = h.mean(-1, keepdims=True)
+                v = ((h - m) ** 2).mean(-1, keepdims=True)
+                h = (h - m) * jax.lax.rsqrt(v + 1e-5) * p["lnf_g"] + p["lnf_b"]
+                return h @ p["head"]
+        else:
+            def fn(p, h):
+                return trunk(p["blocks"], h)
+        params.append(tree)
+        fns.append(fn)
+    return fns, params
+
+
+def _token_nll(logits, labels):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(
+        lp, labels.astype(jnp.int32)[..., None], axis=-1).mean()
+
+
+def _dense_lm_loss(fns, trees, xs, ys):
+    tot = 0.0
+    for m in range(xs.shape[0]):
+        h = xs[m]
+        for fn, tree in zip(fns, trees):
+            h = fn(tree, h)
+        tot = tot + _token_nll(h, ys[m])
+    return tot / xs.shape[0]
+
+
+def _lm_data(rs, M, mb, T, vocab):
+    X = rs.randint(0, vocab, (M, mb, T))
+    Y = np.roll(X.reshape(M * mb, T), -1, axis=1).reshape(M, mb, T)
+    return jnp.asarray(X, jnp.float32), jnp.asarray(Y, jnp.float32)
+
+
+def test_1f1b_transformer_full_model_matches_dense():
+    """The ENTIRE transformer LM — embedding, blocks (4x-wide FFN inside
+    the stage), final norm + head — pipelined 1F1B over 4 stages with
+    per-stage param trees: loss and every stage's grads == dense oracle."""
+    S, D, T, vocab, M, mb = 4, 16, 8, 32, 6, 2
+    rs = np.random.RandomState(3)
+    mesh = create_mesh((S,), ("pipe",), devices=jax.devices("cpu")[:S])
+    fns, trees = _lm_stages(rs, S, D, vocab)
+    stacked, meta = pp.union_stack(trees, mesh)
+    xs, ys = _lm_data(rs, M, mb, T, vocab)
+
+    step = pp.make_pipeline_train_step(fns, _token_nll, meta, mesh)
+    loss, grads = step(stacked, xs, ys)
+
+    dl, dg = jax.value_and_grad(
+        lambda tr: _dense_lm_loss(fns, tr, xs, ys))(trees)
+    np.testing.assert_allclose(float(loss), float(dl), rtol=1e-5)
+    for s, (got, want) in enumerate(zip(pp.union_unstack(grads, meta), dg)):
+        for path, leaf in jax.tree_util.tree_leaves_with_path(want):
+            got_leaf = dict(jax.tree_util.tree_leaves_with_path(got))[path]
+            np.testing.assert_allclose(
+                np.asarray(got_leaf), np.asarray(leaf),
+                rtol=2e-4, atol=1e-5, err_msg=f"stage {s} {path}")
+
+
+def test_1f1b_dp_pp_composes():
+    """The same 1F1B step on a (data=2, pipe=4) mesh: per-device batches
+    halve, grads pmean over data — still == the dense oracle."""
+    S, D, T, vocab, M, mb = 4, 16, 8, 32, 4, 4
+    rs = np.random.RandomState(4)
+    mesh = create_mesh((2, S), ("data", "pipe"))
+    fns, trees = _lm_stages(rs, S, D, vocab)
+    stacked, meta = pp.union_stack(trees, mesh)
+    xs, ys = _lm_data(rs, M, mb, T, vocab)
+
+    step = pp.make_pipeline_train_step(fns, _token_nll, meta, mesh,
+                                       data_axis="data")
+    loss, grads = step(stacked, xs, ys)
+    dl, dg = jax.value_and_grad(
+        lambda tr: _dense_lm_loss(fns, tr, xs, ys))(trees)
+    np.testing.assert_allclose(float(loss), float(dl), rtol=1e-5)
+    for s, (got, want) in enumerate(zip(pp.union_unstack(grads, meta), dg)):
+        for path, leaf in jax.tree_util.tree_leaves_with_path(want):
+            got_leaf = dict(jax.tree_util.tree_leaves_with_path(got))[path]
+            np.testing.assert_allclose(
+                np.asarray(got_leaf), np.asarray(leaf),
+                rtol=2e-4, atol=1e-5, err_msg=f"stage {s} {path}")
+
+
+def test_1f1b_shape_changing_boundaries():
+    """Stage boundaries may change activation shape: a funnel MLP
+    (8 -> 32 -> 16 -> 4 wide) pipelines correctly — the flat boundary
+    buffer pads to the widest edge and each stage reslices statically."""
+    S, M, mb = 4, 4, 2
+    rs = np.random.RandomState(5)
+    widths = [8, 32, 16, 4, 6]  # boundary widths incl. input and output
+    # same-named leaves must share a shape across stages, so a funnel
+    # names its weight per stage
+    trees = [{f"w{i}": jnp.asarray(
+        rs.normal(0, .3, (widths[i], widths[i + 1])), jnp.float32)}
+        for i in range(S)]
+    fns = [lambda p, x, i=i: jnp.tanh(x @ p[f"w{i}"]) for i in range(S)]
+    mesh = create_mesh((S,), ("pipe",), devices=jax.devices("cpu")[:S])
+    stacked, meta = pp.union_stack(trees, mesh)
+    xs = jnp.asarray(rs.normal(size=(M, mb, widths[0])), jnp.float32)
+    ys = jnp.asarray(rs.normal(size=(M, mb, widths[-1])), jnp.float32)
+
+    mse = lambda y, t: jnp.mean((y - t) ** 2)
+    loss, grads = pp.make_pipeline_train_step(fns, mse, meta, mesh)(
+        stacked, xs, ys)
+
+    def dense(tr):
+        tot = 0.0
+        for m in range(M):
+            h = xs[m]
+            for i in range(S):
+                h = fns[i](tr[i], h)
+            tot = tot + mse(h, ys[m])
+        return tot / M
+
+    dl, dg = jax.value_and_grad(dense)(trees)
+    np.testing.assert_allclose(float(loss), float(dl), rtol=1e-5)
+    for i, (got, want) in enumerate(zip(pp.union_unstack(grads, meta), dg)):
+        np.testing.assert_allclose(np.asarray(got[f"w{i}"]),
+                                   np.asarray(want[f"w{i}"]),
+                                   rtol=1e-4, atol=1e-5)
+    # union_stack rejects same-named leaves with different shapes
+    with pytest.raises(ValueError, match="must.*match|rename"):
+        pp.union_stack([{"w": jnp.zeros((3, 3))}, {"w": jnp.zeros((5, 5))}])
+
+
+def test_pp_lm_example_converges():
+    """Pipeline parallelism as a workload: the full-model 1F1B LM
+    (examples/transformer-lm/train_pp.py) trains on a dp x pp mesh."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "transformer-lm", "train_pp.py"),
+         "--steps", "8", "--dp", "2"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "converged" in r.stdout
+
+
+def test_1f1b_apply_tree_inference():
+    """pipeline_apply_tree runs the heterogeneous forward (GPipe) and
+    matches the dense chain, token ids in, logits out."""
+    S, D, T, vocab, M, mb = 4, 16, 8, 32, 4, 2
+    rs = np.random.RandomState(6)
+    mesh = create_mesh((S,), ("pipe",), devices=jax.devices("cpu")[:S])
+    fns, trees = _lm_stages(rs, S, D, vocab)
+    stacked, meta = pp.union_stack(trees, mesh)
+    xs, _ = _lm_data(rs, M, mb, T, vocab)
+    outs = pp.pipeline_apply_tree(fns, stacked, meta, xs, mesh)
+    for m in range(M):
+        h = xs[m]
+        for fn, tree in zip(fns, trees):
+            h = fn(tree, h)
+        np.testing.assert_allclose(np.asarray(outs[m]), np.asarray(h),
+                                   rtol=2e-4, atol=1e-5)
