@@ -70,10 +70,22 @@ def shard_stacked(mesh: Mesh, stacked, axis_name: str = "pipe"):
 
 
 def bubble_fraction(n_stages: int, n_micro: int) -> float:
-    """Fraction of pipeline ticks spent filling/draining (idle bubble):
-    (S-1)/(S-1+M).  GPipe and 1F1B share this bubble; they differ only in
-    activation memory (see module docstring)."""
+    """Idle fraction of the GPipe forward scan (`pipeline_apply`):
+    (S-1)/(S-1+M) — each stage does M useful ticks out of M+S-1."""
     return (n_stages - 1) / (n_stages - 1 + n_micro)
+
+
+def bubble_fraction_1f1b(n_stages: int, n_micro: int) -> float:
+    """Idle fraction of the LOCKSTEP 1F1B train step
+    (`make_pipeline_train_step`): (2S-1)/(M+2S-1).
+
+    Each of the M+2S-1 ticks costs one forward plus one backward on
+    every device (masked slots still execute), and a stage fills M of
+    its fwd slots and M of its bwd slots — so the fill/drain is ~2x the
+    classic asynchronous 1F1B's (S-1)/(M+S-1).  That is the price of
+    running the whole schedule as one SPMD scan; amortize with M >> S,
+    which the O(S) activation stash makes affordable."""
+    return (2 * n_stages - 1) / (n_micro + 2 * n_stages - 1)
 
 
 def stacked_blocks_stage(block_fn):
@@ -195,8 +207,9 @@ def microbatch(x, n_micro):
 #   2S+1-deep stash of boundary INPUTS (backward recomputes the stage,
 #   remat-style, via jax.vjp at the bwd tick) — O(S) in-flight
 #   microbatches versus the O(M) residuals autodiff keeps for the GPipe
-#   scan, at the standard one-extra-forward remat cost.  The bubble is
-#   the same (S-1)-tick fill/drain at each end; `tools/
+#   scan, at the standard one-extra-forward remat cost.  Idle fraction
+#   is (2S-1)/(M+2S-1) (`bubble_fraction_1f1b` — the lockstep scan pays
+#   ~2x the classic 1F1B fill/drain; amortize with M >> S); `tools/
 #   pipeline_memory.py` prints the measured memory table.
 # ===========================================================================
 
@@ -400,8 +413,8 @@ def make_pipeline_train_step(stage_fns, loss_fn, meta, mesh: Mesh,
     Schedule: tick t runs fwd(microbatch t-s) and bwd(microbatch
     t+s-(2S-1)) on stage s; boundary inputs are stashed (depth 2S+1) and
     each backward recomputes its stage via jax.vjp — O(S) activation
-    memory, GPipe-equivalent bubble, one extra stage forward per
-    microbatch (remat trade).
+    memory, (2S-1)/(M+2S-1) lockstep bubble (`bubble_fraction_1f1b`),
+    one extra stage forward per microbatch (remat trade).
     """
     S = mesh.shape[axis_name]
     if len(stage_fns) != S:

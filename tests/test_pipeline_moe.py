@@ -465,9 +465,14 @@ def _lm_stages(rs, S, D, vocab, blocks_per_stage=1):
 
 
 def _token_nll(logits, labels):
-    lp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.take_along_axis(
-        lp, labels.astype(jnp.int32)[..., None], axis=-1).mean()
+    # the one shared copy (examples/transformer-lm/common.py)
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "transformer-lm"))
+    from common import token_nll
+    return token_nll(logits, labels)
 
 
 def _dense_lm_loss(fns, trees, xs, ys):

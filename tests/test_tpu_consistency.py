@@ -50,6 +50,20 @@ def _gate():
         pytest.skip("MXTPU_TPU_TESTS=1 but no healthy TPU backend")
 
 
+def _run_script(script, timeout=900):
+    """Run a python snippet in a chip-visible subprocess (env scrubbed of
+    the cpu pins this test tree sets) and require its FAMILY OK marker —
+    the one copy of the subprocess recipe every chip test shares."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "MXTPU_PLATFORM", "XLA_FLAGS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", textwrap.dedent(script)],
+                       env=env, capture_output=True, text=True,
+                       timeout=timeout)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FAMILY OK" in r.stdout
+
+
 def _run_family(body, timeout=900):
     _gate()
     script = textwrap.dedent("""
@@ -67,13 +81,7 @@ def _run_family(body, timeout=900):
             check_consistency(net, ctxs, rtol=rtol, atol=atol,
                               arg_params=arg_params)
     """) + textwrap.dedent(body) + '\nprint("FAMILY OK")\n'
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "MXTPU_PLATFORM", "XLA_FLAGS")}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    r = subprocess.run([sys.executable, "-c", script], env=env,
-                       capture_output=True, text=True, timeout=timeout)
-    assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAMILY OK" in r.stdout
+    _run_script(script, timeout=timeout)
 
 
 def test_tpu_consistency_dense_act():
@@ -191,14 +199,7 @@ def test_tpu_flash_attention_kernel():
                                            rtol=5e-2, atol=5e-2)
         print("FAMILY OK")
     """
-    import textwrap
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "MXTPU_PLATFORM", "XLA_FLAGS")}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    r = subprocess.run([sys.executable, "-c", textwrap.dedent(script)],
-                       env=env, capture_output=True, text=True, timeout=900)
-    assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAMILY OK" in r.stdout
+    _run_script(script)
 
 
 def test_tpu_module_training_end_to_end():
@@ -238,14 +239,7 @@ def test_tpu_module_training_end_to_end():
         assert acc.get()[1] > 0.9
         print("FAMILY OK")
     """
-    import textwrap
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "MXTPU_PLATFORM", "XLA_FLAGS")}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    r = subprocess.run([sys.executable, "-c", textwrap.dedent(script)],
-                       env=env, capture_output=True, text=True, timeout=900)
-    assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAMILY OK" in r.stdout
+    _run_script(script)
 
 
 def test_tpu_consistency_channels_last_chain():
@@ -264,3 +258,52 @@ def test_tpu_consistency_channels_last_chain():
         net = sym.FullyConnected(sym.Flatten(h), num_hidden=4, name='fc')
         CC(net, data=(2, 3, 12, 12))
     """)
+
+
+def test_tpu_bf16_fused_trainer_vs_cpu_f32():
+    """The bench dtype on the bench path: FusedTrainer(dtype=bfloat16)
+    on the CHIP must track the same model trained f32 on cpu — loss
+    trajectory within bf16 tolerance and masters staying f32 (the CPU
+    twin of this check lives in test_bf16_consistency.py; this one runs
+    the real Mosaic/XLA:TPU lowering)."""
+    _gate()
+    script = """
+        import numpy as np
+        import jax.numpy as jnp
+        import mxnet_tpu as mx
+        from mxnet_tpu import sym
+        from mxnet_tpu.trainer import FusedTrainer
+
+        rs = np.random.RandomState(0)
+        d = sym.Variable("data")
+        h = sym.Activation(sym.BatchNorm(
+            sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                            name="c1"), fix_gamma=False, name="b1"),
+            act_type="relu")
+        net = sym.SoftmaxOutput(
+            sym.FullyConnected(sym.Flatten(h), num_hidden=5, name="fc"),
+            sym.Variable("softmax_label"), name="softmax")
+        feeds = [{"data": rs.uniform(-1, 1, (8, 3, 12, 12)).astype(np.float32),
+                  "softmax_label": rs.randint(0, 5, 8).astype(np.float32)}
+                 for _ in range(3)]
+
+        losses = {}
+        for dtype in (jnp.float32, jnp.bfloat16):
+            np.random.seed(0)
+            mx.random.seed(0)
+            tr = FusedTrainer(net, optimizer="sgd",
+                              optimizer_params={"lr": 0.05, "momentum": 0.9},
+                              dtype=dtype)
+            tr.init(data=(8, 3, 12, 12), softmax_label=(8,))
+            ls = []
+            for i in range(5):
+                outs = tr.step(**feeds[i % 3])
+                ls.append(float(np.asarray(outs[-1]).mean()))
+            losses[str(np.dtype(dtype))] = ls
+            for k, v in tr.params.items():
+                assert np.asarray(v).dtype == np.float32, k
+        np.testing.assert_allclose(losses["bfloat16"], losses["float32"],
+                                   rtol=0.08, atol=0.08)
+        print("FAMILY OK")
+    """
+    _run_script(script)

@@ -19,7 +19,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -98,8 +101,8 @@ def main():
 
     print(f"pipeline memory/bubble: S={S} stages, D={D}, T={T}, mb={mb} "
           f"(XLA temp bytes per compile, CPU mesh)")
-    print(f"{'M':>4} {'bubble':>8} {'GPipe temp':>14} {'1F1B temp':>14} "
-          f"{'ratio':>6}")
+    print(f"{'M':>4} {'gpipe_bub':>10} {'1f1b_bub':>9} {'GPipe temp':>14} "
+          f"{'1F1B temp':>14} {'ratio':>6}")
     for M in args.micro:
         xs = jnp.asarray(rs.randint(0, vocab, (M, mb, T)), jnp.float32)
         ys = jnp.asarray(rs.randint(0, vocab, (M, mb, T)), jnp.float32)
@@ -116,11 +119,11 @@ def main():
         f1 = pp.make_pipeline_train_step(fns, nll, meta, mesh).lower(
             stacked, xs, ys).compile()
         g_b, f_b = temp_bytes(gp), temp_bytes(f1)
-        bub = pp.bubble_fraction(S, M)
         ratio = f"{g_b / f_b:.2f}" if (g_b and f_b) else "n/a"
         fmt = lambda b: f"{b:,}" if b is not None else "n/a"
-        print(f"{M:>4} {bub:>8.3f} {fmt(g_b):>14} {fmt(f_b):>14} "
-              f"{ratio:>6}")
+        print(f"{M:>4} {pp.bubble_fraction(S, M):>10.3f} "
+              f"{pp.bubble_fraction_1f1b(S, M):>9.3f} "
+              f"{fmt(g_b):>14} {fmt(f_b):>14} {ratio:>6}")
         # sanity: same math
         (gl, _), (fl, _) = gp(stacked, xs, ys), f1(stacked, xs, ys)
         assert abs(float(gl) - float(fl)) < 1e-4, (float(gl), float(fl))
