@@ -34,12 +34,7 @@ def zeros(*shape):
     return jnp.zeros(shape, jnp.float32)
 
 
-def token_nll(logits, labels):
-    """Mean next-token NLL on [.., T, V] logits vs [.., T] integer (or
-    float-encoded) labels — the one copy every LM workload shares."""
-    lp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.take_along_axis(
-        lp, labels.astype(jnp.int32)[..., None], axis=-1).mean()
+from mxnet_tpu.ops.loss import token_nll  # noqa: F401 — shared LM loss
 
 
 def attention_block_params(rs, D, scale=0.08):

@@ -281,3 +281,14 @@ def _identity_attach_kl(ctx, data, moving_avg, **attrs):
 
     fwd.defvjp(f, b)
     return fwd(data, moving_avg), (jax.lax.stop_gradient(new_avg),)
+
+
+def token_nll(logits, labels):
+    """Mean next-token negative log-likelihood on [..., T, V] logits vs
+    [..., T] integer (or float-encoded) labels — the functional LM loss
+    every workload/test/tool shares (examples/transformer-lm re-exports
+    it; parity: the loss SoftmaxOutput computes implicitly in backward,
+    reference src/operator/softmax_output-inl.h:224)."""
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(
+        lp, labels.astype(jnp.int32)[..., None], axis=-1).mean()

@@ -91,6 +91,9 @@ class Predictor:
                     f"input_shapes missing for inputs {missing}") from e
             self._input_names = [n for n in self._input_names
                                  if n not in missing]
+            label_args = set(missing)  # bound to zeros by design
+        else:
+            label_args = set()
 
         device = ctx_mod.Context(dev_type, dev_id) \
             if isinstance(dev_type, str) else dev_type
@@ -98,6 +101,16 @@ class Predictor:
                                  **input_shapes)
         self._exec.copy_params_from(arg_params, aux_params,
                                     allow_extra_params=True)
+        # every weight must have come from the checkpoint: simple_bind
+        # leaves unset args at ZERO, so a silently-skipped load would
+        # "work" and return uniform softmax outputs instead of failing
+        uncovered = [n for n in self._exec.arg_dict
+                     if n not in self._input_names and n not in arg_params
+                     and n not in label_args]
+        if uncovered:
+            raise MXNetError(
+                f"params file covers no value for {uncovered[:5]} "
+                "(corrupt/truncated checkpoint, or name mismatch)")
         self._dirty = True
 
     # ------------------------------------------------------------------ API

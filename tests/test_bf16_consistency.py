@@ -23,9 +23,20 @@ from mxnet_tpu import sym
 from mxnet_tpu.trainer import FusedTrainer
 
 
+def _nll_from_probs(outs, feed, label_name="softmax_label"):
+    """Real NLL from SoftmaxOutput's forward output.  The forward emits
+    softmax PROBABILITIES (the loss lives in its custom backward,
+    ops/loss.py) — a mean over probabilities is a constant 1/C, so the
+    trajectory must be derived from p[label]."""
+    p = np.asarray(outs[-1], np.float32)
+    p = p.reshape(-1, p.shape[-1])
+    y = np.asarray(feed[label_name]).reshape(-1).astype(np.int64)
+    return float(-np.log(np.maximum(p[np.arange(len(y)), y], 1e-9)).mean())
+
+
 def _trainers(net, steps, feeds, optimizer="sgd", lr=0.05, seed=0):
     """Train the same symbol in f32 and bf16-compute; returns
-    (trainers, per-step losses, params snapshot after step 1)."""
+    (trainers, per-step NLL losses, params snapshot after step 1)."""
     losses = {}
     trainers = {}
     step1 = {}
@@ -39,9 +50,9 @@ def _trainers(net, steps, feeds, optimizer="sgd", lr=0.05, seed=0):
         tr.init(**{k: v.shape for k, v in feeds[0].items()})
         ls = []
         for i in range(steps):
-            outs = tr.step(**feeds[i % len(feeds)])
-            ls.append(float(np.asarray(outs[-1]).mean())
-                      if len(outs) else 0.0)
+            feed = feeds[i % len(feeds)]
+            outs = tr.step(**feed)
+            ls.append(_nll_from_probs(outs, feed))
             if i == 0:
                 step1[dtype] = {k: np.asarray(v)
                                 for k, v in tr.params.items()}
@@ -51,12 +62,16 @@ def _trainers(net, steps, feeds, optimizer="sgd", lr=0.05, seed=0):
 
 
 def _loss_feeds(rs, data_shape, n_classes, label_name, n_feeds=3):
+    """Learnable feeds: labels are the argmax of a fixed random linear
+    map of the data, so descent is smooth — random labels make the tiny
+    net's loss chaotic and trajectory comparison meaningless."""
+    w = rs.normal(size=(int(np.prod(data_shape[1:])), n_classes))
     feeds = []
     for _ in range(n_feeds):
-        feeds.append({
-            "data": rs.uniform(-1, 1, data_shape).astype(np.float32),
-            label_name: rs.randint(0, n_classes,
-                                   data_shape[0]).astype(np.float32)})
+        data = rs.uniform(-1, 1, data_shape).astype(np.float32)
+        y = (data.reshape(data_shape[0], -1) @ w).argmax(-1)
+        feeds.append({"data": data,
+                      label_name: y.astype(np.float32)})
     return feeds
 
 
@@ -93,13 +108,19 @@ def test_bf16_resnet_block_fused_training():
         sym.FullyConnected(sym.Flatten(h), num_hidden=5, name="fc"),
         sym.Variable("softmax_label"), name="softmax")
 
-    feeds = _loss_feeds(rs, (4, 3, 10, 10), 5, "softmax_label")
-    trainers, losses, step1 = _trainers(net, 6, feeds)
+    # gentle-lr regime so the comparison measures dtype error, not
+    # chaos; a mid-trajectory BN transient still amplifies bf16
+    # rounding briefly, so the per-step bound is loose and the REAL
+    # assertions are (a) step-1 params tight, (b) both modes converge
+    # to a low loss, (c) no step diverges grossly
+    feeds = _loss_feeds(rs, (16, 3, 10, 10), 5, "softmax_label")
+    trainers, losses, step1 = _trainers(net, 12, feeds, lr=0.003)
     np.testing.assert_allclose(losses[jnp.bfloat16], losses[jnp.float32],
-                               rtol=0.06, atol=0.06)
+                               atol=0.3)
     _assert_close_params(trainers, step1)
-    # both modes actually learned
-    assert losses[jnp.bfloat16][-1] < losses[jnp.bfloat16][0] + 1e-3
+    # both modes actually learned (real NLL from ~1.6 to near zero)
+    assert losses[jnp.bfloat16][-1] < 0.15, losses
+    assert losses[jnp.float32][-1] < 0.15, losses
 
 
 def test_bf16_transformer_block_training():
@@ -139,6 +160,17 @@ def test_bf16_moe_routing_and_expert_compute():
         if v.dtype == jnp.float32 else v, params)
     y16, aux16 = moe_mod.moe_ffn(p16, x32.astype(jnp.bfloat16), mesh,
                                  "expert", top_k=2)
+    # routing decisions must be IDENTICAL, not merely close: compare the
+    # top-k expert assignments from the gate logits both dtypes compute
+    def topk_experts(gate_w, x):
+        logits = np.asarray(x.astype(jnp.float32)
+                            @ gate_w.astype(jnp.float32), np.float32)
+        return np.argsort(-logits, axis=-1)[:, :2]
+
+    np.testing.assert_array_equal(
+        topk_experts(params["gate_w"], x32),
+        topk_experts(p16["gate_w"], x32.astype(jnp.bfloat16)),
+        err_msg="bf16 gate flipped a token's expert assignment")
     np.testing.assert_allclose(np.asarray(y16, np.float32),
                                np.asarray(y32), rtol=0.1, atol=0.1)
     np.testing.assert_allclose(float(aux16), float(aux32),

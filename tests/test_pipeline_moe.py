@@ -464,15 +464,7 @@ def _lm_stages(rs, S, D, vocab, blocks_per_stage=1):
     return fns, params
 
 
-def _token_nll(logits, labels):
-    # the one shared copy (examples/transformer-lm/common.py)
-    import os
-    import sys
-    sys.path.insert(0, os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "examples", "transformer-lm"))
-    from common import token_nll
-    return token_nll(logits, labels)
+from mxnet_tpu.ops.loss import token_nll as _token_nll  # shared LM loss
 
 
 def _dense_lm_loss(fns, trees, xs, ys):

@@ -297,8 +297,15 @@ def test_tpu_bf16_fused_trainer_vs_cpu_f32():
             tr.init(data=(8, 3, 12, 12), softmax_label=(8,))
             ls = []
             for i in range(5):
-                outs = tr.step(**feeds[i % 3])
-                ls.append(float(np.asarray(outs[-1]).mean()))
+                feed = feeds[i % 3]
+                outs = tr.step(**feed)
+                # SoftmaxOutput's forward emits PROBABILITIES; derive a
+                # real NLL from p[label] (a mean of probs is constant)
+                p = np.asarray(outs[-1], np.float32)
+                p = p.reshape(-1, p.shape[-1])
+                y = feed["softmax_label"].astype(np.int64)
+                ls.append(float(-np.log(np.maximum(
+                    p[np.arange(len(y)), y], 1e-9)).mean()))
             losses[str(np.dtype(dtype))] = ls
             for k, v in tr.params.items():
                 assert np.asarray(v).dtype == np.float32, k

@@ -32,9 +32,7 @@ import numpy as np  # noqa: E402
 from mxnet_tpu.parallel import pipeline as pp  # noqa: E402
 from mxnet_tpu.parallel.mesh import create_mesh  # noqa: E402
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "examples", "transformer-lm"))
-from common import token_nll as nll  # noqa: E402
+from mxnet_tpu.ops.loss import token_nll as nll  # noqa: E402
 
 
 def tblock(p, h):
