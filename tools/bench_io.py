@@ -100,12 +100,74 @@ def bench_pipeline(path, threads, size):
     return count / dt
 
 
+def bench_device_prefetch(path, threads, size, depth=2):
+    """Full stacked pipeline: ImageRecordIter -> PrefetchingIter ->
+    DevicePrefetchIter, consumed by a simulated compute step — measures
+    the rate the TRAINER sees with host prep AND device staging
+    overlapped."""
+    import jax
+
+    from mxnet_tpu import image as img_mod, io as mio
+
+    it = mio.DevicePrefetchIter(
+        mio.PrefetchingIter(img_mod.ImageRecordIter(
+            path_imgrec=path, data_shape=(3, size, size), batch_size=50,
+            preprocess_threads=threads, shuffle=False)),
+        depth=depth)
+    batch = next(iter(it))  # warmup
+    jax.block_until_ready(batch.data[0].jax_array)
+    tic = time.perf_counter()
+    count = 0
+    for batch in it:
+        # a consumer touch per batch (sum) stands in for the train step
+        jax.block_until_ready(batch.data[0].jax_array.sum())
+        count += batch.data[0].shape[0]
+    dt = time.perf_counter() - tic
+    return count / dt
+
+
+def sweep(args):
+    """Thread-scaling table + host-CPU ceiling model."""
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "bench.rec")
+        make_record_file(path, args.n)
+        ncores = os.cpu_count() or 1
+        print(f"io scaling sweep: n={args.n} images, host cores={ncores}")
+        print(f"{'threads':>8} {'decode img/s':>13} {'pipeline img/s':>15} "
+              f"{'staged img/s':>13}")
+        per_thread = []
+        for t in args.sweep:
+            dec = bench_raw_decode(path, t)
+            pipe = bench_pipeline(path, t, args.size)
+            staged = bench_device_prefetch(path, t, args.size)
+            per_thread.append((t, dec, pipe, staged))
+            print(f"{t:>8} {dec:>13.0f} {pipe:>15.0f} {staged:>13.0f}")
+        best_dec = max(d for _, d, _, _ in per_thread)
+        best_pipe = max(p for _, _, p, _ in per_thread)
+        # ceiling model: decode is GIL-free native libjpeg, so it scales
+        # with PHYSICAL cores; this box's core count bounds what any
+        # thread count can show
+        print(f"host_cores: {ncores}")
+        print(f"best_decode_img_s: {best_dec:.0f}")
+        print(f"best_pipeline_img_s: {best_pipe:.0f}")
+        chip_demand = 5600  # ResNet-50 img/s at MFU 0.35 on v5e
+        need = chip_demand / max(best_pipe, 1.0)
+        print(f"chip_demand_img_s: {chip_demand}")
+        print(f"hosts_or_core_multiple_needed: {need:.1f}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2000)
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--sweep", type=int, nargs="*", default=None,
+                    help="measure a thread-scaling table at these "
+                         "thread counts (e.g. --sweep 1 2 4 8)")
     args = ap.parse_args()
+    if args.sweep is not None:
+        args.sweep = args.sweep or [1, 2, 4, 8]
+        return sweep(args)
 
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "bench.rec")

@@ -1,0 +1,103 @@
+"""Inference throughput through the predict path (the C-ABI surface).
+
+The reference's headline inference table (docs/how_to/perf.md:69-98)
+is measured through its predictor, not the training executor's eval
+graph.  This tool does the same here: build a ResNet-50 checkpoint,
+load it with mxnet_tpu.predict (the module `src/c_predict.cc` embeds —
+the perl/C clients call exactly this code), and time forward at batch
+1 and 32.
+
+Run on the bench chip:  python tools/bench_predict.py
+CPU smoke:              MXTPU_PLATFORM=cpu python tools/bench_predict.py \
+                            --model mlp --iters 20
+"""
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_checkpoint(model, prefix):
+    import mxnet_tpu as mx
+    from mxnet_tpu import models, sym
+
+    if model == "resnet-50":
+        net = models.get_symbol("resnet-50", num_classes=1000)
+        data_shape = (3, 224, 224)
+    else:
+        net = sym.SoftmaxOutput(
+            sym.FullyConnected(sym.Activation(sym.FullyConnected(
+                sym.Variable("data"), num_hidden=64, name="fc1"),
+                act_type="relu"), num_hidden=10, name="fc2"),
+            sym.Variable("softmax_label"), name="softmax")
+        data_shape = (32,)
+
+    ex = net.simple_bind(ctx=mx.cpu(), data=(1,) + data_shape)
+    np.random.seed(0)
+    init = mx.init.Xavier()
+    arg_params = {}
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            init(name, arr)
+            arg_params[name] = arr
+    aux_params = {k: v for k, v in ex.aux_dict.items()}
+    mx.model.save_checkpoint(prefix, 0, net, arg_params, aux_params)
+    return data_shape
+
+
+def bench_batch(prefix, data_shape, batch, iters, dev_type):
+    from mxnet_tpu import predict
+
+    p = predict.create(prefix, 0, {"data": (batch,) + data_shape},
+                       dev_type=dev_type)
+    x = np.random.RandomState(0).uniform(
+        0, 1, (batch,) + data_shape).astype(np.float32)
+    p.forward(data=x)
+    np.asarray(p.get_output(0))  # compile + settle; fetch = real barrier
+    tic = time.perf_counter()
+    for _ in range(iters):
+        p.forward(data=x)
+    np.asarray(p.get_output(0))
+    dt = time.perf_counter() - tic
+    return batch * iters / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet-50",
+                    choices=["resnet-50", "mlp"])
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--batches", type=int, nargs="+", default=[1, 32])
+    args = ap.parse_args()
+
+    platform = os.environ.get("MXTPU_PLATFORM")
+    if platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        dev_type = "cpu"
+    else:
+        dev_type = "tpu"
+
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "m")
+        data_shape = build_checkpoint(args.model, prefix)
+        print(f"predict-path throughput: {args.model}, dev={dev_type} "
+              f"(P100 predictor baselines: b1 113.76, b32 713.17 img/s)")
+        for b in args.batches:
+            rate = bench_batch(prefix, data_shape, b, args.iters, dev_type)
+            line = f"predict_b{b}: {rate:.1f} img/s"
+            if args.model == "resnet-50":
+                base = 113.76 if b == 1 else (713.17 if b == 32 else None)
+                if base:
+                    line += f"  ({rate / base:.2f}x P100 predictor)"
+            print(line)
+
+
+if __name__ == "__main__":
+    main()

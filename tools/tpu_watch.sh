@@ -42,6 +42,9 @@ while true; do
      && ! grep -q '"error"' "$f.bench"; then
     cp "$f.bench" "$OUT/SUCCESS.bench"
     cp "$f.nhwc" "$OUT/SUCCESS.nhwc" 2>/dev/null
+    # predict-ABI throughput (VERDICT r3 #8) — best-effort extra
+    timeout 900 python tools/bench_predict.py > "$f.predict" 2>&1 \
+      && cp "$f.predict" "$OUT/SUCCESS.predict"
     echo "[watch] attempt $attempt: SUCCESS" >> "$OUT/driver.log"
     exit 0
   fi
