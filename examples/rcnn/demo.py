@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Detection demo (parity: example/rcnn/demo.py): load a checkpoint
+saved by train_end2end.py --save-prefix, run the detector on fresh
+synthetic images, and print each image's detections next to its ground
+truth (plus an ASCII render so the localization is visible).
+
+Run:  MXTPU_PLATFORM=cpu python train_end2end.py --steps 200 \
+          --save-prefix /tmp/frcnn
+      MXTPU_PLATFORM=cpu python demo.py --prefix /tmp/frcnn
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from rcnn import config as cfg_mod  # noqa: E402
+from rcnn.detect import im_detect  # noqa: E402
+from rcnn.loader import synth_image_set  # noqa: E402
+from rcnn.symbols import get_symbol  # noqa: E402
+
+CLASSES = ["bg", "wide", "tall"]
+
+
+def ascii_render(img, dets, gt, cfg, cols=48):
+    """Terminal sketch: '#' image intensity, box corners marked."""
+    im = cfg.im_size
+    scale = im / cols
+    rows = cols // 2
+    grid = [[" "] * cols for _ in range(rows)]
+    lum = img.mean(0)
+    for r in range(rows):
+        for c in range(cols):
+            y = int(r * im / rows)
+            x = int(c * scale)
+            if lum[y, x] > 0.5:
+                grid[r][c] = "#"
+
+    def mark(box, ch):
+        x1, y1, x2, y2 = box
+        for (bx, by) in ((x1, y1), (x2, y1), (x1, y2), (x2, y2)):
+            c = min(int(bx / scale), cols - 1)
+            r = min(int(by * rows / im), rows - 1)
+            grid[r][c] = ch
+
+    for g in gt:
+        mark(g[:4], "G")
+    for d in dets:
+        if d[0] > 0:
+            mark(d[2:6], "D")
+    return "\n".join("".join(r) for r in grid)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prefix", required=True)
+    ap.add_argument("--images", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=1234)
+    args = ap.parse_args()
+    cfg = cfg_mod.default
+    b = args.images
+
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(
+        args.prefix, 0)
+    net = get_symbol(cfg, b, train_rois=False)
+    from rcnn.config import feat_size, num_anchors
+
+    f, a0 = feat_size(cfg), num_anchors(cfg)
+    ex = net.simple_bind(
+        ctx=mx.context.default_accelerator_context(), grad_req="null",
+        data=(b, 3, cfg.im_size, cfg.im_size), im_info=(b, 3),
+        rpn_label=(b, a0 * f * f), rpn_bbox_target=(b, 4 * a0, f, f),
+        rpn_bbox_weight=(b, 4 * a0, f, f),
+        roi_label=(b * cfg.rpn_post_nms_top_n,))
+    ex.copy_params_from({k: v for k, v in arg_params.items()},
+                        aux_params, allow_extra_params=True)
+
+    imgs, gt = synth_image_set(cfg, b, seed=args.seed)
+    im = cfg.im_size
+    ex.forward(is_train=False, data=imgs,
+               im_info=np.array([[im, im, 1.0]] * b, np.float32),
+               rpn_label=np.zeros((b, a0 * f * f), np.float32),
+               rpn_bbox_target=np.zeros((b, 4 * a0, f, f), np.float32),
+               rpn_bbox_weight=np.zeros((b, 4 * a0, f, f), np.float32),
+               roi_label=np.zeros((b * cfg.rpn_post_nms_top_n,),
+                                  np.float32))
+    dets = im_detect(ex.outputs, cfg, b)
+    for i in range(b):
+        print(f"--- image {i} ---")
+        for g in gt[i]:
+            print(f"  gt : {CLASSES[int(g[4])]:>5} "
+                  f"[{g[0]:.0f} {g[1]:.0f} {g[2]:.0f} {g[3]:.0f}]")
+        for d in dets[i]:
+            if d[0] > 0:
+                print(f"  det: {CLASSES[int(d[0])]:>5} "
+                      f"[{d[2]:.0f} {d[3]:.0f} {d[4]:.0f} {d[5]:.0f}] "
+                      f"score {d[1]:.2f}")
+        print(ascii_render(imgs[i], dets[i], gt[i], cfg))
+    n_det = int((dets[:, :, 0] > 0).sum())
+    print(f"DEMO OK: {n_det} detections over {b} images")
+
+
+if __name__ == "__main__":
+    main()

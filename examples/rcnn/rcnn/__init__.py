@@ -1,0 +1,3 @@
+"""Faster R-CNN as a modular training system (parity model:
+example/rcnn/rcnn/ — config, anchor/proposal target assignment,
+symbols, loader, metrics as separate concerns, not one script)."""

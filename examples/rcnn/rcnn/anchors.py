@@ -1,0 +1,88 @@
+"""Anchor/box geometry (parity: example/rcnn/rcnn/processing/
+bbox_transform.py + generate_anchor.py): grid anchors, IoU, the
+delta encode/decode pair, clipping and greedy NMS — pure numpy, used
+by the host-side target assignment exactly as the reference computes
+targets in its data loader."""
+import numpy as np
+
+from mxnet_tpu.ops.vision import _generate_anchors
+
+
+def grid_anchors(cfg):
+    """All anchors of the feature grid, (A*FH*FW, 4) in image coords."""
+    from .config import feat_size
+
+    f = feat_size(cfg)
+    base = _generate_anchors(cfg.feature_stride, cfg.anchor_scales,
+                             cfg.anchor_ratios)
+    sx, sy = np.meshgrid(np.arange(f) * cfg.feature_stride,
+                         np.arange(f) * cfg.feature_stride)
+    shifts = np.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], 1)
+    return (shifts[:, None].astype(np.float32) + base[None]).reshape(-1, 4)
+
+
+def np_iou(a, b):
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    iw = np.maximum(ix2 - ix1 + 1, 0)
+    ih = np.maximum(iy2 - iy1 + 1, 0)
+    inter = iw * ih
+    ua = ((a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1))[:, None] + \
+         ((b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1))[None] - inter
+    return inter / np.maximum(ua, 1e-6)
+
+
+def bbox_transform(boxes, gt):
+    """Boxes -> regression deltas to their matched gt (parity:
+    bbox_transform.py nonlinear_transform)."""
+    bw = boxes[:, 2] - boxes[:, 0] + 1
+    bh = boxes[:, 3] - boxes[:, 1] + 1
+    bcx = boxes[:, 0] + 0.5 * (bw - 1)
+    bcy = boxes[:, 1] + 0.5 * (bh - 1)
+    gw = gt[:, 2] - gt[:, 0] + 1
+    gh = gt[:, 3] - gt[:, 1] + 1
+    gcx = gt[:, 0] + 0.5 * (gw - 1)
+    gcy = gt[:, 1] + 0.5 * (gh - 1)
+    return np.stack([(gcx - bcx) / bw, (gcy - bcy) / bh,
+                     np.log(gw / bw), np.log(gh / bh)], axis=1)
+
+
+def bbox_pred(boxes, deltas):
+    """Apply deltas to boxes (inverse of bbox_transform; parity:
+    nonlinear_pred) — deltas is (N, 4) for one class column."""
+    bw = boxes[:, 2] - boxes[:, 0] + 1
+    bh = boxes[:, 3] - boxes[:, 1] + 1
+    bcx = boxes[:, 0] + 0.5 * (bw - 1)
+    bcy = boxes[:, 1] + 0.5 * (bh - 1)
+    cx = deltas[:, 0] * bw + bcx
+    cy = deltas[:, 1] * bh + bcy
+    w = np.exp(np.clip(deltas[:, 2], -10, 10)) * bw
+    h = np.exp(np.clip(deltas[:, 3], -10, 10)) * bh
+    return np.stack([cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+                     cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)], axis=1)
+
+
+def clip_boxes(boxes, im_size):
+    return np.stack([np.clip(boxes[:, 0], 0, im_size - 1),
+                     np.clip(boxes[:, 1], 0, im_size - 1),
+                     np.clip(boxes[:, 2], 0, im_size - 1),
+                     np.clip(boxes[:, 3], 0, im_size - 1)], axis=1)
+
+
+def nms(dets, thresh):
+    """Greedy NMS over (N, 5) [x1 y1 x2 y2 score]; returns kept indices
+    (parity: rcnn/processing/nms.py py_nms_wrapper)."""
+    if len(dets) == 0:
+        return []
+    order = dets[:, 4].argsort()[::-1]
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(int(i))
+        if order.size == 1:
+            break
+        iou = np_iou(dets[i:i + 1, :4], dets[order[1:], :4])[0]
+        order = order[1:][iou <= thresh]
+    return keep

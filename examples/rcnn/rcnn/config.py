@@ -1,0 +1,54 @@
+"""Configuration (parity: example/rcnn/rcnn/config.py — an edict the
+whole system reads; one place to retune the detector)."""
+
+
+class Config(dict):
+    """dict with attribute access, like the reference's EasyDict."""
+
+    __getattr__ = dict.__getitem__
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+default = Config(
+    # synthetic-VOC world geometry
+    im_size=64,
+    feature_stride=4,            # two 2x2 pools in the backbone
+    num_classes=3,               # background, wide, tall
+
+    # anchors
+    anchor_scales=(2, 4, 8),
+    anchor_ratios=(0.5, 1, 2),
+
+    # RPN target assignment (parity: rcnn/io/rpn.py assign_anchor)
+    rpn_fg_overlap=0.5,
+    rpn_bg_overlap=0.3,
+    rpn_batch_rois=64,
+    rpn_fg_fraction=0.5,
+
+    # proposal generation (parity: rpn/proposal.py)
+    rpn_pre_nms_top_n=64,
+    rpn_post_nms_top_n=16,
+    rpn_nms_thresh=0.7,
+    rpn_min_size=4,
+
+    # proposal->head sampling (parity: rcnn/rpn/proposal_target.py)
+    rcnn_batch_rois=16,          # rois per image fed to the head
+    rcnn_fg_fraction=0.25,
+    rcnn_fg_overlap=0.5,
+    rcnn_bbox_stds=(0.1, 0.1, 0.2, 0.2),
+
+    # test-time detection
+    test_nms_thresh=0.3,
+    test_score_thresh=0.05,
+    test_max_per_image=8,
+)
+
+
+def num_anchors(cfg):
+    return len(cfg.anchor_scales) * len(cfg.anchor_ratios)
+
+
+def feat_size(cfg):
+    return cfg.im_size // cfg.feature_stride

@@ -1,0 +1,74 @@
+"""Test-time detection (parity: example/rcnn/rcnn/core/tester.py
+im_detect + pred_eval): per-class bbox decoding from the head's
+regression branch, per-class NMS, and VOC07 mAP over a held-out set."""
+import numpy as np
+
+from .anchors import bbox_pred, clip_boxes, nms
+
+
+def im_detect(outputs, cfg, batch):
+    """Decode one eval forward into per-image detections.
+
+    outputs: [rpn_cls_prob, _, cls_prob (N*R, C),
+              bbox_pred (N*R, 4C), rois (N*R, 5)].
+    Returns dets (batch, max_per_image, 6) rows
+    (cls, score, x1, y1, x2, y2), -1 padded.
+    """
+    def to_np(a):
+        # NDArray lacks __array__ by design; np.asarray would fall back
+        # to element-wise iteration (one device sync per element)
+        return a.asnumpy() if hasattr(a, "asnumpy") else np.asarray(a)
+
+    C = cfg.num_classes
+    stds = np.asarray(cfg.rcnn_bbox_stds, np.float32)
+    probs = to_np(outputs[2])
+    deltas = to_np(outputs[3])
+    rois = to_np(outputs[4])
+    out = np.full((batch, cfg.test_max_per_image, 6), -1.0, np.float32)
+    for i in range(batch):
+        mine = rois[:, 0] == i
+        boxes_i = rois[mine][:, 1:5]
+        probs_i = probs[mine]
+        deltas_i = deltas[mine]
+        dets_i = []
+        for c in range(1, C):  # skip background
+            col = slice(4 * c, 4 * c + 4)
+            decoded = bbox_pred(boxes_i, deltas_i[:, col] * stds)
+            decoded = clip_boxes(decoded, cfg.im_size)
+            scores = probs_i[:, c]
+            keep = scores > cfg.test_score_thresh
+            if not keep.any():
+                continue
+            cand = np.concatenate(
+                [decoded[keep], scores[keep, None]], axis=1)
+            for k in nms(cand, cfg.test_nms_thresh):
+                dets_i.append([c, cand[k, 4], *cand[k, :4]])
+        dets_i.sort(key=lambda d: -d[1])
+        for j, d in enumerate(dets_i[:cfg.test_max_per_image]):
+            out[i, j] = d
+    return out
+
+
+def eval_map(eval_ex, loader, cfg, metric):
+    """Run detection over the loader's epoch and fold into the mAP
+    metric; zero-filled targets feed the unused loss inputs."""
+    from .config import feat_size, num_anchors
+
+    b = loader.batch_size
+    f, a0 = feat_size(cfg), num_anchors(cfg)
+    zeros = dict(
+        rpn_label=np.zeros((b, a0 * f * f), np.float32),
+        rpn_bbox_target=np.zeros((b, 4 * a0, f, f), np.float32),
+        rpn_bbox_weight=np.zeros((b, 4 * a0, f, f), np.float32),
+        roi_label=np.zeros((b * cfg.rpn_post_nms_top_n,), np.float32))
+    loader.reset()
+    for batch in loader:
+        eval_ex.forward(is_train=False, data=batch.data[0],
+                        im_info=batch.data[1], **zeros)
+        dets = im_detect(eval_ex.outputs, cfg, b)
+        labels = np.full((b, 4, 5), -1.0, np.float32)
+        for i, g in enumerate(batch.gt):
+            for j, row in enumerate(g):
+                labels[i, j] = [row[4], row[0], row[1], row[2], row[3]]
+        metric.update([labels], [dets])
+    return metric.get()[1]

@@ -1,0 +1,90 @@
+"""AnchorLoader (parity: example/rcnn/rcnn/io/rpn.py AnchorLoader +
+the synthetic stand-in for the VOC roidb): a DataIter that yields
+images WITH their RPN anchor targets already assigned, so the compiled
+graph never sees dynamic target shapes."""
+import numpy as np
+
+from mxnet_tpu.io import DataBatch, DataIter
+
+from .anchors import grid_anchors
+from .targets import assign_anchor, rpn_targets_to_feature_layout
+
+
+def synth_image_set(cfg, n_images, seed=0):
+    """Deterministic synthetic-VOC set: bright axis-aligned rectangles
+    on noise; class = aspect category (1 wide, 2 tall)."""
+    rs = np.random.RandomState(seed)
+    im = cfg.im_size
+    images = np.zeros((n_images, 3, im, im), np.float32)
+    gt = []
+    for i in range(n_images):
+        x = rs.rand(3, im, im).astype(np.float32) * 0.2
+        boxes = []
+        for _ in range(rs.randint(1, 3)):
+            wide = rs.randint(2)
+            w, h = (rs.randint(20, 32), rs.randint(8, 14)) if wide else \
+                   (rs.randint(8, 14), rs.randint(20, 32))
+            x1 = rs.randint(0, im - w)
+            y1 = rs.randint(0, im - h)
+            x[:, y1:y1 + h, x1:x1 + w] += 0.8
+            boxes.append([x1, y1, x1 + w - 1, y1 + h - 1, 1 + wide])
+        images[i] = np.clip(x, 0, 1)
+        gt.append(np.array(boxes, np.float32))
+    return images, gt
+
+
+class AnchorLoader(DataIter):
+    """Yields DataBatch(data=[data, im_info],
+    label=[rpn_label, rpn_bbox_target, rpn_bbox_weight]); the batch's
+    gt boxes ride on ``batch.gt`` for the proposal_target stage (the
+    reference passes them through the roidb the same way)."""
+
+    def __init__(self, cfg, n_images=64, batch_size=8, seed=0,
+                 shuffle=True):
+        super().__init__()
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.images, self.gt = synth_image_set(cfg, n_images, seed)
+        self.anchors = grid_anchors(cfg)
+        self._rs = np.random.RandomState(seed + 1)
+        self._shuffle = shuffle
+        self._order = np.arange(n_images)
+        self._cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        im = self.cfg.im_size
+        return [("data", (self.batch_size, 3, im, im)),
+                ("im_info", (self.batch_size, 3))]
+
+    @property
+    def provide_label(self):
+        from .config import feat_size, num_anchors
+
+        f, a0 = feat_size(self.cfg), num_anchors(self.cfg)
+        return [("rpn_label", (self.batch_size, a0 * f * f)),
+                ("rpn_bbox_target", (self.batch_size, 4 * a0, f, f)),
+                ("rpn_bbox_weight", (self.batch_size, 4 * a0, f, f))]
+
+    def reset(self):
+        self._cur = 0
+        if self._shuffle:
+            self._rs.shuffle(self._order)
+
+    def next(self):
+        if self._cur + self.batch_size > len(self.images):
+            raise StopIteration
+        idx = self._order[self._cur:self._cur + self.batch_size]
+        self._cur += self.batch_size
+        x = self.images[idx]
+        gt = [self.gt[i] for i in idx]
+        labels, bt, bw = assign_anchor(gt, self.anchors, self.cfg,
+                                       rs=self._rs)
+        lab, bt4, bw4 = rpn_targets_to_feature_layout(labels, bt, bw,
+                                                      self.cfg)
+        im = self.cfg.im_size
+        im_info = np.array([[im, im, 1.0]] * self.batch_size, np.float32)
+        batch = DataBatch([x, im_info], [lab, bt4, bw4])
+        batch.gt = gt
+        return batch

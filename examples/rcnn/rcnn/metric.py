@@ -1,0 +1,58 @@
+"""Training metrics (parity: example/rcnn/rcnn/core/metric.py —
+RPNAccMetric, RPNLogLossMetric, RCNNAccMetric, RCNNLogLossMetric; the
+fit log prints all four so RPN and head learning are visible
+separately)."""
+import numpy as np
+
+from mxnet_tpu.metric import EvalMetric
+
+
+class RPNAccuracy(EvalMetric):
+    def __init__(self):
+        super().__init__("RPNAcc")
+
+    def update(self, labels, preds):
+        label = np.asarray(labels[0])            # (N, A*F*F), -1 ignored
+        prob = np.asarray(preds[0])              # (N, 2, A*F*F)
+        pred = prob.argmax(axis=1)
+        mask = label != -1
+        self.sum_metric += float((pred[mask] == label[mask]).sum())
+        self.num_inst += int(mask.sum())
+
+
+class RPNLogLoss(EvalMetric):
+    def __init__(self):
+        super().__init__("RPNLogLoss")
+
+    def update(self, labels, preds):
+        label = np.asarray(labels[0])
+        prob = np.asarray(preds[0])
+        mask = label != -1
+        lab = np.clip(label, 0, 1).astype(int)
+        picked = np.take_along_axis(prob, lab[:, None, :], axis=1)[:, 0]
+        self.sum_metric += float(
+            -np.log(np.maximum(picked[mask], 1e-12)).sum())
+        self.num_inst += int(mask.sum())
+
+
+class RCNNAccuracy(EvalMetric):
+    def __init__(self):
+        super().__init__("RCNNAcc")
+
+    def update(self, labels, preds):
+        label = np.asarray(labels[0]).astype(int)   # (N*R,)
+        prob = np.asarray(preds[0])                 # (N*R, C)
+        self.sum_metric += float((prob.argmax(1) == label).sum())
+        self.num_inst += label.size
+
+
+class RCNNLogLoss(EvalMetric):
+    def __init__(self):
+        super().__init__("RCNNLogLoss")
+
+    def update(self, labels, preds):
+        label = np.asarray(labels[0]).astype(int)
+        prob = np.asarray(preds[0])
+        picked = prob[np.arange(label.size), label]
+        self.sum_metric += float(-np.log(np.maximum(picked, 1e-12)).sum())
+        self.num_inst += label.size

@@ -1,0 +1,110 @@
+"""Network definitions (parity: example/rcnn/rcnn/symbol/symbol_vgg.py
+— backbone, RPN heads, Proposal, ROI pooling, and the fast-rcnn head
+WITH its per-class bbox regression branch)."""
+from mxnet_tpu import sym
+
+from .config import feat_size, num_anchors
+
+
+def backbone(data):
+    """Small conv trunk standing in for VGG (3 convs, 2 pools -> the
+    configured feature stride)."""
+    net = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=16,
+                          name="conv1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                          name="conv2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                          name="conv3")
+    return sym.Activation(net, act_type="relu", name="feat")
+
+
+def get_symbol(cfg, batch, train_rois=False):
+    """Joint train/eval graph.
+
+    train_rois=True: the head pools an externally supplied `rois`
+    variable (the proposal_target flow — training rois are sampled
+    host-side from the previous forward's proposals) and emits LOSSES
+    for both head branches.  False: the head consumes the in-graph
+    Proposal output and emits raw scores + deltas for detection.
+
+    Outputs: [rpn_cls_prob, rpn_bbox_loss, cls_prob,
+              bbox_loss (train) | bbox_pred (eval), rois]
+    """
+    a0 = num_anchors(cfg)
+    f = feat_size(cfg)
+    C = cfg.num_classes
+
+    data = sym.Variable("data")
+    im_info = sym.Variable("im_info")
+    rpn_label = sym.Variable("rpn_label")
+    rpn_bbox_target = sym.Variable("rpn_bbox_target")
+    rpn_bbox_weight = sym.Variable("rpn_bbox_weight")
+    roi_label = sym.Variable("roi_label")
+
+    feat = backbone(data)
+
+    # RPN
+    rpn = sym.Convolution(feat, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                          name="rpn_conv")
+    rpn = sym.Activation(rpn, act_type="relu")
+    rpn_cls = sym.Convolution(rpn, kernel=(1, 1), num_filter=2 * a0,
+                              name="rpn_cls_score")
+    rpn_bbox = sym.Convolution(rpn, kernel=(1, 1), num_filter=4 * a0,
+                               name="rpn_bbox_pred")
+    rpn_cls_flat = sym.Reshape(rpn_cls, shape=(0, 2, -1),
+                               name="rpn_cls_flat")
+    rpn_cls_prob = sym.SoftmaxOutput(rpn_cls_flat, rpn_label,
+                                     multi_output=True, use_ignore=True,
+                                     ignore_label=-1,
+                                     normalization="valid",
+                                     name="rpn_cls_prob")
+    rpn_bbox_loss = sym.smooth_l1(
+        rpn_bbox_weight * (rpn_bbox - rpn_bbox_target), scalar=3.0)
+    rpn_bbox_loss = sym.MakeLoss(sym.sum(rpn_bbox_loss) / batch,
+                                 name="rpn_bbox_loss")
+
+    # proposals (gradient-free, like the reference's Proposal op)
+    rpn_cls_act = sym.SoftmaxActivation(rpn_cls_flat, mode="channel",
+                                        name="rpn_cls_act")
+    rpn_cls_act = sym.Reshape(rpn_cls_act, shape=(0, 2 * a0, f, f))
+    if train_rois:
+        rois = sym.BlockGrad(sym.Variable("rois"), name="rois")
+    else:
+        rois = sym.Proposal(
+            sym.BlockGrad(rpn_cls_act), sym.BlockGrad(rpn_bbox), im_info,
+            feature_stride=cfg.feature_stride, scales=cfg.anchor_scales,
+            ratios=cfg.anchor_ratios,
+            rpn_pre_nms_top_n=cfg.rpn_pre_nms_top_n,
+            rpn_post_nms_top_n=cfg.rpn_post_nms_top_n,
+            threshold=cfg.rpn_nms_thresh, rpn_min_size=cfg.rpn_min_size,
+            name="rois")
+
+    # fast-rcnn head: shared trunk, class scores AND per-class deltas
+    pooled = sym.ROIPooling(feat, rois, pooled_size=(4, 4),
+                            spatial_scale=1.0 / cfg.feature_stride,
+                            name="roi_pool")
+    head = sym.FullyConnected(sym.Flatten(pooled), num_hidden=64,
+                              name="fc6")
+    head = sym.Activation(head, act_type="relu")
+    cls_score = sym.FullyConnected(head, num_hidden=C, name="cls_score")
+    cls_prob = sym.SoftmaxOutput(cls_score, roi_label, use_ignore=True,
+                                 ignore_label=-1, normalization="valid",
+                                 name="cls_prob")
+    bbox_pred = sym.FullyConnected(head, num_hidden=4 * C,
+                                   name="bbox_pred")
+    if train_rois:
+        bbox_target = sym.Variable("bbox_target")
+        bbox_weight = sym.Variable("bbox_weight")
+        n_rois = batch * cfg.rcnn_batch_rois
+        bbox_loss = sym.smooth_l1(
+            bbox_weight * (bbox_pred - bbox_target), scalar=1.0)
+        bbox_branch = sym.MakeLoss(sym.sum(bbox_loss) / n_rois,
+                                   name="bbox_loss")
+    else:
+        bbox_branch = sym.BlockGrad(bbox_pred, name="bbox_pred_out")
+    return sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_branch,
+                      sym.BlockGrad(rois)])

@@ -1,0 +1,124 @@
+"""Target assignment — the host-side half of Faster R-CNN training.
+
+Parity: the reference computes BOTH target stages in the data path so
+the compiled graph stays static —
+  * RPN anchor targets in the loader (rcnn/io/rpn.py assign_anchor),
+  * head targets by SAMPLING the previous forward's proposals
+    (rcnn/rpn/proposal_target.py: fg/bg fractions, per-class bbox
+    deltas, normalization stds).
+Same split here, numpy end to end.
+"""
+import numpy as np
+
+from .anchors import bbox_transform, np_iou
+
+
+def assign_anchor(gt_list, anchors, cfg, rs=None):
+    """RPN targets: fg iou>=rpn_fg_overlap, bg < rpn_bg_overlap, the
+    rest ignored; a fixed-size anchor batch is sampled per image (up to
+    rpn_fg_fraction foreground) — without sampling the ~100:1 bg:fg
+    imbalance drowns the foreground gradient."""
+    rs = rs or np.random
+    n = len(gt_list)
+    total = anchors.shape[0]
+    labels = np.full((n, total), -1, np.float32)
+    bbox_t = np.zeros((n, total, 4), np.float32)
+    bbox_w = np.zeros((n, total, 4), np.float32)
+    for i, gt in enumerate(gt_list):
+        iou = np_iou(anchors, gt[:, :4])
+        best = iou.max(axis=1)
+        arg = iou.argmax(axis=1)
+        labels[i, best < cfg.rpn_bg_overlap] = 0
+        fg = best >= cfg.rpn_fg_overlap
+        for j in range(gt.shape[0]):   # every gt keeps its best anchor
+            fg[iou[:, j].argmax()] = True
+        labels[i, fg] = 1
+        fg_idx = np.where(labels[i] == 1)[0]
+        n_fg = min(len(fg_idx), int(cfg.rpn_batch_rois * cfg.rpn_fg_fraction))
+        if len(fg_idx) > n_fg:
+            off = rs.choice(fg_idx, len(fg_idx) - n_fg, replace=False)
+            labels[i, off] = -1
+        bg_idx = np.where(labels[i] == 0)[0]
+        n_bg = cfg.rpn_batch_rois - n_fg
+        if len(bg_idx) > n_bg:
+            off = rs.choice(bg_idx, len(bg_idx) - n_bg, replace=False)
+            labels[i, off] = -1
+        fg = labels[i] == 1
+        bbox_t[i, fg] = bbox_transform(anchors[fg], gt[arg[fg], :4])
+        bbox_w[i, fg] = 1.0
+    return labels, bbox_t, bbox_w
+
+
+def rpn_targets_to_feature_layout(labels, bbox_t, bbox_w, cfg):
+    """(N, A*F*F[,4]) row-major anchor targets -> the channel-major
+    layout the RPN heads emit ((N, A*F*F) labels, (N, 4A, F, F) boxes)."""
+    from .config import feat_size, num_anchors
+
+    f, a0 = feat_size(cfg), num_anchors(cfg)
+    n = labels.shape[0]
+    lab = labels.reshape(n, f, f, a0).transpose(0, 3, 1, 2).reshape(n, -1)
+    bt = bbox_t.reshape(n, f, f, a0, 4).transpose(0, 3, 4, 1, 2) \
+        .reshape(n, 4 * a0, f, f)
+    bw = bbox_w.reshape(n, f, f, a0, 4).transpose(0, 3, 4, 1, 2) \
+        .reshape(n, 4 * a0, f, f)
+    return lab, bt, bw
+
+
+def sample_rois(rois, gt_list, cfg, rs=None):
+    """proposal_target: sample a fixed head batch from the proposals.
+
+    Appends each image's gt boxes to its proposal list (the reference
+    does the same so the head always sees true foreground), computes
+    IoU, samples rcnn_fg_fraction foreground + background to
+    rcnn_batch_rois per image, and emits per-class bbox deltas
+    normalized by rcnn_bbox_stds.
+
+    Returns (rois_out [N*R, 5], label [N*R], bbox_target [N*R, 4C],
+    bbox_weight [N*R, 4C]).
+    """
+    rs = rs or np.random
+    n_img = len(gt_list)
+    R = cfg.rcnn_batch_rois
+    C = cfg.num_classes
+    stds = np.asarray(cfg.rcnn_bbox_stds, np.float32)
+    out_rois = np.zeros((n_img * R, 5), np.float32)
+    out_label = np.zeros((n_img * R,), np.float32)
+    out_bt = np.zeros((n_img * R, 4 * C), np.float32)
+    out_bw = np.zeros((n_img * R, 4 * C), np.float32)
+    for i, gt in enumerate(gt_list):
+        mine = rois[rois[:, 0] == i][:, 1:5]
+        cand = np.concatenate([mine, gt[:, :4]], axis=0)
+        iou = np_iou(cand, gt[:, :4])
+        best = iou.max(axis=1)
+        arg = iou.argmax(axis=1)
+        fg_idx = np.where(best >= cfg.rcnn_fg_overlap)[0]
+        bg_idx = np.where(best < cfg.rcnn_fg_overlap)[0]
+        n_fg = int(min(len(fg_idx), round(R * cfg.rcnn_fg_fraction)))
+        if len(fg_idx) > n_fg:
+            fg_idx = rs.choice(fg_idx, n_fg, replace=False)
+        n_bg = R - n_fg
+        if len(bg_idx) >= n_bg:
+            bg_idx = rs.choice(bg_idx, n_bg, replace=False)
+        elif len(bg_idx) > 0:
+            bg_idx = rs.choice(bg_idx, n_bg, replace=True)
+        else:
+            bg_idx = np.zeros((0,), int)
+        keep = np.concatenate([fg_idx, bg_idx]).astype(int)
+        # pad (rare: no bg candidates at all) by repeating the last roi
+        while len(keep) < R:
+            keep = np.concatenate([keep, keep[-1:]])
+        sel = cand[keep]
+        lab = np.zeros((R,), np.float32)
+        lab[:len(fg_idx)] = gt[arg[fg_idx], 4] if len(fg_idx) else 0
+        sl = slice(i * R, (i + 1) * R)
+        out_rois[sl, 0] = i
+        out_rois[sl, 1:5] = sel
+        out_label[sl] = lab
+        if len(fg_idx):
+            deltas = bbox_transform(sel[:len(fg_idx)],
+                                    gt[arg[fg_idx], :4]) / stds
+            for k, cls in enumerate(lab[:len(fg_idx)].astype(int)):
+                col = slice(4 * cls, 4 * cls + 4)
+                out_bt[i * R + k, col] = deltas[k]
+                out_bw[i * R + k, col] = 1.0
+    return out_rois, out_label, out_bt, out_bw

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""End-to-end joint Faster R-CNN training (parity:
+example/rcnn/train_end2end.py): AnchorLoader feeds RPN targets,
+proposal_target samples the head batch from the previous forward's
+proposals, all four losses (rpn cls, rpn bbox, rcnn cls, rcnn bbox)
+train jointly, the four reference metrics log per interval, and eval
+reports VOC07 mAP from per-class decoded + NMSed head detections.
+
+Run:  MXTPU_PLATFORM=cpu python train_end2end.py --steps 150 \
+          --assert-map 0.3
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from rcnn import config as cfg_mod  # noqa: E402
+from rcnn.detect import eval_map  # noqa: E402
+from rcnn.loader import AnchorLoader  # noqa: E402
+from rcnn.metric import (RCNNAccuracy, RCNNLogLoss, RPNAccuracy,  # noqa: E402
+                         RPNLogLoss)
+from rcnn.symbols import get_symbol  # noqa: E402
+from rcnn.targets import sample_rois  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--images", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--log-interval", type=int, default=10)
+    ap.add_argument("--assert-map", type=float, default=None)
+    ap.add_argument("--save-prefix", type=str, default=None,
+                    help="save a Module-format checkpoint after training "
+                         "(demo.py loads it)")
+    args = ap.parse_args()
+    cfg = cfg_mod.default
+    rs = np.random.RandomState(0)
+    np.random.seed(0)  # initializers draw from numpy's global RNG
+
+    loader = AnchorLoader(cfg, n_images=args.images,
+                          batch_size=args.batch)
+    b, R = args.batch, cfg.rcnn_batch_rois
+
+    train_net = get_symbol(cfg, b, train_rois=True)
+    ctx = mx.context.default_accelerator_context()
+    ex = train_net.simple_bind(
+        ctx=ctx, grad_req="write",
+        data=(b, 3, cfg.im_size, cfg.im_size),
+        rpn_label=loader.provide_label[0][1],
+        rpn_bbox_target=loader.provide_label[1][1],
+        rpn_bbox_weight=loader.provide_label[2][1],
+        rois=(b * R, 5), roi_label=(b * R,),
+        bbox_target=(b * R, 4 * cfg.num_classes),
+        bbox_weight=(b * R, 4 * cfg.num_classes))
+    init = mx.init.Xavier()
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name.endswith(("weight", "bias")) and "rpn_bbox" not in name \
+                and "bbox_target" not in name and "bbox_weight" not in name:
+            init(name, arr)
+            params[name] = arr
+
+    # eval graph shares the parameter NDArrays (one update serves both)
+    eval_net = get_symbol(cfg, b, train_rois=False)
+    eval_args = {}
+    for name in eval_net.list_arguments():
+        if name in ex.arg_dict:
+            eval_args[name] = ex.arg_dict[name]
+        else:
+            shp = {"data": (b, 3, cfg.im_size, cfg.im_size),
+                   "im_info": (b, 3)}.get(name)
+            eval_args[name] = mx.nd.zeros(shp) if shp else mx.nd.zeros((1,))
+    eval_ex = eval_net.bind(ctx=ctx, args=eval_args, args_grad=None,
+                            grad_req="null")
+
+    opt = mx.optimizer.create("sgd", learning_rate=args.lr, momentum=0.9,
+                              rescale_grad=1.0 / b)
+    updater = mx.optimizer.get_updater(opt)
+    metrics = [RPNAccuracy(), RPNLogLoss(), RCNNAccuracy(), RCNNLogLoss()]
+
+    step = 0
+    tic = time.perf_counter()
+    while step < args.steps:
+        loader.reset()
+        for batch in loader:
+            if step >= args.steps:
+                break
+            lab, bt4, bw4 = batch.label
+            # stage 1: this batch's proposals from the CURRENT weights
+            eval_ex.forward(
+                is_train=False, data=batch.data[0], im_info=batch.data[1],
+                rpn_label=np.zeros_like(lab),
+                rpn_bbox_target=np.zeros_like(bt4),
+                rpn_bbox_weight=np.zeros_like(bw4),
+                roi_label=np.zeros((b * cfg.rpn_post_nms_top_n,),
+                                   np.float32))
+            proposals = eval_ex.outputs[4].asnumpy()
+            # stage 2: proposal_target sampling
+            rois, roi_label, bbox_t, bbox_w = sample_rois(
+                proposals, batch.gt, cfg, rs=rs)
+            # stage 3: joint forward/backward on the sampled batch
+            ex.forward(is_train=True, data=batch.data[0], rpn_label=lab,
+                       rpn_bbox_target=bt4, rpn_bbox_weight=bw4,
+                       rois=rois, roi_label=roi_label,
+                       bbox_target=bbox_t, bbox_weight=bbox_w)
+            ex.backward()
+            for i, (name, arr) in enumerate(sorted(params.items())):
+                updater(i, ex.grad_dict[name], arr)
+            metrics[0].update([lab], [ex.outputs[0].asnumpy()
+                                      .reshape(b, 2, -1)])
+            metrics[1].update([lab], [ex.outputs[0].asnumpy()
+                                      .reshape(b, 2, -1)])
+            metrics[2].update([roi_label], [ex.outputs[2].asnumpy()])
+            metrics[3].update([roi_label], [ex.outputs[2].asnumpy()])
+            step += 1
+            if step % args.log_interval == 0:
+                vals = "  ".join("%s=%.4f" % m.get() for m in metrics)
+                rate = args.log_interval * b / (time.perf_counter() - tic)
+                print(f"step {step}  {vals}  ({rate:.1f} img/s)")
+                for m in metrics:
+                    m.reset()
+                tic = time.perf_counter()
+
+    # held-out eval: fresh images the detector never trained on
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "ssd"))
+    from eval_metric import VOC07MApMetric
+
+    heldout = AnchorLoader(cfg, n_images=32, batch_size=b, seed=99,
+                           shuffle=False)
+    mAP = eval_map(eval_ex, heldout, cfg, VOC07MApMetric())
+    print("VOC07_mAP: %.4f" % mAP)
+    if args.save_prefix:
+        mx.model.save_checkpoint(
+            args.save_prefix, 0, eval_net,
+            {k: v for k, v in params.items()}, {})
+        print("saved %s-0000.params" % args.save_prefix)
+    if args.assert_map is not None:
+        assert mAP > args.assert_map, \
+            f"mAP {mAP:.4f} below floor {args.assert_map}"
+        print("MAP_FLOOR_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,7 +43,8 @@ def evaluate(arg_dict, args, imgs, labels):
 
     from mxnet_tpu import nd
 
-    deploy = ssd.get_symbol(num_classes=args.num_classes)
+    deploy = ssd.get_symbol(num_classes=args.num_classes,
+                            backbone=args.backbone)
     b = args.batch_size
     dex = deploy.simple_bind(ctx=None,
                              data=(b, 3, args.data_size, args.data_size))
@@ -67,13 +68,24 @@ if __name__ == "__main__":
     ap.add_argument("--data-size", type=int, default=300)
     ap.add_argument("--num-steps", type=int, default=5)
     ap.add_argument("--lr", type=float, default=0.001)
+    ap.add_argument("--backbone", default="vgg16",
+                    choices=["vgg16", "tiny"],
+                    help="tiny: small from-scratch-trainable trunk "
+                         "(VGG16 needs pretrained weights to learn "
+                         "in a short run, as in the reference)")
     ap.add_argument("--eval", action="store_true",
                     help="compute VOC mAP with the deploy graph after "
                          "training")
+    ap.add_argument("--assert-map", type=float, default=None,
+                    help="fail unless VOC07 mAP exceeds this floor "
+                         "(implies --eval)")
     args = ap.parse_args()
+    if args.assert_map is not None:
+        args.eval = True
     logging.basicConfig(level=logging.INFO)
 
-    net = ssd.get_symbol_train(num_classes=args.num_classes)
+    net = ssd.get_symbol_train(num_classes=args.num_classes,
+                               backbone=args.backbone)
     b = args.batch_size
     ex = net.simple_bind(ctx=None, data=(b, 3, args.data_size, args.data_size),
                          label=(b, 8, 5))
@@ -87,7 +99,13 @@ if __name__ == "__main__":
     opt = mx.optimizer.create("sgd", learning_rate=args.lr, momentum=0.9,
                               wd=5e-4)
     updater = mx.optimizer.get_updater(opt)
+    import time
+
+    tic = None
     for step in range(args.num_steps):
+        if step == 1:
+            ex.outputs[0].asnumpy()  # sync step 0 before timing starts
+            tic = time.perf_counter()  # discard the compile step
         sel = slice((step * b) % 64, (step * b) % 64 + b)
         ex.arg_dict["data"][:] = imgs[sel]
         ex.arg_dict["label"][:] = labels[sel]
@@ -97,12 +115,22 @@ if __name__ == "__main__":
             if name in ("data", "label") or ex.grad_dict.get(name) is None:
                 continue
             updater(i, ex.grad_dict[name], ex.arg_dict[name])
-        outs = [o.asnumpy() for o in ex.outputs]
-        logging.info("step %d  outputs %s", step,
-                     [tuple(o.shape) for o in outs])
+        if step % 10 == 0:
+            cls_prob = ex.outputs[0].asnumpy()  # (N, C+1, A) softmax
+            logging.info("step %d  mean max cls prob %.3f", step,
+                         float(cls_prob.max(axis=1).mean()))
+    ex.outputs[0].asnumpy()  # barrier before the perf line
+    if tic is not None and args.num_steps > 1:
+        rate = b * (args.num_steps - 1) / (time.perf_counter() - tic)
+        print("train_perf: %.1f img/s" % rate)
     if args.eval:
         mAP, mAP07 = evaluate(ex.arg_dict, args, imgs, labels)
         logging.info("eval: mAP=%.4f  VOC07_mAP=%.4f", mAP, mAP07)
         print("mAP: %.4f" % mAP)
+        print("VOC07_mAP: %.4f" % mAP07)
+        if args.assert_map is not None:
+            assert mAP07 > args.assert_map, \
+                f"VOC07 mAP {mAP07:.4f} below floor {args.assert_map}"
+            print("MAP_FLOOR_OK")
     logging.info("done — deploy graph: models.ssd.get_symbol() adds "
                  "softmax + NMS MultiBoxDetection")

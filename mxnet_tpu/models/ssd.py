@@ -119,8 +119,39 @@ def multibox_layer(from_layers, num_classes, sizes, ratios, normalization):
     return loc_preds, cls_preds, anchor_boxes
 
 
-def _build(num_classes):
+def tiny_base(data):
+    """4-conv trunk for from-scratch training (the reference always
+    fine-tunes a pretrained VGG; a 13-conv VGG from random init cannot
+    learn in a short CPU run — this trunk can, and exercises the same
+    two-scale multibox head wiring)."""
+    net = data
+    for i, nf in enumerate((16, 32)):
+        net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                              num_filter=nf, name=f"tconv{i + 1}")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max")
+    c3 = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                         name="tconv3")
+    c3 = sym.Activation(c3, act_type="relu", name="tiny_scale1")
+    c4 = sym.Pooling(c3, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c4 = sym.Convolution(c4, kernel=(3, 3), pad=(1, 1), num_filter=64,
+                         name="tconv4")
+    c4 = sym.Activation(c4, act_type="relu", name="tiny_scale2")
+    return c3, c4
+
+
+_TINY_SIZES = [(0.2, 0.272), (0.4, 0.5, 0.65)]
+_TINY_RATIOS = [(1, 2, 0.5), (1, 2, 0.5)]
+_TINY_NORMALIZATION = [-1, -1]
+
+
+def _build(num_classes, backbone="vgg16"):
     data = sym.Variable("data")
+    if backbone == "tiny":
+        s1, s2 = tiny_base(data)
+        return multibox_layer([s1, s2], num_classes, _TINY_SIZES,
+                              _TINY_RATIOS, _TINY_NORMALIZATION)
     conv4_3, fc7 = vgg16_base(data)
     extras = _extra_layers(fc7)
     from_layers = [conv4_3, fc7] + extras
@@ -128,11 +159,11 @@ def _build(num_classes):
                           _NORMALIZATION)
 
 
-def get_symbol_train(num_classes=20, **kwargs):
+def get_symbol_train(num_classes=20, backbone="vgg16", **kwargs):
     """Training graph (parity: symbol_vgg16_ssd_300.py get_symbol_train):
     label is (N, M, 5) [cls, x1, y1, x2, y2] normalized, -1-padded."""
     label = sym.Variable("label")
-    loc_preds, cls_preds, anchor_boxes = _build(num_classes)
+    loc_preds, cls_preds, anchor_boxes = _build(num_classes, backbone)
 
     loc_target, loc_target_mask, cls_target = sym.MultiBoxTarget(
         anchor_boxes, label, cls_preds, overlap_threshold=0.5,
@@ -161,9 +192,9 @@ def get_symbol_train(num_classes=20, **kwargs):
 
 
 def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
-               nms_topk=400, **kwargs):
+               nms_topk=400, backbone="vgg16", **kwargs):
     """Deploy graph: softmax over classes + NMS detection output."""
-    loc_preds, cls_preds, anchor_boxes = _build(num_classes)
+    loc_preds, cls_preds, anchor_boxes = _build(num_classes, backbone)
     cls_prob = sym.SoftmaxActivation(cls_preds, mode="channel",
                                      name="cls_prob")
     return sym.MultiBoxDetection(cls_prob, loc_preds, anchor_boxes,
