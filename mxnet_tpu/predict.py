@@ -16,6 +16,8 @@ deployment story.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from . import ndarray as nd
@@ -25,12 +27,21 @@ from .base import MXNetError
 
 class Predictor:
     """Parity: the ``MXPredCreate``/``SetInput``/``Forward``/``GetOutput``
-    lifecycle rolled into one object."""
+    lifecycle rolled into one object.
+
+    ``dtype``: inference compute precision.  ``"bfloat16"`` casts fp32
+    weights/inputs to bf16 *inside* the compiled program (the casts fuse
+    into the first consumers) and casts outputs back to fp32 — the
+    deployment analog of ``FusedTrainer(dtype='bfloat16')``.  Default is
+    the checkpoint's own precision; the ``MXTPU_PREDICT_DTYPE`` env var
+    sets it for non-Python clients of the C ABI (src/c_predict.cc),
+    which construct this class without kwargs.
+    """
 
     def __init__(self, symbol_json_str=None, param_bytes=None,
                  input_shapes=None, dev_type="cpu", dev_id=0,
                  symbol=None, arg_params=None, aux_params=None,
-                 output_index=None):
+                 output_index=None, dtype=None):
         from . import context as ctx_mod
         from .executor import simple_bind
 
@@ -113,9 +124,72 @@ class Predictor:
                 "(corrupt/truncated checkpoint, or name mismatch)")
         self._dirty = True
 
+        if dtype is None:
+            dtype = os.environ.get("MXTPU_PREDICT_DTYPE") or None
+        self._dtype = dtype  # normalized to a jnp dtype in _build_fast_forward
+        self._build_fast_forward()
+        self._fast_outs = None
+        self._step = 0
+
+    def _build_fast_forward(self):
+        """One jitted computation per Predictor: params/inputs → outputs.
+
+        Unlike Executor.forward (which runs eager NDArray writes, an
+        eager RNG fold, and output re-wrapping per call — each one a
+        host↔device round trip that serializes on tunneled/remote
+        backends), this path is a single dispatch: the RNG fold happens
+        *inside* the program (the step counter is a traced scalar), the
+        dtype casts fuse into their consumers, and outputs stay raw jax
+        arrays until ``get_output`` copies them out (parity note: the
+        reference forces the synchronous NaiveEngine for predict,
+        include/mxnet/base.h:72-74 — here "synchronous" is simply one
+        XLA program per forward)."""
+        import jax
+        import jax.numpy as jnp
+
+        if getattr(self._exec, "_placed", False):
+            self._infer_jit = None  # ctx-group graphs: outer must stay unjitted
+            if self._dtype not in (None, "float32"):
+                import warnings
+
+                warnings.warn(
+                    "Predictor dtype=%r is not applied on ctx-group (placed) "
+                    "graphs — the executor fallback computes in the "
+                    "checkpoint's own precision" % (self._dtype,),
+                    stacklevel=3)
+            return
+        graph_fn = self._exec._graph_fn
+        cast = None if self._dtype is None else jnp.dtype(self._dtype)
+        # weights are immutable after construction (set_input only accepts
+        # declared inputs; reshape() builds a whole new Predictor), so
+        # snapshot them once — forward() then only uploads the inputs
+        self._param_snapshot = {
+            k: v._read() for k, v in self._exec.arg_dict.items()
+            if k not in self._input_names}
+        self._aux_snapshot = {
+            k: v._read() for k, v in self._exec.aux_dict.items()}
+
+        def _infer(params, aux, inputs, step, base_key):
+            key = jax.random.fold_in(base_key, step)
+            merged = dict(params)
+            merged.update(inputs)
+            if cast is not None and cast != jnp.float32:
+                merged = {k: v.astype(cast) if v.dtype == jnp.float32 else v
+                          for k, v in merged.items()}
+                aux = {k: v.astype(cast) if v.dtype == jnp.float32 else v
+                       for k, v in aux.items()}
+            outs, _ = graph_fn(merged, aux, key, False)
+            if cast is not None and cast != jnp.float32:
+                outs = [o.astype(jnp.float32) if o.dtype == cast else o
+                        for o in outs]
+            return outs
+
+        self._infer_jit = jax.jit(_infer)
+
     # ------------------------------------------------------------------ API
-    def set_input(self, name, value):
-        """Parity: MXPredSetInput."""
+    def _coerce_input(self, name, value):
+        """Validate name/shape and coerce to the bound dtype (shared by
+        set_input and forward kwargs)."""
         if name not in self._input_names:
             raise MXNetError(f"unknown input {name}; inputs: {self._input_names}")
         arr = self._exec.arg_dict[name]
@@ -123,14 +197,42 @@ class Predictor:
         if value.shape != arr.shape:
             raise MXNetError(
                 f"shape mismatch for {name}: got {value.shape}, bound {arr.shape}")
-        arr[:] = value
+        return arr, value
+
+    def _upload_input(self, name, value):
+        """Single host→device transfer straight onto the bound array's
+        device — no eager broadcast op, no default-device detour."""
+        import jax
+
+        arr, value = self._coerce_input(name, value)
+        arr._set(jax.device_put(value, arr._read().sharding))
+
+    def set_input(self, name, value):
+        """Parity: MXPredSetInput."""
+        self._upload_input(name, value)
         self._dirty = True
 
     def forward(self, **inputs):
         """Parity: MXPredForward (kwargs are a convenience for set_input)."""
+        if self._infer_jit is None:  # ctx-group fallback: executor path
+            for name, value in inputs.items():
+                self.set_input(name, value)
+            self._exec.forward(is_train=False)
+            self._fast_outs = None
+            self._dirty = False
+            return
+        from . import random as _random
+
+        arg_dict = self._exec.arg_dict
         for name, value in inputs.items():
-            self.set_input(name, value)
-        self._exec.forward(is_train=False)
+            self._upload_input(name, value)
+        feeds = {n: arg_dict[n]._read() for n in self._input_names}
+        # the key is a traced argument (not a closure constant) so a
+        # later mx.random.seed() is honored, matching Executor.forward
+        self._fast_outs = self._infer_jit(
+            self._param_snapshot, self._aux_snapshot, feeds,
+            np.uint32(self._step), _random.current_key())
+        self._step += 1
         self._dirty = False
 
     def partial_forward(self, step):
@@ -155,18 +257,31 @@ class Predictor:
         return [o.asnumpy() for o in ex.outputs]
 
     def get_output_shape(self, index=0):
-        """Parity: MXPredGetOutputShape."""
+        """Parity: MXPredGetOutputShape — usable BEFORE the first forward
+        (the reference computes output shapes at MXPredCreate so C clients
+        can size their buffers, c_predict_api.cc)."""
+        if self._fast_outs is not None:
+            return tuple(self._fast_outs[index].shape)
+        if self._exec._outputs_cache is None and self._exec._pending is None:
+            shapes = {n: self._exec.arg_dict[n].shape
+                      for n in self._input_names}
+            _, out_shapes, _ = self.symbol.infer_shape(**shapes)
+            return tuple(out_shapes[index])
         return tuple(self._exec.outputs[index].shape)
 
     def get_output(self, index=0):
         """Parity: MXPredGetOutput — blocking copy-out."""
         if self._dirty:
             self.forward()
+        if self._fast_outs is not None:
+            return np.asarray(self._fast_outs[index])
         return self._exec.outputs[index].asnumpy()
 
     @property
     def num_outputs(self):
-        return len(self._exec.outputs)
+        if self._fast_outs is not None:
+            return len(self._fast_outs)
+        return len(self.symbol.list_outputs())
 
     def _input_shape(self, name):
         """Bound shape of an input (used by the C ABI to reshape flat
@@ -181,7 +296,8 @@ class Predictor:
         aux_params = dict(self._exec.aux_dict)
         new = Predictor(symbol=self.symbol, arg_params=arg_params,
                         aux_params=aux_params, input_shapes=input_shapes,
-                        dev_type=self._exec._ctx)  # keep the original device
+                        dev_type=self._exec._ctx,  # keep the original device
+                        dtype=self._dtype)
         self.__dict__.update(new.__dict__)
 
 
@@ -197,7 +313,8 @@ def _load_param_bytes(param_bytes):
         os.unlink(path)
 
 
-def create(prefix, epoch, input_shapes, dev_type="cpu", dev_id=0):
+def create(prefix, epoch, input_shapes, dev_type="cpu", dev_id=0,
+           dtype=None):
     """Load a save_checkpoint()-style checkpoint into a Predictor
     (parity: the common MXPredCreate usage in c_predict_api examples)."""
     from .model import load_checkpoint
@@ -205,4 +322,4 @@ def create(prefix, epoch, input_shapes, dev_type="cpu", dev_id=0):
     symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
     return Predictor(symbol=symbol, arg_params=arg_params,
                      aux_params=aux_params, input_shapes=input_shapes,
-                     dev_type=dev_type, dev_id=dev_id)
+                     dev_type=dev_type, dev_id=dev_id, dtype=dtype)

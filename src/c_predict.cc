@@ -155,9 +155,17 @@ int MXPredSetInput(void *handle, const char *key, const float *data,
   }
   Py_DECREF(arr);
   if (!reshaped) return Fail("reshape");
-  PyObject *r = PyObject_CallMethod(h->predictor, "set_input", "sO", key,
-                                    reshaped);
+  // the frombuffer view points at the CALLER's memory with no ownership;
+  // jax's cpu backend may alias host buffers zero-copy into the device
+  // array, so the value must be copied into a python-owned buffer before
+  // the caller is allowed to free theirs (observed: intermittent
+  // zero-weight forwards when the freed buffer's pages were reused)
+  PyObject *owned = PyObject_CallMethod(reshaped, "copy", nullptr);
   Py_DECREF(reshaped);
+  if (!owned) return Fail("copy input");
+  PyObject *r = PyObject_CallMethod(h->predictor, "set_input", "sO", key,
+                                    owned);
+  Py_DECREF(owned);
   if (!r) return Fail("set_input");
   Py_DECREF(r);
   return 0;

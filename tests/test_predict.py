@@ -114,3 +114,42 @@ def test_executor_manager_train_step():
     out_params, out_aux = {}, {}
     mgr.copy_to(out_params, out_aux)
     assert set(out_params) == set(mgr.param_names)
+
+
+def test_predictor_bf16_dtype(tmp_path):
+    """dtype='bfloat16' casts inside the compiled program: outputs come
+    back fp32 and stay within bf16 tolerance of the fp32 predictor."""
+    prefix, x = _trained_checkpoint(tmp_path)
+    p32 = pred_create(prefix, 1, {"data": (16, 8)})
+    p16 = pred_create(prefix, 1, {"data": (16, 8)}, dtype="bfloat16")
+    p32.forward(data=x[:16])
+    p16.forward(data=x[:16])
+    o32 = p32.get_output(0)
+    o16 = p16.get_output(0)
+    assert o16.dtype == np.float32  # cast back at the program boundary
+    assert np.allclose(o16.sum(axis=1), 1.0, atol=1e-2)
+    assert np.allclose(o16, o32, atol=0.03)
+
+
+def test_predictor_set_input_then_parameterless_forward(tmp_path):
+    """The C ABI flow (src/c_predict.cc): SetInput -> Forward() with no
+    kwargs -> GetOutput must hit the single-dispatch path and agree with
+    the kwargs flow."""
+    prefix, x = _trained_checkpoint(tmp_path)
+    p = pred_create(prefix, 1, {"data": (16, 8)})
+    p.set_input("data", x[:16])
+    p.forward()
+    via_abi = p.get_output(0)
+    p2 = pred_create(prefix, 1, {"data": (16, 8)})
+    p2.forward(data=x[:16])
+    assert np.allclose(via_abi, p2.get_output(0), atol=1e-6)
+
+
+def test_predictor_output_shape_before_forward(tmp_path):
+    """MXPredGetOutputShape is queried right after MXPredCreate to size
+    client buffers (reference c_predict_api flow) — must work with no
+    forward run yet."""
+    prefix, _ = _trained_checkpoint(tmp_path)
+    p = pred_create(prefix, 1, {"data": (16, 8)})
+    assert p.get_output_shape(0) == (16, 4)
+    assert p.num_outputs == 1

@@ -50,11 +50,11 @@ def build_checkpoint(model, prefix):
     return data_shape
 
 
-def bench_batch(prefix, data_shape, batch, iters, dev_type):
+def bench_batch(prefix, data_shape, batch, iters, dev_type, dtype=None):
     from mxnet_tpu import predict
 
     p = predict.create(prefix, 0, {"data": (batch,) + data_shape},
-                       dev_type=dev_type)
+                       dev_type=dev_type, dtype=dtype)
     x = np.random.RandomState(0).uniform(
         0, 1, (batch,) + data_shape).astype(np.float32)
     p.forward(data=x)
@@ -73,6 +73,9 @@ def main():
                     choices=["resnet-50", "mlp"])
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--batches", type=int, nargs="+", default=[1, 32])
+    ap.add_argument("--dtype", default=None, choices=["bfloat16"],
+                    help="inference compute precision (bf16 casts fuse "
+                         "into the compiled program)")
     args = ap.parse_args()
 
     platform = os.environ.get("MXTPU_PLATFORM")
@@ -90,7 +93,8 @@ def main():
         print(f"predict-path throughput: {args.model}, dev={dev_type} "
               f"(P100 predictor baselines: b1 113.76, b32 713.17 img/s)")
         for b in args.batches:
-            rate = bench_batch(prefix, data_shape, b, args.iters, dev_type)
+            rate = bench_batch(prefix, data_shape, b, args.iters, dev_type,
+                               dtype=args.dtype)
             line = f"predict_b{b}: {rate:.1f} img/s"
             if args.model == "resnet-50":
                 base = 113.76 if b == 1 else (713.17 if b == 32 else None)
