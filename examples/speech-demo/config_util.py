@@ -1,0 +1,28 @@
+"""Config handling (parity: example/speech-demo/config_util.py — the
+reference drives training from .cfg files with CLI overrides)."""
+import argparse
+import configparser
+import os
+
+
+def parse_args(default_cfg):
+    """--configfile picks the .cfg; any remaining --section_key=value
+    overrides that entry (the reference's override convention)."""
+    ap = argparse.ArgumentParser(
+        description="config-driven speech training",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("--configfile", default=default_cfg)
+    args, overrides = ap.parse_known_args()
+    cfg = configparser.ConfigParser()
+    if not os.path.exists(args.configfile):
+        raise FileNotFoundError(args.configfile)
+    cfg.read(args.configfile)
+    for ov in overrides:
+        if not ov.startswith("--") or "=" not in ov:
+            raise ValueError(f"override must look like --section_key=value: {ov}")
+        key, value = ov[2:].split("=", 1)
+        section, opt = key.split("_", 1)
+        if not cfg.has_section(section):
+            raise ValueError(f"unknown config section {section!r} in {ov}")
+        cfg.set(section, opt, value)
+    return cfg

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Posterior decoding: run the trained acoustic model over a feature scp
+and write frame log-likelihoods as a Kaldi TEXT archive that an external
+decoder (kaldi latgen-faster-mapped) consumes (parity:
+example/speech-demo/decode_mxnet.py + decode_mxnet.sh).
+
+Acoustic-model scaling follows the standard hybrid recipe: output =
+log p(state|x) - log p(state) (posteriors divided by the label priors
+computed from the training alignments).
+
+Usage (after train_lstm_proj.py):
+  python decode.py                          # decodes the dev set
+  python decode.py --scp F --ali A --out O  # any feature scp
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+from config_util import parse_args  # noqa: E402
+from io_util import (add_deltas, apply_cmvn, load_cmvn,  # noqa: E402
+                     read_scp_matrices, read_text_ark, write_text_ark)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def compute_priors(ali_ark, num_states):
+    """State priors from training alignments (decode_mxnet.sh feeds
+    kaldi's class counts; here they come from the same alignment ark)."""
+    counts = np.zeros(num_states)
+    for _, a in read_text_ark(ali_ark):
+        idx, c = np.unique(a[:, 0].astype(np.int64), return_counts=True)
+        counts[idx] += c
+    return counts / counts.sum()
+
+
+def main():
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--scp")
+    ap.add_argument("--out")
+    ap.add_argument("--ali")
+    cli, rest = ap.parse_known_args()
+    sys.argv = [sys.argv[0]] + rest
+    cfg = parse_args(os.path.join(HERE, "default.cfg"))
+
+    work = cfg.get("data", "workdir")
+    scp = cli.scp or os.path.join(work, "dev.scp")
+    ali = cli.ali or os.path.join(work, "train_ali.ark")
+    out = cli.out or os.path.join(work, "dev_loglikes.ark")
+    prefix = cfg.get("train", "checkpoint_prefix")
+    epoch = cfg.getint("train", "num_epochs")
+    num_states = cfg.getint("data", "num_states")
+
+    stats = load_cmvn(os.path.join(work, "cmvn.npy"))
+    log_priors = np.log(compute_priors(ali, num_states) + 1e-10)
+
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, epoch)
+    from mxnet_tpu.predict import Predictor
+
+    # load everything, pad to ONE static length (a single compile —
+    # padding frames are sliced off the output)
+    deltas = cfg.getint("arch", "add_deltas")
+    entries = []
+    for utt, raw in read_scp_matrices(scp):
+        feats = apply_cmvn(raw, stats)
+        if deltas:
+            feats = add_deltas(feats)
+        entries.append((utt, feats))
+    max_t = max(len(f) for _, f in entries)
+    dim = entries[0][1].shape[1]
+    shapes = {"data": (1, max_t, dim)}
+    # initial LSTMP states are inputs of the saved graph; bind batch-1
+    # zeros (they are never fed per utterance)
+    for i in range(cfg.getint("arch", "num_layers")):
+        shapes[f"l{i}_begin_state_0"] = (1, cfg.getint("arch", "num_proj"))
+        shapes[f"l{i}_begin_state_1"] = (1, cfg.getint("arch", "num_hidden"))
+    # the train symbol's label head stays in the graph; bind a zero
+    # label (softmax ignores it at inference)
+    shapes["softmax_label"] = (1, max_t)
+    p = Predictor(
+        symbol=symbol, arg_params=arg_params, aux_params=aux_params,
+        input_shapes=shapes,
+        dev_type=mx.context.default_accelerator_context())
+    loglikes = {}
+    for utt, feats in entries:
+        t = len(feats)
+        buf = np.zeros((1, max_t, dim), np.float32)
+        buf[0, :t] = feats
+        p.forward(data=buf)
+        post = p.get_output(0).reshape(max_t, num_states)[:t]
+        loglikes[utt] = np.log(post + 1e-10) - log_priors
+
+    write_text_ark(out, loglikes)
+    print(f"wrote {len(loglikes)} utterances to {out}")
+
+    # sanity: frame accuracy of argmax loglikes vs alignments when the
+    # scp's alignment ark exists (dev set in the synthetic corpus)
+    dev_ali = os.path.join(work, "dev_ali.ark")
+    if os.path.exists(dev_ali) and scp.endswith("dev.scp"):
+        refs = {u: a[:, 0] for u, a in read_text_ark(dev_ali)}
+        correct = total = 0
+        for utt, ll in loglikes.items():
+            hyp = ll.argmax(axis=1)
+            correct += int((hyp == refs[utt].astype(np.int64)).sum())
+            total += len(hyp)
+        acc = correct / total
+        print(f"frame accuracy from decoded loglikes: {acc:.3f}")
+        assert acc > cfg.getfloat("train", "min_frame_acc"), acc
+        print("DECODE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Speech feature IO (parity: example/speech-demo/io_util.py + io_func/):
+readers/writers for the two standard acoustic-feature containers (HTK
+feature files, Kaldi ark/scp), CMVN statistics, delta/splice transforms,
+and the utterance iterator that feeds BucketingModule.
+
+Everything is implemented from the public format specifications (HTKBook
+§5.10; Kaldi I/O docs) in numpy — no Kaldi/HTK installation needed.
+"""
+from .htk import read_htk, write_htk, PARM_FBANK, PARM_MFCC, PARM_USER
+from .kaldi import (read_ark, read_ark_entry, write_ark, read_scp,
+                    read_scp_matrices, write_text_ark, read_text_ark)
+from .cmvn import (compute_cmvn_stats, compute_cmvn_stats_scp, apply_cmvn,
+                   save_cmvn, load_cmvn)
+from .feats import add_deltas, splice_frames, UtteranceIter

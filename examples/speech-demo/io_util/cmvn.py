@@ -1,0 +1,53 @@
+"""Cepstral mean/variance normalization (parity: the reference's
+make_stats.py computes feature statistics before training; Kaldi's
+compute-cmvn-stats layout is used so stats interoperate).
+
+Stats matrix layout (Kaldi convention): shape (2, D+1) —
+  row 0 = [sum_1..sum_D, frame_count]
+  row 1 = [sumsq_1..sumsq_D, 0]
+"""
+import numpy as np
+
+from .kaldi import read_scp_matrices
+
+
+def compute_cmvn_stats(utts):
+    """Accumulate global stats over {utt: (T, D)} or an iterable of
+    (utt, feats)."""
+    items = utts.items() if hasattr(utts, "items") else utts
+    stats = None
+    for _, feats in items:
+        feats = np.asarray(feats, dtype=np.float64)
+        if stats is None:
+            stats = np.zeros((2, feats.shape[1] + 1))
+        stats[0, :-1] += feats.sum(axis=0)
+        stats[0, -1] += feats.shape[0]
+        stats[1, :-1] += np.square(feats).sum(axis=0)
+    if stats is None:
+        raise ValueError("no utterances")
+    return stats
+
+
+def compute_cmvn_stats_scp(scp_path):
+    """Accumulate stats straight from an scp index (streamed, one open
+    handle per ark)."""
+    return compute_cmvn_stats(read_scp_matrices(scp_path))
+
+
+def apply_cmvn(feats, stats, var_norm=True, floor=1e-8):
+    """Normalize (T, D) features to zero mean (and unit variance)."""
+    count = stats[0, -1]
+    mean = stats[0, :-1] / count
+    out = np.asarray(feats, dtype=np.float32) - mean.astype(np.float32)
+    if var_norm:
+        var = np.maximum(stats[1, :-1] / count - np.square(mean), floor)
+        out /= np.sqrt(var).astype(np.float32)
+    return out
+
+
+def save_cmvn(path, stats):
+    np.save(path, stats)
+
+
+def load_cmvn(path):
+    return np.load(path)

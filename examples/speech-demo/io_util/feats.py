@@ -1,0 +1,127 @@
+"""Feature transforms + the utterance iterator feeding BucketingModule.
+
+Parity: the reference's io_util.py wraps Kaldi/TNet readers into
+TruncatedSentenceIter/SimpleIter with frame labels from alignment files;
+here UtteranceIter buckets whole utterances by length (the TPU-friendly
+choice: a handful of padded static shapes, loss-masked padding, one
+compile per bucket — docs/how_to/bucketing.md) instead of the
+reference's fixed-length truncated-BPTT chopping.
+"""
+import bisect
+
+import numpy as np
+
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+
+
+def add_deltas(feats, order=2, window=2):
+    """Append delta (and delta-delta...) features via the standard
+    regression formula over +/-window frames (HTKBook eq. 5.16)."""
+    feats = np.asarray(feats, dtype=np.float32)
+    blocks = [feats]
+    denom = 2.0 * sum(n * n for n in range(1, window + 1))
+    cur = feats
+    for _ in range(order):
+        padded = np.pad(cur, ((window, window), (0, 0)), mode="edge")
+        delta = np.zeros_like(cur)
+        for n in range(1, window + 1):
+            delta += n * (padded[window + n:len(padded) - window + n]
+                          - padded[window - n:len(padded) - window - n])
+        cur = (delta / denom).astype(np.float32)
+        blocks.append(cur)
+    return np.concatenate(blocks, axis=1)
+
+
+def splice_frames(feats, left=5, right=5):
+    """Stack a context window around every frame (edge-padded) — the
+    standard DNN acoustic-model input transform."""
+    feats = np.asarray(feats, dtype=np.float32)
+    padded = np.pad(feats, ((left, right), (0, 0)), mode="edge")
+    t = len(feats)
+    return np.concatenate(
+        [padded[k:k + t] for k in range(left + right + 1)], axis=1)
+
+
+class UtteranceIter(DataIter):
+    """Bucket whole utterances by length into padded (N, T, D) batches
+    with frame labels (N, T); padding frames carry ``ignore_label`` so
+    the masked softmax drops them from loss and gradient."""
+
+    def __init__(self, utts, labels, batch_size, buckets=None,
+                 ignore_label=-1, data_name="data",
+                 label_name="softmax_label", init_states=None,
+                 shuffle=True):
+        super().__init__()
+        lengths = [len(f) for _, f in utts]
+        if not buckets:
+            buckets = sorted(set(
+                int(np.ceil(l / 10.0) * 10) for l in lengths))
+        self.buckets = sorted(buckets)
+        dim = utts[0][1].shape[1]
+        self.data = [[] for _ in self.buckets]
+        self.label = [[] for _ in self.buckets]
+        ndiscard = 0
+        for (utt, feats), lab in zip(utts, labels):
+            if len(feats) != len(lab):
+                raise ValueError(f"{utt}: {len(feats)} frames vs "
+                                 f"{len(lab)} labels")
+            i = bisect.bisect_left(self.buckets, len(feats))
+            if i == len(self.buckets):
+                ndiscard += 1
+                continue
+            t = self.buckets[i]
+            fbuf = np.zeros((t, dim), np.float32)
+            fbuf[:len(feats)] = feats
+            lbuf = np.full((t,), ignore_label, np.float32)
+            lbuf[:len(lab)] = lab
+            self.data[i].append(fbuf)
+            self.label[i].append(lbuf)
+        if ndiscard:
+            print(f"UtteranceIter: discarded {ndiscard} utterances longer "
+                  f"than the largest bucket ({self.buckets[-1]})")
+        self.data = [np.asarray(b) for b in self.data]
+        self.label = [np.asarray(b) for b in self.label]
+        self.batch_size = batch_size
+        self.ignore_label = ignore_label
+        self.data_name = data_name
+        self.label_name = label_name
+        self.shuffle = shuffle
+        self.default_bucket_key = max(self.buckets)
+        self.init_states = list(init_states or [])
+        self._init_arrays = [nd.array(np.zeros(s, np.float32))
+                             for _, s in self.init_states]
+        self.provide_data = [DataDesc(
+            data_name, (batch_size, self.default_bucket_key, dim))] + \
+            [DataDesc(n, s) for n, s in self.init_states]
+        self.provide_label = [DataDesc(
+            label_name, (batch_size, self.default_bucket_key))]
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend(
+                (i, j) for j in range(0, len(buck) - batch_size + 1,
+                                      batch_size))
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+            for i in range(len(self.data)):
+                perm = np.random.permutation(len(self.data[i]))
+                self.data[i] = self.data[i][perm]
+                self.label[i] = self.label[i][perm]
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        data = self.data[i][j:j + self.batch_size]
+        label = self.label[i][j:j + self.batch_size]
+        return DataBatch(
+            [nd.array(data)] + self._init_arrays, [nd.array(label)], pad=0,
+            bucket_key=self.buckets[i],
+            provide_data=[DataDesc(self.data_name, data.shape)] +
+                         [DataDesc(n, s) for n, s in self.init_states],
+            provide_label=[DataDesc(self.label_name, label.shape)])

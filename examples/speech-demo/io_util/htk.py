@@ -1,0 +1,61 @@
+"""HTK feature-file reader/writer (public format: HTKBook §5.10.1).
+
+Layout: a 12-byte big-endian header — nSamples (int32), sampPeriod
+(int32, 100ns units), sampSize (int16, bytes per frame), parmKind
+(int16) — followed by nSamples frames of big-endian float32.
+
+Parity: the reference's io_func/feat_readers read HTK/TNet feature files
+for its Kaldi-fed speech demo; this module provides the same container
+so features produced by HTK tooling load directly.
+"""
+import struct
+
+import numpy as np
+
+# base parameter kinds (HTKBook table 5.2)
+PARM_WAVEFORM = 0
+PARM_LPC = 1
+PARM_MFCC = 6
+PARM_FBANK = 7
+PARM_MELSPEC = 8
+PARM_USER = 9
+PARM_PLP = 11
+
+# qualifier bits
+Q_E = 0o100      # log energy appended
+Q_D = 0o400      # delta coefficients appended
+Q_A = 0o1000     # acceleration coefficients appended
+Q_Z = 0o4000     # zero-mean normalized
+
+
+def write_htk(path, feats, samp_period=100000, parm_kind=PARM_USER):
+    """Write a (T, D) float array as an HTK feature file.
+
+    samp_period is in 100ns units (100000 = the standard 10ms shift).
+    """
+    feats = np.asarray(feats, dtype=np.float32)
+    if feats.ndim != 2:
+        raise ValueError(f"expected (T, D) features, got {feats.shape}")
+    n, dim = feats.shape
+    with open(path, "wb") as f:
+        f.write(struct.pack(">iihh", n, samp_period, dim * 4, parm_kind))
+        f.write(feats.astype(">f4").tobytes())
+
+
+def read_htk(path):
+    """Read an HTK feature file -> (feats (T, D) float32, samp_period,
+    parm_kind)."""
+    with open(path, "rb") as f:
+        header = f.read(12)
+        if len(header) != 12:
+            raise ValueError(f"{path}: truncated HTK header")
+        n, samp_period, samp_size, parm_kind = struct.unpack(">iihh", header)
+        if samp_size <= 0 or samp_size % 4:
+            raise ValueError(
+                f"{path}: sampSize {samp_size} is not float32 frames "
+                "(compressed (_C) files are not supported)")
+        dim = samp_size // 4
+        data = np.frombuffer(f.read(n * samp_size), dtype=">f4")
+    if data.size != n * dim:
+        raise ValueError(f"{path}: expected {n}x{dim} floats, got {data.size}")
+    return data.reshape(n, dim).astype(np.float32), samp_period, parm_kind

@@ -1,0 +1,161 @@
+"""Kaldi ark/scp float-matrix reader/writer (public format, Kaldi I/O
+docs "The Table concept" / kaldi-matrix binary layout).
+
+Binary archive entry:   <utt_id> <space> \\0B FM <i4:rows> <i4:cols> data
+  - "\\0B" is the binary-mode marker, "FM " the float-matrix token,
+  - each dimension is written as \\x04 (byte count) + int32 LE,
+  - data is row-major float32 LE.
+Text archive entry:     <utt_id>  [\\n  v v v\\n  v v v ]\\n
+Script file (scp) line: <utt_id> <path>:<byte offset of \\0B>
+
+Parity: the reference speech demo trains from Kaldi archives via its
+io_func/ readers; these functions produce/consume the same containers so
+the demo interoperates with Kaldi-prepared data while running without
+Kaldi itself.
+"""
+import struct
+
+import numpy as np
+
+
+def write_ark(ark_path, utts, scp_path=None):
+    """Write {utt_id: (T, D) array} to a binary ark; optionally also an
+    scp index.  Returns {utt_id: offset}."""
+    offsets = {}
+    with open(ark_path, "wb") as f:
+        for utt, feats in utts.items():
+            feats = np.asarray(feats, dtype=np.float32)
+            if feats.ndim != 2:
+                raise ValueError(f"{utt}: expected (T, D), got {feats.shape}")
+            f.write(utt.encode() + b" ")
+            offsets[utt] = f.tell()
+            f.write(b"\0BFM ")
+            f.write(b"\x04" + struct.pack("<i", feats.shape[0]))
+            f.write(b"\x04" + struct.pack("<i", feats.shape[1]))
+            f.write(feats.astype("<f4").tobytes())
+    if scp_path:
+        with open(scp_path, "w") as f:
+            for utt, off in offsets.items():
+                f.write(f"{utt} {ark_path}:{off}\n")
+    return offsets
+
+
+def _read_entry_at(f):
+    """Read one binary matrix at the current position (after the id)."""
+    marker = f.read(2)
+    if marker != b"\0B":
+        raise ValueError(f"bad binary marker {marker!r}")
+    token = f.read(3)
+    if token != b"FM ":
+        raise ValueError(f"unsupported kaldi type token {token!r}")
+    sizes = []
+    for _ in range(2):
+        nb = f.read(1)
+        if nb != b"\x04":
+            raise ValueError("bad dimension byte-count")
+        sizes.append(struct.unpack("<i", f.read(4))[0])
+    rows, cols = sizes
+    data = np.frombuffer(f.read(rows * cols * 4), dtype="<f4")
+    if data.size != rows * cols:
+        raise ValueError("truncated matrix data")
+    return data.reshape(rows, cols).astype(np.float32)
+
+
+def read_ark(ark_path):
+    """Stream a binary ark -> yields (utt_id, feats)."""
+    with open(ark_path, "rb") as f:
+        while True:
+            utt = bytearray()
+            while True:
+                c = f.read(1)
+                if not c:
+                    return
+                if c == b" ":
+                    break
+                utt += c
+            yield utt.decode(), _read_entry_at(f)
+
+
+def read_ark_entry(ark_path, offset):
+    """Random access via an scp offset."""
+    with open(ark_path, "rb") as f:
+        f.seek(offset)
+        return _read_entry_at(f)
+
+
+def read_scp_matrices(scp_path):
+    """Yield (utt_id, feats) for every scp entry in order, keeping one
+    open handle per distinct ark (a real corpus has thousands of
+    utterances per archive — one open/seek cycle per utterance is O(N)
+    syscall churn read_ark_entry callers should avoid)."""
+    handles = {}
+    try:
+        for utt, path, off in read_scp(scp_path):
+            f = handles.get(path)
+            if f is None:
+                f = handles[path] = open(path, "rb")
+            f.seek(off)
+            yield utt, _read_entry_at(f)
+    finally:
+        for f in handles.values():
+            f.close()
+
+
+def read_scp(scp_path):
+    """Read an scp file -> list of (utt_id, ark_path, offset)."""
+    entries = []
+    with open(scp_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            utt, loc = line.split(None, 1)
+            path, off = loc.rsplit(":", 1)
+            entries.append((utt, path, int(off)))
+    return entries
+
+
+def write_text_ark(path, utts):
+    """Write {utt_id: (T, D)} as a Kaldi text archive (the format
+    `copy-feats ark:- ark,t:-` emits; also what the decode step writes
+    so Kaldi's latgen reads our posteriors)."""
+    with open(path, "w") as f:
+        for utt, feats in utts.items():
+            feats = np.asarray(feats, dtype=np.float32)
+            if len(feats) == 0:
+                f.write(f"{utt}  [ ]\n")
+                continue
+            f.write(f"{utt}  [\n")
+            for i, row in enumerate(feats):
+                end = " ]" if i == len(feats) - 1 else ""
+                f.write("  " + " ".join(f"{v:.7g}" for v in row) + end + "\n")
+
+
+def read_text_ark(path):
+    """Read a Kaldi text archive -> yields (utt_id, feats)."""
+    with open(path) as f:
+        utt, rows = None, []
+        for line in f:
+            line = line.strip()
+            if utt is None:
+                if not line:
+                    continue
+                utt, bracket = line.split(None, 1)
+                bracket = bracket.strip()
+                if bracket == "[ ]":  # empty matrix, kaldi inline form
+                    yield utt, np.zeros((0, 0), dtype=np.float32)
+                    utt = None
+                    continue
+                if bracket != "[":
+                    raise ValueError(f"{utt}: expected '[', got {bracket!r}")
+                rows = []
+            else:
+                done = line.endswith("]")
+                line = line[:-1].strip() if done else line
+                if line:
+                    rows.append([float(v) for v in line.split()])
+                if done:
+                    yield utt, np.asarray(rows, dtype=np.float32)
+                    utt, rows = None, []
+        if utt is not None:
+            raise ValueError(f"{utt}: unterminated matrix")

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Compute CMVN statistics from a feature scp (parity:
+example/speech-demo/make_stats.py — the reference computes feature
+stats before training; stats use the Kaldi (2, D+1) layout).
+
+Usage: python make_stats.py --scp /path/feats.scp --out /path/cmvn.npy
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from io_util import compute_cmvn_stats_scp, save_cmvn  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scp", required=True)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+    stats = compute_cmvn_stats_scp(args.scp)
+    save_cmvn(args.out, stats)
+    count = stats[0, -1]
+    print(f"accumulated {int(count)} frames, dim {stats.shape[1] - 1}")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -141,10 +141,15 @@ class CompositeEvalMetric(EvalMetric):
 
 
 class Accuracy(EvalMetric):
-    """Parity: metric.py Accuracy — argmax over axis 1 when needed."""
+    """Parity: metric.py Accuracy — argmax over axis 1 when needed.
 
-    def __init__(self):
+    ``ignore_label`` drops masked entries (padding frames in bucketed
+    sequence training) from both numerator and denominator, pairing with
+    SoftmaxOutput(use_ignore=True)."""
+
+    def __init__(self, ignore_label=None):
         super().__init__("accuracy")
+        self.ignore_label = ignore_label
 
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
@@ -155,6 +160,9 @@ class Accuracy(EvalMetric):
                 pred_np = pred_np.argmax(axis=1)
             pred_np = pred_np.astype(np.int32).reshape(-1)
             label_np = label_np.reshape(-1)
+            if self.ignore_label is not None:
+                keep = label_np != self.ignore_label
+                pred_np, label_np = pred_np[keep], label_np[keep]
             self.sum_metric += float((pred_np == label_np).sum())
             self.num_inst += len(label_np)
 

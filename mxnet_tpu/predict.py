@@ -201,11 +201,19 @@ class Predictor:
 
     def _upload_input(self, name, value):
         """Single host→device transfer straight onto the bound array's
-        device — no eager broadcast op, no default-device detour."""
+        device — no eager broadcast op, no default-device detour.
+
+        The host value is copied first: jax's cpu backend may alias a
+        numpy buffer zero-copy into the device array, so without the
+        copy a caller that mutates (or frees — the C ABI case) its
+        buffer after set_input would corrupt the bound input.  The copy
+        restores the old ``arr[:] = value`` semantics at memcpy cost,
+        negligible next to the transfer it precedes."""
         import jax
 
         arr, value = self._coerce_input(name, value)
-        arr._set(jax.device_put(value, arr._read().sharding))
+        arr._set(jax.device_put(np.array(value, copy=True),
+                                arr._read().sharding))
 
     def set_input(self, name, value):
         """Parity: MXPredSetInput."""

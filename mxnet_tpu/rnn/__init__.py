@@ -1,5 +1,6 @@
 """RNN toolkit (parity: python/mxnet/rnn/)."""
 from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
-                       FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
-                       RNNCell, RNNParams, SequentialRNNCell, ZoneoutCell)
+                       FusedRNNCell, GRUCell, LSTMCell, LSTMPCell,
+                       ModifierCell, RNNCell, RNNParams, SequentialRNNCell,
+                       ZoneoutCell)
 from .io import BucketSentenceIter

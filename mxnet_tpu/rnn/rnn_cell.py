@@ -127,6 +127,24 @@ class RNNCell(BaseRNNCell):
         return output, [output]
 
 
+def _lstm_step(name, inputs, prev_h, prev_c, num_hidden, iW, iB, hW, hB):
+    """The shared LSTM recurrence (gate order i, g(tanh), f, o):
+    returns (next_h, next_c)."""
+    i2h = symbol.FullyConnected(inputs, weight=iW, bias=iB,
+                                num_hidden=num_hidden * 4, name=f"{name}i2h")
+    h2h = symbol.FullyConnected(prev_h, weight=hW, bias=hB,
+                                num_hidden=num_hidden * 4, name=f"{name}h2h")
+    slice_gates = symbol.SliceChannel(i2h + h2h, num_outputs=4,
+                                      name=f"{name}slice")
+    in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid")
+    in_transform = symbol.Activation(slice_gates[1], act_type="tanh")
+    forget_gate = symbol.Activation(slice_gates[2], act_type="sigmoid")
+    out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid")
+    next_c = forget_gate * prev_c + in_gate * in_transform
+    next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
+    return next_h, next_c
+
+
 class LSTMCell(BaseRNNCell):
     """LSTM cell (parity: rnn_cell.py LSTMCell:224; gate order i,g,f,o)."""
 
@@ -149,19 +167,43 @@ class LSTMCell(BaseRNNCell):
     def __call__(self, inputs, states):
         self._counter += 1
         name = f"{self._prefix}t{self._counter}_"
-        i2h = symbol.FullyConnected(inputs, weight=self._iW, bias=self._iB,
-                                    num_hidden=self._num_hidden * 4, name=f"{name}i2h")
-        h2h = symbol.FullyConnected(states[0], weight=self._hW, bias=self._hB,
-                                    num_hidden=self._num_hidden * 4, name=f"{name}h2h")
-        gates = i2h + h2h
-        slice_gates = symbol.SliceChannel(gates, num_outputs=4, name=f"{name}slice")
-        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid")
-        in_transform = symbol.Activation(slice_gates[1], act_type="tanh")
-        forget_gate = symbol.Activation(slice_gates[2], act_type="sigmoid")
-        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid")
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
+        next_h, next_c = _lstm_step(name, inputs, states[0], states[1],
+                                    self._num_hidden, self._iW, self._iB,
+                                    self._hW, self._hB)
         return next_h, [next_h, next_c]
+
+
+class LSTMPCell(BaseRNNCell):
+    """LSTM with a linear projection of the hidden state (LSTMP,
+    Sak et al. 2014 — the acoustic-model cell the reference builds
+    inline in example/speech-demo/lstm_proj.py:49-56): the recurrence
+    and the output both use ``r_t = W_r h_t`` with ``num_proj`` units,
+    shrinking the h2h matmul from H×4H to P×4H.  State = [r, c]."""
+
+    def __init__(self, num_hidden, num_proj, prefix="lstmp_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_proj = num_proj
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+        self._pW = self.params.get("proj_weight")
+
+    @property
+    def state_shape(self):
+        return [(0, self._num_proj), (0, self._num_hidden)]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        next_h, next_c = _lstm_step(name, inputs, states[0], states[1],
+                                    self._num_hidden, self._iW, self._iB,
+                                    self._hW, self._hB)
+        next_r = symbol.FullyConnected(next_h, weight=self._pW, no_bias=True,
+                                       num_hidden=self._num_proj,
+                                       name=f"{name}proj")
+        return next_r, [next_r, next_c]
 
 
 class GRUCell(BaseRNNCell):

@@ -156,16 +156,13 @@ int MXPredSetInput(void *handle, const char *key, const float *data,
   Py_DECREF(arr);
   if (!reshaped) return Fail("reshape");
   // the frombuffer view points at the CALLER's memory with no ownership;
-  // jax's cpu backend may alias host buffers zero-copy into the device
-  // array, so the value must be copied into a python-owned buffer before
-  // the caller is allowed to free theirs (observed: intermittent
-  // zero-weight forwards when the freed buffer's pages were reused)
-  PyObject *owned = PyObject_CallMethod(reshaped, "copy", nullptr);
-  Py_DECREF(reshaped);
-  if (!owned) return Fail("copy input");
+  // Predictor.set_input copies it into python-owned memory before the
+  // device upload (jax's cpu backend may alias host buffers zero-copy —
+  // observed as intermittent zero-weight forwards when a freed caller
+  // buffer's pages were reused), so the view is safe to hand over
   PyObject *r = PyObject_CallMethod(h->predictor, "set_input", "sO", key,
-                                    owned);
-  Py_DECREF(owned);
+                                    reshaped);
+  Py_DECREF(reshaped);
   if (!r) return Fail("set_input");
   Py_DECREF(r);
   return 0;

@@ -1,0 +1,200 @@
+"""Speech-demo subsystem tests: feature container round-trips (HTK,
+Kaldi ark/scp, text ark), CMVN, delta/splice transforms, the LSTMP cell,
+the scheduled-momentum optimizer, and the utterance bucketing iterator.
+
+Parity model: the reference ships io_func/feat_readers with
+tests/test_system.py reading prepared feature files
+(example/speech-demo/tests/test_system.py); here the files are written
+by our own writers first, so both directions are pinned.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+SPEECH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "speech-demo")
+sys.path.insert(0, SPEECH)
+
+from io_util import (  # noqa: E402
+    UtteranceIter, add_deltas, apply_cmvn, compute_cmvn_stats,
+    compute_cmvn_stats_scp, read_ark, read_ark_entry, read_htk, read_scp,
+    read_text_ark, splice_frames, write_ark, write_htk, write_text_ark)
+
+
+def _feats(rs, t, d=8):
+    return rs.randn(t, d).astype(np.float32)
+
+
+def test_htk_roundtrip(tmp_path):
+    rs = np.random.RandomState(0)
+    x = _feats(rs, 17, 13)
+    path = str(tmp_path / "a.fea")
+    write_htk(path, x, samp_period=100000, parm_kind=9)
+    y, period, kind = read_htk(path)
+    np.testing.assert_array_equal(x, y)
+    assert period == 100000 and kind == 9
+    # header is genuinely big-endian HTK: first int32 BE == nSamples
+    raw = open(path, "rb").read()
+    assert int.from_bytes(raw[:4], "big") == 17
+
+
+def test_kaldi_binary_ark_roundtrip(tmp_path):
+    rs = np.random.RandomState(1)
+    utts = {f"u{i}": _feats(rs, 5 + i) for i in range(4)}
+    ark = str(tmp_path / "f.ark")
+    scp = str(tmp_path / "f.scp")
+    write_ark(ark, utts, scp)
+    back = dict(read_ark(ark))
+    assert list(back) == list(utts)
+    for u in utts:
+        np.testing.assert_array_equal(utts[u], back[u])
+    # random access through the scp index
+    entries = read_scp(scp)
+    assert [u for u, _, _ in entries] == list(utts)
+    for u, path, off in entries:
+        np.testing.assert_array_equal(read_ark_entry(path, off), utts[u])
+
+
+def test_kaldi_text_ark_roundtrip(tmp_path):
+    rs = np.random.RandomState(2)
+    utts = {"a": _feats(rs, 3, 4), "empty": np.zeros((0, 4), np.float32),
+            "b": _feats(rs, 6, 4)}
+    path = str(tmp_path / "t.ark")
+    write_text_ark(path, utts)
+    back = dict(read_text_ark(path))
+    assert list(back) == list(utts)
+    for u in ("a", "b"):
+        np.testing.assert_allclose(utts[u], back[u], rtol=1e-5)
+    assert back["empty"].size == 0
+
+
+def test_kaldi_scp_streaming_matches_random_access(tmp_path):
+    from io_util import read_scp_matrices
+
+    rs = np.random.RandomState(8)
+    utts = {f"u{i}": _feats(rs, 4 + i) for i in range(5)}
+    ark, scp = str(tmp_path / "s.ark"), str(tmp_path / "s.scp")
+    write_ark(ark, utts, scp)
+    streamed = dict(read_scp_matrices(scp))
+    assert list(streamed) == list(utts)
+    for u in utts:
+        np.testing.assert_array_equal(streamed[u], utts[u])
+
+
+def test_cmvn(tmp_path):
+    rs = np.random.RandomState(3)
+    utts = {f"u{i}": _feats(rs, 50, 6) * 3.0 + 5.0 for i in range(3)}
+    stats = compute_cmvn_stats(utts)
+    assert stats.shape == (2, 7) and stats[0, -1] == 150
+    allf = np.concatenate([apply_cmvn(f, stats) for f in utts.values()])
+    np.testing.assert_allclose(allf.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(allf.std(axis=0), 1.0, atol=1e-3)
+    # scp-driven accumulation matches in-memory accumulation
+    ark, scp = str(tmp_path / "c.ark"), str(tmp_path / "c.scp")
+    write_ark(ark, utts, scp)
+    np.testing.assert_allclose(stats, compute_cmvn_stats_scp(scp), rtol=1e-6)
+
+
+def test_deltas_and_splice():
+    rs = np.random.RandomState(4)
+    x = _feats(rs, 12, 5)
+    d = add_deltas(x, order=2)
+    assert d.shape == (12, 15)
+    np.testing.assert_array_equal(d[:, :5], x)
+    # constant signal -> zero deltas
+    const = np.ones((8, 3), np.float32)
+    np.testing.assert_allclose(add_deltas(const)[:, 3:], 0.0, atol=1e-7)
+    # ramp -> constant first delta in the interior
+    ramp = np.arange(20, dtype=np.float32)[:, None]
+    dd = add_deltas(ramp, order=1, window=2)
+    np.testing.assert_allclose(dd[4:-4, 1], 1.0, atol=1e-5)
+    s = splice_frames(x, left=2, right=2)
+    assert s.shape == (12, 25)
+    np.testing.assert_array_equal(s[3, 10:15], x[3])  # center block
+    np.testing.assert_array_equal(s[0, 0:5], x[0])    # edge-padded
+
+
+def test_utterance_iter_buckets_and_masking():
+    rs = np.random.RandomState(5)
+    utts = [(f"u{i}", _feats(rs, int(rs.randint(8, 25)), 6))
+            for i in range(40)]
+    labels = [rs.randint(0, 3, len(f)).astype(np.float32)
+              for _, f in utts]
+    it = UtteranceIter(utts, labels, batch_size=4, buckets=[10, 25],
+                       ignore_label=-1, shuffle=False)
+    seen = 0
+    for batch in it:
+        t = batch.bucket_key
+        data = batch.data[0].asnumpy()
+        lab = batch.label[0].asnumpy()
+        assert data.shape == (4, t, 6) and lab.shape == (4, t)
+        # padding frames are ignore-labeled and zero-featured
+        for r in range(4):
+            pad = lab[r] == -1
+            assert np.all(data[r][pad] == 0)
+        seen += 1
+    assert seen == it.curr_idx and seen > 0
+
+
+def test_lstmp_cell_projection_shapes_and_grads():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMPCell(16, 6, prefix="l0_"))
+    outputs, states = stack.unroll(4, inputs=sym.Variable("data"),
+                                   layout="NTC", merge_outputs=True)
+    net = sym.MakeLoss(sym.sum(outputs))
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 4, 5),
+                         l0_begin_state_0=(2, 6), l0_begin_state_1=(2, 16))
+    assert ex.arg_dict["l0_h2h_weight"].shape == (64, 6)   # 4H x P
+    assert ex.arg_dict["l0_proj_weight"].shape == (6, 16)  # P x H
+    rs = np.random.RandomState(6)
+    for k, v in ex.arg_dict.items():
+        if "state" not in k:
+            v[:] = rs.uniform(-0.3, 0.3, v.shape)
+    ex.forward(is_train=True)
+    ex.backward()
+    for k in ("l0_i2h_weight", "l0_h2h_weight", "l0_proj_weight"):
+        assert float(np.abs(ex.grad_dict[k].asnumpy()).sum()) > 0, k
+    # the output is the projection: last dim P, not H
+    assert ex.outputs[0].shape == ()
+
+
+def test_speech_sgd_matches_sgd_without_schedule():
+    import speech_sgd  # noqa: F401 — registers
+
+    rs = np.random.RandomState(7)
+    w0 = rs.uniform(-1, 1, (5, 3)).astype(np.float32)
+    grads = [rs.uniform(-1, 1, (5, 3)).astype(np.float32) for _ in range(4)]
+
+    def run(name):
+        o = mx.optimizer.create(name, learning_rate=0.1, momentum=0.9)
+        w = mx.nd.array(w0.copy())
+        state = o.create_state(0, w)
+        for g in grads:
+            o.update(0, w, mx.nd.array(g), state)
+        return w.asnumpy()
+
+    np.testing.assert_allclose(run("speechsgd"), run("sgd"), rtol=1e-6)
+
+
+def test_speech_sgd_scheduled_momentum():
+    from speech_sgd import EpochScheduler
+
+    o = mx.optimizer.create("speechsgd", learning_rate=0.1,
+                            lr_scheduler=EpochScheduler(momentum=0.9, ramp=3))
+    w = mx.nd.array(np.zeros((2,), np.float32))
+    state = o.create_state(0, w)
+    g = mx.nd.array(np.ones((2,), np.float32))
+    # num_update counts 1-based: updates 1,2 < ramp -> momentum off,
+    # plain sgd steps of -0.1 (the momentum buffer still accumulates)
+    o.update(0, w, g, state)
+    o.update(0, w, g, state)
+    np.testing.assert_allclose(w.asnumpy(), -0.2, rtol=1e-6)
+    # update 3: momentum on -> mom = 0.9*prev(=1.0) + grad
+    o.update(0, w, g, state)
+    np.testing.assert_allclose(w.asnumpy(), -0.2 - 0.1 * (0.9 + 1.0),
+                               rtol=1e-5)

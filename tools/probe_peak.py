@@ -1,0 +1,76 @@
+"""Probe: the chip's two roofline corners, measured not quoted.
+
+1. MXU corner — dense bf16 matmul MFU at sizes from 2k to 8k: how close
+   can ANY program get to the datasheet peak (v5e: 197 TFLOP/s)?
+2. HBM corner — streaming read+write bandwidth via y = a*x + y over
+   arrays far larger than VMEM (v5e datasheet: 819 GB/s).
+
+Together with tools/probe_nhwc.py (the ResNet-50 train step itself)
+these pin where that workload sits on the roofline: if matmul MFU is
+high and the train step's implied bytes/s ~= the measured stream
+bandwidth, the step is HBM-bound and its MFU ceiling is a property of
+the workload's arithmetic intensity, not the framework.
+
+Run on a chip:  python tools/probe_peak.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK_TFLOPS = 197.0   # v5e bf16 datasheet
+PEAK_GBS = 819.0      # v5e HBM datasheet
+
+
+def matmul_mfu(n, iters=20):
+    a = jnp.asarray(np.random.RandomState(0).normal(size=(n, n)),
+                    jnp.bfloat16)
+    b = jnp.asarray(np.random.RandomState(1).normal(size=(n, n)),
+                    jnp.bfloat16)
+
+    @jax.jit
+    def chain(a, b):
+        # two chained matmuls so the loop body can't be folded away
+        c = jax.lax.dot(a, b, preferred_element_type=jnp.float32)
+        return c.astype(jnp.bfloat16)
+
+    out = chain(a, b)
+    jax.block_until_ready(out)
+    tic = time.perf_counter()
+    for _ in range(iters):
+        out = chain(out, b)
+    _ = float(jnp.asarray(out[0, 0], jnp.float32))  # fetch = real barrier
+    dt = time.perf_counter() - tic
+    tflops = 2.0 * n * n * n * iters / dt / 1e12
+    print(f"matmul {n}x{n}x{n} bf16: {tflops:8.1f} TFLOP/s  "
+          f"mfu={tflops / PEAK_TFLOPS:.3f}", flush=True)
+
+
+def hbm_bandwidth(mb=512, iters=30):
+    n = mb * 1024 * 1024 // 4
+    x = jnp.zeros((n,), jnp.float32)
+    y = jnp.ones((n,), jnp.float32)
+
+    @jax.jit
+    def axpy(x, y):
+        return 1.0001 * x + y
+
+    out = axpy(x, y)
+    jax.block_until_ready(out)
+    tic = time.perf_counter()
+    for _ in range(iters):
+        out = axpy(out, y)
+    _ = float(out[0])
+    dt = time.perf_counter() - tic
+    # per iter: read x, read y, write out = 3 * mb
+    gbs = 3 * mb * iters / 1024 / dt
+    print(f"hbm axpy {mb}MB: {gbs:8.1f} GB/s  "
+          f"of datasheet {PEAK_GBS:.0f} ({gbs / PEAK_GBS:.2f})", flush=True)
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices(), flush=True)
+    for n in (2048, 4096, 8192):
+        matmul_mfu(n)
+    hbm_bandwidth()

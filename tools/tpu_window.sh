@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-4 second TPU window: the follow-up payloads after the headline
+# bench landed (tools/tpu_watch.sh attempt 1, docs/measured/).  Runs each
+# payload once when the backend answers, writing per-payload output files:
+#
+#   peak     - tools/probe_peak.py       (MXU + HBM roofline corners)
+#   profile  - tools/probe_profile.py    (xprof op-level time split)
+#   predict  - tools/bench_predict.py    (single-dispatch path, f32 + bf16)
+#
+# Usage: nohup setsid bash tools/tpu_window.sh >/tmp/tpu_window/driver.log 2>&1 &
+OUT=/tmp/tpu_window
+mkdir -p "$OUT"
+cd /root/repo || exit 1
+export PYTHONPATH=/root/.axon_site:/root/repo
+export JAX_PLATFORMS=axon
+
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  echo "[window] attempt $attempt $(date -u +%H:%M:%S)" >> "$OUT/driver.log"
+  timeout 600 env BENCH_DEVICE_CHECK=1 BENCH_INIT_TIMEOUT_S=560 \
+    python bench.py > "$OUT/probe" 2>&1
+  if ! grep -q '"device_check"' "$OUT/probe"; then
+    echo "[window] attempt $attempt: backend down" >> "$OUT/driver.log"
+    sleep 120
+    continue
+  fi
+  echo "[window] attempt $attempt: BACKEND UP" >> "$OUT/driver.log"
+
+  [ -f "$OUT/peak.ok" ] || { timeout 900 python tools/probe_peak.py \
+      > "$OUT/peak" 2>&1 && grep -q "hbm axpy" "$OUT/peak" \
+      && touch "$OUT/peak.ok"; }
+  [ -f "$OUT/predict.ok" ] || { { timeout 900 python tools/bench_predict.py \
+      --iters 20 > "$OUT/predict" 2>&1 \
+      && timeout 900 python tools/bench_predict.py --iters 20 \
+         --dtype bfloat16 >> "$OUT/predict" 2>&1; } \
+      && grep -q "predict_b32" "$OUT/predict" && touch "$OUT/predict.ok"; }
+  [ -f "$OUT/profile.ok" ] || { timeout 1200 python tools/probe_profile.py \
+      > "$OUT/profile" 2>&1 && grep -q "wrote" "$OUT/profile" \
+      && touch "$OUT/profile.ok"; }
+
+  if [ -f "$OUT/peak.ok" ] && [ -f "$OUT/predict.ok" ] && [ -f "$OUT/profile.ok" ]; then
+    echo "[window] attempt $attempt: ALL DONE" >> "$OUT/driver.log"
+    exit 0
+  fi
+  echo "[window] attempt $attempt: partial, retrying" >> "$OUT/driver.log"
+  sleep 120
+done
