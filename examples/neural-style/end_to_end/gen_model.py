@@ -1,0 +1,58 @@
+"""Feed-forward style-transfer generator (parity:
+example/neural-style/end_to_end/{basic,gen_v3,gen_v4}.py — the
+reference's trained generators that replace per-image optimization
+with one forward pass).
+
+Architecture (the Johnson-et-al shape the reference's gen_v4
+approximates): reflection-ish padded conv stem, two stride-2
+downsamples, residual blocks, two deconv upsamples, tanh output scaled
+to the vgg-normalized range.  InstanceNorm throughout — the
+style-transfer-critical normalization (batch stats bleed styles across
+images).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+from mxnet_tpu import sym  # noqa: E402
+
+
+def _conv_in_relu(x, num_filter, kernel, stride, name):
+    pad = (kernel // 2, kernel // 2)
+    x = sym.Convolution(x, kernel=(kernel, kernel), stride=(stride, stride),
+                        pad=pad, num_filter=num_filter, name=f"{name}_conv")
+    x = sym.InstanceNorm(x, name=f"{name}_in")
+    return sym.Activation(x, act_type="relu", name=f"{name}_relu")
+
+
+def _res_block(x, num_filter, name):
+    h = _conv_in_relu(x, num_filter, 3, 1, f"{name}_a")
+    h = sym.Convolution(h, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                        num_filter=num_filter, name=f"{name}_b_conv")
+    h = sym.InstanceNorm(h, name=f"{name}_b_in")
+    return x + h
+
+
+def _deconv_in_relu(x, num_filter, name):
+    x = sym.Deconvolution(x, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                          num_filter=num_filter, name=f"{name}_deconv")
+    x = sym.InstanceNorm(x, name=f"{name}_in")
+    return sym.Activation(x, act_type="relu", name=f"{name}_relu")
+
+
+def generator(prefix="g", base=16, n_res=3, out_scale=150.0):
+    """data (N,3,H,W) -> stylized (N,3,H,W), vgg-normalized range."""
+    data = sym.Variable("data")
+    x = _conv_in_relu(data, base, 9, 1, f"{prefix}0")
+    x = _conv_in_relu(x, base * 2, 3, 2, f"{prefix}1")
+    x = _conv_in_relu(x, base * 4, 3, 2, f"{prefix}2")
+    for i in range(n_res):
+        x = _res_block(x, base * 4, f"{prefix}res{i}")
+    x = _deconv_in_relu(x, base * 2, f"{prefix}3")
+    x = _deconv_in_relu(x, base, f"{prefix}4")
+    x = sym.Convolution(x, kernel=(9, 9), stride=(1, 1), pad=(4, 4),
+                        num_filter=3, name=f"{prefix}out_conv")
+    return out_scale * sym.Activation(x, act_type="tanh",
+                                      name=f"{prefix}out_tanh")

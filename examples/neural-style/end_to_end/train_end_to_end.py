@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Train a feed-forward style generator against a fixed perceptual loss
+(parity: example/neural-style/end_to_end/train.py — the reference
+chains a generator executor into the VGG descriptor executor and routes
+the style/content gradients back through the generator; same two-
+executor manual grad routing here).
+
+  content batch -> generator -> stylized image
+                                  |  (grad w.r.t. data flows back)
+                stylized image -> VGG loss graph (style grams fixed from
+                                  ONE style image; content target = the
+                                  input batch's own VGG features)
+
+After training, stylize.py runs the saved generator on held-out images
+in one forward.  Synthetic content/style images keep it standalone;
+point --params at converted VGG weights and feed real images for the
+real recipe.
+"""
+import argparse
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", "..", ".."))
+sys.path.insert(0, os.path.join(HERE, ".."))
+sys.path.insert(0, HERE)
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+from gen_model import generator  # noqa: E402
+from neural_style import (MEAN, make_loss, synth_images,  # noqa: E402
+                          vgg19_features)
+
+
+def synth_content_batch(rs, n, size):
+    """Random checkerboards/stripes with varying phase and scale."""
+    out = np.zeros((n, 3, size, size), np.float32)
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        kind = rs.randint(3)
+        period = int(rs.randint(8, 24))
+        phase = int(rs.randint(period))
+        if kind == 0:
+            base = 80.0 * (((xx + yy + phase) % period) < period // 2) - 40.0
+        elif kind == 1:
+            base = 80.0 * (((xx + phase) % period) < period // 2) - 40.0
+        else:
+            base = 60.0 * np.sin((yy + phase) / (period / 6.0))
+        out[i] = base + rs.randn(3, size, size) * 5.0
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=48)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=120)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--style-weight", type=float, default=1.0)
+    ap.add_argument("--content-weight", type=float, default=4.0)
+    ap.add_argument("--tv-weight", type=float, default=1e-4)
+    ap.add_argument("--prefix", default="/tmp/fast_style/gen")
+    args = ap.parse_args()
+    if args.size % 4:
+        ap.error(f"--size must be a multiple of 4 (two stride-2 "
+                 f"down/upsamples); got {args.size}")
+    rs = np.random.RandomState(0)
+    ctx = mx.context.default_accelerator_context()
+    shape = (args.batch, 3, args.size, args.size)
+
+    # ---- fixed descriptor: VGG feature extractor + perceptual loss ----
+    style_feats, content_feat = vgg19_features()
+    fe = sym.Group(list(style_feats) + [content_feat]).simple_bind(
+        ctx=ctx, grad_req="null", data=shape)
+    init = mx.init.Xavier()
+    vgg_weights = {}
+    for name, arr in fe.arg_dict.items():
+        if name != "data":
+            init(name, arr)
+            vgg_weights[name] = arr.asnumpy()
+
+    def extract(imgs):
+        fe.forward(is_train=False, data=imgs)
+        outs = [o.asnumpy() for o in fe.outputs]
+        grams = []
+        for f in outs[:-1]:
+            flat = f.reshape(f.shape[0], f.shape[1], -1)
+            grams.append(np.matmul(flat, flat.transpose(0, 2, 1))
+                         .mean(axis=0, keepdims=True))
+        return grams, outs[-1]
+
+    _, style_img = synth_images(rs, args.size)
+    style_grams, _ = extract(np.repeat(style_img, args.batch, axis=0))
+
+    loss = make_loss(style_feats, content_feat, args.style_weight,
+                     args.content_weight, args.tv_weight)
+    lshapes = {"data": shape,
+               "content": (args.batch,) + fe.outputs[-1].shape[1:]}
+    # style targets are the ONE style image's grams repeated per sample
+    for i, g in enumerate(style_grams):
+        lshapes[f"sgram{i}"] = (args.batch,) + g.shape[1:]
+    dex = loss.simple_bind(ctx=ctx, grad_req={"data": "write"}, **lshapes)
+    for name, w in vgg_weights.items():
+        dex.arg_dict[name][:] = w
+    for i, g in enumerate(style_grams):
+        dex.arg_dict[f"sgram{i}"][:] = np.repeat(g, args.batch, axis=0)
+
+    # ---- trainable generator module: the RAW symbol, so backward()
+    # takes the descriptor's dLoss/dImage as its head gradient (MakeLoss
+    # would override it with ones — the dcgan example's routing) ----
+    gen = generator()
+    gmod = mx.mod.Module(gen, context=ctx,
+                         data_names=("data",), label_names=())
+    gmod.bind(data_shapes=[("data", shape)], label_shapes=None,
+              for_training=True)
+    gmod.init_params(mx.init.Xavier())
+    gmod.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr})
+
+    first = last = None
+    for it in range(args.iters):
+        batch = synth_content_batch(rs, args.batch, args.size)
+        _, content_tgt = extract(batch)
+        dex.arg_dict["content"][:] = content_tgt
+
+        gmod.forward(mx.io.DataBatch(data=[mx.nd.array(batch)], label=None),
+                     is_train=True)
+        stylized = gmod.get_outputs()[0]
+
+        dex.arg_dict["data"][:] = stylized
+        dex.forward(is_train=True)
+        dex.backward()
+        grad = dex.grad_dict["data"]
+
+        gmod.backward(out_grads=[grad])
+        gmod.update()
+
+        last = float(dex.outputs[0].asnumpy())
+        if it == 0:
+            first = last
+        if it % 20 == 0:
+            print(f"iter {it}: perceptual loss {last:.1f}")
+
+    os.makedirs(os.path.dirname(args.prefix), exist_ok=True)
+    arg_params, aux_params = gmod.get_params()
+    mx.model.save_checkpoint(args.prefix, args.iters, sym.Group([gen]),
+                             arg_params, aux_params)
+    print(f"first {first:.1f} last {last:.1f}")
+    assert last < first * 0.7, (first, last)
+    print("E2E TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,20 @@
 #!/usr/bin/env python
-"""Neural style transfer (parity: example/neural-style/).
+"""Neural style transfer (parity: example/neural-style/nstyle.py +
+model_vgg19.py): optimize the INPUT image against a fixed VGG-19 conv
+trunk — Gram-matrix style losses on relu1_1/2_1/3_1/4_1, content loss
+on relu4_2, total-variation regularization, Adam on the image with a
+factor lr schedule, and early stop on relative image change
+(nstyle.py's stop_eps).
 
-The reference optimizes the INPUT image against a fixed conv net:
-content loss on deep features, style loss on Gram matrices of shallower
-features, gradients taken w.r.t. the image (inputs_need_grad / arg grad
-on 'data').  Same structure here with a small random-weight encoder
-(random conv features famously suffice for the loss geometry) and
-synthetic content/style images, so the demo is self-contained.
+TPU-first notes: the whole objective INCLUDING the TV term is one
+compiled loss graph (the reference computes the TV gradient with a
+separate hand-rolled depthwise conv kernel each step); the image update
+runs through the framework's Adam.  Without a downloaded checkpoint the
+trunk uses Xavier random weights — random VGG features carry enough
+loss geometry for the demo to converge and assert; pass --params with a
+VGG-19 .params file (save_checkpoint format, e.g. imported from a
+reference checkpoint via mxnet_tpu.interop) for the real thing, and
+--content-image/--style-image for real photos (PIL).
 """
 import argparse
 import os
@@ -20,104 +28,192 @@ import numpy as np  # noqa: E402
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import sym  # noqa: E402
 
-IM = 48
+STYLE_LAYERS = ("relu1_1", "relu2_1", "relu3_1", "relu4_1")
+CONTENT_LAYER = "relu4_2"
+MEAN = np.array([123.68, 116.779, 103.939], np.float32)  # RGB, vgg convention
 
 
-def encoder():
+def vgg19_features():
+    """VGG-19 conv trunk up to relu4_2 with the reference's layer names
+    (model_vgg19.py); avg pooling, as the style-transfer recipe uses."""
+    cfg = [(1, 2, 64), (2, 2, 128), (3, 4, 256), (4, 4, 512)]
     data = sym.Variable("data")
-    feats = []
-    net = data
-    for i, nf in enumerate((8, 16, 32)):
-        net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=nf,
-                              name=f"conv{i}")
-        net = sym.Activation(net, act_type="relu")
-        feats.append(net)
-        if i < 2:
-            net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
-                              pool_type="avg")
-    return feats  # two style layers + one content layer
+    taps = {}
+    body = data
+    for stage, num, filters in cfg:
+        for i in range(num):
+            body = sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                   num_filter=filters,
+                                   name=f"conv{stage}_{i + 1}")
+            body = sym.Activation(body, act_type="relu",
+                                  name=f"relu{stage}_{i + 1}")
+            taps[f"relu{stage}_{i + 1}"] = body
+            if stage == 4 and i + 1 == 2:
+                style = [taps[n] for n in STYLE_LAYERS]
+                return style, taps[CONTENT_LAYER]
+        body = sym.Pooling(body, pool_type="avg", kernel=(2, 2),
+                           stride=(2, 2), name=f"pool{stage}")
+    raise AssertionError("unreachable")
 
 
-def style_content_loss(feats, style_grams, content_feat):
+def make_loss(style_feats, content_feat, style_weight, content_weight,
+              tv_weight):
+    """One graph: weighted Gram style + content + TV, grads w.r.t. data."""
     losses = []
-    for i, f in enumerate(feats[:2]):
-        flat = sym.Reshape(f, shape=(0, 0, -1))           # (N, C, HW)
-        gram = sym.batch_dot(flat, flat, transpose_b=True)  # (N, C, C)
-        target = sym.Variable(f"gram{i}")
-        losses.append(sym.mean(sym.square(gram - target)))
+    for i, f in enumerate(style_feats):
+        flat = sym.Reshape(f, shape=(0, 0, -1))             # (1, C, HW)
+        gram = sym.batch_dot(flat, flat, transpose_b=True)  # (1, C, C)
+        target = sym.Variable(f"sgram{i}")
+        losses.append((style_weight / len(style_feats))
+                      * sym.mean(sym.square(gram - target)))
     target_c = sym.Variable("content")
-    losses.append(0.1 * sym.mean(sym.square(feats[2] - target_c)))
-    total = losses[0] + losses[1] + losses[2]
+    losses.append(content_weight * sym.mean(sym.square(content_feat
+                                                       - target_c)))
+    img = sym.Variable("data")
+    dx = sym.slice_axis(img, axis=3, begin=1, end=None) \
+        - sym.slice_axis(img, axis=3, begin=0, end=-1)
+    dy = sym.slice_axis(img, axis=2, begin=1, end=None) \
+        - sym.slice_axis(img, axis=2, begin=0, end=-1)
+    losses.append(tv_weight * (sym.mean(sym.square(dx))
+                               + sym.mean(sym.square(dy))))
+    total = losses[0]
+    for term in losses[1:]:
+        total = total + term
     return sym.MakeLoss(total, name="style_loss")
+
+
+def load_image(path, size):
+    from PIL import Image
+
+    img = Image.open(path).convert("RGB").resize((size, size), Image.LANCZOS)
+    arr = np.asarray(img, np.float32)  # (H, W, 3) RGB 0..255
+    return (arr - MEAN).transpose(2, 0, 1)[None]
+
+
+def save_image(path, arr):
+    out = np.clip(arr[0].transpose(1, 2, 0) + MEAN, 0, 255).astype(np.uint8)
+    try:
+        from PIL import Image
+
+        Image.fromarray(out).save(path)
+    except ImportError:
+        np.save(path + ".npy", out)
+        path = path + ".npy"
+    print(f"saved {path}")
+
+
+def synth_images(rs, size):
+    """Checkerboard content / wave-texture style, vgg-normalized range."""
+    yy, xx = np.mgrid[0:size, 0:size]
+    content = (80.0 * ((xx + yy) % 16 < 8) - 40.0
+               + rs.randn(3, size, size) * 5.0)[None].astype(np.float32)
+    style = (60.0 * np.sin(xx / 3.0) + rs.randn(3, size, size)
+             * 5.0)[None].astype(np.float32)
+    return content, style
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=60)
-    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--content-image")
+    ap.add_argument("--style-image")
+    ap.add_argument("--params", help="VGG-19 .params file (converted)")
+    ap.add_argument("--output", default="/tmp/nstyle_out.png")
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--style-weight", type=float, default=1.0)
+    ap.add_argument("--content-weight", type=float, default=10.0)
+    ap.add_argument("--tv-weight", type=float, default=1e-4)
+    ap.add_argument("--stop-eps", type=float, default=0.004,
+                    help="stop when relative image change falls below this")
     args = ap.parse_args()
     rs = np.random.RandomState(0)
-
     ctx = mx.context.default_accelerator_context()
-    feats = encoder()
-    loss = style_content_loss(feats, None, None)
 
-    # feature extraction pass: bind the bare encoder to compute targets
-    grp = sym.Group(feats)
-    fe = grp.simple_bind(ctx=ctx, grad_req="null", data=(1, 3, IM, IM))
-    init = mx.init.Xavier()
-    weights = {}
-    for name, arr in fe.arg_dict.items():
-        if name != "data":
-            init(name, arr)
-            weights[name] = arr.asnumpy()
+    if bool(args.content_image) != bool(args.style_image):
+        ap.error("--content-image and --style-image must be given together")
+    if args.content_image:
+        content_img = load_image(args.content_image, args.size)
+        style_img = load_image(args.style_image, args.size)
+    else:
+        content_img, style_img = synth_images(rs, args.size)
 
-    yy, xx = np.mgrid[0:IM, 0:IM]
-    content_img = np.clip(
-        0.3 + 0.7 * ((xx + yy) % 16 < 8)[None, None].astype(np.float32)
-        + rs.rand(1, 3, IM, IM).astype(np.float32) * 0.1, 0, 1)
-    style_img = np.clip(
-        0.5 + 0.5 * np.sin(xx / 3.0)[None, None].astype(np.float32)
-        + rs.rand(1, 3, IM, IM).astype(np.float32) * 0.1, 0, 1)
+    style_feats, content_feat = vgg19_features()
+    extractor = sym.Group(list(style_feats) + [content_feat])
+    fe = extractor.simple_bind(ctx=ctx, grad_req="null",
+                               data=content_img.shape)
+    if args.params:
+        loaded = mx.nd.load(args.params)
+        arg_params = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                      if k.startswith("arg:")}
+        missing = [n for n in fe.arg_dict
+                   if n != "data" and n not in arg_params]
+        if missing:
+            # a wrong-format file would otherwise leave zero weights and
+            # still "converge" on the TV term alone
+            raise SystemExit(f"--params covers no value for {missing[:5]} "
+                             "(expected save_checkpoint-style arg: keys)")
+        fe.copy_params_from(arg_params, {}, allow_extra_params=True)
+        weights = {k: v.asnumpy() for k, v in fe.arg_dict.items()
+                   if k != "data"}
+    else:
+        init = mx.init.Xavier()
+        weights = {}
+        for name, arr in fe.arg_dict.items():
+            if name != "data":
+                init(name, arr)
+                weights[name] = arr.asnumpy()
 
-    def grams_and_content(img):
+    def extract(img):
         fe.forward(is_train=False, data=img)
         outs = [o.asnumpy() for o in fe.outputs]
         grams = []
-        for f in outs[:2]:
+        for f in outs[:-1]:
             flat = f.reshape(f.shape[0], f.shape[1], -1)
             grams.append(np.matmul(flat, flat.transpose(0, 2, 1)))
-        return grams, outs[2]
+        return grams, outs[-1]
 
-    style_grams, _ = grams_and_content(style_img)
-    _, content_feat = grams_and_content(content_img)
+    style_grams, _ = extract(style_img)
+    _, content_tgt = extract(content_img)
 
-    ex = loss.simple_bind(ctx=ctx, grad_req={"data": "write"},
-                          data=(1, 3, IM, IM), gram0=style_grams[0].shape,
-                          gram1=style_grams[1].shape,
-                          content=content_feat.shape)
+    loss = make_loss(style_feats, content_feat, args.style_weight,
+                     args.content_weight, args.tv_weight)
+    shapes = {"data": content_img.shape, "content": content_tgt.shape}
+    for i, g in enumerate(style_grams):
+        shapes[f"sgram{i}"] = g.shape
+    ex = loss.simple_bind(ctx=ctx, grad_req={"data": "write"}, **shapes)
     for name, w in weights.items():
         ex.arg_dict[name][:] = w
-    ex.arg_dict["gram0"][:] = style_grams[0]
-    ex.arg_dict["gram1"][:] = style_grams[1]
-    ex.arg_dict["content"][:] = content_feat
-    img = content_img.copy()  # optimize starting from the content image
+    for i, g in enumerate(style_grams):
+        ex.arg_dict[f"sgram{i}"][:] = g
+    ex.arg_dict["content"][:] = content_tgt
+
+    img = mx.nd.array(content_img.copy())
+    opt = mx.optimizer.create(
+        "adam", learning_rate=args.lr,
+        lr_scheduler=mx.lr_scheduler.FactorScheduler(step=40, factor=0.75))
+    state = opt.create_state(0, img)
 
     first = last = None
     for step in range(args.steps):
+        old = img.asnumpy()
         ex.arg_dict["data"][:] = img
         ex.forward(is_train=True)
         ex.backward()
-        g = ex.grad_dict["data"].asnumpy()
-        img = np.clip(img - args.lr * g / (np.abs(g).mean() + 1e-8) * 0.01,
-                      0, 1)
-        val = float(ex.outputs[0].asnumpy())
+        opt.update(0, img, ex.grad_dict["data"], state)
+        new = img.asnumpy()
+        last = float(ex.outputs[0].asnumpy())
         if step == 0:
-            first = val
-        last = val
+            first = last
+        eps = np.linalg.norm(new - old) / (np.linalg.norm(new) + 1e-12)
         if step % 20 == 0:
-            print(f"step {step}: loss {val:.5f}")
-    print(f"first {first:.5f} last {last:.5f}")
+            print(f"step {step}: loss {last:.4f} rel-change {eps:.5f}")
+        if eps < args.stop_eps:
+            print(f"converged at step {step} (eps {eps:.5f})")
+            break
+
+    save_image(args.output, img.asnumpy())
+    print(f"first {first:.4f} last {last:.4f}")
     assert last < first
     print("STYLE OK")
 
