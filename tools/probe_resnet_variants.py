@@ -1,0 +1,125 @@
+"""Probe: decompose the ResNet-50 train-step HBM ceiling.
+
+Variants of the raw-JAX NHWC step (tools/probe_nhwc.py):
+  base      - the probe_nhwc step as-is (bf16 compute, f32 BN stats)
+  bf16stats - BN statistics accumulated straight from bf16 activations
+              (jnp.sum(..., dtype=f32): reads stay bf16, accumulator f32)
+  nobn      - BN replaced by a per-channel scale+shift (no batch stats):
+              the upper bound showing what the stats passes cost
+  b512      - base at batch 512 (does more batch amortize anything left?)
+
+Interpretation: if nobn >> base, the BN stat/normalize passes are the
+HBM traffic to attack; if bf16stats ~= base, XLA already fuses the f32
+casts into the reductions and there is nothing left on that axis.
+
+Run on a chip: python tools/probe_resnet_variants.py
+"""
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from tools.probe_nhwc import STAGES, conv, make_params
+
+PEAK = 197e12
+
+
+def bn_variant(x, gamma, beta, mode):
+    c = x.shape[3]
+    shape = (1, 1, 1, -1)
+    if mode == "nobn":
+        return x * gamma.reshape(shape).astype(x.dtype) \
+            + beta.reshape(shape).astype(x.dtype)
+    n = x.size // c
+    if mode == "bf16stats":
+        mean = jnp.sum(x, (0, 1, 2), dtype=jnp.float32) / n
+        var = jnp.maximum(
+            jnp.sum(jnp.square(x), (0, 1, 2), dtype=jnp.float32) / n
+            - jnp.square(mean), 0.0)
+    else:  # base
+        x32 = x.astype(jnp.float32)
+        mean = jnp.sum(x32, (0, 1, 2)) / n
+        var = jnp.maximum(jnp.sum(jnp.square(x32), (0, 1, 2)) / n
+                          - jnp.square(mean), 0.0)
+    out = (x.astype(jnp.float32) - mean.reshape(shape)) \
+        * jax.lax.rsqrt(var.reshape(shape) + 1e-3)
+    return (out * gamma.reshape(shape) + beta.reshape(shape)).astype(x.dtype)
+
+
+def forward(params, x, mode):
+    x = conv(x, params["stem"], 2, "NHWC")
+    x = jax.nn.relu(bn_variant(x, params["stem_g"], params["stem_b"], mode))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1),
+                              [(0, 0), (1, 1), (1, 1), (0, 0)])
+    cin = 64
+    for si, (blocks, cout) in enumerate(STAGES):
+        for bi in range(blocks):
+            p = f"s{si}b{bi}"
+            stride = 2 if (bi == 0 and si > 0) else 1
+            sc = x
+            if cin != cout:
+                sc = conv(x, params[p + "proj"], stride, "NHWC")
+            h = jax.nn.relu(bn_variant(
+                conv(x, params[p + "c1"], 1, "NHWC"),
+                params[p + "g1"], params[p + "b1"], mode))
+            h = jax.nn.relu(bn_variant(
+                conv(h, params[p + "c2"], stride, "NHWC"),
+                params[p + "g2"], params[p + "b2"], mode))
+            h = bn_variant(conv(h, params[p + "c3"], 1, "NHWC"),
+                           params[p + "g3"], params[p + "b3"], mode)
+            x = jax.nn.relu(h + sc)
+            cin = cout
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    return x.astype(jnp.bfloat16) @ params["fc"]
+
+
+def loss_fn(params, x, y, mode):
+    logits = forward(params, x, mode).astype(jnp.float32)
+    return jnp.mean(-jax.nn.log_softmax(logits)[jnp.arange(x.shape[0]), y])
+
+
+@partial(jax.jit, static_argnames=("mode",), donate_argnums=(0, 1))
+def train_step(params, mom, x, y, mode):
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, y, mode)
+    new_p, new_m = {}, {}
+    for k, g in grads.items():
+        m = mom[k] * 0.9 + g.astype(jnp.float32)
+        new_m[k] = m
+        new_p[k] = (params[k].astype(jnp.float32) - 0.1 * m).astype(
+            params[k].dtype)
+    return new_p, new_m, loss
+
+
+def run(mode, batch, iters=30):
+    rng = np.random.RandomState(0)
+    params = make_params("NHWC", rng)
+    mom = {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()}
+    x = jnp.asarray(rng.uniform(0, 1, (batch, 224, 224, 3)), jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)
+    for _ in range(5):
+        params, mom, loss = train_step(params, mom, x, y, mode)
+    _ = float(np.asarray(loss))
+    tic = time.perf_counter()
+    for _ in range(iters):
+        params, mom, loss = train_step(params, mom, x, y, mode)
+    _ = float(np.asarray(loss))
+    dt = time.perf_counter() - tic
+    img_s = batch * iters / dt
+    mfu = img_s * 3 * 4.089e9 / PEAK
+    print(f"{mode:10s} b{batch}: {img_s:8.1f} img/s   mfu={mfu:.3f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices(), flush=True)
+    for mode in ("base", "bf16stats", "nobn"):
+        run(mode, 128)
+    run("base", 512, iters=12)

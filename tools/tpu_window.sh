@@ -38,8 +38,12 @@ while true; do
   [ -f "$OUT/profile.ok" ] || { timeout 1200 python tools/probe_profile.py \
       > "$OUT/profile" 2>&1 && grep -q "wrote" "$OUT/profile" \
       && touch "$OUT/profile.ok"; }
+  [ -f "$OUT/variants.ok" ] || { timeout 1500 python \
+      tools/probe_resnet_variants.py > "$OUT/variants" 2>&1 \
+      && grep -q "nobn" "$OUT/variants" && touch "$OUT/variants.ok"; }
 
-  if [ -f "$OUT/peak.ok" ] && [ -f "$OUT/predict.ok" ] && [ -f "$OUT/profile.ok" ]; then
+  if [ -f "$OUT/peak.ok" ] && [ -f "$OUT/predict.ok" ] \
+     && [ -f "$OUT/profile.ok" ] && [ -f "$OUT/variants.ok" ]; then
     echo "[window] attempt $attempt: ALL DONE" >> "$OUT/driver.log"
     exit 0
   fi
