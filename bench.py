@@ -49,8 +49,22 @@ def _emit(payload):
 
 
 def _fail(msg, metric="resnet50_train_imgs_per_sec_per_chip"):
-    _emit({"metric": metric, "value": 0.0, "unit": "img/s",
-           "vs_baseline": 0.0, "error": msg})
+    payload = {"metric": metric, "value": 0.0, "unit": "img/s",
+               "vs_baseline": 0.0, "error": msg}
+    # a backend outage at bench time should not erase the round's real
+    # measurement: embed the committed artifact (captured by
+    # tools/tpu_watch.sh during an earlier backend window) so the error
+    # line still carries the hardware numbers and where they came from
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "docs", "measured",
+                               "bench_r04_tpu_v5e.json")) as f:
+            payload["last_measured"] = json.load(f)
+            payload["last_measured_source"] = \
+                "docs/measured/bench_r04_tpu_v5e.json (2026-07-31 window)"
+    except Exception:  # noqa: BLE001 — the artifact is best-effort
+        pass
+    _emit(payload)
 
 
 def _peak_flops(device_kind):
