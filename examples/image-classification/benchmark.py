@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Training-throughput sweep (parity:
+example/image-classification/benchmark.py — the reference sweeps
+network × batch-size × #GPUs on dummy data and logs img/s; here the
+device axis is a dp mesh over however many devices the backend exposes,
+the TPU-native equivalent of its multi-GPU KVStore sweep).
+
+  python benchmark.py --networks resnet-50 inception-v3 \
+                      --batch-sizes 16 32 --dp 1 2 4
+
+On a CPU box set XLA_FLAGS=--xla_force_host_platform_device_count=8
+MXTPU_PLATFORM=cpu to sweep virtual devices.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+
+def bench_one(network, batch, dp, iters, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel.mesh import create_mesh
+    from mxnet_tpu.trainer import FusedTrainer
+
+    if dp > len(jax.devices()):
+        return None
+    mesh = create_mesh((dp,), axes=("data",),
+                       devices=jax.devices()[:dp]) if dp > 1 else None
+    if network == "mlp":
+        net, shape = models.get_symbol("mlp"), (784,)
+    else:
+        net, shape = models.get_symbol(network, num_classes=1000), \
+            (3, 224, 224)
+    tr = FusedTrainer(
+        net, optimizer="sgd",
+        optimizer_params={"lr": 0.05, "momentum": 0.9,
+                          "rescale_grad": 1.0 / batch},
+        dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32,
+        mesh=mesh)
+    tr.init(data=(batch,) + shape)
+    rs = np.random.RandomState(0)
+    feed = {"data": jax.device_put(
+        rs.uniform(0, 1, (batch,) + shape).astype(np.float32)),
+        "softmax_label": jax.device_put(
+            rs.randint(0, 1000, batch).astype(np.float32))}
+
+    def barrier():
+        name = sorted(tr.params)[0]
+        return float(np.asarray(tr.params[name]).ravel()[0])
+
+    for _ in range(4):
+        tr.step(**feed)
+    barrier()
+    tic = time.perf_counter()
+    for _ in range(iters):
+        tr.step(**feed)
+    barrier()
+    return batch * iters / (time.perf_counter() - tic)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--networks", nargs="+",
+                    default=["resnet-50", "inception-v3"])
+    ap.add_argument("--batch-sizes", type=int, nargs="+", default=[32])
+    ap.add_argument("--dp", type=int, nargs="+", default=[1],
+                    help="data-parallel device counts to sweep")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--dtype", default="bf16", choices=["bf16", "fp32"])
+    args = ap.parse_args()
+
+    print(f"{'network':16s} {'batch':>5s} {'dp':>3s} {'img/s':>9s}")
+    for net in args.networks:
+        for batch in args.batch_sizes:
+            for dp in args.dp:
+                if batch % dp:
+                    print(f"{net:16s} {batch:5d} {dp:3d}   (batch not "
+                          f"divisible by dp)")
+                    continue
+                rate = bench_one(net, batch, dp, args.iters, args.dtype)
+                if rate is None:
+                    print(f"{net:16s} {batch:5d} {dp:3d}   (needs {dp} "
+                          "devices)")
+                    continue
+                print(f"{net:16s} {batch:5d} {dp:3d} {rate:9.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Score a saved checkpoint on a validation set (parity:
+example/image-classification/score.py — load with mx.model, bind
+forward-only, run acc/top-5 over a rec file).
+
+With --data-val absent, runs the self-contained path: trains a small
+model for one epoch on synthetic data, saves it, scores it back, and
+asserts the scored accuracy matches Module.score.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def score(model_prefix, epoch, data_iter, metrics, ctx, max_num_examples=None):
+    """The reference's score(): checkpoint -> forward-only module ->
+    metric sweep; returns (metric results, images/sec)."""
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(
+        model_prefix, epoch)
+    mod = mx.mod.Module(symbol, context=ctx, label_names=["softmax_label"])
+    mod.bind(for_training=False, data_shapes=data_iter.provide_data,
+             label_shapes=data_iter.provide_label)
+    mod.set_params(arg_params, aux_params)
+    if not isinstance(metrics, list):
+        metrics = [metrics]
+    num = 0
+    tic = time.time()
+    for batch in data_iter:
+        mod.forward(batch, is_train=False)
+        for m in metrics:
+            mod.update_metric(m, batch.label)
+        num += batch.data[0].shape[0]
+        if max_num_examples and num >= max_num_examples:
+            break
+    return [m.get() for m in metrics], num / (time.time() - tic)
+
+
+def self_test(ctx):
+    rs = np.random.RandomState(0)
+    x = rs.uniform(size=(512, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 4).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(x[:128], y[:128], batch_size=32)
+
+    from mxnet_tpu import sym
+
+    net = sym.SoftmaxOutput(sym.FullyConnected(sym.Activation(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=32, name="fc1"),
+        act_type="relu"), num_hidden=2, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    prefix = "/tmp/score_selftest"
+    mod.save_checkpoint(prefix, 10)
+
+    val.reset()
+    oracle = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    val.reset()
+    (results,), speed = score(prefix, 10, val, mx.metric.Accuracy(), ctx)
+    name, acc = results
+    print(f"scored {name}={acc:.4f} at {speed:.0f} img/s "
+          f"(module oracle {oracle:.4f})")
+    assert abs(acc - oracle) < 1e-6
+    assert acc > 0.9, acc
+    print("SCORE OK")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--data-val", help="validation .rec file")
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--max-num-examples", type=int)
+    args = ap.parse_args()
+    ctx = mx.context.default_accelerator_context()
+
+    if not args.data_val:
+        self_test(ctx)
+        return
+    shape = tuple(int(v) for v in args.image_shape.split(","))
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val, data_shape=shape,
+        batch_size=args.batch_size, rand_crop=False, rand_mirror=False)
+    metrics = [mx.metric.Accuracy(), mx.metric.TopKAccuracy(top_k=5)]
+    results, speed = score(args.model_prefix, args.epoch, val, metrics, ctx,
+                           args.max_num_examples)
+    print(f"{speed:.1f} img/s")
+    for name, value in results:
+        print(f"{name}: {value:.5f}")
+
+
+if __name__ == "__main__":
+    main()

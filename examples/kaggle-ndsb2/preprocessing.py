@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Data staging for the second National Data Science Bowl (cardiac MRI
+volume estimation).  Parity: example/kaggle-ndsb2/Preprocessing.py —
+the reference crops/rescales each study's 30-frame short-axis cine
+into 64x64 frames and writes one CSV row per study
+(train-64x64-data.csv) plus a label CSV (id, systole, diastole).
+
+Real DICOM decoding needs pydicom (absent from this image), so this
+script synthesizes the same artifact: a pulsating-disc "heart" whose
+min/max area over the cycle IS the systole/diastole label — the CSV
+formats match the reference exactly, so a real preprocessed dataset
+drops straight into train.py.
+"""
+import argparse
+import os
+
+import numpy as np
+
+FRAMES, SIZE = 30, 64
+
+
+def synth_study(rs):
+    """A 30-frame cine: a disc whose radius pulses over the cycle, plus
+    chest-like background structure and noise."""
+    diastole_r = rs.uniform(8, 22)                  # max radius
+    systole_r = diastole_r * rs.uniform(0.45, 0.8)  # min radius
+    cx, cy = rs.uniform(24, 40, 2)
+    phase = rs.uniform(0, 2 * np.pi)
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE]
+    bg = rs.uniform(0, 60) + 20 * np.sin(xx / rs.uniform(6, 14))
+    video = np.zeros((FRAMES, SIZE, SIZE), np.float32)
+    for t in range(FRAMES):
+        # radius swings diastole -> systole -> diastole over the cycle
+        c = 0.5 * (1 + np.cos(2 * np.pi * t / FRAMES + phase))
+        r = systole_r + (diastole_r - systole_r) * c
+        disc = ((xx - cx) ** 2 + (yy - cy) ** 2) < r ** 2
+        video[t] = np.clip(bg + 200 * disc + rs.randn(SIZE, SIZE) * 8,
+                           0, 255)
+    # labels: ventricle "volume" in the competition's mL-like range
+    systole = np.pi * systole_r ** 2 * 0.3
+    diastole = np.pi * diastole_r ** 2 * 0.3
+    return video, systole, diastole
+
+
+def write_split(path_prefix, n, rs, with_labels=True):
+    data_rows, labels = [], []
+    for i in range(n):
+        video, sys_v, dia_v = synth_study(rs)
+        data_rows.append(video.reshape(-1))
+        labels.append((i + 1, sys_v, dia_v))
+    np.savetxt(path_prefix + "-64x64-data.csv",
+               np.asarray(data_rows, np.float32), delimiter=",", fmt="%g")
+    if with_labels:
+        np.savetxt(path_prefix + "-label.csv", np.asarray(labels),
+                   delimiter=",", fmt="%g")
+    return labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/ndsb2")
+    ap.add_argument("--train", type=int, default=500)
+    ap.add_argument("--validate", type=int, default=100)
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    rs = np.random.RandomState(0)
+    write_split(os.path.join(args.out, "train"), args.train, rs)
+    write_split(os.path.join(args.out, "validate"), args.validate, rs)
+    print(f"staged {args.train}+{args.validate} studies under {args.out}")
+
+
+if __name__ == "__main__":
+    main()
