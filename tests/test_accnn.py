@@ -1,0 +1,136 @@
+"""ACCNN low-rank compression tests (parity: tools/accnn/ — the
+reference ships V-H conv SVD, FC truncated SVD, and DP rank selection;
+pinned here end to end: full-rank surgery is (near-)exact, reduced rank
+shrinks params and FLOPs, fine-tuning the compressed net recovers
+accuracy)."""
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "accnn"))
+
+from acc_conv import decompose_weights  # noqa: E402
+from acc_fc import decompose_fc  # noqa: E402
+from accnn import compress, conv_layer_shapes  # noqa: E402
+from rank_selection import select_ranks  # noqa: E402
+
+
+def _cnn():
+    net = sym.Convolution(sym.Variable("data"), kernel=(3, 3), pad=(1, 1),
+                          num_filter=8, name="conv1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=16,
+                          name="conv2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _train(net, x, y, epochs=6):
+    it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    return mod
+
+
+def _data(rs, n=128):
+    # class = which horizontal third carries the planted energy band
+    x = rs.uniform(size=(n, 3, 12, 12)).astype(np.float32) * 0.3
+    y = rs.randint(0, 3, n).astype(np.float32)
+    for i in range(n):
+        band = int(y[i]) * 4
+        x[i, :, band:band + 4, :] += 1.0
+    return x, y
+
+
+def test_conv_decomposition_full_rank_exact():
+    rs = np.random.RandomState(0)
+    W = rs.randn(8, 4, 3, 3).astype(np.float32)
+    b = rs.randn(8).astype(np.float32)
+    V, H, b2 = decompose_weights(W, b, K=4 * 3)  # full rank C*y
+    # reconstruct: W[n,c,y,x] = sum_k V[k,c,y,0] * H[n,k,0,x]
+    W_rec = np.einsum("kcy,nkx->ncyx", V[:, :, :, 0], H[:, :, 0, :])
+    np.testing.assert_allclose(W_rec, W, atol=1e-4)
+    np.testing.assert_array_equal(b2, b)
+
+
+def test_fc_decomposition_full_rank_exact():
+    rs = np.random.RandomState(1)
+    W = rs.randn(10, 20).astype(np.float32)
+    W1, W2, _ = decompose_fc(W, np.zeros(10, np.float32), K=10)
+    np.testing.assert_allclose(W2 @ W1, W, atol=1e-4)
+
+
+def test_graph_surgery_full_rank_preserves_outputs():
+    rs = np.random.RandomState(2)
+    x, y = _data(rs)
+    mod = _train(_cnn(), x, y, epochs=2)
+    arg_params, aux_params = mod.get_params()
+    arg_np = {k: v.asnumpy() for k, v in arg_params.items()}
+
+    full = {"conv1": 3 * 3, "conv2": 16 * 3, "fc1": 32}
+    new_sym, new_args, new_aux = compress(mod.symbol, arg_np,
+                                          {}, full)
+    assert "conv1_weight" not in new_args
+    assert "conv1_v_weight" in new_args and "conv1_h_weight" in new_args
+
+    def forward(symbol, params, data):
+        ex = symbol.simple_bind(ctx=mx.cpu(), grad_req="null",
+                                data=data.shape)
+        ex.copy_params_from({k: mx.nd.array(v) for k, v in params.items()},
+                            {}, allow_extra_params=True)
+        ex.forward(is_train=False, data=data)
+        return ex.outputs[0].asnumpy()
+
+    out_orig = forward(mod.symbol, arg_np, x[:8])
+    out_comp = forward(new_sym, new_args, x[:8])
+    np.testing.assert_allclose(out_comp, out_orig, atol=2e-3)
+
+
+def test_rank_selection_and_finetune_recovers():
+    rs = np.random.RandomState(3)
+    x, y = _data(rs, 192)
+    mod = _train(_cnn(), x, y)
+    it = mx.io.NDArrayIter(x, y, batch_size=16)
+    base_acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert base_acc > 0.8, base_acc
+
+    arg_np = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    shapes = conv_layer_shapes(mod.symbol, (3, 12, 12))
+    assert set(shapes) == {"conv1", "conv2"}
+    ranks = select_ranks(arg_np, shapes, speedup=1.5)
+    for name, (n, c, yk, xk, _, _) in shapes.items():
+        assert 1 <= ranks[name] <= c * yk
+
+    new_sym, new_args, _ = compress(mod.symbol, arg_np, {}, ranks)
+    assert sum(v.size for v in new_args.values()) < \
+        sum(v.size for v in arg_np.values())
+
+    # fine-tune the compressed net from the decomposed weights
+    ft = mx.mod.Module(new_sym, context=mx.cpu())
+    it.reset()
+    ft.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    ft.set_params({k: mx.nd.array(v) for k, v in new_args.items()}, {},
+                  allow_missing=False)
+    ft.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.02,
+                                        "momentum": 0.9})
+    for _ in range(3):
+        it.reset()
+        for batch in it:
+            ft.forward(batch, is_train=True)
+            ft.backward()
+            ft.update()
+    it.reset()
+    acc = dict(ft.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > base_acc - 0.1, (acc, base_acc)

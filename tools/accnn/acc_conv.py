@@ -1,0 +1,76 @@
+"""Vertical-horizontal low-rank conv decomposition (parity:
+tools/accnn/acc_conv.py — Jaderberg-style SVD factorization of a k×k
+conv into a k×1 conv with K filters followed by a 1×k conv).
+
+W (N,C,y,x) reshapes to (C·y, N·x); its rank-K SVD splits into
+V (K,C,y,1) and H (N,K,1,x) with the singular values' square roots
+folded into both factors.  The bias rides on the horizontal conv.
+"""
+import json
+
+import numpy as np
+
+
+def matricize(W):
+    """(N,C,y,x) -> the (C·y, N·x) matrix whose SVD factorizes the conv
+    (single source of truth — rank_selection's spectra must match)."""
+    n, c, y, x = W.shape
+    return W.transpose(1, 2, 0, 3).reshape(c * y, n * x)
+
+
+def decompose_weights(W, b, K):
+    n, c, y, x = W.shape
+    U, D, Qt = np.linalg.svd(matricize(W), full_matrices=False)
+    K = min(K, len(D))
+    sqrt_d = np.sqrt(D[:K])
+    V = (U[:, :K] * sqrt_d).T.reshape(K, c, y, 1)
+    H = (Qt[:K].T * sqrt_d).reshape(n, x, K).transpose(0, 2, 1)[:, :, None, :]
+    return V.astype(W.dtype), H.astype(W.dtype), b
+
+
+def make_conv_handler(ranks, arg_params, new_params, replaced=None):
+    """rewrite_graph handler replacing each ranked conv with its V/H
+    pair; decomposed weights land in new_params, replaced layer names in
+    ``replaced`` (so the caller only drops params it actually swapped)."""
+
+    def handler(node, inputs, emit):
+        name = node["name"]
+        if name not in ranks:
+            return None
+        attrs = {k: json.loads(v) if isinstance(v, str) else v
+                 for k, v in node["attrs"].items()}
+        kernel = tuple(attrs["kernel"])
+        if kernel[0] == 1 or kernel[1] == 1:
+            return None  # already rank-1 spatially
+        if tuple(attrs.get("dilate", (1, 1))) != (1, 1) \
+                or int(attrs.get("num_group", 1)) != 1:
+            return None  # V/H split would change semantics
+        stride = tuple(attrs.get("stride", (1, 1)))
+        pad = tuple(attrs.get("pad", (0, 0)))
+        num_filter = int(attrs["num_filter"])
+        K = int(ranks[name])
+
+        W = arg_params[name + "_weight"]
+        b = arg_params.get(name + "_bias",
+                           np.zeros(num_filter, dtype=W.dtype))
+        V, H, b2 = decompose_weights(W, b, K)
+        new_params[name + "_v_weight"] = V
+        new_params[name + "_h_weight"] = H
+        new_params[name + "_h_bias"] = b2
+        if replaced is not None:
+            replaced.add(name)
+
+        vw = emit("null", name + "_v_weight", {}, [])
+        conv_v = emit("Convolution", name + "_v",
+                      {"kernel": [kernel[0], 1], "stride": [stride[0], 1],
+                       "pad": [pad[0], 0], "num_filter": V.shape[0],
+                       "no_bias": True},
+                      [inputs[0], vw])
+        hw = emit("null", name + "_h_weight", {}, [])
+        hb = emit("null", name + "_h_bias", {}, [])
+        return emit("Convolution", name + "_h",
+                    {"kernel": [1, kernel[1]], "stride": [1, stride[1]],
+                     "pad": [0, pad[1]], "num_filter": num_filter},
+                    [conv_v, hw, hb])
+
+    return handler

@@ -1,0 +1,40 @@
+"""Truncated-SVD FullyConnected decomposition (parity:
+tools/accnn/acc_fc.py): W (M,D) ≈ W2 (M,K) · W1 (K,D), bias on the
+second layer."""
+import numpy as np
+
+
+def decompose_fc(W, b, K):
+    U, D, Qt = np.linalg.svd(W, full_matrices=False)
+    K = min(K, len(D))
+    sqrt_d = np.sqrt(D[:K])
+    W1 = (Qt[:K].T * sqrt_d).T          # (K, D)
+    W2 = U[:, :K] * sqrt_d              # (M, K)
+    return W1.astype(W.dtype), W2.astype(W.dtype), b
+
+
+def make_fc_handler(ranks, arg_params, new_params, replaced=None):
+    def handler(node, inputs, emit):
+        name = node["name"]
+        if name not in ranks:
+            return None
+        W = arg_params[name + "_weight"]
+        b = arg_params.get(name + "_bias",
+                           np.zeros(W.shape[0], dtype=W.dtype))
+        K = int(ranks[name])
+        W1, W2, b2 = decompose_fc(W, b, K)
+        new_params[name + "_a_weight"] = W1
+        new_params[name + "_b_weight"] = W2
+        new_params[name + "_b_bias"] = b2
+        if replaced is not None:
+            replaced.add(name)
+        w1 = emit("null", name + "_a_weight", {}, [])
+        fc1 = emit("FullyConnected", name + "_a",
+                   {"num_hidden": W1.shape[0], "no_bias": True},
+                   [inputs[0], w1])
+        w2 = emit("null", name + "_b_weight", {}, [])
+        b2n = emit("null", name + "_b_bias", {}, [])
+        return emit("FullyConnected", name + "_b",
+                    {"num_hidden": W2.shape[0]}, [fc1, w2, b2n])
+
+    return handler

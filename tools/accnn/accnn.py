@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""ACCNN driver (parity: tools/accnn/accnn.py): load a checkpoint,
+pick per-layer ranks (DP under --speedup, or an explicit JSON config),
+rewrite every spatial conv into its vertical/horizontal low-rank pair
+and chosen FCs into truncated-SVD pairs, save the compressed
+checkpoint.
+
+  python accnn.py --model prefix --epoch N --data-shape 3,224,224 \
+                  --speedup 2 --save-model prefix-acc
+  python accnn.py ... --config ranks.json   # {"conv1": 12, "fc1": 64}
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from acc_conv import make_conv_handler  # noqa: E402
+from acc_fc import make_fc_handler  # noqa: E402
+from rank_selection import select_ranks  # noqa: E402
+from utils import load_model, rewrite_graph, save_model  # noqa: E402
+
+
+def conv_layer_shapes(symbol, data_shape):
+    """{conv name: (N, C, y, x, out_h, out_w)} for every spatial conv,
+    via the symbol's own shape inference."""
+    g = json.loads(symbol.tojson())
+    internals = symbol.get_internals()
+    out_names = internals.list_outputs()
+    arg_shapes, out_shapes, _ = internals.infer_shape(
+        data=(1,) + tuple(data_shape))
+    arg_dict = dict(zip(internals.list_arguments(), arg_shapes))
+    out_dict = dict(zip(out_names, out_shapes))
+    shapes = {}
+    for node in g["nodes"]:
+        if node["op"] != "Convolution":
+            continue
+        name = node["name"]
+        attrs = node["attrs"]
+        kernel = json.loads(attrs["kernel"])
+        if kernel[0] == 1 or kernel[1] == 1:
+            continue
+        if tuple(json.loads(attrs.get("dilate", "[1, 1]"))) != (1, 1) \
+                or int(attrs.get("num_group", "1")) != 1:
+            continue  # the V/H handler declines these; don't rank them
+        wshape = arg_dict[name + "_weight"]
+        oshape = out_dict[name + "_output"]
+        shapes[name] = (wshape[0], wshape[1], wshape[2], wshape[3],
+                        oshape[2], oshape[3])
+    return shapes
+
+
+def compress(symbol, arg_params, aux_params, ranks):
+    new_params = dict(arg_params)
+    conv_ranks = {n: k for n, k in ranks.items()
+                  if n + "_weight" in arg_params
+                  and arg_params[n + "_weight"].ndim == 4}
+    fc_ranks = {n: k for n, k in ranks.items()
+                if n + "_weight" in arg_params
+                and arg_params[n + "_weight"].ndim == 2}
+    replaced = set()
+    handlers = {
+        "Convolution": make_conv_handler(conv_ranks, arg_params, new_params,
+                                         replaced),
+        "FullyConnected": make_fc_handler(fc_ranks, arg_params, new_params,
+                                          replaced),
+    }
+    new_sym = rewrite_graph(symbol, handlers)
+    # drop only the originals the handlers actually swapped (a ranked
+    # conv the handler declined — 1-dim kernel, dilated, grouped — keeps
+    # its weights)
+    for n in replaced:
+        new_params.pop(n + "_weight", None)
+        new_params.pop(n + "_bias", None)
+    keep = set(new_sym.list_arguments())
+    new_params = {k: v for k, v in new_params.items() if k in keep}
+    return new_sym, new_params, dict(aux_params)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--data-shape", default="3,224,224")
+    ap.add_argument("--speedup", type=float, default=2.0)
+    ap.add_argument("--config", help="JSON {layer: rank} overriding the DP")
+    ap.add_argument("--save-model", required=True)
+    args = ap.parse_args()
+
+    symbol, arg_params, aux_params = load_model(args.model, args.epoch)
+    data_shape = tuple(int(v) for v in args.data_shape.split(","))
+    if args.config:
+        ranks = {k: int(v) for k, v in
+                 json.load(open(args.config)).items()}
+    else:
+        shapes = conv_layer_shapes(symbol, data_shape)
+        ranks = select_ranks(arg_params, shapes, args.speedup)
+    print("ranks:", ranks)
+    new_sym, new_args, new_aux = compress(symbol, arg_params, aux_params,
+                                          ranks)
+    before = sum(v.size for v in arg_params.values())
+    after = sum(v.size for v in new_args.values())
+    print(f"params {before} -> {after} ({after / before:.2%})")
+    save_model(args.save_model, args.epoch, new_sym, new_args, new_aux)
+    print(f"saved {args.save_model}-{args.epoch:04d}.params")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Rank selection for ACCNN (parity: tools/accnn/rank_selection.py —
+the reference allocates per-layer ranks by dynamic programming over the
+SVD energy spectra under a target speedup).
+
+Each k×k conv layer's decomposition cost scales ~ K·(C·y + N·x)·HW
+against the original N·C·y·x·HW, so for a requested overall speedup S
+the DP picks the rank vector maximizing retained spectral energy
+subject to  sum(decomposed FLOPs) <= sum(original FLOPs)/S.
+"""
+import numpy as np
+
+from acc_conv import matricize
+
+GRID = 16  # rank candidates per layer (fractions of full rank)
+
+
+def spectra(arg_params, layers):
+    """Singular-value energy spectra per layer name (values-only SVD of
+    the same matricization acc_conv decomposes)."""
+    return {name: np.linalg.svd(matricize(arg_params[name + "_weight"]),
+                                compute_uv=False) ** 2
+            for name in layers}
+
+
+def select_ranks(arg_params, conv_shapes, speedup):
+    """conv_shapes: {name: (N, C, y, x, out_h, out_w)}.  Returns
+    {name: rank} maximizing retained energy under the FLOPs budget."""
+    layers = list(conv_shapes)
+    energy = spectra(arg_params, layers)
+    orig_flops, options = 0, {}
+    for name in layers:
+        n, c, y, x, oh, ow = conv_shapes[name]
+        orig = n * c * y * x * oh * ow
+        orig_flops += orig
+        full = len(energy[name])
+        opts = []
+        for i in range(1, GRID + 1):
+            k = max(1, int(round(full * i / GRID)))
+            flops = k * (c * y + n * x) * oh * ow
+            frac = float(energy[name][:k].sum() / energy[name].sum())
+            opts.append((k, flops, frac))
+        options[name] = opts
+    budget = orig_flops / speedup
+
+    # DP over layers with a discretized budget axis; each bin carries
+    # the FULL choice vector so backtracking cannot drift.  Bin count
+    # scales with depth: at a fixed 200 bins the per-layer minimum cost
+    # of one bin would make any net deeper than 200 conv layers read as
+    # infeasible, and ceil-quantization would eat the budget
+    BINS = max(200, 8 * len(layers))
+    scale = budget / BINS
+    NEG = -1e18
+    dp = np.full(BINS + 1, NEG)
+    dp[0] = 0.0
+    picks = [None] * (BINS + 1)
+    picks[0] = []
+    for name in layers:
+        nxt = np.full(BINS + 1, NEG)
+        nxt_picks = [None] * (BINS + 1)
+        for k_i, (k, flops, frac) in enumerate(options[name]):
+            cost = max(1, int(np.ceil(flops / scale)))
+            if cost > BINS:
+                continue
+            gain = np.log(max(frac, 1e-12))
+            for b in range(cost, BINS + 1):
+                if dp[b - cost] <= NEG / 2:
+                    continue
+                cand = dp[b - cost] + gain
+                if cand > nxt[b]:
+                    nxt[b] = cand
+                    nxt_picks[b] = picks[b - cost] + [k]
+        dp, picks = nxt, nxt_picks
+    best = int(np.argmax(dp))
+    if dp[best] <= NEG / 2:
+        raise ValueError(f"speedup {speedup}x infeasible even at rank 1")
+    return dict(zip(layers, picks[best]))

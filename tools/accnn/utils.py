@@ -1,0 +1,91 @@
+"""Graph-surgery helpers for ACCNN (parity: tools/accnn/utils.py —
+the reference rewrites the symbol's JSON node list to swap layers for
+their low-rank decompositions; same mechanism here against this
+package's JSON schema, symbol.py tojson/load_json).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def load_model(prefix, epoch):
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, epoch)
+    return symbol, {k: v.asnumpy() for k, v in arg_params.items()}, \
+        {k: v.asnumpy() for k, v in aux_params.items()}
+
+
+def save_model(prefix, epoch, symbol, arg_params, aux_params):
+    mx.model.save_checkpoint(
+        prefix, epoch, symbol,
+        {k: mx.nd.array(v) for k, v in arg_params.items()},
+        {k: mx.nd.array(v) for k, v in aux_params.items()})
+
+
+def rewrite_graph(symbol, handlers):
+    """Rebuild the symbol's JSON graph, letting ``handlers[op]`` expand
+    chosen nodes into several.
+
+    handler(node, inputs, emit) -> output entry
+      - node:   the original JSON node dict
+      - inputs: the node's input entries already mapped to the new graph
+      - emit(op, name, attrs, inputs, is_aux=False) -> entry in the new
+        graph (use op="null" for new variables)
+    Returning None keeps the node unchanged.  Unconsumed null nodes
+    (e.g. the replaced conv's weight) are dropped automatically by
+    emitting variables lazily.
+    """
+    g = json.loads(symbol.tojson())
+    new_nodes = []
+    entry_map = {}  # old node id -> new entry [id, out_idx, 0]
+
+    def emit(op, name, attrs, inputs, is_aux=False):
+        new_nodes.append({"op": op, "name": name,
+                          "attrs": {k: json.dumps(v) if not isinstance(v, str)
+                                    else v for k, v in (attrs or {}).items()},
+                          "extra_attrs": {}, "is_aux": is_aux,
+                          "inputs": [list(e) for e in inputs]})
+        return [len(new_nodes) - 1, 0, 0]
+
+    # null nodes are emitted lazily on first use so orphaned params vanish
+    lazy = {}
+
+    def resolve(old_entry):
+        oid, oidx, _ = old_entry
+        if oid in entry_map:
+            e = entry_map[oid]
+            return [e[0], oidx, 0]
+        node = g["nodes"][oid]
+        assert node["op"] == "null", node
+        if oid not in lazy:
+            lazy[oid] = emit("null", node["name"], node.get("attrs", {}),
+                             [], node.get("is_aux", False))
+        entry_map[oid] = lazy[oid]
+        return [lazy[oid][0], oidx, 0]
+
+    for oid, node in enumerate(g["nodes"]):
+        if node["op"] == "null":
+            continue  # lazily emitted
+        inputs = [resolve(e) for e in node["inputs"]]
+        handler = handlers.get(node["op"])
+        out = handler(node, inputs, emit) if handler else None
+        if out is None:
+            out = emit(node["op"], node["name"], node.get("attrs", {}),
+                       inputs, node.get("is_aux", False))
+        entry_map[oid] = out
+
+    heads = []
+    for e in g["heads"]:
+        ne = entry_map[e[0]]
+        heads.append([ne[0], e[1], 0])
+    out = {"nodes": new_nodes, "heads": heads}
+    if "arg_nodes" in g:
+        out["arg_nodes"] = [i for i, n in enumerate(new_nodes)
+                            if n["op"] == "null"]
+    from mxnet_tpu import symbol as sym_mod
+
+    return sym_mod.load_json(json.dumps(out))
