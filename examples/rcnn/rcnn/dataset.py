@@ -1,0 +1,129 @@
+"""Pascal VOC dataset loading (parity:
+example/rcnn/rcnn/dataset/pascal_voc.py — the reference parses a
+VOCdevkit tree: ImageSets/Main lists, Annotations XML, JPEGImages —
+into a roidb).  Same tree format here, parsed with ElementTree + PIL,
+resized to the configured square input with boxes rescaled.
+
+``write_synth_devkit`` emits a REAL VOCdevkit directory from the
+synthetic rectangles task (JPEG images, XML annotations, image-set
+lists), so the parse path is exercised out of the box and a real
+VOC2007 devkit drops straight in.
+"""
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from .loader import synth_image_set
+
+CLASSES = ("__background__", "wide", "tall")
+
+
+def write_synth_devkit(path, cfg, n_images, seed=0, year="2007"):
+    """Materialize the synthetic set as VOCdevkit/VOC<year>/..."""
+    from PIL import Image
+
+    root = os.path.join(path, f"VOC{year}")
+    for d in ("Annotations", "JPEGImages", "ImageSets/Main"):
+        os.makedirs(os.path.join(root, d), exist_ok=True)
+    images, gt = synth_image_set(cfg, n_images, seed)
+    ids = []
+    for i, (im, boxes) in enumerate(zip(images, gt)):
+        idx = f"{i:06d}"
+        ids.append(idx)
+        arr = (im.transpose(1, 2, 0) * 255).clip(0, 255).astype(np.uint8)
+        Image.fromarray(arr).save(
+            os.path.join(root, "JPEGImages", idx + ".jpg"), quality=95)
+        ann = ET.Element("annotation")
+        ET.SubElement(ann, "filename").text = idx + ".jpg"
+        size = ET.SubElement(ann, "size")
+        ET.SubElement(size, "width").text = str(im.shape[2])
+        ET.SubElement(size, "height").text = str(im.shape[1])
+        ET.SubElement(size, "depth").text = "3"
+        for row in boxes:
+            obj = ET.SubElement(ann, "object")
+            ET.SubElement(obj, "name").text = CLASSES[int(row[4])]
+            ET.SubElement(obj, "difficult").text = "0"
+            bb = ET.SubElement(obj, "bndbox")
+            # VOC convention: 1-based inclusive pixel coordinates
+            ET.SubElement(bb, "xmin").text = str(int(row[0]) + 1)
+            ET.SubElement(bb, "ymin").text = str(int(row[1]) + 1)
+            ET.SubElement(bb, "xmax").text = str(int(row[2]) + 1)
+            ET.SubElement(bb, "ymax").text = str(int(row[3]) + 1)
+        ET.ElementTree(ann).write(
+            os.path.join(root, "Annotations", idx + ".xml"))
+    n_train = max(1, int(n_images * 0.8))
+    with open(os.path.join(root, "ImageSets/Main/trainval.txt"), "w") as f:
+        f.write("\n".join(ids[:n_train]) + "\n")
+    with open(os.path.join(root, "ImageSets/Main/test.txt"), "w") as f:
+        f.write("\n".join(ids[n_train:]) + "\n")
+    return root
+
+
+class PascalVOC:
+    """Parse VOCdevkit/VOC<year> into (images, gt) arrays the
+    AnchorLoader consumes; classes absent from ``classes`` are skipped
+    (the reference filters the 20-class list the same way)."""
+
+    def __init__(self, devkit_path, image_set="trainval", year="2007",
+                 classes=CLASSES, cfg=None, skip_difficult=True):
+        self.root = os.path.join(devkit_path, f"VOC{year}")
+        if not os.path.isdir(self.root):
+            raise FileNotFoundError(self.root)
+        self.classes = tuple(classes)
+        self._cls_index = {c: i for i, c in enumerate(self.classes)}
+        self.cfg = cfg
+        self.skip_difficult = skip_difficult
+        with open(os.path.join(self.root, "ImageSets/Main",
+                               image_set + ".txt")) as f:
+            self.ids = [line.strip() for line in f if line.strip()]
+
+    def _parse_annotation(self, idx, scale_x, scale_y):
+        tree = ET.parse(os.path.join(self.root, "Annotations", idx + ".xml"))
+        boxes = []
+        for obj in tree.findall("object"):
+            name = obj.find("name").text.strip()
+            if name not in self._cls_index:
+                continue
+            diff = obj.find("difficult")
+            if self.skip_difficult and diff is not None \
+                    and int(diff.text) == 1:
+                continue
+            bb = obj.find("bndbox")
+            x1 = (float(bb.find("xmin").text) - 1) * scale_x
+            y1 = (float(bb.find("ymin").text) - 1) * scale_y
+            x2 = (float(bb.find("xmax").text) - 1) * scale_x
+            y2 = (float(bb.find("ymax").text) - 1) * scale_y
+            boxes.append([x1, y1, x2, y2, self._cls_index[name]])
+        return np.asarray(boxes, np.float32).reshape(-1, 5)
+
+    def load(self):
+        """-> (images (N,3,S,S) float32 in [0,1], [gt (k,5)]).
+
+        Images left with ZERO usable boxes (all objects difficult or
+        outside the class list) are dropped — anchor assignment and
+        proposal sampling both need at least one gt box (the reference
+        filters its roidb the same way, filter_roidb)."""
+        from PIL import Image
+
+        size = self.cfg.im_size
+        images, gt, dropped = [], [], 0
+        for idx in self.ids:
+            img = Image.open(os.path.join(
+                self.root, "JPEGImages", idx + ".jpg")).convert("RGB")
+            w, h = img.size
+            boxes = self._parse_annotation(
+                idx, (size - 1) / max(w - 1, 1), (size - 1) / max(h - 1, 1))
+            if len(boxes) == 0:
+                dropped += 1
+                continue
+            arr = np.asarray(img.resize((size, size), Image.BILINEAR),
+                             np.float32) / 255.0
+            images.append(arr.transpose(2, 0, 1))
+            gt.append(boxes)
+        if dropped:
+            print(f"PascalVOC: dropped {dropped} images with no usable "
+                  "gt boxes")
+        if not images:
+            raise ValueError(f"{self.root}: no images with usable gt boxes")
+        return np.stack(images), gt

@@ -66,7 +66,8 @@ def eval_map(eval_ex, loader, cfg, metric):
         eval_ex.forward(is_train=False, data=batch.data[0],
                         im_info=batch.data[1], **zeros)
         dets = im_detect(eval_ex.outputs, cfg, b)
-        labels = np.full((b, 4, 5), -1.0, np.float32)
+        max_gt = max((len(g) for g in batch.gt), default=1)
+        labels = np.full((b, max(max_gt, 1), 5), -1.0, np.float32)
         for i, g in enumerate(batch.gt):
             for j, row in enumerate(g):
                 labels[i, j] = [row[4], row[0], row[1], row[2], row[3]]

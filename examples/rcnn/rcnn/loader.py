@@ -40,11 +40,16 @@ class AnchorLoader(DataIter):
     reference passes them through the roidb the same way)."""
 
     def __init__(self, cfg, n_images=64, batch_size=8, seed=0,
-                 shuffle=True):
+                 shuffle=True, images=None, gt=None):
         super().__init__()
         self.cfg = cfg
         self.batch_size = batch_size
-        self.images, self.gt = synth_image_set(cfg, n_images, seed)
+        if images is not None:
+            # preloaded set (e.g. dataset.PascalVOC.load())
+            self.images, self.gt = images, gt
+            n_images = len(images)
+        else:
+            self.images, self.gt = synth_image_set(cfg, n_images, seed)
         self.anchors = grid_anchors(cfg)
         self._rs = np.random.RandomState(seed + 1)
         self._shuffle = shuffle

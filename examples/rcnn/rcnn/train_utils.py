@@ -1,0 +1,68 @@
+"""Shared trainer plumbing for the Faster R-CNN scripts (parity:
+example/rcnn/rcnn/core/module.py + tools/train_rpn.py scaffolding —
+the executor setup, parameter collection, and proposal extraction the
+reference's train_end2end/train_alternate both lean on)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+from .symbols import get_symbol
+
+# label/target variables that LOOK like parameters by suffix but must
+# never be initialized or updated (the old substring filter
+# '"rpn_bbox" not in name' also swallowed rpn_bbox_pred_weight/bias —
+# leaving the RPN box regressor untrained at its bind-time zeros)
+LABEL_VARS = frozenset((
+    "rpn_label", "rpn_bbox_target", "rpn_bbox_weight",
+    "rois", "roi_label", "bbox_target", "bbox_weight"))
+
+
+def build_executors(cfg, batch, ctx, loader):
+    """Bind the joint train graph + the proposal/eval graph sharing ONE
+    set of parameter NDArrays; returns (train_ex, eval_ex, params)."""
+    b, R = batch, cfg.rcnn_batch_rois
+    train_net = get_symbol(cfg, b, train_rois=True)
+    ex = train_net.simple_bind(
+        ctx=ctx, grad_req="write",
+        data=(b, 3, cfg.im_size, cfg.im_size),
+        rpn_label=loader.provide_label[0][1],
+        rpn_bbox_target=loader.provide_label[1][1],
+        rpn_bbox_weight=loader.provide_label[2][1],
+        rois=(b * R, 5), roi_label=(b * R,),
+        bbox_target=(b * R, 4 * cfg.num_classes),
+        bbox_weight=(b * R, 4 * cfg.num_classes))
+    init = mx.init.Xavier()
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name in LABEL_VARS or name in ("data", "im_info"):
+            continue
+        if name.endswith(("weight", "bias")):
+            init(name, arr)
+            params[name] = arr
+
+    eval_net = get_symbol(cfg, b, train_rois=False)
+    eval_args = {}
+    for name in eval_net.list_arguments():
+        if name in ex.arg_dict:
+            eval_args[name] = ex.arg_dict[name]  # shared: one update serves both
+        else:
+            shp = {"data": (b, 3, cfg.im_size, cfg.im_size),
+                   "im_info": (b, 3)}.get(name)
+            eval_args[name] = mx.nd.zeros(shp) if shp else mx.nd.zeros((1,))
+    eval_ex = eval_net.bind(ctx=ctx, args=eval_args, args_grad=None,
+                            grad_req="null")
+    return ex, eval_ex, params
+
+
+def current_proposals(eval_ex, batch, cfg):
+    """Forward the proposal graph on a batch (zero-filled loss inputs)
+    and return its rois (N*post_nms, 5) as numpy."""
+    lab, bt4, bw4 = batch.label
+    b = batch.data[0].shape[0]
+    eval_ex.forward(
+        is_train=False, data=batch.data[0], im_info=batch.data[1],
+        rpn_label=np.zeros(lab.shape, np.float32),
+        rpn_bbox_target=np.zeros(bt4.shape, np.float32),
+        rpn_bbox_weight=np.zeros(bw4.shape, np.float32),
+        roi_label=np.zeros((b * cfg.rpn_post_nms_top_n,), np.float32))
+    return eval_ex.outputs[4].asnumpy()

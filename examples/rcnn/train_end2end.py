@@ -26,7 +26,7 @@ from rcnn.detect import eval_map  # noqa: E402
 from rcnn.loader import AnchorLoader  # noqa: E402
 from rcnn.metric import (RCNNAccuracy, RCNNLogLoss, RPNAccuracy,  # noqa: E402
                          RPNLogLoss)
-from rcnn.symbols import get_symbol  # noqa: E402
+from rcnn.train_utils import build_executors, current_proposals  # noqa: E402
 from rcnn.targets import sample_rois  # noqa: E402
 
 
@@ -48,39 +48,12 @@ def main():
 
     loader = AnchorLoader(cfg, n_images=args.images,
                           batch_size=args.batch)
-    b, R = args.batch, cfg.rcnn_batch_rois
-
-    train_net = get_symbol(cfg, b, train_rois=True)
+    b = args.batch
     ctx = mx.context.default_accelerator_context()
-    ex = train_net.simple_bind(
-        ctx=ctx, grad_req="write",
-        data=(b, 3, cfg.im_size, cfg.im_size),
-        rpn_label=loader.provide_label[0][1],
-        rpn_bbox_target=loader.provide_label[1][1],
-        rpn_bbox_weight=loader.provide_label[2][1],
-        rois=(b * R, 5), roi_label=(b * R,),
-        bbox_target=(b * R, 4 * cfg.num_classes),
-        bbox_weight=(b * R, 4 * cfg.num_classes))
-    init = mx.init.Xavier()
-    params = {}
-    for name, arr in ex.arg_dict.items():
-        if name.endswith(("weight", "bias")) and "rpn_bbox" not in name \
-                and "bbox_target" not in name and "bbox_weight" not in name:
-            init(name, arr)
-            params[name] = arr
-
-    # eval graph shares the parameter NDArrays (one update serves both)
-    eval_net = get_symbol(cfg, b, train_rois=False)
-    eval_args = {}
-    for name in eval_net.list_arguments():
-        if name in ex.arg_dict:
-            eval_args[name] = ex.arg_dict[name]
-        else:
-            shp = {"data": (b, 3, cfg.im_size, cfg.im_size),
-                   "im_info": (b, 3)}.get(name)
-            eval_args[name] = mx.nd.zeros(shp) if shp else mx.nd.zeros((1,))
-    eval_ex = eval_net.bind(ctx=ctx, args=eval_args, args_grad=None,
-                            grad_req="null")
+    # shared plumbing (rcnn/train_utils.py) — note this also fixes the
+    # old substring param filter that silently left rpn_bbox_pred's
+    # weight/bias untrained at bind-time zeros
+    ex, eval_ex, params = build_executors(cfg, b, ctx, loader)
 
     opt = mx.optimizer.create("sgd", learning_rate=args.lr, momentum=0.9,
                               rescale_grad=1.0 / b)
@@ -96,14 +69,7 @@ def main():
                 break
             lab, bt4, bw4 = batch.label
             # stage 1: this batch's proposals from the CURRENT weights
-            eval_ex.forward(
-                is_train=False, data=batch.data[0], im_info=batch.data[1],
-                rpn_label=np.zeros_like(lab),
-                rpn_bbox_target=np.zeros_like(bt4),
-                rpn_bbox_weight=np.zeros_like(bw4),
-                roi_label=np.zeros((b * cfg.rpn_post_nms_top_n,),
-                                   np.float32))
-            proposals = eval_ex.outputs[4].asnumpy()
+            proposals = current_proposals(eval_ex, batch, cfg)
             # stage 2: proposal_target sampling
             rois, roi_label, bbox_t, bbox_w = sample_rois(
                 proposals, batch.gt, cfg, rs=rs)
@@ -141,7 +107,7 @@ def main():
     print("VOC07_mAP: %.4f" % mAP)
     if args.save_prefix:
         mx.model.save_checkpoint(
-            args.save_prefix, 0, eval_net,
+            args.save_prefix, 0, eval_ex.symbol,
             {k: v for k, v in params.items()}, {})
         print("saved %s-0000.params" % args.save_prefix)
     if args.assert_map is not None:

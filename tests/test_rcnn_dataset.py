@@ -1,0 +1,81 @@
+"""Pascal VOC dataset loader tests (parity:
+example/rcnn/rcnn/dataset/pascal_voc.py — the reference parses a
+VOCdevkit tree into a roidb; here the writer emits a real devkit and
+the parser reads it back, pinning the XML 1-based-coordinate and class
+conventions)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+RCNN = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "rcnn")
+sys.path.insert(0, RCNN)
+
+from rcnn import config as cfg_mod  # noqa: E402
+from rcnn.dataset import CLASSES, PascalVOC, write_synth_devkit  # noqa: E402
+from rcnn.loader import AnchorLoader, synth_image_set  # noqa: E402
+
+pytest.importorskip("PIL")
+
+
+def test_devkit_roundtrip(tmp_path):
+    cfg = cfg_mod.default
+    root = write_synth_devkit(str(tmp_path), cfg, 10, seed=3)
+    assert os.path.isfile(os.path.join(root, "Annotations", "000000.xml"))
+    assert os.path.isfile(os.path.join(root, "JPEGImages", "000000.jpg"))
+
+    train = PascalVOC(str(tmp_path), "trainval", cfg=cfg)
+    test = PascalVOC(str(tmp_path), "test", cfg=cfg)
+    assert len(train.ids) == 8 and len(test.ids) == 2
+
+    images, gt = train.load()
+    src_images, src_gt = synth_image_set(cfg, 10, seed=3)
+    assert images.shape == (8, 3, cfg.im_size, cfg.im_size)
+    for i in range(8):
+        # boxes survive the XML round trip exactly (same-size images:
+        # scale 1; VOC 1-based offsets cancel)
+        np.testing.assert_allclose(gt[i], src_gt[i], atol=1e-4)
+        # jpeg is lossy but close
+        assert np.abs(images[i] - src_images[i]).mean() < 0.06
+
+
+def test_unknown_and_difficult_objects_skipped(tmp_path):
+    cfg = cfg_mod.default
+    root = write_synth_devkit(str(tmp_path), cfg, 4, seed=0)
+    # append an unknown-class and a difficult object to image 0
+    import xml.etree.ElementTree as ET
+
+    p = os.path.join(root, "Annotations", "000000.xml")
+    tree = ET.parse(p)
+    for name, difficult in (("unicorn", "0"), ("wide", "1")):
+        obj = ET.SubElement(tree.getroot(), "object")
+        ET.SubElement(obj, "name").text = name
+        ET.SubElement(obj, "difficult").text = difficult
+        bb = ET.SubElement(obj, "bndbox")
+        for tag, v in (("xmin", "1"), ("ymin", "1"), ("xmax", "9"),
+                       ("ymax", "9")):
+            ET.SubElement(bb, tag).text = v
+    tree.write(p)
+
+    n_before = len(PascalVOC(str(tmp_path), "trainval", cfg=cfg)
+                   .load()[1][0])
+    _, src_gt = synth_image_set(cfg, 4, seed=0)
+    assert n_before == len(src_gt[0])  # both extras skipped
+
+    keep_difficult = PascalVOC(str(tmp_path), "trainval", cfg=cfg,
+                               skip_difficult=False).load()[1][0]
+    assert len(keep_difficult) == len(src_gt[0]) + 1
+
+
+def test_anchor_loader_accepts_preloaded_set(tmp_path):
+    cfg = cfg_mod.default
+    write_synth_devkit(str(tmp_path), cfg, 10, seed=1)
+    images, gt = PascalVOC(str(tmp_path), "trainval", cfg=cfg).load()
+    loader = AnchorLoader(cfg, batch_size=4, images=images, gt=gt,
+                          shuffle=False)
+    batch = next(loader)
+    assert batch.data[0].shape == (4, 3, cfg.im_size, cfg.im_size)
+    assert len(batch.gt) == 4
+    assert CLASSES[int(batch.gt[0][0][4])] in ("wide", "tall")
