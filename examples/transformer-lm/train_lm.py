@@ -74,18 +74,28 @@ def main():
             logging.info("step %d: next-char acc %.3f", step,
                          float((pred == labs).mean()))
 
-    # sampling: feed a sliding window through the eval graph
-    ctx_toks = list(ids[:args.seq_len].astype(int))
-    out_chars = []
-    for _ in range(args.sample_len):
-        win = np.array(ctx_toks[-args.seq_len:], np.float32)[None, :]
-        probs = np.asarray(tr.eval(
-            data=win, softmax_label=np.zeros_like(win))[0])
-        p = probs.reshape(args.seq_len, -1)[-1]
-        nxt = int(rs.choice(len(vocab), p=p / p.sum()))
-        ctx_toks.append(nxt)
-        out_chars.append(inv[nxt])
-    print("sample:", "".join(out_chars))
+    # sampling through the KV-cache decoder (models/decode.py): prefill
+    # the prompt once, then ONE jitted O(seq_len) step per token — the
+    # old sliding-window eval re-ran the full O(T^2) forward per token
+    import time
+
+    from mxnet_tpu.models.decode import KVDecoder
+
+    dec = KVDecoder(tr.params, num_layers=args.num_layers,
+                    num_heads=args.num_heads, max_len=args.seq_len)
+    n_prompt = max(1, min(8, args.seq_len // 2))
+    n_sample = min(args.sample_len, args.seq_len - n_prompt)
+    if n_sample < args.sample_len:
+        print(f"note: sampling {n_sample} tokens (seq_len {args.seq_len} "
+              f"bounds prompt+sample; train with a longer --seq-len for "
+              "longer samples)")
+    prompt = ids[:n_prompt].astype(int)[None, :]
+    tic = time.perf_counter()
+    sampled = dec.generate(prompt, n_sample, temperature=1.0, rng=rs)
+    dt = time.perf_counter() - tic
+    print("sample:", "".join(inv[int(t)] for t in sampled[0]))
+    print(f"decode: {n_sample / dt:.1f} tok/s (KV cache, prefill "
+          f"{n_prompt} + {n_sample} steps)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,181 @@
+"""Incremental (KV-cache) decoding for the transformer LM
+(beyond-reference: the reference has no autoregressive serving story —
+its RNN demos re-run full windows per token.  This is the standard
+O(T) decode: prefill once, then one position per step against cached
+K/V, everything jitted with static shapes).
+
+Works straight off a `models.transformer.transformer_lm` checkpoint:
+the decoder reads the SAME arg_params the training symbol binds
+(tok_embed/pos_embed/layer{i}_*/final_ln/lm_head), re-expressing the
+forward functionally so each step is one XLA program with
+`lax.dynamic_update_slice` into a (L, B, H, max_len, dh) cache.
+`tests/test_decode.py` pins step-by-step equivalence against the
+symbol graph's full forward.
+"""
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _ln(x, g, b, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * g + b
+
+
+def _fc(x, w, b=None):
+    y = x @ w.T
+    return y if b is None else y + b
+
+
+class KVDecoder:
+    """One instance per (checkpoint, batch, max_len) combination.
+
+    state = (k_cache, v_cache, pos):
+      k/v_cache (L, B, H, max_len, dh); pos int32 — tokens filled so far.
+    """
+
+    def __init__(self, arg_params, num_layers, num_heads, max_len,
+                 dtype=jnp.float32):
+        to = lambda a: jnp.asarray(
+            a.asnumpy() if hasattr(a, "asnumpy") else a, dtype)
+        p = {k: to(v) for k, v in arg_params.items()}
+        self.p = p
+        self.L, self.H = num_layers, num_heads
+        self.max_len = max_len
+        self.d_model = p["tok_embed_weight"].shape[1]
+        self.dh = self.d_model // num_heads
+        self.vocab = p["lm_head_weight"].shape[0]
+        if p["pos_embed"].shape[1] < max_len:
+            raise ValueError(
+                f"checkpoint pos table {p['pos_embed'].shape[1]} < "
+                f"max_len {max_len}")
+        self._step_jit = jax.jit(partial(self._forward_positions, n=1))
+        self._prefill_cache = {}
+
+    # ---------------------------------------------------------------- core
+    def _block_qkv(self, i, h2):
+        p = self.p
+        name = f"layer{i}"
+        q = _fc(h2, p[f"{name}_q_weight"], p[f"{name}_q_bias"])
+        k = _fc(h2, p[f"{name}_k_weight"], p[f"{name}_k_bias"])
+        v = _fc(h2, p[f"{name}_v_weight"], p[f"{name}_v_bias"])
+        return q, k, v
+
+    def _forward_positions(self, kc, vc, pos, tokens, n):
+        """Run ``n`` new positions (tokens (B, n)) against the cache.
+        ``pos`` rides as a traced scalar; the HOST tracks the counter so
+        no step ever fetches device state (on tunneled backends a
+        per-step sync would dominate decode latency)."""
+        p = self.p
+        B = tokens.shape[0]
+        H, dh, D = self.H, self.dh, self.d_model
+
+        tok = jnp.take(p["tok_embed_weight"], tokens.astype(jnp.int32),
+                       axis=0)                       # (B, n, D)
+        posv = jax.lax.dynamic_slice(
+            p["pos_embed"], (0, pos, 0), (1, n, D))
+        h = tok + posv
+        # positions 0..max_len-1 valid iff < pos+ their offset
+        span = pos + jnp.arange(n)                   # (n,)
+        mask = jnp.arange(self.max_len)[None, :] <= span[:, None]  # (n, S)
+        for i in range(self.L):
+            name = f"layer{i}"
+            h2 = _ln(h, p[f"{name}_ln1_gamma"], p[f"{name}_ln1_beta"])
+            q, k, v = self._block_qkv(i, h2)
+            sh = lambda a: a.reshape(B, n, H, dh).transpose(0, 2, 1, 3)
+            qh, kh, vh = sh(q), sh(k), sh(v)         # (B, H, n, dh)
+            kc = jax.lax.dynamic_update_slice(
+                kc, kh[None], (i, 0, 0, pos, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, vh[None], (i, 0, 0, pos, 0))
+            scores = jnp.einsum("bhnd,bhsd->bhns", qh, kc[i]) \
+                / jnp.sqrt(jnp.asarray(dh, h.dtype))
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+            att = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bhns,bhsd->bhnd", att, vc[i])
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(B, n, D)
+            proj = _fc(ctx, p[f"{name}_proj_weight"],
+                       p[f"{name}_proj_bias"])
+            h = h + proj
+            h2 = _ln(h, p[f"{name}_ln2_gamma"], p[f"{name}_ln2_beta"])
+            f = _fc(h2, p[f"{name}_ffn_in_weight"],
+                    p[f"{name}_ffn_in_bias"])
+            f = jax.nn.gelu(f)
+            f = _fc(f, p[f"{name}_ffn_out_weight"],
+                    p[f"{name}_ffn_out_bias"])
+            h = h + f
+        h = _ln(h, p["final_ln_gamma"], p["final_ln_beta"])
+        logits = _fc(h, p["lm_head_weight"], p["lm_head_bias"])
+        return (kc, vc), logits                      # logits (B, n, V)
+
+    # ----------------------------------------------------------------- API
+    def init_state(self, batch):
+        """state = (k_cache, v_cache, pos) — pos is a HOST int."""
+        shape = (self.L, batch, self.H, self.max_len, self.dh)
+        dtype = self.p["tok_embed_weight"].dtype
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), 0)
+
+    def prefill(self, tokens):
+        """tokens (B, T) -> (state, logits (B, T, V)); one compile per
+        distinct prompt length."""
+        tokens = jnp.asarray(tokens)
+        B, T = tokens.shape
+        if T > self.max_len:
+            raise ValueError(f"prompt {T} > max_len {self.max_len}")
+        if T not in self._prefill_cache:
+            self._prefill_cache[T] = jax.jit(
+                partial(self._forward_positions, n=T))
+        kc, vc, pos = self.init_state(B)
+        (kc, vc), logits = self._prefill_cache[T](kc, vc, pos, tokens)
+        return (kc, vc, pos + T), logits
+
+    def step(self, state, token):
+        """token (B,) -> (state, logits (B, V)) — ONE fused XLA program
+        per call, O(max_len) attention, no host-device sync."""
+        kc, vc, pos = state
+        if pos >= self.max_len:
+            raise ValueError(
+                f"cache full: {self.max_len} positions decoded (the "
+                "checkpoint's positional table ends there)")
+        (kc, vc), logits = self._step_jit(
+            kc, vc, pos, jnp.asarray(token).reshape(-1, 1))
+        return (kc, vc, pos + 1), logits[:, 0]
+
+    def generate(self, prompt, n_tokens, temperature=1.0, top_k=None,
+                 rng=None):
+        """Greedy/temperature sampling loop; returns (B, n_tokens)."""
+        rng = rng or np.random.RandomState(0)
+        prompt = np.asarray(prompt)
+        total = prompt.shape[1] + n_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt+n_tokens = {total} exceeds max_len "
+                f"{self.max_len} (the checkpoint's positional table)")
+        if n_tokens <= 0:
+            return np.zeros((prompt.shape[0], 0), np.int64)
+        state, logits = self.prefill(prompt)
+        last = logits[:, -1]
+        out = []
+        for i in range(n_tokens):
+            lg = np.asarray(last, np.float32)
+            if temperature <= 0:
+                nxt = lg.argmax(-1)
+            else:
+                lg = lg / temperature
+                if top_k:
+                    kth = np.partition(lg, -top_k, axis=-1)[:, -top_k, None]
+                    lg = np.where(lg < kth, -np.inf, lg)
+                z = lg - lg.max(-1, keepdims=True)
+                prob = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+                nxt = np.array([rng.choice(lg.shape[-1], p=p_)
+                                for p_ in prob])
+            out.append(nxt)
+            if i + 1 < n_tokens:  # the last sampled token needs no step
+                state, last = self.step(state, nxt)
+        return np.stack(out, axis=1)
