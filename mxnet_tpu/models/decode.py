@@ -22,6 +22,11 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _logsumexp(x):
+    m = x.max(-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(-1, keepdims=True))
+
+
 def _ln(x, g, b, eps=1e-5):
     m = x.mean(-1, keepdims=True)
     v = ((x - m) ** 2).mean(-1, keepdims=True)
@@ -56,6 +61,8 @@ class KVDecoder:
                 f"checkpoint pos table {p['pos_embed'].shape[1]} < "
                 f"max_len {max_len}")
         self._step_jit = jax.jit(partial(self._forward_positions, n=1))
+        self._reorder_jit = jax.jit(
+            lambda kc, vc, idx: (kc[:, idx], vc[:, idx]))
         self._prefill_cache = {}
 
     # ---------------------------------------------------------------- core
@@ -179,3 +186,56 @@ class KVDecoder:
             if i + 1 < n_tokens:  # the last sampled token needs no step
                 state, last = self.step(state, nxt)
         return np.stack(out, axis=1)
+
+    def beam_search(self, prompt, n_tokens, beam_size=4,
+                    length_penalty=0.0):
+        """Beam decode: returns (tokens (B, beam, n_tokens),
+        scores (B, beam)) sorted best-first per batch row.
+
+        The cache runs at batch B*beam from the start (prompt rows
+        replicated); beam reordering is a jitted row-gather on the
+        device cache, the bookkeeping (log-probs, back-pointers) stays
+        host-side like the sampling loop."""
+        prompt = np.asarray(prompt)
+        B, T = prompt.shape
+        if T + n_tokens > self.max_len:
+            raise ValueError(
+                f"prompt+n_tokens = {T + n_tokens} exceeds max_len "
+                f"{self.max_len}")
+        if n_tokens <= 0:
+            return (np.zeros((B, beam_size, 0), np.int64),
+                    np.zeros((B, beam_size), np.float32))
+        K = beam_size
+        state, logits = self.prefill(np.repeat(prompt, K, axis=0))
+        last = np.asarray(logits[:, -1], np.float32)     # (B*K, V)
+        V = last.shape[-1]
+        logp = last - _logsumexp(last)
+        # first expansion: distinct top-K continuations per batch row
+        first = logp.reshape(B, K, V)[:, 0]              # replicas identical
+        top = np.argsort(-first, axis=-1)[:, :K]         # (B, K)
+        scores = np.take_along_axis(first, top, axis=-1)  # (B, K)
+        seqs = top[:, :, None]                           # (B, K, 1)
+        nxt = top.reshape(-1)
+        for i in range(1, n_tokens):
+            state, lg = self.step(state, nxt)
+            logp = np.asarray(lg, np.float32)
+            logp = (logp - _logsumexp(logp)).reshape(B, K, V)
+            cand = scores[:, :, None] + logp             # (B, K, V)
+            flat = cand.reshape(B, K * V)
+            top = np.argsort(-flat, axis=-1)[:, :K]      # (B, K)
+            beam_idx, tok = top // V, top % V
+            scores = np.take_along_axis(flat, top, axis=-1)
+            seqs = np.concatenate(
+                [np.take_along_axis(seqs, beam_idx[:, :, None], axis=1),
+                 tok[:, :, None]], axis=2)
+            # reorder the device cache rows to follow the survivors
+            rows = (np.arange(B)[:, None] * K + beam_idx).reshape(-1)
+            kc, vc, pos = state
+            kc, vc = self._reorder_jit(kc, vc, jnp.asarray(rows))
+            state = (kc, vc, pos)
+            nxt = tok.reshape(-1)
+        if length_penalty:
+            scores = scores / (n_tokens ** length_penalty)
+        order = np.argsort(-scores, axis=-1)
+        return (np.take_along_axis(seqs, order[:, :, None], axis=1),
+                np.take_along_axis(scores, order, axis=-1))

@@ -73,3 +73,25 @@ def test_generate_shapes_and_determinism():
     c = dec.generate(prompt, 6, temperature=0.8, top_k=5,
                      rng=np.random.RandomState(1))
     assert c.shape == (2, 6) and (c < V).all()
+
+
+def test_beam_search_beam1_matches_greedy():
+    _, params, rs = _bound_model()
+    dec = KVDecoder(params, num_layers=L, num_heads=H, max_len=T)
+    prompt = rs.randint(0, V, (2, 4))
+    greedy = dec.generate(prompt, 6, temperature=0)
+    beam, scores = dec.beam_search(prompt, 6, beam_size=1)
+    assert beam.shape == (2, 1, 6) and scores.shape == (2, 1)
+    np.testing.assert_array_equal(beam[:, 0], greedy)
+
+
+def test_beam_search_widening_never_hurts_best_score():
+    _, params, rs = _bound_model()
+    dec = KVDecoder(params, num_layers=L, num_heads=H, max_len=T)
+    prompt = rs.randint(0, V, (1, 3))
+    _, s1 = dec.beam_search(prompt, 5, beam_size=1)
+    _, s4 = dec.beam_search(prompt, 5, beam_size=4)
+    # a wider beam can only find an equal-or-better best sequence
+    assert s4[0, 0] >= s1[0, 0] - 1e-5
+    # per-beam scores come back sorted best-first
+    assert (np.diff(s4[0]) <= 1e-6).all()
