@@ -188,9 +188,16 @@ class KVDecoder:
         return np.stack(out, axis=1)
 
     def beam_search(self, prompt, n_tokens, beam_size=4,
-                    length_penalty=0.0):
+                    length_penalty=0.0, eos_id=None):
         """Beam decode: returns (tokens (B, beam, n_tokens),
         scores (B, beam)) sorted best-first per batch row.
+
+        With ``eos_id`` set, beams that emit it stop accumulating score
+        (further positions are eos-padded) and ``length_penalty``
+        normalizes each beam's score by its OWN length^penalty — the
+        standard way longer unfinished beams compete with short
+        finished ones.  Without an eos, every beam has equal length and
+        the penalty only rescales scores.
 
         The cache runs at batch B*beam from the start (prompt rows
         replicated); beam reordering is a jitted row-gather on the
@@ -202,40 +209,70 @@ class KVDecoder:
             raise ValueError(
                 f"prompt+n_tokens = {T + n_tokens} exceeds max_len "
                 f"{self.max_len}")
+        if beam_size > self.vocab:
+            raise ValueError(
+                f"beam_size {beam_size} > vocab {self.vocab}")
         if n_tokens <= 0:
             return (np.zeros((B, beam_size, 0), np.int64),
                     np.zeros((B, beam_size), np.float32))
         K = beam_size
+
+        def topk(mat, k):
+            part = np.argpartition(-mat, k - 1, axis=-1)[:, :k]
+            vals = np.take_along_axis(mat, part, axis=-1)
+            order = np.argsort(-vals, axis=-1)
+            return np.take_along_axis(part, order, axis=-1)
+
         state, logits = self.prefill(np.repeat(prompt, K, axis=0))
         last = np.asarray(logits[:, -1], np.float32)     # (B*K, V)
         V = last.shape[-1]
         logp = last - _logsumexp(last)
         # first expansion: distinct top-K continuations per batch row
         first = logp.reshape(B, K, V)[:, 0]              # replicas identical
-        top = np.argsort(-first, axis=-1)[:, :K]         # (B, K)
+        top = topk(first, K)                             # (B, K)
         scores = np.take_along_axis(first, top, axis=-1)  # (B, K)
         seqs = top[:, :, None]                           # (B, K, 1)
+        finished = (top == eos_id) if eos_id is not None \
+            else np.zeros((B, K), bool)
+        lengths = np.ones((B, K), np.int64)
         nxt = top.reshape(-1)
         for i in range(1, n_tokens):
+            if finished.all():
+                pad = np.full((B, K, n_tokens - i), eos_id, np.int64)
+                seqs = np.concatenate([seqs, pad], axis=2)
+                break
             state, lg = self.step(state, nxt)
             logp = np.asarray(lg, np.float32)
             logp = (logp - _logsumexp(logp)).reshape(B, K, V)
             cand = scores[:, :, None] + logp             # (B, K, V)
+            if eos_id is not None:
+                # a finished beam contributes exactly one candidate:
+                # itself, eos-padded, score frozen
+                cand[finished] = NEG_INF
+                cand[finished, eos_id] = scores[finished]
             flat = cand.reshape(B, K * V)
-            top = np.argsort(-flat, axis=-1)[:, :K]      # (B, K)
+            top = topk(flat, K)                          # (B, K)
             beam_idx, tok = top // V, top % V
             scores = np.take_along_axis(flat, top, axis=-1)
             seqs = np.concatenate(
                 [np.take_along_axis(seqs, beam_idx[:, :, None], axis=1),
                  tok[:, :, None]], axis=2)
-            # reorder the device cache rows to follow the survivors
-            rows = (np.arange(B)[:, None] * K + beam_idx).reshape(-1)
-            kc, vc, pos = state
-            kc, vc = self._reorder_jit(kc, vc, jnp.asarray(rows))
-            state = (kc, vc, pos)
+            parent_fin = np.take_along_axis(finished, beam_idx, axis=-1)
+            lengths = np.take_along_axis(lengths, beam_idx, axis=-1) \
+                + (~parent_fin)
+            if eos_id is not None:
+                finished = parent_fin | (tok == eos_id)
             nxt = tok.reshape(-1)
+            if i + 1 < n_tokens and not finished.all():
+                # follow the survivors on the device cache (skipped on
+                # the last step — nothing consumes it)
+                rows = (np.arange(B)[:, None] * K + beam_idx).reshape(-1)
+                kc, vc, pos = state
+                kc, vc = self._reorder_jit(kc, vc, jnp.asarray(rows))
+                state = (kc, vc, pos)
         if length_penalty:
-            scores = scores / (n_tokens ** length_penalty)
+            scores = scores / (lengths.astype(np.float32)
+                               ** length_penalty)
         order = np.argsort(-scores, axis=-1)
         return (np.take_along_axis(seqs, order[:, :, None], axis=1),
                 np.take_along_axis(scores, order, axis=-1))

@@ -95,3 +95,31 @@ def test_beam_search_widening_never_hurts_best_score():
     assert s4[0, 0] >= s1[0, 0] - 1e-5
     # per-beam scores come back sorted best-first
     assert (np.diff(s4[0]) <= 1e-6).all()
+
+
+def test_beam_search_eos_finishes_and_pads():
+    _, params, rs = _bound_model()
+    dec = KVDecoder(params, num_layers=L, num_heads=H, max_len=T)
+    prompt = rs.randint(0, V, (1, 3))
+    # find the greedy first token, then make IT the eos: the best beam
+    # finishes immediately and must come back fully eos-padded with its
+    # single-token score frozen
+    base, base_scores = dec.beam_search(prompt, 5, beam_size=2)
+    eos = int(base[0, 0, 0])
+    toks, scores = dec.beam_search(prompt, 5, beam_size=2, eos_id=eos,
+                                   length_penalty=1.0)
+    assert (toks[0, 0] == eos).all()
+    # the finished beam froze after ONE token: its length-normalized
+    # score is that single logprob, strictly better than any 5-token
+    # accumulation (logprobs only subtract)
+    assert scores[0, 0] > base_scores[0, 0]
+    assert toks.shape == (1, 2, 5)
+
+
+def test_beam_search_rejects_oversized_beam():
+    import pytest
+
+    _, params, rs = _bound_model()
+    dec = KVDecoder(params, num_layers=L, num_heads=H, max_len=T)
+    with pytest.raises(ValueError, match="beam_size"):
+        dec.beam_search(rs.randint(0, V, (1, 2)), 3, beam_size=V + 1)
