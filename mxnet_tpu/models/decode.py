@@ -46,10 +46,26 @@ class KVDecoder:
     """
 
     def __init__(self, arg_params, num_layers, num_heads, max_len,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, mesh=None, model_axis="model"):
+        """``mesh``: shard serving over devices, Megatron-style — q/k/v
+        and ffn_in weights column-parallel, proj and ffn_out
+        row-parallel, the K/V cache split on its HEAD axis — so each
+        device holds 1/tp of the weights and cache and GSPMD inserts
+        the one all-reduce per block the row-parallel products need
+        (the serving mirror of parallel/mesh.megatron_rules)."""
         to = lambda a: jnp.asarray(
             a.asnumpy() if hasattr(a, "asnumpy") else a, dtype)
         p = {k: to(v) for k, v in arg_params.items()}
+        self.mesh = mesh
+        self.model_axis = model_axis
+        if mesh is not None:
+            tp = mesh.shape[model_axis]
+            if num_heads % tp:
+                raise ValueError(
+                    f"num_heads {num_heads} must divide by the "
+                    f"{model_axis!r} mesh axis ({tp})")
+            p = {k: jax.device_put(v, self._param_sharding(k))
+                 for k, v in p.items()}
         self.p = p
         self.L, self.H = num_layers, num_heads
         self.max_len = max_len
@@ -64,6 +80,31 @@ class KVDecoder:
         self._reorder_jit = jax.jit(
             lambda kc, vc, idx: (kc[:, idx], vc[:, idx]))
         self._prefill_cache = {}
+
+    def _param_sharding(self, name):
+        """NamedSharding for one checkpoint tensor under the tp mesh.
+        FullyConnected weights are (out, in): column-parallel = shard
+        dim 0, row-parallel = shard dim 1."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ax = self.model_axis
+        if name.endswith(("_q_weight", "_k_weight", "_v_weight",
+                          "_ffn_in_weight")):
+            spec = P(ax, None)
+        elif name.endswith(("_q_bias", "_k_bias", "_v_bias",
+                            "_ffn_in_bias")):
+            spec = P(ax)
+        elif name.endswith(("_proj_weight", "_ffn_out_weight")):
+            spec = P(None, ax)
+        else:  # embeddings, norms, heads, row-parallel biases: replicate
+            spec = P()
+        return NamedSharding(self.mesh, spec)
+
+    def _cache_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # (L, B, H, max_len, dh): split the head axis
+        return NamedSharding(self.mesh, P(None, None, self.model_axis))
 
     # ---------------------------------------------------------------- core
     def _block_qkv(self, i, h2):
@@ -126,7 +167,12 @@ class KVDecoder:
         """state = (k_cache, v_cache, pos) — pos is a HOST int."""
         shape = (self.L, batch, self.H, self.max_len, self.dh)
         dtype = self.p["tok_embed_weight"].dtype
-        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), 0)
+        kc = jnp.zeros(shape, dtype)
+        vc = jnp.zeros(shape, dtype)
+        if self.mesh is not None:
+            sh = self._cache_sharding()
+            kc, vc = jax.device_put(kc, sh), jax.device_put(vc, sh)
+        return (kc, vc, 0)
 
     def prefill(self, tokens):
         """tokens (B, T) -> (state, logits (B, T, V)); one compile per
