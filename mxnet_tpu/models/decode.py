@@ -59,13 +59,25 @@ class KVDecoder:
         self.mesh = mesh
         self.model_axis = model_axis
         if mesh is not None:
+            from ..parallel.mesh import megatron_rules, shard_params
+
             tp = mesh.shape[model_axis]
             if num_heads % tp:
                 raise ValueError(
                     f"num_heads {num_heads} must divide by the "
                     f"{model_axis!r} mesh axis ({tp})")
-            p = {k: jax.device_put(v, self._param_sharding(k))
-                 for k, v in p.items()}
+            for k, v in p.items():
+                if k.endswith("_ffn_in_weight") and v.shape[0] % tp:
+                    raise ValueError(
+                        f"{k}: d_ff {v.shape[0]} must divide by the "
+                        f"{model_axis!r} mesh axis ({tp})")
+            # the training layout, minus the vocab-sharded head/embed
+            # (decode keeps logits replicated — the sampler reads them
+            # on the host every step)
+            rules = tuple(r for r in megatron_rules(model_axis)
+                          if "lm_head" not in r.pattern
+                          and "tok_embed" not in r.pattern)
+            p = shard_params(mesh, p, rules)
         self.p = p
         self.L, self.H = num_layers, num_heads
         self.max_len = max_len
@@ -80,25 +92,6 @@ class KVDecoder:
         self._reorder_jit = jax.jit(
             lambda kc, vc, idx: (kc[:, idx], vc[:, idx]))
         self._prefill_cache = {}
-
-    def _param_sharding(self, name):
-        """NamedSharding for one checkpoint tensor under the tp mesh.
-        FullyConnected weights are (out, in): column-parallel = shard
-        dim 0, row-parallel = shard dim 1."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        ax = self.model_axis
-        if name.endswith(("_q_weight", "_k_weight", "_v_weight",
-                          "_ffn_in_weight")):
-            spec = P(ax, None)
-        elif name.endswith(("_q_bias", "_k_bias", "_v_bias",
-                            "_ffn_in_bias")):
-            spec = P(ax)
-        elif name.endswith(("_proj_weight", "_ffn_out_weight")):
-            spec = P(None, ax)
-        else:  # embeddings, norms, heads, row-parallel biases: replicate
-            spec = P()
-        return NamedSharding(self.mesh, spec)
 
     def _cache_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -167,11 +160,16 @@ class KVDecoder:
         """state = (k_cache, v_cache, pos) — pos is a HOST int."""
         shape = (self.L, batch, self.H, self.max_len, self.dh)
         dtype = self.p["tok_embed_weight"].dtype
-        kc = jnp.zeros(shape, dtype)
-        vc = jnp.zeros(shape, dtype)
         if self.mesh is not None:
+            # allocate SHARDED: each device holds 1/tp of the cache from
+            # the start (a dense zeros + reshard would transiently put
+            # the whole cache on one device)
             sh = self._cache_sharding()
-            kc, vc = jax.device_put(kc, sh), jax.device_put(vc, sh)
+            kc = jnp.zeros(shape, dtype, device=sh)
+            vc = jnp.zeros(shape, dtype, device=sh)
+        else:
+            kc = jnp.zeros(shape, dtype)
+            vc = jnp.zeros(shape, dtype)
         return (kc, vc, 0)
 
     def prefill(self, tokens):
