@@ -41,9 +41,14 @@ while true; do
   [ -f "$OUT/variants.ok" ] || { timeout 1500 python \
       tools/probe_resnet_variants.py > "$OUT/variants" 2>&1 \
       && grep -q "nobn" "$OUT/variants" && touch "$OUT/variants.ok"; }
+  [ -f "$OUT/tputests.ok" ] || { timeout 1800 env MXTPU_TPU_TESTS=1 \
+      python -m pytest tests/test_tpu_consistency.py -q \
+      > "$OUT/tputests" 2>&1 \
+      && grep -qE "passed" "$OUT/tputests" && touch "$OUT/tputests.ok"; }
 
   if [ -f "$OUT/peak.ok" ] && [ -f "$OUT/predict.ok" ] \
-     && [ -f "$OUT/profile.ok" ] && [ -f "$OUT/variants.ok" ]; then
+     && [ -f "$OUT/profile.ok" ] && [ -f "$OUT/variants.ok" ] \
+     && [ -f "$OUT/tputests.ok" ]; then
     echo "[window] attempt $attempt: ALL DONE" >> "$OUT/driver.log"
     exit 0
   fi
