@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""LSTM + CTC OCR (parity: example/warpctc/lstm_ocr.py — the
+reference trains an LSTM over captcha image columns with warp-ctc and
+reports sequence accuracy from the greedy CTC decode; same system here
+on synthetic seven-segment captchas, so it runs with no font/captcha
+dependency).
+
+Variable-length digit strings (3-5 digits) render at jittered positions
+and widths; labels are 0-padded (the warp-ctc blank/padding
+convention); the alignment-free CTC loss (WarpCTC,
+a built-in op here — lax.scan alpha recursion, no plugin) learns the
+column<->digit correspondence itself.  After training, the checkpoint
+feeds ocr_predict.py (the predictor path).
+
+Run:  MXTPU_PLATFORM=cpu python lstm_ocr.py --assert-acc 0.8
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+H, W = 16, 48          # image size (rows, columns=timesteps)
+MAX_DIGITS = 5
+BLANK = 0              # class 0 = CTC blank; digits are 1..10
+
+# seven-segment truth table: (top, top-l, top-r, mid, bot-l, bot-r, bot)
+SEGMENTS = {
+    0: (1, 1, 1, 0, 1, 1, 1), 1: (0, 0, 1, 0, 0, 1, 0),
+    2: (1, 0, 1, 1, 1, 0, 1), 3: (1, 0, 1, 1, 0, 1, 1),
+    4: (0, 1, 1, 1, 0, 1, 0), 5: (1, 1, 0, 1, 0, 1, 1),
+    6: (1, 1, 0, 1, 1, 1, 1), 7: (1, 0, 1, 0, 0, 1, 0),
+    8: (1, 1, 1, 1, 1, 1, 1), 9: (1, 1, 1, 1, 0, 1, 1),
+}
+
+
+def draw_digit(digit, height, width):
+    """Render one seven-segment digit into a (height, width) patch."""
+    img = np.zeros((height, width), np.float32)
+    t, tl, tr, m, bl, br, b = SEGMENTS[digit]
+    mid = height // 2
+    if t:
+        img[0:2, 1:width - 1] = 1
+    if m:
+        img[mid - 1:mid + 1, 1:width - 1] = 1
+    if b:
+        img[height - 2:height, 1:width - 1] = 1
+    if tl:
+        img[0:mid, 0:2] = 1
+    if tr:
+        img[0:mid, width - 2:width] = 1
+    if bl:
+        img[mid:height, 0:2] = 1
+    if br:
+        img[mid:height, width - 2:width] = 1
+    return img
+
+
+def gen_captcha(rs):
+    """-> (image (H, W), label (MAX_DIGITS,) 0-padded, digits list).
+    The label lists exactly the digits that fit on the canvas."""
+    n = int(rs.randint(3, MAX_DIGITS + 1))
+    want = [int(rs.randint(0, 10)) for _ in range(n)]
+    img = np.zeros((H, W), np.float32)
+    x = int(rs.randint(0, 4))
+    drawn = []
+    for d in want:
+        w = int(rs.randint(6, 9))
+        if x + w > W:
+            break
+        y0 = int(rs.randint(0, 3))
+        img[y0:y0 + 12, x:x + w] = np.maximum(
+            img[y0:y0 + 12, x:x + w], draw_digit(d, 12, w))
+        drawn.append(d)
+        x += w + int(rs.randint(1, 4))
+    img = np.clip(img + rs.normal(0, 0.08, img.shape), 0, 1)
+    # warp-ctc label convention: 0 is blank AND padding; digits -> 1..10
+    label = np.zeros((MAX_DIGITS,), np.float32)
+    for i, d in enumerate(drawn):
+        label[i] = d + 1
+    return img.astype(np.float32), label, drawn
+
+
+def ctc_greedy_decode(path, blank=BLANK):
+    """Collapse repeats then drop blanks (best-path decoding)."""
+    out, prev = [], None
+    for p in path:
+        if p != prev and p != blank:
+            out.append(int(p))
+        prev = p
+    return out
+
+
+def build_net(batch, num_hidden, num_classes, for_training=True):
+    data = sym.Variable("data")                    # (N, H, W)
+    cols = sym.transpose(data, axes=(0, 2, 1))     # (N, T=W, H)
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden, prefix="l0_"))
+    outputs, _ = stack.unroll(W, inputs=cols, layout="NTC",
+                              merge_outputs=True)  # (N, T, Hdn)
+    feat = sym.Reshape(outputs, shape=(-1, num_hidden))
+    fc = sym.FullyConnected(feat, num_hidden=num_classes, name="pred_fc")
+    pred = sym.Reshape(fc, shape=(-1, W, num_classes))
+    pred = sym.transpose(pred, axes=(1, 0, 2))     # (T, N, C)
+    if not for_training:
+        return sym.SoftmaxActivation(sym.Reshape(pred, shape=(-1, num_classes)),
+                                     name="probs")
+    label = sym.Variable("label")                  # (N, MAX_DIGITS)
+    return sym.WarpCTC(pred, label, label_length=MAX_DIGITS,
+                       input_length=W, name="ctc")
+
+
+def seq_accuracy(probs_TNC, labels, blank=BLANK):
+    """probs (T, N, C) -> greedy decode vs 0-padded labels."""
+    paths = probs_TNC.argmax(axis=2).T             # (N, T)
+    correct = 0
+    for row, lab in zip(paths, labels):
+        truth = [int(v) for v in lab if v > 0]     # 0 = blank/padding
+        if ctc_greedy_decode(row, blank) == truth:
+            correct += 1
+    return correct / len(labels)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=800)
+    ap.add_argument("--num-hidden", type=int, default=48)
+    ap.add_argument("--lr", type=float, default=4e-3)
+    ap.add_argument("--log-interval", type=int, default=50)
+    ap.add_argument("--save-prefix", default="/tmp/ocr/model")
+    ap.add_argument("--assert-acc", type=float, default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(0)
+    np.random.seed(0)
+    num_classes = 11  # blank + 10 digits
+    b = args.batch_size
+
+    net = build_net(b, args.num_hidden, num_classes)
+    state_shapes = {"l0_begin_state_0": (b, args.num_hidden),
+                    "l0_begin_state_1": (b, args.num_hidden)}
+    ex = net.simple_bind(ctx=None, data=(b, H, W),
+                         label=(b, MAX_DIGITS), **state_shapes)
+    init = mx.init.Xavier()
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label") and "state" not in name:
+            init(name, arr)
+            params[name] = arr
+    opt = mx.optimizer.create("adam", learning_rate=args.lr)
+    updater = mx.optimizer.get_updater(opt)
+
+    def batch_of(n):
+        imgs, labels = [], []
+        for _ in range(n):
+            img, lab, _ = gen_captcha(rs)
+            imgs.append(img)
+            labels.append(lab)
+        return np.stack(imgs), np.stack(labels)
+
+    for step in range(args.steps):
+        imgs, labels = batch_of(b)
+        ex.arg_dict["data"][:] = imgs
+        ex.arg_dict["label"][:] = labels
+        ex.forward(is_train=True)
+        ex.backward()
+        for i, (name, arr) in enumerate(sorted(params.items())):
+            updater(i, ex.grad_dict[name], arr)
+        if step % args.log_interval == 0 or step == args.steps - 1:
+            out = ex.outputs[0].asnumpy()          # (T, N, C) softmaxed
+            acc = seq_accuracy(out, labels)
+            logging.info("step %d  train seq-acc %.3f", step, acc)
+
+    # held-out evaluation
+    imgs, labels = batch_of(b)
+    ex.arg_dict["data"][:] = imgs
+    ex.arg_dict["label"][:] = labels
+    ex.forward(is_train=False)
+    acc = seq_accuracy(ex.outputs[0].asnumpy(), labels)
+    print(f"held-out sequence accuracy: {acc:.3f}")
+
+    os.makedirs(os.path.dirname(args.save_prefix), exist_ok=True)
+    deploy = build_net(b, args.num_hidden, num_classes, for_training=False)
+    mx.model.save_checkpoint(
+        args.save_prefix, 1, deploy,
+        {k: v for k, v in params.items()}, {})
+    print(f"saved {args.save_prefix}-0001.params (ocr_predict.py loads it)")
+    if args.assert_acc is not None:
+        assert acc >= args.assert_acc, (acc, args.assert_acc)
+        print("OCR OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""OCR inference through the predictor (parity:
+example/warpctc/ocr_predict.py — the reference loads the trained OCR
+checkpoint with its predict API and best-path-decodes the CTC output;
+same flow here through mxnet_tpu.predict, the exact path the C ABI and
+bindings serve).
+
+Run after lstm_ocr.py:  MXTPU_PLATFORM=cpu python ocr_predict.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.predict import Predictor  # noqa: E402
+
+from lstm_ocr import (H, W, ctc_greedy_decode, gen_captcha,  # noqa: E402
+                      seq_accuracy)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prefix", default="/tmp/ocr/model")
+    ap.add_argument("--epoch", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--num-hidden", type=int, default=48)
+    ap.add_argument("--assert-acc", type=float, default=0.8)
+    args = ap.parse_args()
+    b = args.batch
+
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(
+        args.prefix, args.epoch)
+    p = Predictor(
+        symbol=symbol, arg_params=arg_params, aux_params=aux_params,
+        input_shapes={"data": (b, H, W),
+                      "l0_begin_state_0": (b, args.num_hidden),
+                      "l0_begin_state_1": (b, args.num_hidden)},
+        dev_type=mx.context.default_accelerator_context())
+
+    rs = np.random.RandomState(123)  # unseen captchas
+    imgs, labels = [], []
+    for _ in range(b):
+        img, lab, _ = gen_captcha(rs)
+        imgs.append(img)
+        labels.append(lab)
+    p.forward(data=np.stack(imgs))
+    probs = p.get_output(0).reshape(W, b, -1)
+    acc = seq_accuracy(probs, np.stack(labels))
+
+    hyp = ctc_greedy_decode(probs.argmax(axis=2).T[0])
+    truth = [int(v) for v in labels[0] if v > 0]
+    print(f"sample: decoded {[d - 1 for d in hyp]} "
+          f"truth {[d - 1 for d in truth]}")
+    print(f"predictor sequence accuracy: {acc:.3f}")
+    assert acc >= args.assert_acc, (acc, args.assert_acc)
+    print("PREDICT OK")
+
+
+if __name__ == "__main__":
+    main()
