@@ -195,7 +195,7 @@ class HttpFileSystem(FileSystem):
 
     # auth hook: subclasses (S3/GS) rewrite the URI to a concrete endpoint
     # URL and inject auth headers; the base class is a pass-through
-    def _prepare(self, uri, headers, method):
+    def _prepare(self, uri, headers, method, data=None):
         return uri, headers
 
     # range hook: how [lo, hi) is expressed on the wire.  HTTP object
@@ -207,16 +207,41 @@ class HttpFileSystem(FileSystem):
                 "Range": f"bytes={lo}-{hi - 1}"}) as r:
             return r.read(), r.status == 206
 
-    def _urlopen(self, uri, headers=None, method="GET"):
+    def _urlopen(self, uri, headers=None, method="GET", data=None):
         import urllib.request
 
-        url, hdrs = self._prepare(uri, dict(headers or {}), method)
-        req = urllib.request.Request(url, headers=hdrs, method=method)
+        url, hdrs = self._prepare(uri, dict(headers or {}), method,
+                                  data=data)
+        req = urllib.request.Request(url, headers=hdrs, method=method,
+                                     data=data)
         return urllib.request.urlopen(req, timeout=self.timeout)
 
+    def _put(self, path, data):
+        raise MXNetError(f"{type(self).__name__} is read-only")
+
     def open(self, path, mode="rb"):
-        if "w" in mode or "a" in mode:
-            raise MXNetError("http filesystem is read-only")
+        if "a" in mode or "+" in mode:
+            raise MXNetError("object stores support only 'rb' and "
+                             "truncating 'wb'")
+        if "w" in mode:
+            if type(self)._put is HttpFileSystem._put:
+                # fail at open, not buried in a close the caller (or GC)
+                # might swallow
+                raise MXNetError(f"{type(self).__name__} is read-only")
+            fs = self
+
+            class _Writer(io.BytesIO):
+                """Buffer locally, upload the whole object on close —
+                object stores write whole objects, not streams (the
+                reference's dmlc-core S3 writer buffers the same way)."""
+
+                def close(self_inner):
+                    if not self_inner.closed:
+                        fs._put(path, self_inner.getvalue())
+                        fs._size_cache.pop(path, None)
+                    super().close()
+
+            return _Writer()
         return self._RangeFile(self, path, self.size(path))
 
     def size(self, path):
@@ -270,8 +295,10 @@ _EMPTY_SHA256 = (
 
 
 def _sigv4_headers(method, host, path, headers, access_key, secret_key,
-                   region, amzdate, session_token=None, service="s3"):
-    """AWS Signature Version 4 for a bodyless request (GET/HEAD).
+                   region, amzdate, session_token=None, service="s3",
+                   payload_hash=_EMPTY_SHA256):
+    """AWS Signature Version 4 (GET/HEAD, and PUT when ``payload_hash``
+    is the body's sha256).
 
     Pure-stdlib signing of the canonical request -> string-to-sign ->
     derived key chain, per the SigV4 spec; returns the full header dict
@@ -284,7 +311,7 @@ def _sigv4_headers(method, host, path, headers, access_key, secret_key,
 
     hdrs = dict(headers)
     hdrs["x-amz-date"] = amzdate
-    hdrs["x-amz-content-sha256"] = _EMPTY_SHA256
+    hdrs["x-amz-content-sha256"] = payload_hash
     if session_token:
         hdrs["x-amz-security-token"] = session_token
     hdrs["host"] = host
@@ -295,7 +322,7 @@ def _sigv4_headers(method, host, path, headers, access_key, secret_key,
     signed = ";".join(k for k, _ in items)
     canon_headers = "".join(f"{k}:{v}\n" for k, v in items)
     canonical = "\n".join([method, canon_uri, "", canon_headers, signed,
-                           _EMPTY_SHA256])
+                           payload_hash])
     datestamp = amzdate[:8]
     scope = f"{datestamp}/{region}/{service}/aws4_request"
     to_sign = "\n".join([
@@ -340,7 +367,7 @@ class S3FileSystem(HttpFileSystem):
                 env.get("AWS_REGION",
                         env.get("AWS_DEFAULT_REGION", "us-east-1")))
 
-    def _prepare(self, uri, headers, method):
+    def _prepare(self, uri, headers, method, data=None):
         from urllib.parse import quote, urlsplit
 
         parts = urlsplit(uri)
@@ -364,12 +391,24 @@ class S3FileSystem(HttpFileSystem):
         ak, sk, tok, region = self._creds()
         if ak and sk:
             import datetime as _dt
+            import hashlib
 
             amzdate = _dt.datetime.now(_dt.timezone.utc).strftime(
                 "%Y%m%dT%H%M%SZ")
+            payload_hash = (hashlib.sha256(data).hexdigest()
+                            if data is not None else _EMPTY_SHA256)
             headers = _sigv4_headers(method, host, path, headers, ak, sk,
-                                     region, amzdate, tok)
+                                     region, amzdate, tok,
+                                     payload_hash=payload_hash)
         return url, headers
+
+    def _put(self, path, data):
+        """Signed PUT of a whole object (parity: dmlc-core's S3 write
+        stream, which buffers and multipart-uploads; whole-object PUT
+        covers the checkpoint/save_checkpoint use case)."""
+        with self._urlopen(path, method="PUT", data=data) as r:
+            if r.status not in (200, 201):
+                raise MXNetError(f"s3 PUT {path!r} -> HTTP {r.status}")
 
 
 class GSFileSystem(HttpFileSystem):
@@ -378,7 +417,7 @@ class GSFileSystem(HttpFileSystem):
     unauthenticated access to public objects).  GS_ENDPOINT overrides the
     endpoint for test doubles / emulators."""
 
-    def _prepare(self, uri, headers, method):
+    def _prepare(self, uri, headers, method, data=None):
         from urllib.parse import quote, urlsplit
 
         parts = urlsplit(uri)
@@ -391,6 +430,12 @@ class GSFileSystem(HttpFileSystem):
         if token:
             headers["Authorization"] = f"Bearer {token}"
         return url, headers
+
+    def _put(self, path, data):
+        # the GCS XML API accepts whole-object PUT on the same URL shape
+        with self._urlopen(path, method="PUT", data=data) as r:
+            if r.status not in (200, 201):
+                raise MXNetError(f"gs PUT {path!r} -> HTTP {r.status}")
 
 
 class WebHdfsFileSystem(HttpFileSystem):
@@ -434,6 +479,45 @@ class WebHdfsFileSystem(HttpFileSystem):
         url = self._url(uri, "OPEN", f"&offset={lo}&length={hi - lo}")
         with self._urlopen(url) as r:
             return r.read(), True  # OPEN always returns exactly the span
+
+    def _put(self, path, data):
+        """WebHDFS CREATE (the two-step namenode->datanode dance): PUT
+        op=CREATE gets a 307 with the datanode Location, the body goes
+        there.  Servers that skip the redirect (single-node doubles)
+        accept the body on the first request."""
+        import urllib.error
+        import urllib.request
+
+        url = self._url(path, "CREATE", "&overwrite=true")
+
+        class _NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **kw):
+                return None
+
+        opener = urllib.request.build_opener(_NoRedirect)
+        req = urllib.request.Request(url, method="PUT", data=b"")
+        try:
+            with opener.open(req, timeout=self.timeout) as r:
+                status, location = r.status, r.headers.get("Location")
+        except urllib.error.HTTPError as e:
+            status, location = e.code, e.headers.get("Location")
+        if status == 307:
+            if not location:
+                raise MXNetError(
+                    f"webhdfs CREATE {path!r}: 307 without Location")
+            target = location
+        elif status in (200, 201):
+            # no redirect (single-node doubles): the body goes straight
+            # to the namenode URL
+            target = url
+        else:
+            raise MXNetError(f"webhdfs CREATE {path!r} -> HTTP {status}")
+        req2 = urllib.request.Request(target, method="PUT", data=data)
+        with urllib.request.urlopen(req2, timeout=self.timeout) as r2:
+            if r2.status not in (200, 201):
+                raise MXNetError(
+                    f"webhdfs PUT {path!r} -> HTTP {r2.status}")
+        self._size_cache.pop(path, None)
 
     def size(self, path):
         import json as _json
@@ -532,6 +616,14 @@ def open_uri(uri: str, mode: str = "rb"):
     scheme, _ = _split_scheme(uri)
     path = _strip_local(uri) if scheme in ("", "file") else uri
     return get_filesystem(uri).open(path, mode)
+
+
+def is_remote(uri: str) -> bool:
+    """True when the URI names a non-local filesystem (the save/load
+    paths stage through a temp file + open_uri for these — checkpoints
+    write straight to s3://, gs://, hdfs://, mem://)."""
+    scheme, _ = _split_scheme(str(uri))
+    return scheme not in ("", "file")
 
 
 class InputSplit:

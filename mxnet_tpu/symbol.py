@@ -378,6 +378,12 @@ class Symbol:
         )
 
     def save(self, fname: str):
+        from .filesystem import is_remote, open_uri
+
+        if is_remote(fname):
+            with open_uri(fname, "wb") as f:
+                f.write(self.tojson().encode())
+            return
         with open(fname, "w") as f:
             f.write(self.tojson())
 
@@ -532,6 +538,11 @@ def _create_named(od, sym_inputs, attrs, name, extra_attr):
 
 
 def load(fname: str) -> Symbol:
+    from .filesystem import is_remote, open_uri
+
+    if is_remote(fname):
+        with open_uri(fname, "rb") as f:
+            return load_json(f.read().decode())
     with open(fname) as f:
         return load_json(f.read())
 
