@@ -413,24 +413,81 @@ class FusedTrainer:
             v = multihost_utils.process_allgather(v, tiled=True)
         return np.asarray(v)
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        background=False):
         """Write ``prefix-symbol.json`` + ``prefix-%04d.params`` — the
         Module checkpoint format, loadable by Module/FeedForward — plus a
         FusedTrainer-format ``.states`` file (flat per-key slot arrays +
         the step counter; NOT Module's pickled-updater format) when
-        ``save_optimizer_states``."""
-        from . import ndarray as nd_mod
-        from .model import save_checkpoint as _save
+        ``save_optimizer_states``.
 
-        arg = {k: NDArray(self._gather(v)) for k, v in self.params.items()}
-        aux = {k: NDArray(self._gather(v)) for k, v in self.aux.items()}
-        _save(prefix, epoch, self.symbol, arg, aux)
-        if save_optimizer_states:
-            flat = {"__step__": NDArray(np.array([self._step], np.int64))}
-            for k, states in self.opt_state.items():
-                for i, s in enumerate(states):
-                    flat[f"{k}:{i}"] = NDArray(self._gather(s))
-            nd_mod.save("%s-%04d.states" % (prefix, epoch), flat)
+        ``background=True`` overlaps the checkpoint with training:
+        params are immutable jax arrays, so snapshotting their refs (and
+        the step counter) is free, and the device→host fetch + file
+        write run on a writer thread while step() keeps training — on
+        slow host links the fetch dominates checkpoint time, so this
+        hides essentially all of it.  Returns a ``threading.Thread``
+        (already started; ``join()`` before relying on the files);
+        a raise on the writer thread is re-raised by ``join`` via the
+        returned thread's ``exc`` attribute being checked in
+        ``wait_checkpoint``."""
+        # SNAPSHOT at HBM speed: the fused step DONATES its buffers, so
+        # bare refs would be invalidated by the next step() — a device-
+        # side copy per tensor (dispatched async, microseconds) detaches
+        # the snapshot; only the slow device→host fetch runs on the
+        # writer thread
+        import jax
+        import jax.numpy as jnp
+
+        def snap(v):
+            return jnp.copy(v) if isinstance(v, jax.Array) else v
+
+        params = {k: snap(v) for k, v in self.params.items()}
+        aux = {k: snap(v) for k, v in self.aux.items()}
+        step = self._step
+        opt_state = {k: [snap(s) for s in v]
+                     for k, v in self.opt_state.items()} \
+            if save_optimizer_states else None
+
+        def _write():
+            from . import ndarray as nd_mod
+            from .model import save_checkpoint as _save
+
+            arg = {k: NDArray(self._gather(v)) for k, v in params.items()}
+            auxd = {k: NDArray(self._gather(v)) for k, v in aux.items()}
+            _save(prefix, epoch, self.symbol, arg, auxd)
+            if opt_state is not None:
+                flat = {"__step__": NDArray(np.array([step], np.int64))}
+                for k, states in opt_state.items():
+                    for i, s in enumerate(states):
+                        flat[f"{k}:{i}"] = NDArray(self._gather(s))
+                nd_mod.save("%s-%04d.states" % (prefix, epoch), flat)
+
+        if not background:
+            _write()
+            return None
+        import threading
+
+        def _runner():
+            try:
+                _write()
+            except BaseException as e:  # noqa: BLE001 — surfaced in join
+                thread.exc = e
+
+        thread = threading.Thread(target=_runner, daemon=False,
+                                  name="ckpt-writer")
+        thread.exc = None
+        thread.start()
+        return thread
+
+    @staticmethod
+    def wait_checkpoint(thread):
+        """Join a background save and re-raise any writer-thread error."""
+        if thread is None:
+            return
+        thread.join()
+        if getattr(thread, "exc", None) is not None:
+            raise thread.exc
 
     def load_checkpoint(self, prefix, epoch, load_optimizer_states=False):
         """Restore params/aux (and optimizer state + step counter) saved

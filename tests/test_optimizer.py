@@ -357,3 +357,47 @@ def test_fused_trainer_lr_wd_mult():
     # fc2_weight DID receive decay (differs from the no-wd run)
     assert not np.allclose(after["fc2_weight"],
                            np.asarray(tr2.params["fc2_weight"]))
+
+
+def test_fused_trainer_background_checkpoint(tmp_path):
+    """background=True snapshots param REFS before returning: steps
+    taken while the writer thread runs must not leak into the saved
+    checkpoint, and the files must equal a synchronous save made at the
+    same step."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.trainer import FusedTrainer
+
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=4, name="fc"), name="softmax")
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.1, "momentum": 0.9})
+    tr.init(data=(8, 6))
+    rs = np.random.RandomState(0)
+    batch = {"data": rs.rand(8, 6).astype(np.float32),
+             "softmax_label": rs.randint(0, 4, 8).astype(np.float32)}
+    for _ in range(3):
+        tr.step(**batch)
+
+    sync_prefix = str(tmp_path / "sync")
+    tr.save_checkpoint(sync_prefix, 3, save_optimizer_states=True)
+
+    bg_prefix = str(tmp_path / "bg")
+    th = tr.save_checkpoint(bg_prefix, 3, save_optimizer_states=True,
+                            background=True)
+    # keep training WHILE the writer runs
+    for _ in range(5):
+        tr.step(**batch)
+    FusedTrainer.wait_checkpoint(th)
+
+    a = mx.nd.load(sync_prefix + "-0003.params")
+    b = mx.nd.load(bg_prefix + "-0003.params")
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k].asnumpy(), b[k].asnumpy())
+    sa = mx.nd.load(sync_prefix + "-0003.states")
+    sb = mx.nd.load(bg_prefix + "-0003.states")
+    for k in sa:
+        np.testing.assert_array_equal(sa[k].asnumpy(), sb[k].asnumpy())
