@@ -431,16 +431,28 @@ class FusedTrainer:
         a raise on the writer thread is re-raised by ``join`` via the
         returned thread's ``exc`` attribute being checked in
         ``wait_checkpoint``."""
-        # SNAPSHOT at HBM speed: the fused step DONATES its buffers, so
-        # bare refs would be invalidated by the next step() — a device-
-        # side copy per tensor (dispatched async, microseconds) detaches
-        # the snapshot; only the slow device→host fetch runs on the
-        # writer thread
-        import jax
-        import jax.numpy as jnp
+        if background and jax.process_count() > 1:
+            # the writer thread's gather collectives would interleave
+            # with training-step collectives in host-dependent order —
+            # a deadlock class; multi-process saves stay synchronous
+            import warnings
 
-        def snap(v):
-            return jnp.copy(v) if isinstance(v, jax.Array) else v
+            warnings.warn("background checkpointing is single-process "
+                          "only; saving synchronously", stacklevel=2)
+            background = False
+
+        if background:
+            # SNAPSHOT at HBM speed: the fused step DONATES its buffers,
+            # so bare refs would be invalidated by the next step() — a
+            # device-side copy per tensor (dispatched async) detaches
+            # the snapshot; only the slow device→host fetch runs on the
+            # writer thread.  The synchronous path below reads the live
+            # tensors directly (no duplicate HBM footprint).
+            def snap(v):
+                return jnp.copy(v) if isinstance(v, jax.Array) else v
+        else:
+            def snap(v):
+                return v
 
         params = {k: snap(v) for k, v in self.params.items()}
         aux = {k: snap(v) for k, v in self.aux.items()}
