@@ -7,7 +7,7 @@ reference (SURVEY.md §3.1 update_metric step).
 """
 from __future__ import annotations
 
-import numpy as np
+import numpy as _np
 
 from .base import MXNetError
 
@@ -155,10 +155,10 @@ class Accuracy(EvalMetric):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
             pred_np = pred.asnumpy()
-            label_np = label.asnumpy().astype(np.int32)
+            label_np = label.asnumpy().astype(_np.int32)
             if pred_np.ndim > 1 and pred_np.shape != label_np.shape:
                 pred_np = pred_np.argmax(axis=1)
-            pred_np = pred_np.astype(np.int32).reshape(-1)
+            pred_np = pred_np.astype(_np.int32).reshape(-1)
             label_np = label_np.reshape(-1)
             if self.ignore_label is not None:
                 keep = label_np != self.ignore_label
@@ -175,8 +175,8 @@ class TopKAccuracy(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             pred_np = pred.asnumpy()
-            label_np = label.asnumpy().astype(np.int32).reshape(-1)
-            argsorted = np.argsort(-pred_np, axis=1)[:, : self.top_k]
+            label_np = label.asnumpy().astype(_np.int32).reshape(-1)
+            argsorted = _np.argsort(-pred_np, axis=1)[:, : self.top_k]
             self.sum_metric += float((argsorted == label_np[:, None]).any(axis=1).sum())
             self.num_inst += len(label_np)
 
@@ -190,10 +190,10 @@ class F1(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             pred_np = pred.asnumpy()
-            label_np = label.asnumpy().astype(np.int32).reshape(-1)
+            label_np = label.asnumpy().astype(_np.int32).reshape(-1)
             if pred_np.ndim > 1:
                 pred_np = pred_np.argmax(axis=1)
-            pred_np = pred_np.astype(np.int32).reshape(-1)
+            pred_np = pred_np.astype(_np.int32).reshape(-1)
             tp = float(((pred_np == 1) & (label_np == 1)).sum())
             fp = float(((pred_np == 1) & (label_np == 0)).sum())
             fn = float(((pred_np == 0) & (label_np == 1)).sum())
@@ -211,7 +211,7 @@ class MAE(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             l, p = label.asnumpy(), pred.asnumpy()
-            self.sum_metric += float(np.abs(l.reshape(p.shape) - p).mean())
+            self.sum_metric += float(_np.abs(l.reshape(p.shape) - p).mean())
             self.num_inst += 1
 
 
@@ -233,7 +233,7 @@ class RMSE(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             l, p = label.asnumpy(), pred.asnumpy()
-            self.sum_metric += float(np.sqrt(((l.reshape(p.shape) - p) ** 2).mean()))
+            self.sum_metric += float(_np.sqrt(((l.reshape(p.shape) - p) ** 2).mean()))
             self.num_inst += 1
 
 
@@ -244,10 +244,10 @@ class CrossEntropy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
-            label_np = label.asnumpy().astype(np.int32).reshape(-1)
+            label_np = label.asnumpy().astype(_np.int32).reshape(-1)
             pred_np = pred.asnumpy()
-            prob = pred_np[np.arange(label_np.shape[0]), label_np]
-            self.sum_metric += float((-np.log(prob + self.eps)).sum())
+            prob = pred_np[_np.arange(label_np.shape[0]), label_np]
+            self.sum_metric += float((-_np.log(prob + self.eps)).sum())
             self.num_inst += label_np.shape[0]
 
 
@@ -264,23 +264,23 @@ class Perplexity(EvalMetric):
     def update(self, labels, preds):
         loss, num = 0.0, 0
         for label, pred in zip(labels, preds):
-            label_np = label.asnumpy().astype(np.int32).reshape(-1)
+            label_np = label.asnumpy().astype(_np.int32).reshape(-1)
             pred_np = pred.asnumpy()
             if self.axis not in (-1, pred_np.ndim - 1):
-                pred_np = np.moveaxis(pred_np, self.axis, -1)
+                pred_np = _np.moveaxis(pred_np, self.axis, -1)
             pred_np = pred_np.reshape(label_np.shape[0], -1)
-            prob = pred_np[np.arange(label_np.shape[0]),
-                           np.clip(label_np, 0, pred_np.shape[1] - 1)]
-            mask = np.ones_like(prob, dtype=bool)
+            prob = pred_np[_np.arange(label_np.shape[0]),
+                           _np.clip(label_np, 0, pred_np.shape[1] - 1)]
+            mask = _np.ones_like(prob, dtype=bool)
             if self.ignore_label is not None:
                 mask = label_np != self.ignore_label
-            loss += float(-np.log(np.maximum(prob[mask], 1e-10)).sum())
+            loss += float(-_np.log(_np.maximum(prob[mask], 1e-10)).sum())
             num += int(mask.sum())
         self.sum_metric += loss
         self.num_inst += num
 
     def _value(self, s, n):
-        return float(np.exp(s / n)) if n else float("nan")
+        return float(_np.exp(s / n)) if n else float("nan")
 
 
 class Torch(EvalMetric):
@@ -324,8 +324,17 @@ def np_metric(name=None, allow_extra_outputs=False):
     return deco
 
 
-np = np  # keep numpy accessible; mx.metric.np is the decorator below
-globals()["np_decorator"] = np_metric
+def np(numpy_feval=None, name=None, allow_extra_outputs=False):
+    """Parity: mx.metric.np — wrap a numpy function as an EvalMetric.
+
+    Usable both ways the reference allows:
+      mx.metric.np(CRPS)                      # direct wrap
+      @mx.metric.np                            # bare decorator
+      @mx.metric.np(name="crps")               # configured decorator
+    """
+    if callable(numpy_feval):
+        return CustomMetric(numpy_feval, name, allow_extra_outputs)
+    return np_metric(name=name, allow_extra_outputs=allow_extra_outputs)
 
 _METRICS = {
     "acc": Accuracy,

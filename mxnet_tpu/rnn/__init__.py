@@ -4,3 +4,11 @@ from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
                        ModifierCell, RNNCell, RNNParams, SequentialRNNCell,
                        ZoneoutCell)
 from .io import BucketSentenceIter
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC"):
+    """Parity: rnn/rnn.py rnn_unroll — the module-level unroll the
+    reference exposes alongside cell.unroll()."""
+    return cell.unroll(length, inputs=inputs, begin_state=begin_state,
+                       input_prefix=input_prefix, layout=layout)
