@@ -57,11 +57,21 @@ def _fail(msg, metric="resnet50_train_imgs_per_sec_per_chip"):
     # line still carries the hardware numbers and where they came from
     try:
         here = os.path.dirname(os.path.abspath(__file__))
-        with open(os.path.join(here, "docs", "measured",
-                               "bench_r04_tpu_v5e.json")) as f:
-            payload["last_measured"] = json.load(f)
-            payload["last_measured_source"] = \
-                "docs/measured/bench_r04_tpu_v5e.json (2026-07-31 window)"
+        rel = os.path.join("docs", "measured", "bench_r04_tpu_v5e.json")
+        art = os.path.join(here, rel)
+        with open(art) as f:
+            measured = json.load(f)
+        # artifacts carry their own capture date; never guess from file
+        # mtime (that's the checkout time on a fresh clone)
+        stamp = measured.get("captured_utc", "date unrecorded")
+        # nested under "error" context so automated extra-key scanners
+        # can't mistake the stale artifact for a live measurement
+        payload["last_measured"] = {
+            "note": "NOT a live capture; committed artifact embedded "
+                    "because this run errored",
+            "source": "%s (captured %s)" % (rel, stamp),
+            "data": measured,
+        }
     except Exception:  # noqa: BLE001 — the artifact is best-effort
         pass
     _emit(payload)
@@ -188,6 +198,7 @@ def _bench(dev, kind):
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "captured_utc": time.strftime("%Y-%m-%d", time.gmtime()),
         "device_kind": kind,
         "batch": batch,
         "mfu": round(mfu, 4) if mfu is not None else None,

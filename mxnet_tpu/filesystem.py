@@ -233,10 +233,22 @@ class HttpFileSystem(FileSystem):
             class _Writer(io.BytesIO):
                 """Buffer locally, upload the whole object on close —
                 object stores write whole objects, not streams (the
-                reference's dmlc-core S3 writer buffers the same way)."""
+                reference's dmlc-core S3 writer buffers the same way).
+
+                A failed `with` body must NOT publish: a half-written
+                buffer uploaded on close would overwrite a good remote
+                object (WebHDFS create uses overwrite=true) with a
+                truncated one, so __exit__ discards on exception."""
+
+                _discard = False
+
+                def __exit__(self_inner, exc_type, exc, tb):
+                    if exc_type is not None:
+                        self_inner._discard = True
+                    return super().__exit__(exc_type, exc, tb)
 
                 def close(self_inner):
-                    if not self_inner.closed:
+                    if not self_inner.closed and not self_inner._discard:
                         fs._put(path, self_inner.getvalue())
                         fs._size_cache.pop(path, None)
                     super().close()

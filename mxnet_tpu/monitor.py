@@ -2,74 +2,97 @@
 
 Parity: python/mxnet/monitor.py (reference): installs an executor monitor
 callback (GraphExecutor::SetMonitorCallback, graph_executor.cc:63), pattern
-matches tensor names, prints a stat per tensor every `interval` batches.
+matches tensor names, and reports one statistic per matched tensor every
+`interval` batches.  API-compatible (install/tic/toc/toc_print and the
+(batch, name, stat_string) result rows); internals are this framework's
+own: the tap accumulates finished records per flush window and formatting
+is centralized in one scalar renderer.
 """
 from __future__ import annotations
 
 import logging
 import re
-from math import sqrt
 
 from . import ndarray as nd
 from .ndarray import NDArray
 
 
+def _rms(x):
+    """Default statistic: ||x||_2 / sqrt(n) — the root-mean-square of the
+    tensor, matching the reference monitor's default."""
+    return nd.norm(x) / float(max(x.size, 1)) ** 0.5
+
+
+def _render(value):
+    """One stat value -> display string.  stat_func may return a scalar
+    NDArray, a python number, or a list of either."""
+    items = value if isinstance(value, (list, tuple)) else [value]
+    parts = []
+    for item in items:
+        if isinstance(item, NDArray) and item.size == 1:
+            item = item.asscalar()
+        parts.append(str(item))
+    return "\t".join(parts) + "\t"
+
+
 class Monitor:
+    """Tap internal outputs of installed executors.
+
+    interval:  flush window in batches (tic activates every interval-th)
+    stat_func: NDArray -> stat (scalar NDArray / number / list); default
+               root-mean-square
+    pattern:   regex a tensor name must match to be recorded
+    sort:      order toc() rows by tensor name
+    """
+
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-
-            def asum_stat(x):
-                return nd.norm(x) / sqrt(max(x.size, 1))
-
-            stat_func = asum_stat
-        self.stat_func = stat_func
+        self.stat_func = stat_func if stat_func is not None else _rms
         self.interval = interval
+        self.sort = sort
+        self.re_prog = re.compile(pattern)
         self.activated = False
-        self.queue = []
         self.step = 0
         self.exes = []
-        self.re_prog = re.compile(pattern)
-        self.sort = sort
+        self._records = []
 
+    # executor callback (name, array) — records only while a tic window
+    # is open and the name matches
     def stat_helper(self, name, array):
-        if not self.activated or not self.re_prog.match(name):
-            return
-        self.queue.append((self.step, name, self.stat_func(array)))
+        if self.activated and self.re_prog.match(name):
+            self._records.append((self.step, name, self.stat_func(array)))
 
     def install(self, exe):
+        """Hook this monitor into an executor's internal-output taps."""
         exe.set_monitor_callback(self.stat_helper)
         self.exes.append(exe)
 
     def tic(self):
+        """Open a recording window if this batch index is due."""
         if self.step % self.interval == 0:
             for exe in self.exes:
                 for array in exe.arg_arrays:
                     array.wait_to_read()
-            self.queue = []
+            self._records = []
             self.activated = True
         self.step += 1
 
     def toc(self):
+        """Close the window; returns [(batch, tensor_name, stat_str)]."""
         if not self.activated:
             return []
         self.activated = False
-        res = []
+        taken, self._records = self._records, []
         if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            s = ""
-            for v in v_list:
-                if isinstance(v, NDArray) and v.size == 1:
-                    s += str(v.asscalar()) + "\t"
-                else:
-                    s += str(v) + "\t"
-            res.append((n, k, s))
-        self.queue = []
-        return res
+            taken.sort(key=lambda rec: rec[1])
+        return [(batch, name, _render(value))
+                for batch, name, value in taken]
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """toc() + log every row (the reference's printing entry point)."""
+        for batch, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", batch, name, stat)
+
+    # legacy alias kept for parity with the reference's internal name
+    @property
+    def queue(self):
+        return self._records

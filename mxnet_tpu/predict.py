@@ -127,8 +127,12 @@ class Predictor:
         if dtype is None:
             dtype = os.environ.get("MXTPU_PREDICT_DTYPE") or None
         self._dtype = dtype  # normalized to a jnp dtype in _build_fast_forward
+        self._wire_dtype = None  # host-side upload dtype (set below)
         self._build_fast_forward()
         self._fast_outs = None
+        self._inflight = {}   # ticket -> list of dispatched outputs
+        self._inflight_lock = __import__("threading").Lock()
+        self._ticket = 0
         self._step = 0
 
     def _build_fast_forward(self):
@@ -168,6 +172,13 @@ class Predictor:
             if k not in self._input_names}
         self._aux_snapshot = {
             k: v._read() for k, v in self._exec.aux_dict.items()}
+        # upload inputs over the wire ALREADY in the compute dtype: the
+        # in-graph cast would throw the upper half of every fp32 mantissa
+        # away on arrival anyway, so casting on the host first halves the
+        # host->device bytes — on transport-bound deployments (remote/
+        # tunneled devices) input upload IS the predictor's bottleneck
+        if cast is not None and cast != jnp.float32:
+            self._wire_dtype = cast
 
         def _infer(params, aux, inputs, step, base_key):
             key = jax.random.fold_in(base_key, step)
@@ -212,8 +223,11 @@ class Predictor:
         import jax
 
         arr, value = self._coerce_input(name, value)
-        arr._set(jax.device_put(np.array(value, copy=True),
-                                arr._read().sharding))
+        if self._wire_dtype is not None and value.dtype == np.float32:
+            value = value.astype(self._wire_dtype)  # astype copies
+        else:
+            value = np.array(value, copy=True)
+        arr._set(jax.device_put(value, arr._read().sharding))
 
     def set_input(self, name, value):
         """Parity: MXPredSetInput."""
@@ -229,6 +243,11 @@ class Predictor:
             self._fast_outs = None
             self._dirty = False
             return
+        self._fast_outs = self._dispatch(inputs)
+
+    def _dispatch(self, inputs):
+        """Upload inputs and dispatch one forward (shared by forward and
+        forward_async); returns the raw output arrays without joining."""
         from . import random as _random
 
         arg_dict = self._exec.arg_dict
@@ -237,11 +256,77 @@ class Predictor:
         feeds = {n: arg_dict[n]._read() for n in self._input_names}
         # the key is a traced argument (not a closure constant) so a
         # later mx.random.seed() is honored, matching Executor.forward
-        self._fast_outs = self._infer_jit(
+        outs = self._infer_jit(
             self._param_snapshot, self._aux_snapshot, feeds,
             np.uint32(self._step), _random.current_key())
         self._step += 1
         self._dirty = False
+        return outs
+
+    def forward_async(self, **inputs):
+        """Dispatch a forward WITHOUT joining it; returns a ticket for
+        ``get_async``.  Several tickets may be in flight at once — each
+        call's input upload, compute, and device→host output fetch queue
+        independently, so consecutive calls pipeline all three stages
+        against each other.  On transport-bound deployments (remote or
+        tunneled devices) this hides compute and output-fetch time under
+        the next call's input upload; a strict
+        ``forward()``/``get_output()`` loop instead pays the full
+        upload+compute+fetch round trip per call.
+
+        The C ABI exposes this pair as MXPredForwardAsync /
+        MXPredGetOutputAsync (src/c_predict.cc)."""
+        if self._infer_jit is None:
+            raise MXNetError("forward_async is not supported on ctx-group "
+                             "(placed) graphs — use forward()")
+        outs = self._dispatch(inputs)
+        # get_output() after forward_async keeps last-forward-wins
+        # semantics (this IS the most recent forward)
+        self._fast_outs = outs
+        for o in outs:
+            start = getattr(o, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()  # fetch streams while later calls compute
+                except Exception:  # noqa: BLE001 — fetch runs in get_async
+                    break
+        with self._inflight_lock:
+            self._ticket += 1
+            ticket = self._ticket
+            self._inflight[ticket] = list(outs)
+            # abandoned tickets (multi-output partial fetches, clients
+            # that error out) must not pin device buffers forever: keep
+            # at most 64 in flight, evicting oldest-first (dict preserves
+            # insertion order) — a pipelined client holds a handful
+            while len(self._inflight) > 64:
+                self._inflight.pop(next(iter(self._inflight)))
+        return ticket
+
+    def get_async(self, ticket, index=0):
+        """Join output ``index`` of an in-flight ``forward_async`` ticket
+        as a host array.  Each output is fetchable once; the ticket
+        retires after its last unfetched output is taken (or via
+        ``discard_async``)."""
+        with self._inflight_lock:
+            outs = self._inflight.get(ticket)
+            if outs is None:
+                raise MXNetError(
+                    f"unknown or already-retired ticket {ticket}")
+            if not 0 <= index < len(outs) or outs[index] is None:
+                raise MXNetError(
+                    f"ticket {ticket}: output {index} is out of range or "
+                    f"already fetched ({len(outs)} outputs)")
+            out, outs[index] = outs[index], None
+            if all(o is None for o in outs):
+                del self._inflight[ticket]
+        return np.asarray(out, dtype=np.float32) \
+            if out.dtype != np.float32 else np.asarray(out)
+
+    def discard_async(self, ticket):
+        """Drop an in-flight ticket without fetching (frees its device
+        output buffers); unknown tickets are a no-op."""
+        with self._inflight_lock:
+            self._inflight.pop(ticket, None)
 
     def partial_forward(self, step):
         """Parity: MXPredPartialForward — the reference runs the op

@@ -177,6 +177,41 @@ int MXPredForward(void *handle) {
   return 0;
 }
 
+int MXPredForwardAsync(void *handle, int64_t *out_ticket) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GilGuard gil;
+  PyObject *t = PyObject_CallMethod(h->predictor, "forward_async", nullptr);
+  if (!t) return Fail("forward_async");
+  *out_ticket = PyLong_AsLongLong(t);
+  Py_DECREF(t);
+  if (PyErr_Occurred()) return Fail("forward_async ticket");
+  return 0;
+}
+
+int MXPredGetOutputAsync(void *handle, int64_t ticket, uint32_t index,
+                         float *data, uint32_t size) {
+  auto *h = static_cast<PredHandle *>(handle);
+  GilGuard gil;
+  PyObject *out = PyObject_CallMethod(h->predictor, "get_async", "LI",
+                                      static_cast<long long>(ticket), index);
+  if (!out) return Fail("get_async");
+  PyObject *ravel = PyObject_CallMethod(out, "ravel", nullptr);
+  Py_DECREF(out);
+  if (!ravel) return Fail("ravel");
+  PyObject *bytes = PyObject_CallMethod(ravel, "tobytes", nullptr);
+  Py_DECREF(ravel);
+  if (!bytes) return Fail("tobytes");
+  Py_ssize_t nbytes = PyBytes_Size(bytes);
+  if (nbytes > static_cast<Py_ssize_t>(size) * 4) {
+    Py_DECREF(bytes);
+    last_error = "output buffer too small";
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), nbytes);
+  Py_DECREF(bytes);
+  return 0;
+}
+
 int MXPredGetOutputShape(void *handle, uint32_t index, uint32_t **shape_data,
                          uint32_t *shape_ndim) {
   auto *h = static_cast<PredHandle *>(handle);

@@ -115,6 +115,13 @@ int MXPredCreate(const char *symbol_json, const void *param_bytes,
 int MXPredSetInput(void *handle, const char *key, const float *data,
                    uint32_t size);
 int MXPredForward(void *handle);
+/* Pipelined inference: ForwardAsync dispatches without joining and hands
+ * back a ticket; GetOutputAsync joins that ticket.  Keeping 2+ tickets in
+ * flight overlaps input upload, compute, and output fetch across calls —
+ * the transport-hiding path for remote/tunneled devices. */
+int MXPredForwardAsync(void *handle, int64_t *out_ticket);
+int MXPredGetOutputAsync(void *handle, int64_t ticket, uint32_t index,
+                         float *data, uint32_t size);
 int MXPredGetOutputShape(void *handle, uint32_t index, uint32_t **shape_data,
                          uint32_t *shape_ndim);
 int MXPredGetOutput(void *handle, uint32_t index, float *data, uint32_t size);

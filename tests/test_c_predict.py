@@ -65,6 +65,30 @@ C_CLIENT = textwrap.dedent("""
             fprintf(stderr, "get_output: %s\\n", MXPredGetLastError());
             return 7;
         }
+        /* pipelined path: two tickets in flight must reproduce the
+           synchronous result for the same staged input */
+        int64_t t0, t1;
+        if (MXPredForwardAsync(h, &t0) != 0 ||
+            MXPredForwardAsync(h, &t1) != 0) {
+            fprintf(stderr, "forward_async: %s\\n", MXPredGetLastError());
+            return 8;
+        }
+        float *a1 = malloc(total * sizeof(float));
+        float *a0 = malloc(total * sizeof(float));
+        if (MXPredGetOutputAsync(h, t1, 0, a1, total) != 0 ||
+            MXPredGetOutputAsync(h, t0, 0, a0, total) != 0) {
+            fprintf(stderr, "get_async: %s\\n", MXPredGetLastError());
+            return 9;
+        }
+        for (unsigned i = 0; i < total; ++i) {
+            if (a0[i] - out[i] > 1e-5f || out[i] - a0[i] > 1e-5f ||
+                a1[i] - out[i] > 1e-5f || out[i] - a1[i] > 1e-5f) {
+                fprintf(stderr, "async mismatch at %u\\n", i);
+                return 10;
+            }
+        }
+        free(a0);
+        free(a1);
         printf("shape:");
         for (unsigned i = 0; i < ondim; ++i) printf(" %u", oshape[i]);
         printf("\\n");
@@ -99,10 +123,10 @@ def checkpoint(tmp_path_factory):
 
 
 def test_c_predict_matches_python(checkpoint, tmp_path):
-    if not os.path.exists(LIB):
-        r = subprocess.run(["make", "-C", os.path.join(REPO, "src"),
-                            "predict"], capture_output=True, text=True)
-        assert r.returncode == 0, r.stderr
+    # make is incremental: rebuilds only when src/c_predict.cc is newer
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "src"),
+                        "predict"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
 
     c_path = tmp_path / "client.c"
     c_path.write_text(C_CLIENT)

@@ -153,3 +153,39 @@ def test_predictor_output_shape_before_forward(tmp_path):
     p = pred_create(prefix, 1, {"data": (16, 8)})
     assert p.get_output_shape(0) == (16, 4)
     assert p.num_outputs == 1
+
+
+def test_predictor_forward_async_pipeline(tmp_path):
+    """forward_async/get_async: results match forward(), tickets join in
+    any order, and a retired ticket raises."""
+    prefix, x = _trained_checkpoint(tmp_path)
+    p = pred_create(prefix, 1, {"data": (8, 8)})
+    p.forward(data=x[:8])
+    want0 = p.get_output(0)
+    p.forward(data=x[8:16])
+    want1 = p.get_output(0)
+
+    t0 = p.forward_async(data=x[:8])
+    t1 = p.forward_async(data=x[8:16])  # two tickets in flight
+    out1 = p.get_async(t1)              # out-of-order join
+    out0 = p.get_async(t0)
+    assert np.allclose(out0, want0, atol=1e-5)
+    assert np.allclose(out1, want1, atol=1e-5)
+    with pytest.raises(mx.MXNetError):
+        p.get_async(t0)  # already retired
+
+
+def test_predictor_bf16_wire_upload(tmp_path):
+    """dtype='bfloat16' uploads inputs already cast on the host (half the
+    wire bytes) and still matches the f32 predictor to bf16 tolerance."""
+    prefix, x = _trained_checkpoint(tmp_path)
+    p32 = pred_create(prefix, 1, {"data": (8, 8)})
+    p16 = pred_create(prefix, 1, {"data": (8, 8)}, dtype="bfloat16")
+    assert p16._wire_dtype is not None
+    p32.forward(data=x[:8])
+    p16.forward(data=x[:8])
+    a, b = p32.get_output(0), p16.get_output(0)
+    assert b.dtype == np.float32  # outputs cast back for the ABI
+    assert np.allclose(a, b, atol=2e-2)
+    t = p16.forward_async(data=x[:8])
+    assert np.allclose(p16.get_async(t), b, atol=2e-2)

@@ -51,6 +51,15 @@ def build_checkpoint(model, prefix):
 
 
 def bench_batch(prefix, data_shape, batch, iters, dev_type, dtype=None):
+    """Three disciplines over the same predictor:
+
+    dispatch —  forward xN, one trailing fetch (upload-bound ceiling)
+    serial   —  forward + get_output every call (the naive client loop:
+                full upload+compute+fetch round trip per sample)
+    overlap  —  forward_async/get_async, 4 tickets in flight (the
+                transport-hiding path; uploads, computes and fetches of
+                consecutive calls pipeline)
+    """
     from mxnet_tpu import predict
 
     p = predict.create(prefix, 0, {"data": (batch,) + data_shape},
@@ -59,12 +68,30 @@ def bench_batch(prefix, data_shape, batch, iters, dev_type, dtype=None):
         0, 1, (batch,) + data_shape).astype(np.float32)
     p.forward(data=x)
     np.asarray(p.get_output(0))  # compile + settle; fetch = real barrier
+    res = {}
     tic = time.perf_counter()
     for _ in range(iters):
         p.forward(data=x)
     np.asarray(p.get_output(0))
-    dt = time.perf_counter() - tic
-    return batch * iters / dt
+    res["dispatch"] = batch * iters / (time.perf_counter() - tic)
+
+    tic = time.perf_counter()
+    for _ in range(iters):
+        p.forward(data=x)
+        np.asarray(p.get_output(0))
+    res["serial"] = batch * iters / (time.perf_counter() - tic)
+
+    depth = 4
+    tic = time.perf_counter()
+    pending = []
+    for _ in range(iters):
+        pending.append(p.forward_async(data=x))
+        if len(pending) >= depth:
+            p.get_async(pending.pop(0))
+    while pending:
+        p.get_async(pending.pop(0))
+    res["overlap"] = batch * iters / (time.perf_counter() - tic)
+    return res
 
 
 def main():
@@ -93,14 +120,18 @@ def main():
         print(f"predict-path throughput: {args.model}, dev={dev_type} "
               f"(P100 predictor baselines: b1 113.76, b32 713.17 img/s)")
         for b in args.batches:
-            rate = bench_batch(prefix, data_shape, b, args.iters, dev_type,
-                               dtype=args.dtype)
+            res = bench_batch(prefix, data_shape, b, args.iters, dev_type,
+                              dtype=args.dtype)
+            rate = res["dispatch"]
             line = f"predict_b{b}: {rate:.1f} img/s"
             if args.model == "resnet-50":
                 base = 113.76 if b == 1 else (713.17 if b == 32 else None)
                 if base:
                     line += f"  ({rate / base:.2f}x P100 predictor)"
-            print(line)
+            line += (f"   serial {res['serial']:.1f}"
+                     f"   overlap(d4) {res['overlap']:.1f}"
+                     f"   [{res['overlap'] / max(res['serial'], 1e-9):.2f}x]")
+            print(line, flush=True)
 
 
 if __name__ == "__main__":

@@ -1,11 +1,15 @@
 #!/bin/bash
-# Round-4 second TPU window: the follow-up payloads after the headline
-# bench landed (tools/tpu_watch.sh attempt 1, docs/measured/).  Runs each
-# payload once when the backend answers, writing per-payload output files:
+# Round-5 TPU window watcher.  Polls the backend; when it answers, runs the
+# round-5 payload set once each (correctness before perf, per VERDICT r04
+# item 5), writing per-payload output files under /tmp/tpu_window:
 #
-#   peak     - tools/probe_peak.py       (MXU + HBM roofline corners)
-#   profile  - tools/probe_profile.py    (xprof op-level time split)
-#   predict  - tools/bench_predict.py    (single-dispatch path, f32 + bf16)
+#   tputests - MXTPU_TPU_TESTS=1 pytest tpu_consistency + bf16 + flash-attn
+#   bench    - full bench.py capture (headline + extras) on the live chip
+#   peak     - tools/probe_peak.py        (MXU + HBM roofline corners)
+#   profile  - tools/probe_profile.py     (xprof op-level time split)
+#   variants - tools/probe_resnet_variants.py (BN-cost A/B)
+#   predict  - tools/bench_predict.py f32+bf16, overlap off/on A/B
+#   lmmfu    - tools/probe_lm_mfu.py      (compute-bound LM MFU headline)
 #
 # Usage: nohup setsid bash tools/tpu_window.sh >/tmp/tpu_window/driver.log 2>&1 &
 OUT=/tmp/tpu_window
@@ -27,28 +31,45 @@ while true; do
   fi
   echo "[window] attempt $attempt: BACKEND UP" >> "$OUT/driver.log"
 
+  # 1. numerics on silicon — correctness outranks perf
+  [ -f "$OUT/tputests.ok" ] || { timeout 2400 env MXTPU_TPU_TESTS=1 \
+      python -m pytest tests/test_tpu_consistency.py \
+      tests/test_bf16_consistency.py tests/test_flash_attention.py -q \
+      > "$OUT/tputests" 2>&1 \
+      && grep -qE "passed" "$OUT/tputests" \
+      && ! grep -qE "failed|error" "$OUT/tputests" \
+      && touch "$OUT/tputests.ok"; }
+  # 2. the headline bench, full extras — the round's own clean capture
+  [ -f "$OUT/bench.ok" ] || { timeout 1500 env BENCH_INIT_TIMEOUT_S=560 \
+      python bench.py > "$OUT/bench" 2>&1 \
+      && grep -q '"resnet50_train' "$OUT/bench" \
+      && ! grep -q '"error"' "$OUT/bench" && touch "$OUT/bench.ok"; }
+  # 3. roofline probes
   [ -f "$OUT/peak.ok" ] || { timeout 900 python tools/probe_peak.py \
       > "$OUT/peak" 2>&1 && grep -q "hbm axpy" "$OUT/peak" \
       && touch "$OUT/peak.ok"; }
-  [ -f "$OUT/predict.ok" ] || { { timeout 900 python tools/bench_predict.py \
-      --iters 20 > "$OUT/predict" 2>&1 \
-      && timeout 900 python tools/bench_predict.py --iters 20 \
-         --dtype bfloat16 >> "$OUT/predict" 2>&1; } \
-      && grep -q "predict_b32" "$OUT/predict" && touch "$OUT/predict.ok"; }
   [ -f "$OUT/profile.ok" ] || { timeout 1200 python tools/probe_profile.py \
       > "$OUT/profile" 2>&1 && grep -q "wrote" "$OUT/profile" \
       && touch "$OUT/profile.ok"; }
   [ -f "$OUT/variants.ok" ] || { timeout 1500 python \
       tools/probe_resnet_variants.py > "$OUT/variants" 2>&1 \
       && grep -q "nobn" "$OUT/variants" && touch "$OUT/variants.ok"; }
-  [ -f "$OUT/tputests.ok" ] || { timeout 1800 env MXTPU_TPU_TESTS=1 \
-      python -m pytest tests/test_tpu_consistency.py -q \
-      > "$OUT/tputests" 2>&1 \
-      && grep -qE "passed" "$OUT/tputests" && touch "$OUT/tputests.ok"; }
+  # 4. predictor path, f32 + bf16 (bench_predict runs its own overlap A/B
+  #    when the predictor supports it)
+  [ -f "$OUT/predict.ok" ] || { { timeout 900 python tools/bench_predict.py \
+      --iters 20 > "$OUT/predict" 2>&1 \
+      && timeout 900 python tools/bench_predict.py --iters 20 \
+         --dtype bfloat16 >> "$OUT/predict" 2>&1; } \
+      && grep -q "predict_b32" "$OUT/predict" && touch "$OUT/predict.ok"; }
+  # 5. compute-bound LM MFU headline (probe lands later this round)
+  [ -f "$OUT/lmmfu.ok" ] || { [ -f tools/probe_lm_mfu.py ] \
+      && timeout 1800 python tools/probe_lm_mfu.py > "$OUT/lmmfu" 2>&1 \
+      && grep -q "mfu" "$OUT/lmmfu" && touch "$OUT/lmmfu.ok"; }
 
-  if [ -f "$OUT/peak.ok" ] && [ -f "$OUT/predict.ok" ] \
-     && [ -f "$OUT/profile.ok" ] && [ -f "$OUT/variants.ok" ] \
-     && [ -f "$OUT/tputests.ok" ]; then
+  if [ -f "$OUT/tputests.ok" ] && [ -f "$OUT/bench.ok" ] \
+     && [ -f "$OUT/peak.ok" ] && [ -f "$OUT/profile.ok" ] \
+     && [ -f "$OUT/variants.ok" ] && [ -f "$OUT/predict.ok" ] \
+     && { [ ! -f tools/probe_lm_mfu.py ] || [ -f "$OUT/lmmfu.ok" ]; }; then
     echo "[window] attempt $attempt: ALL DONE" >> "$OUT/driver.log"
     exit 0
   fi
