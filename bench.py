@@ -379,6 +379,51 @@ def _bench(dev, kind):
                 extras["lm_skipped"] = "insufficient extras budget"
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))
+        try:
+            # compute-bound MFU headline: a ~220M-param LM config where
+            # the MXU is actually fed (ResNet-50-with-BN is HBM-roofline-
+            # bound at ~0.175 on v5e; tools/probe_lm_mfu.py sweeps this
+            # family with the SAME shared config + FLOP rule)
+            if peak and time.monotonic() < deadline - 180 and \
+                    os.environ.get("BENCH_LM_MFU", "1") == "1":
+                from mxnet_tpu.models.transformer import (
+                    MFU_HEADLINE_CONFIG, lm_train_flops_per_token)
+
+                cfg = MFU_HEADLINE_CONFIG
+                Tm, Vm = cfg["seq_len"], cfg["vocab_size"]
+                Bm = int(os.environ.get("BENCH_LM_MFU_BATCH", "16"))
+                big_lm = models.transformer.transformer_lm(**cfg)
+                mtr = FusedTrainer(big_lm, optimizer="adam",
+                                   optimizer_params={"lr": 1e-4},
+                                   dtype=dtype)
+                mtr.init(data=(Bm, Tm), softmax_label=(Bm, Tm))
+                mtoks = jax.device_put(rs.randint(
+                    0, Vm, (Bm, Tm)).astype(np.float32))
+                mlabs = jax.device_put(rs.randint(
+                    0, Vm, (Bm, Tm)).astype(np.float32))
+                mtr.step(data=mtoks, softmax_label=mlabs)  # compile
+                mname = sorted(mtr.params)[0]
+                mbarrier = lambda: float(
+                    np.asarray(mtr.params[mname]).ravel()[0])
+                mbarrier()
+                mdt = _time_steps(
+                    lambda: mtr.step(data=mtoks, softmax_label=mlabs),
+                    mbarrier, 10)
+                mtok_s = Bm * Tm * 10 / mdt
+                fpt = lm_train_flops_per_token(
+                    cfg["num_layers"], cfg["d_model"], cfg["d_ff"], Tm, Vm)
+                extras["transformer_lm_mfu"] = round(
+                    mtok_s * fpt / peak, 4)
+                extras["transformer_lm_mfu_tokens_per_sec"] = round(
+                    mtok_s, 0)
+                extras["transformer_lm_mfu_config"] = (
+                    "L%d D%d ff%d T%d V%d b%d %s" % (
+                        cfg["num_layers"], cfg["d_model"], cfg["d_ff"],
+                        Tm, Vm, Bm, jnp.dtype(dtype).name))
+        except Exception as exc:  # noqa: BLE001
+            extras["lm_mfu_error"] = repr(exc)  # the headline must not
+            #                                     vanish behind an earlier
+            #                                     block's unrelated error
         if not claim():
             return 0  # the watchdog already emitted the primary payload
         payload.update(extras)

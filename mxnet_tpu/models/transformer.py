@@ -90,3 +90,25 @@ def transformer_lm(num_layers=4, num_heads=4, d_model=128, d_ff=None,
 def get_symbol(num_classes=1000, **kwargs):
     kwargs.setdefault("vocab_size", num_classes)
     return transformer_lm(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MFU accounting — the ONE definition bench.py and tools/probe_lm_mfu.py
+# share, so the bench extra and the probe sweep can never desynchronize.
+# ---------------------------------------------------------------------------
+
+# the compute-bound headline config (~220M params): big enough matmuls to
+# feed the MXU, small enough that Adam state + activations fit one v5e
+MFU_HEADLINE_CONFIG = dict(num_layers=12, num_heads=16, d_model=1024,
+                           d_ff=4096, seq_len=1024, vocab_size=32768)
+
+
+def lm_train_flops_per_token(num_layers, d_model, d_ff, seq_len,
+                             vocab_size):
+    """Model-FLOP cost of ONE training token, conservative accounting:
+    6 * matmul-params (qkv/proj, ffn, head; embedding gathers are free)
+    plus causal-halved flash attention (6*L*T*D — the Pallas kernel
+    skips fully-masked key blocks, ops/flash_attention.py:48-63)."""
+    n_mat = (num_layers * (4 * d_model * d_model + 2 * d_model * d_ff)
+             + d_model * vocab_size)
+    return 6 * n_mat + 6 * num_layers * seq_len * d_model

@@ -65,11 +65,16 @@ while true; do
   [ -f "$OUT/lmmfu.ok" ] || { [ -f tools/probe_lm_mfu.py ] \
       && timeout 1800 python tools/probe_lm_mfu.py > "$OUT/lmmfu" 2>&1 \
       && grep -q "mfu" "$OUT/lmmfu" && touch "$OUT/lmmfu.ok"; }
+  # 6. framework-vs-raw gap decomposition (host vs device vs ceiling)
+  [ -f "$OUT/gap.ok" ] || { [ -f tools/probe_gap.py ] \
+      && timeout 1500 python tools/probe_gap.py > "$OUT/gap" 2>&1 \
+      && grep -q "framework b" "$OUT/gap" && touch "$OUT/gap.ok"; }
 
   if [ -f "$OUT/tputests.ok" ] && [ -f "$OUT/bench.ok" ] \
      && [ -f "$OUT/peak.ok" ] && [ -f "$OUT/profile.ok" ] \
      && [ -f "$OUT/variants.ok" ] && [ -f "$OUT/predict.ok" ] \
-     && { [ ! -f tools/probe_lm_mfu.py ] || [ -f "$OUT/lmmfu.ok" ]; }; then
+     && { [ ! -f tools/probe_lm_mfu.py ] || [ -f "$OUT/lmmfu.ok" ]; } \
+     && { [ ! -f tools/probe_gap.py ] || [ -f "$OUT/gap.ok" ]; }; then
     echo "[window] attempt $attempt: ALL DONE" >> "$OUT/driver.log"
     exit 0
   fi
