@@ -476,21 +476,25 @@ class ImageRecordIter(DataIter):
         lab = np.atleast_1d(np.asarray(label, np.float32))
         return img.transpose(2, 0, 1), lab
 
-    def next(self):
-        from . import storage
+    def _next_into(self, data, labels):
+        """Decode the next batch INTO caller-provided buffers (``data``
+        shaped (batch,)+data_shape f32, ``labels`` (batch, label_width)
+        f32); returns the pad count or raises StopIteration.
 
+        This is the device-free core of ``next()``: the multi-process
+        pipeline (mp_io.py) calls it from decode worker processes with
+        shared-memory ring slots as the buffers, so pixels are written
+        exactly once — straight into the cross-process ring."""
         if self.cur >= len(self.order):
             raise StopIteration
         idxs = self.order[self.cur : self.cur + self.batch_size]
         pad = self.batch_size - len(idxs)
-        if pad:
-            idxs = idxs + self.order[:pad]  # wrap-around padding
+        while len(idxs) < self.batch_size:
+            # wrap-around padding; LOOPED so shards smaller than one
+            # batch (realistic under per-process sharding) still fill
+            # every row instead of leaving stale buffer contents
+            idxs = idxs + self.order[: self.batch_size - len(idxs)]
         self.cur += self.batch_size
-        # decode/augment on the thread pool; workers write straight into
-        # the pooled staging buffer (copy-on-stage recycles it below)
-        data = storage.staging_empty((self.batch_size,) + self.data_shape,
-                                     np.float32)
-        labels = np.empty((self.batch_size, self.label_width), np.float32)
 
         def work(slot, rec):
             img, lab = self._decode_one(rec)
@@ -499,12 +503,31 @@ class ImageRecordIter(DataIter):
             labels[slot, :n] = lab[:n]
             labels[slot, n:] = 0.0
 
+        list(self.pool.map(work, range(len(idxs)),
+                           [self.records[i] for i in idxs]))
+        return pad
+
+    def next(self):
+        from . import storage
+
+        if self.cur >= len(self.order):
+            raise StopIteration
+        # decode/augment on the thread pool; workers write straight into
+        # the pooled staging buffer (copy-on-stage recycles it below)
+        data = storage.staging_empty((self.batch_size,) + self.data_shape,
+                                     np.float32)
+        labels = np.empty((self.batch_size, self.label_width), np.float32)
         try:
-            list(self.pool.map(work, range(len(idxs)),
-                               [self.records[i] for i in idxs]))
+            pad = self._next_into(data, labels)
         except Exception:
             storage.staging_free(data)  # decode error must not leak block
             raise
         label_out = labels[:, 0] if self.label_width == 1 else labels
         return DataBatch([nd.NDArray(storage.stage_to_device(data))],
                          [nd.array(label_out)], pad=pad)
+
+
+# sharded-host multi-process pipeline (N decode processes -> shared-memory
+# ring -> this process); lives in mp_io.py, surfaced here beside the
+# single-process ImageRecordIter it parallelizes
+from .mp_io import MultiProcessImageRecordIter  # noqa: E402,F401

@@ -1,0 +1,275 @@
+"""Sharded-host multi-process input pipeline.
+
+Parity: src/io/iter_image_recordio.cc:150-368 (reference) — there, one
+process decodes with an OpenMP preprocess-thread pool because C++ threads
+scale with cores.  A Python host adds GIL and allocator contention on the
+numpy/augment stages, so the TPU-native equivalent fans the WHOLE
+pipeline out across worker *processes*:
+
+    worker p of N:  InputSplit shard p/N (filesystem.py, dmlc-core
+                    semantics) -> jpeg decode -> augment -> batch,
+                    written ONCE, straight into a shared-memory ring slot
+                    (ImageRecordIter._next_into with ring views as the
+                    output buffers — no pickling, no pipe copies)
+    consumer:       pops finished slots, stages them through the pooled
+                    host arena to the device (storage.stage_to_device),
+                    recycles the slot
+
+Stack ``MultiProcessImageRecordIter -> io.DevicePrefetchIter`` to overlap
+the host pipeline with device compute.  Scaling is measured by the
+default ``python tools/bench_io.py`` run (mp_pipeline rows); the design
+scales decode with host cores x processes the way the reference's
+preprocess_threads scales with cores (docs/how_to/perf.md Data-IO
+section).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as queue_mod
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .io import DataBatch, DataIter
+from .io import DataDesc
+
+
+def _attach_shm(name):
+    from multiprocessing import shared_memory
+    import inspect
+
+    # the parent owns the segments' lifetime: workers must attach WITHOUT
+    # resource-tracker registration or the tracker double-unlinks (3.13+
+    # has track=False for exactly this; 3.12's attach-path registration
+    # is imbalanced, so there we unregister straight after attaching)
+    if "track" in inspect.signature(
+            shared_memory.SharedMemory.__init__).parameters:
+        return shared_memory.SharedMemory(name=name, track=False)
+    # pre-3.13 attach does not register with the resource tracker, so a
+    # plain attach is already untracked (an explicit unregister here
+    # would make the tracker's cache go negative and raise at exit)
+    return shared_memory.SharedMemory(name=name)
+
+
+def _worker(path, data_shape, batch_size, label_width, wid, num_workers,
+            slot_names, free_q, full_q, stop, barrier, seed, iter_kwargs):
+    """Decode worker: runs the full shard->decode->augment->batch pipeline
+    over InputSplit shard ``wid``/``num_workers``, writing each batch
+    straight into a free ring slot.  Device-free by construction (only
+    ImageRecordIter._next_into is used, never .next()).
+
+    Epoch discipline: after a full pass over the shard the worker posts
+    its "end" sentinel and WAITS at the shared barrier until the consumer
+    has drained the epoch — otherwise a fast worker's next-epoch batches
+    would interleave into the current epoch's count."""
+    from .image import ImageRecordIter
+
+    shms = {name: _attach_shm(name) for name in slot_names}
+    data_elems = batch_size * int(np.prod(data_shape))
+    it = ImageRecordIter(path_imgrec=path, data_shape=data_shape,
+                         batch_size=batch_size, label_width=label_width,
+                         part_index=wid, num_parts=num_workers,
+                         seed=seed + wid, **iter_kwargs)
+    try:
+        while not stop.is_set():
+            it.reset()
+            while True:
+                slot = free_q.get()
+                if slot is None or stop.is_set():
+                    return
+                buf = shms[slot].buf
+                data = np.ndarray((batch_size,) + tuple(data_shape),
+                                  np.float32, buffer=buf)
+                labels = np.ndarray((batch_size, label_width), np.float32,
+                                    buffer=buf, offset=data_elems * 4)
+                try:
+                    pad = it._next_into(data, labels)  # noqa: SLF001
+                except StopIteration:
+                    free_q.put(slot)  # hand the unused slot back
+                    break
+                except Exception as exc:  # noqa: BLE001
+                    # a decode/augment failure must surface in the
+                    # CONSUMER immediately (the single-process iterator
+                    # raises in place; dying silently here would turn it
+                    # into a stall_timeout hang)
+                    import traceback
+
+                    free_q.put(slot)
+                    full_q.put(("error", wid,
+                                "".join(traceback.format_exception(exc))))
+                    return
+                full_q.put(("batch", slot, pad))
+            full_q.put(("end", wid))
+            try:
+                barrier.wait()  # consumer joins once the epoch is drained
+            except Exception:  # noqa: BLE001 — aborted barrier = shutdown
+                return
+    finally:
+        for shm in shms.values():
+            shm.close()
+
+
+class MultiProcessImageRecordIter(DataIter):
+    """N-process RecordIO image pipeline over a shared-memory ring.
+
+    path_imgrec/data_shape/batch_size/label_width and the augmentation
+    kwargs match ImageRecordIter (each worker builds one over its own
+    InputSplit shard).  ``num_workers`` decode processes publish finished
+    batches into ``slots`` ring slots (default 2*workers+2).
+
+    Epoch semantics: one epoch = every worker completing one pass over
+    its shard (each worker wrap-pads its own final batch, like the
+    reference's sharded iterators); workers free-run ahead into the next
+    epoch while the consumer drains the current one.  ``close()`` (or
+    garbage collection) shuts the processes down and unlinks the ring.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 num_workers=2, slots=None, seed=0, start_method=None,
+                 stall_timeout=300.0, **iter_kwargs):
+        super().__init__()
+        from multiprocessing import shared_memory
+
+        self.batch_size = int(batch_size)
+        self.data_shape = tuple(int(x) for x in data_shape)
+        self.label_width = int(label_width)
+        self.num_workers = int(num_workers)
+        if self.num_workers < 1:
+            raise MXNetError("num_workers must be >= 1")
+        self._stall_timeout = float(stall_timeout)
+        # forkserver by default: plain fork of a parent whose jax/TPU
+        # client already started threads is a deadlock class, and spawn
+        # re-executes the parent's __main__ (breaks script/REPL parents);
+        # the forkserver's clean server process forks device-free workers
+        # that import only mxnet_tpu.mp_io
+        default = "forkserver" if hasattr(os, "fork") else "spawn"
+        method = start_method or os.environ.get("MXTPU_MP_START", default)
+        ctx = mp.get_context(method)
+        n_slots = int(slots) if slots else 2 * self.num_workers + 2
+        data_elems = self.batch_size * int(np.prod(self.data_shape))
+        slot_bytes = 4 * (data_elems + self.batch_size * self.label_width)
+        self._data_elems = data_elems
+        self._shms = [shared_memory.SharedMemory(create=True,
+                                                 size=slot_bytes)
+                      for _ in range(n_slots)]
+        self._shm_by_name = {s.name: s for s in self._shms}
+        self._free_q = ctx.Queue()
+        for s in self._shms:
+            self._free_q.put(s.name)
+        self._full_q = ctx.Queue()
+        self._stop = ctx.Event()
+        # workers + consumer meet here at every epoch boundary (reusable)
+        self._barrier = ctx.Barrier(self.num_workers + 1)
+        self._ends = set()
+        self._closed = False
+        self._procs = [
+            ctx.Process(
+                target=_worker,
+                args=(path_imgrec, self.data_shape, self.batch_size,
+                      self.label_width, wid, self.num_workers,
+                      [s.name for s in self._shms], self._free_q,
+                      self._full_q, self._stop, self._barrier, seed,
+                      iter_kwargs),
+                daemon=True)
+            for wid in range(self.num_workers)
+        ]
+        for p in self._procs:
+            p.start()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        # workers free-run; the consumer just opens the next epoch window
+        pass
+
+    def next(self):
+        from . import storage
+
+        if self._closed:
+            raise MXNetError("iterator is closed")
+        while True:
+            try:
+                msg = self._full_q.get(timeout=self._stall_timeout)
+            except queue_mod.Empty:
+                dead = [w for w, p in enumerate(self._procs)
+                        if not p.is_alive()]
+                raise MXNetError(
+                    f"input workers stalled for {self._stall_timeout}s "
+                    f"(dead workers: {dead or 'none'})") from None
+            if msg[0] == "error":
+                raise MXNetError(
+                    f"input worker {msg[1]} failed:\n{msg[2]}")
+            if msg[0] == "end":
+                self._ends.add(msg[1])
+                if len(self._ends) == self.num_workers:
+                    self._ends = set()
+                    self._barrier.wait(timeout=self._stall_timeout)
+                    raise StopIteration
+                continue
+            _, slot, pad = msg
+            buf = self._shm_by_name[slot].buf
+            view = np.ndarray((self.batch_size,) + self.data_shape,
+                              np.float32, buffer=buf)
+            lview = np.ndarray((self.batch_size, self.label_width),
+                               np.float32, buffer=buf,
+                               offset=self._data_elems * 4)
+            # one copy into the pooled staging arena (recycled by
+            # stage_to_device), then the slot goes straight back to the
+            # ring — the consumer never blocks on device transfer
+            data = storage.staging_empty(
+                (self.batch_size,) + self.data_shape, np.float32)
+            np.copyto(data, view)
+            labels = lview.copy()
+            self._free_q.put(slot)
+            label_out = labels[:, 0] if self.label_width == 1 else labels
+            return DataBatch([nd.NDArray(storage.stage_to_device(data))],
+                             [nd.array(label_out)], pad=pad)
+
+    def close(self):
+        """Stop workers, drain the ring, unlink the shared memory."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        try:
+            self._barrier.abort()  # wake workers parked at an epoch end
+        except Exception:  # noqa: BLE001
+            pass
+        for _ in self._procs:  # wake workers blocked on free_q.get()
+            self._free_q.put(None)
+        for p in self._procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+        # drain queues so their feeder threads don't block interpreter exit
+        for q in (self._full_q, self._free_q):
+            try:
+                while True:
+                    q.get_nowait()
+            except (queue_mod.Empty, OSError):
+                pass
+            q.close()
+        for shm in self._shms:
+            try:
+                shm.close()
+                shm.unlink()
+            except Exception:  # noqa: BLE001 — double-close on interpreter exit
+                pass
+        self._shms = []
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

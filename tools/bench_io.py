@@ -126,6 +126,32 @@ def bench_device_prefetch(path, threads, size, depth=2):
     return count / dt
 
 
+def bench_mp_pipeline(path, workers, size, batches=30):
+    """Sharded-host multi-process pipeline: N decode processes ->
+    shared-memory ring -> this process staging to device
+    (mp_io.MultiProcessImageRecordIter).  The process fan-out is the
+    scale-out answer where thread counts stop helping (GIL/allocator
+    contention on the python stages)."""
+    from mxnet_tpu.image import MultiProcessImageRecordIter
+
+    it = MultiProcessImageRecordIter(
+        path_imgrec=path, data_shape=(3, size, size), batch_size=50,
+        num_workers=workers, stall_timeout=180)
+    try:
+        src = iter(it)
+        next(src)  # worker spin-up + first decode out of the timing
+        tic = time.perf_counter()
+        count = 0
+        for batch in src:
+            count += batch.data[0].shape[0]
+            if count >= batches * 50:
+                break
+        dt = time.perf_counter() - tic
+        return count / dt
+    finally:
+        it.close()
+
+
 def sweep(args):
     """Thread-scaling table + host-CPU ceiling model."""
     with tempfile.TemporaryDirectory() as tmp:
@@ -157,6 +183,16 @@ def sweep(args):
 
 
 def main():
+    # the host pipeline is what's being measured; on a box whose
+    # accelerator plugin can hang at init (the axon plugin ignores
+    # JAX_PLATFORMS), pin the cpu platform before any staging runs
+    if os.environ.get("MXTPU_PLATFORM", "cpu") == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — a backend already won the race
+            pass
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2000)
     ap.add_argument("--threads", type=int, default=4)
@@ -189,6 +225,9 @@ def main():
               (args.threads, nopool_rate))
         target = 1000.0
         print("target_1k_met: %s" % ("yes" if dec_rate >= target else "no"))
+        for w in (1, 2, 4):
+            mp_rate = bench_mp_pipeline(path, w, args.size)
+            print("mp_pipeline(workers=%d): %.0f img/s" % (w, mp_rate))
 
 
 if __name__ == "__main__":
