@@ -1,0 +1,74 @@
+"""Shared recommender pieces (parity: example/recommenders/recotools.py +
+crossentropy.py's role): synthetic implicit-feedback data and the ranking
+metrics the workloads assert on, built as mx.metric.EvalMetric
+subclasses so they plug into Module.score/fit like any built-in."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def synth_implicit(rs, users, items, rank, interactions_per_user):
+    """Low-rank preference matrix -> each user 'consumes' their top-k
+    items (plus noise).  Returns (positives[user, item], heldout[user ->
+    one positive item held out of training])."""
+    gu = rs.randn(users, rank).astype(np.float32)
+    gi = rs.randn(items, rank).astype(np.float32)
+    scores = gu @ gi.T + rs.randn(users, items).astype(np.float32) * 0.3
+    pos, heldout = [], {}
+    k = interactions_per_user
+    for u in range(users):
+        top = np.argpartition(-scores[u], k + 1)[: k + 1]
+        top = top[np.argsort(-scores[u][top])]
+        heldout[u] = int(top[0])        # best item: held out for eval
+        for i in top[1:]:
+            pos.append((u, int(i)))
+    return np.asarray(pos, np.int64), heldout
+
+
+class AUCMetric(mx.metric.EvalMetric):
+    """Pairwise AUC over a binary-labelled batch: P(score_pos >
+    score_neg) estimated from all pos/neg pairs in the batch (the metric
+    implicit-feedback recommenders report; label 1 = observed pair)."""
+
+    def __init__(self):
+        super().__init__("auc")
+
+    def update(self, labels, preds):
+        lab = labels[0].asnumpy().ravel()
+        p = preds[0].asnumpy().ravel()
+        pos, neg = p[lab > 0.5], p[lab <= 0.5]
+        if len(pos) == 0 or len(neg) == 0:
+            return
+        # exact pairwise count via rank-sum (O(n log n))
+        allp = np.concatenate([pos, neg])
+        ranks = allp.argsort().argsort().astype(np.float64) + 1
+        auc = (ranks[: len(pos)].sum() - len(pos) * (len(pos) + 1) / 2) \
+            / (len(pos) * len(neg))
+        self.sum_metric += float(auc)
+        self.num_inst += 1
+
+
+class HitRateAtK:
+    """HitRate@K over held-out positives: score EVERY item for a user,
+    hit if the held-out item ranks in the top K.  Not an EvalMetric
+    (needs full score vectors, not batch preds) — the workloads call
+    ``update(rank)`` directly."""
+
+    def __init__(self, k):
+        self.k = k
+        self.hits = 0
+        self.total = 0
+
+    def update(self, rank):
+        self.hits += int(rank < self.k)
+        self.total += 1
+
+    def get(self):
+        return ("hitrate@%d" % self.k,
+                self.hits / max(self.total, 1))

@@ -28,6 +28,8 @@ CASES = [
     ("rcnn", "train_end2end.py", ["--steps", "15", "--log-interval", "15"],
      "VOC07_mAP"),
     ("image-classification", "score.py", [], "SCORE OK"),
+    ("gan", "cgan.py", ["--num-batches", "400"], "CGAN OK"),
+    ("recommenders", "implicit.py", ["--epochs", "8"], "IMPLICIT OK"),
 ]
 
 

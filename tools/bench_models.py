@@ -1,0 +1,190 @@
+"""Model-family training/forward throughput capture — ONE script that
+re-measures every perf.md model-family number so each published figure
+has a committed raw artifact (docs/measured/bench_models_r*.txt).
+
+Covers (bf16, one chip, device-staged synthetic data, fetch-barrier
+timing — the bench.py discipline):
+  inception-v3   train b32            (reference perf.md:132-139 P100
+                                       129.98 img/s)
+  lstm-ptb       train 2x200 seq35    (example/rnn/lstm_bucketing.py
+                 b32 vocab10k          config)
+  ssd-vgg16-300  forward b32          (reference example/ssd)
+  transformer-lm train 12L d512 T1024 (beyond-reference family)
+                 b8 flash-attention
+
+Run on the bench chip:  python tools/bench_models.py [--iters N]
+CPU smoke:  MXTPU_PLATFORM=cpu python tools/bench_models.py --smoke
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _timed(tr, feed, iters, warmup=3):
+    import numpy as _np
+
+    pname = sorted(tr.params)[0]
+
+    def barrier():
+        return float(_np.asarray(tr.params[pname]).ravel()[0])
+
+    for _ in range(warmup):
+        tr.step(**feed)
+    barrier()
+    tic = time.perf_counter()
+    for _ in range(iters):
+        tr.step(**feed)
+    barrier()
+    return time.perf_counter() - tic
+
+
+def bench_inception(iters, smoke=False):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import models
+    from mxnet_tpu.trainer import FusedTrainer
+
+    net = models.get_symbol("inception-v3", num_classes=1000)
+    b = 32 if not smoke else 1
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.1, "momentum": 0.9,
+                                        "rescale_grad": 1.0 / b},
+                      dtype=jnp.bfloat16)
+    tr.init(data=(b, 3, 299, 299))
+    rs = np.random.RandomState(0)
+    feed = {"data": jax.device_put(
+        rs.uniform(0, 1, (b, 3, 299, 299)).astype(np.float32)),
+        "softmax_label": jax.device_put(
+            rs.randint(0, 1000, b).astype(np.float32))}
+    dt = _timed(tr, feed, iters)
+    print(f"inception_v3_train_b{b}: {b * iters / dt:.1f} img/s "
+          f"({dt / iters * 1e3:.1f} ms/step)", flush=True)
+
+
+def bench_lstm_ptb(iters, smoke=False):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import models
+    from mxnet_tpu.trainer import FusedTrainer
+
+    from mxnet_tpu.models import lstm
+
+    b, seq, hid = (32, 35, 200) if not smoke else (2, 8, 16)
+    layers = 2
+    net = lstm.get_symbol(num_classes=10000, seq_len=seq,
+                          num_hidden=hid, num_embed=hid,
+                          num_lstm_layer=layers)
+    # the unrolled graph's initial c/h are DATA (zero-fed each step, the
+    # example/rnn contract), not trainable params
+    states = [f"l{i}_init_{s}" for i in range(layers) for s in "ch"]
+    tr = FusedTrainer(net, data_names=("data", *states),
+                      optimizer="sgd",
+                      optimizer_params={"lr": 1.0, "rescale_grad": 1.0 / b},
+                      dtype=jnp.bfloat16)
+    shapes = {s: (b, hid) for s in states}
+    tr.init(data=(b, seq), softmax_label=(b, seq), **shapes)
+    rs = np.random.RandomState(0)
+    zeros = jax.device_put(np.zeros((b, hid), np.float32))
+    feed = {"data": jax.device_put(
+        rs.randint(0, 10000, (b, seq)).astype(np.float32)),
+        "softmax_label": jax.device_put(
+            rs.randint(0, 10000, (b, seq)).astype(np.float32)),
+        **{s: zeros for s in states}}
+    dt = _timed(tr, feed, iters)
+    print(f"lstm_ptb_train_tokens_per_sec: {b * seq * iters / dt:.0f} "
+          f"({dt / iters * 1e3:.1f} ms/step)", flush=True)
+
+
+def bench_ssd_forward(iters, smoke=False):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import models
+    from mxnet_tpu.trainer import FusedTrainer
+
+    b, hw = (32, 300) if not smoke else (1, 96)
+    net = models.get_symbol("ssd-vgg16", num_classes=20)
+    tr = FusedTrainer(net, optimizer="sgd", optimizer_params={"lr": 0.1},
+                      dtype=jnp.bfloat16,
+                      label_names=("label",))
+    tr.init(data=(b, 3, hw, hw), label=(b, 8, 5))
+    rs = np.random.RandomState(0)
+    data = jax.device_put(
+        rs.uniform(0, 1, (b, 3, hw, hw)).astype(np.float32))
+    label = jax.device_put(np.full((b, 8, 5), -1.0, np.float32))
+    # eval (forward-only) discipline: the published number is forward
+    out = tr.eval(data=data, label=label)
+    float(np.asarray(out[0]).ravel()[0])
+    tic = time.perf_counter()
+    for _ in range(iters):
+        out = tr.eval(data=data, label=label)
+    float(np.asarray(out[0]).ravel()[0])
+    dt = time.perf_counter() - tic
+    print(f"ssd_vgg16_300_fwd_b{b}: {b * iters / dt:.1f} img/s "
+          f"({dt / iters * 1e3:.1f} ms/fwd)", flush=True)
+
+
+def bench_transformer_lm(iters, smoke=False):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import models
+    from mxnet_tpu.models.transformer import lm_train_flops_per_token
+    from mxnet_tpu.trainer import FusedTrainer
+
+    if smoke:
+        L, H, D, T, V, b = 2, 2, 64, 64, 512, 2
+    else:
+        L, H, D, T, V, b = 12, 8, 512, 1024, 16000, 8
+    lm = models.transformer.transformer_lm(
+        num_layers=L, num_heads=H, d_model=D, seq_len=T, vocab_size=V)
+    tr = FusedTrainer(lm, optimizer="adam", optimizer_params={"lr": 1e-4},
+                      dtype=jnp.bfloat16)
+    tr.init(data=(b, T), softmax_label=(b, T))
+    rs = np.random.RandomState(0)
+    feed = {"data": jax.device_put(
+        rs.randint(0, V, (b, T)).astype(np.float32)),
+        "softmax_label": jax.device_put(
+            rs.randint(0, V, (b, T)).astype(np.float32))}
+    dt = _timed(tr, feed, iters)
+    tok_s = b * T * iters / dt
+    fpt = lm_train_flops_per_token(L, D, 4 * D, T, V)
+    print(f"transformer_lm_12L_d512_train_tokens_per_sec: {tok_s:.0f} "
+          f"({dt / iters * 1e3:.1f} ms/step, mfu={tok_s * fpt / 197e12:.3f})",
+          flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--only", choices=["inception", "lstm", "ssd", "lm"])
+    args = ap.parse_args()
+    if os.environ.get("MXTPU_PLATFORM") == "cpu" or args.smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        args.smoke = True
+        args.iters = min(args.iters, 2)
+    import jax
+
+    print("devices:", jax.devices(), flush=True)
+    benches = {"inception": bench_inception, "lstm": bench_lstm_ptb,
+               "ssd": bench_ssd_forward, "lm": bench_transformer_lm}
+    picks = [args.only] if args.only else list(benches)
+    for name in picks:
+        try:
+            benches[name](args.iters, smoke=args.smoke)
+        except Exception as exc:  # noqa: BLE001 — keep capturing the rest
+            print(f"{name}: FAILED {exc!r}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,12 +69,26 @@ while true; do
   [ -f "$OUT/gap.ok" ] || { [ -f tools/probe_gap.py ] \
       && timeout 1500 python tools/probe_gap.py > "$OUT/gap" 2>&1 \
       && grep -q "framework b" "$OUT/gap" && touch "$OUT/gap.ok"; }
+  # 7. model-family re-capture: every perf.md figure gets a raw artifact
+  [ -f "$OUT/modelbench.ok" ] || { [ -f tools/bench_models.py ] \
+      && timeout 2400 python tools/bench_models.py > "$OUT/modelbench" 2>&1 \
+      && grep -q "tokens_per_sec" "$OUT/modelbench" \
+      && ! grep -q "FAILED" "$OUT/modelbench" \
+      && touch "$OUT/modelbench.ok"; }
+  # 8. inference sweep behind the published 7-model table
+  [ -f "$OUT/score.ok" ] || { timeout 2400 python \
+      tools/benchmark_score.py --batches 32 > "$OUT/score" 2>&1 \
+      && grep -qi "resnet-152" "$OUT/score" \
+      && ! grep -qiE "FAILED|error" "$OUT/score" \
+      && touch "$OUT/score.ok"; }
 
   if [ -f "$OUT/tputests.ok" ] && [ -f "$OUT/bench.ok" ] \
      && [ -f "$OUT/peak.ok" ] && [ -f "$OUT/profile.ok" ] \
      && [ -f "$OUT/variants.ok" ] && [ -f "$OUT/predict.ok" ] \
      && { [ ! -f tools/probe_lm_mfu.py ] || [ -f "$OUT/lmmfu.ok" ]; } \
-     && { [ ! -f tools/probe_gap.py ] || [ -f "$OUT/gap.ok" ]; }; then
+     && { [ ! -f tools/probe_gap.py ] || [ -f "$OUT/gap.ok" ]; } \
+     && { [ ! -f tools/bench_models.py ] || [ -f "$OUT/modelbench.ok" ]; } \
+     && [ -f "$OUT/score.ok" ]; then
     echo "[window] attempt $attempt: ALL DONE" >> "$OUT/driver.log"
     exit 0
   fi
