@@ -14,6 +14,8 @@ UpSampling, Pad, Crop — SURVEY.md Appendix A).  TPU-first mapping:
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -257,14 +259,37 @@ def _batch_norm(ctx, data, gamma, beta, moving_mean, moving_var, **attrs):
         # read of the activation (f32 accumulation), halving the HBM
         # traffic of the two-pass mean-then-centered-var formulation —
         # the dominant cost of train-mode BN on TPU (profiled; same
-        # E[x^2]-E[x]^2 trick as mshadow's batch_norm forward)
+        # E[x^2]-E[x]^2 trick as mshadow's batch_norm forward).
+        # MXTPU_BN_STATS_DTYPE=compute keeps the reduction arithmetic in
+        # the compute dtype (bf16 under mixed precision) with f32
+        # accumulators (jnp.sum dtype=) — the traffic pattern
+        # tools/probe_resnet_variants.py A/Bs, in case XLA does not fuse
+        # the default path's f32 upcast into the reduction reads.
+        # Squaring in bf16 would make E[x^2]-E[x]^2 catastrophically
+        # cancellable whenever |mean| >> std (bf16's ~2^-9 relative
+        # rounding on the two large terms swamps a small variance), so
+        # the moments are SHIFTED by the moving mean first: x-c is
+        # small, bf16 represents small values with the same relative
+        # precision, and Var = E[(x-c)^2] - (E[x]-c)^2 subtracts two
+        # small numbers.  Opt-in until the probe proves the win.
         n = 1.0
         for ax in axes:
             n *= data.shape[ax]
-        data32 = data.astype(jnp.float32)  # fused into the reduction reads
-        mean32 = jnp.sum(data32, axis=axes) / n
-        sqmean = jnp.sum(jnp.square(data32), axis=axes) / n
-        var32 = jnp.maximum(sqmean - jnp.square(mean32), 0.0)
+        if os.environ.get("MXTPU_BN_STATS_DTYPE") == "compute":
+            shift = jax.lax.stop_gradient(moving_mean).astype(data.dtype)
+            centered = data - shift.reshape(bshape)
+            m1 = jnp.sum(centered, axis=axes, dtype=jnp.float32) / n
+            sq = jnp.sum(jnp.square(centered), axis=axes,
+                         dtype=jnp.float32) / n
+            # add back the ROUNDED shift actually subtracted, not the
+            # raw moving mean — they differ when aux arrives f32
+            mean32 = m1 + shift.astype(jnp.float32)
+            var32 = jnp.maximum(sq - jnp.square(m1), 0.0)
+        else:
+            data32 = data.astype(jnp.float32)  # fused into the reads
+            mean32 = jnp.sum(data32, axis=axes) / n
+            sqmean = jnp.sum(jnp.square(data32), axis=axes) / n
+            var32 = jnp.maximum(sqmean - jnp.square(mean32), 0.0)
         mean = mean32.astype(data.dtype)
         var = var32.astype(data.dtype)
         new_mean = moving_mean * momentum + mean32 * (1 - momentum)

@@ -46,3 +46,26 @@ def test_example_smoke(dirname, script, args, marker):
     out = r.stdout + r.stderr
     assert r.returncode == 0, out[-3000:]
     assert marker in out, out[-3000:]
+
+
+def test_notebooks_reexecute():
+    """Re-build + re-execute every tutorial notebook (the committed
+    .ipynb carry executed outputs; this pins that their assertions stay
+    true).  Same gate as the script smokes."""
+    if os.environ.get("MXTPU_EXAMPLE_TESTS") != "1":
+        pytest.skip("example smokes disabled; set MXTPU_EXAMPLE_TESTS=1")
+    import tempfile
+
+    env = dict(os.environ, MXTPU_PLATFORM="cpu", PYTHONUNBUFFERED="1")
+    with tempfile.TemporaryDirectory() as tmp:
+        # write into a scratch tree: executed outputs carry timings and
+        # temp paths, so re-running in place would dirty the committed
+        # notebooks on every gated test run
+        env["MXTPU_NOTEBOOK_OUT"] = tmp
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "make_notebooks.py")],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=1200)
+        assert r.returncode == 0, (r.stdout + r.stderr)[-3000:]
+        assert r.stdout.count("wrote ") == 4, r.stdout

@@ -290,3 +290,33 @@ def test_sampling_ops_shapes_and_ranges():
     assert (a >= 2.0).all() and (a < 3.0).all()
     n = mx.nd.normal(loc=5.0, scale=0.1, shape=(2000,)).asnumpy()
     assert abs(n.mean() - 5.0) < 0.05
+
+
+def test_batchnorm_stats_dtype_flag(monkeypatch):
+    """MXTPU_BN_STATS_DTYPE=compute accumulates BN moments in the input
+    dtype (the HBM-traffic A/B knob tools/probe_resnet_variants.py
+    measures); default stays f32 and the two must agree loosely."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import ops
+
+    rs = np.random.RandomState(0)
+    # large |mean| / small std: the regime where naive bf16 squares
+    # would cancel catastrophically — the shifted-moments formulation
+    # must stay accurate here
+    x = jnp.asarray(rs.normal(40.0, 1.0, (8, 4, 5, 5)), jnp.bfloat16)
+    gamma = jnp.ones(4)
+    beta = jnp.zeros(4)
+    mm, mv = jnp.full(4, 40.0), jnp.ones(4)
+    octx = ops.OpCtx(is_train=True)
+    bn = ops.get("BatchNorm").fn
+    monkeypatch.delenv("MXTPU_BN_STATS_DTYPE", raising=False)
+    out_f32, _ = bn(octx, x, gamma, beta, mm, mv)
+    monkeypatch.setenv("MXTPU_BN_STATS_DTYPE", "compute")
+    out_bf16, _ = bn(octx, x, gamma, beta, mm, mv)
+    # same math with bf16-rounded squares: close (possibly identical
+    # after the output's own bf16 rounding — the flag's effect is HBM
+    # traffic, not numerics)
+    np.testing.assert_allclose(
+        np.asarray(out_f32, np.float32), np.asarray(out_bf16, np.float32),
+        atol=0.15)
