@@ -83,12 +83,30 @@ def get_imagenet_iter(args):
     batch = args.batch_size
     shape = tuple(int(x) for x in args.image_shape.split(","))
     if getattr(args, "data_train", None) and os.path.exists(args.data_train):
-        train = mx.image.ImageRecordIter(
-            path_imgrec=args.data_train, data_shape=shape, batch_size=batch,
-            rand_crop=True, rand_mirror=True,
-            part_index=getattr(args, "part_index", 0),
-            num_parts=getattr(args, "num_parts", 1),
-            preprocess_threads=args.data_nthreads)
+        workers = int(getattr(args, "data_nprocs", 0) or 0)
+        if workers > 0:
+            # sharded-host pipeline: N decode processes over a
+            # shared-memory ring (mp_io.py) — the scale-out path when
+            # one process's threads can't feed the chip.  Host sharding
+            # (part_index/num_parts) composes with the worker fan-out;
+            # --data-nthreads is split across the workers; the device
+            # copy overlaps via DevicePrefetchIter.
+            train = mx.io.DevicePrefetchIter(
+                mx.image.MultiProcessImageRecordIter(
+                    path_imgrec=args.data_train, data_shape=shape,
+                    batch_size=batch, num_workers=workers,
+                    part_index=getattr(args, "part_index", 0),
+                    num_parts=getattr(args, "num_parts", 1),
+                    preprocess_threads=max(1,
+                                           args.data_nthreads // workers),
+                    rand_crop=True, rand_mirror=True))
+        else:
+            train = mx.image.ImageRecordIter(
+                path_imgrec=args.data_train, data_shape=shape,
+                batch_size=batch, rand_crop=True, rand_mirror=True,
+                part_index=getattr(args, "part_index", 0),
+                num_parts=getattr(args, "num_parts", 1),
+                preprocess_threads=args.data_nthreads)
         val = None
         if getattr(args, "data_val", None) and os.path.exists(args.data_val):
             val = mx.image.ImageRecordIter(

@@ -40,6 +40,9 @@ def add_fit_args(parser: argparse.ArgumentParser):
     train.add_argument("--num-examples", type=int, default=4096)
     train.add_argument("--num-classes", type=int, default=10)
     train.add_argument("--data-nthreads", type=int, default=4)
+    train.add_argument("--data-nprocs", type=int, default=0,
+                       help="decode worker PROCESSES (shared-memory ring"
+                            " pipeline, mp_io.py); 0 = threaded iterator")
     return parser
 
 

@@ -54,7 +54,8 @@ def _attach_shm(name):
 
 
 def _worker(path, data_shape, batch_size, label_width, wid, num_workers,
-            slot_names, free_q, full_q, stop, barrier, seed, iter_kwargs):
+            part_index, num_parts, slot_names, free_q, full_q, stop,
+            barrier, seed, iter_kwargs):
     """Decode worker: runs the full shard->decode->augment->batch pipeline
     over InputSplit shard ``wid``/``num_workers``, writing each batch
     straight into a free ring slot.  Device-free by construction (only
@@ -68,9 +69,13 @@ def _worker(path, data_shape, batch_size, label_width, wid, num_workers,
 
     shms = {name: _attach_shm(name) for name in slot_names}
     data_elems = batch_size * int(np.prod(data_shape))
+    # host-level sharding (part_index/num_parts, the distributed
+    # contract) COMPOSES with the worker fan-out: this worker owns
+    # global shard host_part*num_workers + wid of num_parts*num_workers
     it = ImageRecordIter(path_imgrec=path, data_shape=data_shape,
                          batch_size=batch_size, label_width=label_width,
-                         part_index=wid, num_parts=num_workers,
+                         part_index=part_index * num_workers + wid,
+                         num_parts=num_parts * num_workers,
                          seed=seed + wid, **iter_kwargs)
     try:
         while not stop.is_set():
@@ -118,6 +123,9 @@ class MultiProcessImageRecordIter(DataIter):
     kwargs match ImageRecordIter (each worker builds one over its own
     InputSplit shard).  ``num_workers`` decode processes publish finished
     batches into ``slots`` ring slots (default 2*workers+2).
+    ``part_index``/``num_parts`` keep the distributed host-sharding
+    contract: this host's shard is subdivided across its workers
+    (global shard part_index*num_workers+wid of num_parts*num_workers).
 
     Epoch semantics: one epoch = every worker completing one pass over
     its shard (each worker wrap-pads its own final batch, like the
@@ -127,8 +135,9 @@ class MultiProcessImageRecordIter(DataIter):
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
-                 num_workers=2, slots=None, seed=0, start_method=None,
-                 stall_timeout=300.0, **iter_kwargs):
+                 num_workers=2, part_index=0, num_parts=1, slots=None,
+                 seed=0, start_method=None, stall_timeout=300.0,
+                 **iter_kwargs):
         super().__init__()
         from multiprocessing import shared_memory
 
@@ -169,6 +178,7 @@ class MultiProcessImageRecordIter(DataIter):
                 target=_worker,
                 args=(path_imgrec, self.data_shape, self.batch_size,
                       self.label_width, wid, self.num_workers,
+                      int(part_index), int(num_parts),
                       [s.name for s in self._shms], self._free_q,
                       self._full_q, self._stop, self._barrier, seed,
                       iter_kwargs),

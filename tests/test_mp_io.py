@@ -142,3 +142,30 @@ def test_mp_iter_worker_decode_error_surfaces(tmp_path):
                 it.next()
     finally:
         it.close()
+
+
+def test_mp_iter_host_sharding_composes(tmp_path):
+    """part_index/num_parts (the distributed host contract) compose with
+    the worker fan-out: two 'hosts' x two workers cover the dataset in
+    four disjoint shards."""
+    rec = _write_labeled_rec(tmp_path, n=32)
+    seen = {}
+    for host in range(2):
+        it = MultiProcessImageRecordIter(
+            path_imgrec=rec, data_shape=(3, 16, 16), batch_size=4,
+            num_workers=2, part_index=host, num_parts=2,
+            stall_timeout=120)
+        try:
+            labels = []
+            pads = 0
+            for b in it:
+                labels.extend(b.label[0].asnumpy().astype(int).tolist())
+                pads += b.pad
+            seen[host] = (labels, pads)
+        finally:
+            it.close()
+    l0, p0 = seen[0]
+    l1, p1 = seen[1]
+    # disjoint between hosts (net of wrap padding), union = everything
+    assert set(l0) | set(l1) == set(range(32))
+    assert (len(l0) - p0) + (len(l1) - p1) == 32
