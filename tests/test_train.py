@@ -106,3 +106,43 @@ def test_fused_trainer_fixed_param_names():
     np.testing.assert_array_equal(np.asarray(tr.params["fc1_weight"]),
                                   frozen_w)
     assert not np.allclose(np.asarray(tr.params["fc2_weight"]), live_w)
+
+
+def test_fused_trainer_bf16_cache_tracks_masters():
+    """Mixed precision carries a DONATED bf16 compute copy updated
+    inside the optimizer step; it must equal the f32 masters' bf16 cast
+    after every step, and eval consumes it (same outputs as a fresh
+    trainer loaded from the same masters)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import sym
+    from mxnet_tpu.trainer import FusedTrainer
+
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=4, name="fc"), name="softmax")
+    tr = FusedTrainer(net, optimizer="adam", optimizer_params={"lr": 0.05},
+                      dtype=jnp.bfloat16)
+    tr.init(data=(8, 6))
+    rs = np.random.RandomState(3)
+    for i in range(5):
+        tr.step(data=rs.rand(8, 6).astype(np.float32),
+                softmax_label=rs.randint(0, 4, 8).astype(np.float32))
+    for k, master in tr.params.items():
+        assert master.dtype == jnp.float32
+        cached = tr._cparams[k]
+        assert cached.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(cached, np.float32),
+            np.asarray(master.astype(jnp.bfloat16), np.float32),
+            err_msg=k)
+    # eval reads the carried cache: its outputs must match a fresh
+    # trainer whose cache was rebuilt from these same masters
+    x = rs.rand(8, 6).astype(np.float32)
+    out_live = np.asarray(tr.eval(data=x)[0])
+    tr2 = FusedTrainer(net, optimizer="adam", optimizer_params={"lr": 0.05},
+                       dtype=jnp.bfloat16)
+    tr2.init(data=(8, 6))
+    tr2.params = dict(tr.params)
+    tr2.aux = dict(tr.aux)
+    tr2._refresh_compute_cache()
+    np.testing.assert_array_equal(out_live, np.asarray(tr2.eval(data=x)[0]))
