@@ -39,6 +39,11 @@ while true; do
       && grep -qE "passed" "$OUT/tputests" \
       && ! grep -qE "failed|error" "$OUT/tputests" \
       && touch "$OUT/tputests.ok"; }
+  # 1b. end-to-end training convergence on the chip (fast, <3 min)
+  [ -f "$OUT/trainchk.ok" ] || { [ -f tools/tpu_train_check.py ] \
+      && timeout 900 python tools/tpu_train_check.py > "$OUT/trainchk" 2>&1 \
+      && grep -q "TRAIN-ON-DEVICE OK" "$OUT/trainchk" \
+      && touch "$OUT/trainchk.ok"; }
   # 2. the headline bench, full extras — the round's own clean capture
   [ -f "$OUT/bench.ok" ] || { timeout 1500 env BENCH_INIT_TIMEOUT_S=560 \
       python bench.py > "$OUT/bench" 2>&1 \
@@ -88,6 +93,7 @@ while true; do
      && { [ ! -f tools/probe_lm_mfu.py ] || [ -f "$OUT/lmmfu.ok" ]; } \
      && { [ ! -f tools/probe_gap.py ] || [ -f "$OUT/gap.ok" ]; } \
      && { [ ! -f tools/bench_models.py ] || [ -f "$OUT/modelbench.ok" ]; } \
+     && { [ ! -f tools/tpu_train_check.py ] || [ -f "$OUT/trainchk.ok" ]; } \
      && [ -f "$OUT/score.ok" ]; then
     echo "[window] attempt $attempt: ALL DONE" >> "$OUT/driver.log"
     exit 0
