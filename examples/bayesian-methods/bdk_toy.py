@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Bayesian Dark Knowledge on the classic cubic-regression toy
+(parity: example/bayesian-methods/bdk_demo.py + algos.py — there, an
+SGLD teacher's posterior predictive is distilled into one student net
+that carries the uncertainty; same system here, asserted not eyeballed).
+
+Three framework features get exercised end to end:
+  - the SGLD optimizer as a POSTERIOR SAMPLER (weight decay = gaussian
+    prior, rescale_grad = full-data likelihood scaling, per-step
+    gaussian noise), driven through the Module update loop,
+  - posterior-predictive assembly from weight samples (mean + variance
+    over an input grid),
+  - a custom distillation loss via MakeLoss: the student outputs
+    (mean, log-variance) and minimizes the gaussian NLL of the
+    TEACHER'S predictive distribution — (mu_t - mu_s)^2 + var_t inside
+    the quadratic term, the BDK objective.
+
+Asserts: the teacher's predictive mean tracks y=x^3 inside the data;
+its predictive std GROWS outside the data (the Bayesian claim); the
+student reproduces both.
+
+Run:  MXTPU_PLATFORM=cpu python bdk_toy.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+NOISE_STD = 0.1    # observation noise on the NORMALIZED scale
+X_SCALE, Y_SCALE = 4.0, 30.0
+
+
+def true_fn(x):
+    return (X_SCALE * x) ** 3 / Y_SCALE
+
+
+def make_data(rs, n):
+    x = rs.uniform(-1.0, 1.0, n).astype(np.float32)          # x/4 in [-1,1]
+    y = true_fn(x) + rs.normal(0, NOISE_STD, n).astype(np.float32)
+    return x[:, None], y.astype(np.float32)
+
+
+def teacher_symbol(hidden):
+    data = sym.Variable("data")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=hidden,
+                                          name="t_fc1"), act_type="relu")
+    pred = sym.FullyConnected(h, num_hidden=1, name="t_fc2")
+    return sym.LinearRegressionOutput(sym.Flatten(pred),
+                                      sym.Variable("y_label"), name="reg")
+
+
+def student_symbol(hidden):
+    """Heteroscedastic student: outputs (mu, log var); MakeLoss carries
+    the BDK objective  0.5*logvar + ((mu_t - mu)^2 + var_t)/(2*var)."""
+    data = sym.Variable("data")
+    mu_t = sym.Variable("mu_t")          # teacher predictive mean
+    var_t = sym.Variable("var_t")        # teacher predictive variance
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=hidden,
+                                          name="s_fc1"), act_type="relu")
+    out = sym.FullyConnected(h, num_hidden=2, name="s_fc2")
+    mu = sym.slice_axis(out, axis=1, begin=0, end=1)
+    logv = sym.slice_axis(out, axis=1, begin=1, end=2)
+    logv = sym.clip(logv, a_min=-8.0, a_max=4.0)
+    nll = 0.5 * logv + (sym.square(mu - mx.sym.Reshape(mu_t, shape=(-1, 1)))
+                        + mx.sym.Reshape(var_t, shape=(-1, 1))) \
+        * sym.exp(-logv) * 0.5
+    loss = sym.MakeLoss(sym.mean(nll), name="bdk_loss")
+    # expose mu/logv for prediction alongside the loss head
+    return sym.Group([loss, sym.BlockGrad(mu), sym.BlockGrad(logv)])
+
+
+def fit_teacher_sgld(args, x, y, grid):
+    """SGLD over the teacher posterior; returns predictive mean/var on
+    the grid assembled from post-burn-in weight samples."""
+    n = len(x)
+    mod = mx.mod.Module(teacher_symbol(args.hidden), data_names=("data",),
+                        label_names=("y_label",))
+    it = mx.io.NDArrayIter({"data": x}, {"y_label": y},
+                           batch_size=args.batch, shuffle=True)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    # SGLD hyperparameters ARE the Bayesian model: rescale_grad scales
+    # the minibatch gradient to the full-data log-likelihood (N/batch
+    # over the noise variance), wd is the gaussian prior precision
+    mod.init_optimizer(optimizer="sgld", optimizer_params={
+        "learning_rate": args.sgld_lr,
+        "rescale_grad": n / args.batch / (NOISE_STD ** 2),
+        "wd": 1.0})
+    pred_mod = mx.mod.Module(teacher_symbol(args.hidden),
+                             data_names=("data",), label_names=("y_label",))
+    pred_mod.bind(data_shapes=[("data", (len(grid), 1))],
+                  label_shapes=[("y_label", (len(grid),))],
+                  for_training=False, shared_module=mod)
+    moments = np.zeros((2, len(grid)), np.float64)
+    count, step = 0, 0
+    while count < args.samples:
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            step += 1
+            if step > args.burn_in and step % args.thin == 0:
+                pred_mod.forward(mx.io.DataBatch(
+                    [mx.nd.array(grid[:, None])],
+                    [mx.nd.zeros((len(grid),))]), is_train=False)
+                p = pred_mod.get_outputs()[0].asnumpy().ravel()
+                moments[0] += p
+                moments[1] += p * p
+                count += 1
+                if count >= args.samples:
+                    break
+    mean = moments[0] / count
+    var = np.maximum(moments[1] / count - mean ** 2, 1e-8) + NOISE_STD ** 2
+    return mean.astype(np.float32), var.astype(np.float32)
+
+
+def fit_student(args, mu_t, var_t, grid):
+    smod = mx.mod.Module(student_symbol(args.hidden),
+                         data_names=("data",), label_names=("mu_t", "var_t"))
+    it = mx.io.NDArrayIter({"data": grid[:, None]},
+                           {"mu_t": mu_t, "var_t": var_t},
+                           batch_size=args.batch, shuffle=True)
+    smod.fit(it, num_epoch=args.student_epochs, optimizer="adam",
+             optimizer_params={"learning_rate": 3e-3},
+             initializer=mx.init.Xavier(),
+             eval_metric=mx.metric.Torch())
+    smod_p = mx.mod.Module(student_symbol(args.hidden),
+                           data_names=("data",),
+                           label_names=("mu_t", "var_t"))
+    smod_p.bind(data_shapes=[("data", (len(grid), 1))],
+                label_shapes=[("mu_t", (len(grid),)),
+                              ("var_t", (len(grid),))],
+                for_training=False, shared_module=smod)
+    smod_p.forward(mx.io.DataBatch(
+        [mx.nd.array(grid[:, None])],
+        [mx.nd.array(mu_t), mx.nd.array(var_t)]), is_train=False)
+    outs = smod_p.get_outputs()
+    mu_s = outs[1].asnumpy().ravel()
+    var_s = np.exp(outs[2].asnumpy().ravel())
+    return mu_s, var_s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--n", type=int, default=160)
+    ap.add_argument("--sgld-lr", type=float, default=4e-6)
+    ap.add_argument("--burn-in", type=int, default=600)
+    ap.add_argument("--thin", type=int, default=10)
+    ap.add_argument("--samples", type=int, default=150)
+    ap.add_argument("--student-epochs", type=int, default=300)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    logging.getLogger().setLevel(logging.WARNING)  # quiet the fit loop
+    rs = np.random.RandomState(0)
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    x, y = make_data(rs, args.n)
+    # grid spans BEYOND the data: the out-of-distribution region is
+    # where the posterior must show its uncertainty
+    grid = np.linspace(-1.5, 1.5, 121).astype(np.float32)
+
+    mu_t, var_t = fit_teacher_sgld(args, x, y, grid)
+    inside = np.abs(grid) <= 0.75
+    outside = np.abs(grid) >= 1.25
+    rmse_in = float(np.sqrt(np.mean(
+        (mu_t[inside] - true_fn(grid[inside])) ** 2)))
+    std_in = float(np.sqrt(var_t[inside]).mean())
+    std_out = float(np.sqrt(var_t[outside]).mean())
+    print(f"teacher: rmse(in)={rmse_in:.3f} "
+          f"std(in)={std_in:.3f} std(out)={std_out:.3f} "
+          f"ratio={std_out / std_in:.2f}")
+    assert rmse_in < 0.25, rmse_in
+    assert std_out > 1.5 * std_in, (std_in, std_out)
+
+    mu_s, var_s = fit_student(args, mu_t, var_t, grid)
+    s_rmse = float(np.sqrt(np.mean((mu_s[inside] - mu_t[inside]) ** 2)))
+    s_std_in = float(np.sqrt(var_s[inside]).mean())
+    s_std_out = float(np.sqrt(var_s[outside]).mean())
+    print(f"student: rmse-vs-teacher(in)={s_rmse:.3f} "
+          f"std(in)={s_std_in:.3f} std(out)={s_std_out:.3f} "
+          f"ratio={s_std_out / s_std_in:.2f}")
+    assert s_rmse < 0.25, s_rmse
+    assert s_std_out > 1.3 * s_std_in, (s_std_in, s_std_out)
+    print("BDK OK: posterior distilled, uncertainty preserved")
+
+
+if __name__ == "__main__":
+    main()

@@ -101,6 +101,32 @@ class DataParallelExecutorGroup:
         shared_exec = shared_group.execs[0] if shared_group is not None else None
         exec_ = simple_bind(symbol, contexts[0], grad_req=req,
                             shared_exec=shared_exec, **input_shapes)
+        same_mesh = (shared_group is not None
+                     and list(shared_group.mesh.devices.flat)
+                     == list(self.mesh.devices.flat))
+        if shared_exec is not None and same_mesh:
+            # LIVE param/aux sharing (reference parity: shared_module
+            # executors share parameter storage, module.py:346-349 +
+            # the shared memory pool — an update through EITHER module
+            # is immediately visible to the other; bucketing and
+            # train-then-serve sharing both rely on it).  Sharing the
+            # NDArray object shares its chunk, so in-place optimizer
+            # writes propagate.  Only when both groups run the SAME
+            # device mesh: a sharee on a trimmed mesh (smaller batch)
+            # would re-shard the donor's live chunks out from under its
+            # compiled step — there, snapshot semantics remain.
+            for name in self.param_names:
+                donor = shared_exec.arg_dict.get(name)
+                mine = exec_.arg_dict.get(name)
+                if donor is not None and mine is not None \
+                        and donor.shape == mine.shape:
+                    exec_.arg_dict[name] = donor
+            exec_.arg_arrays = [exec_.arg_dict[n] for n in arg_names]
+            for name, donor in shared_exec.aux_dict.items():
+                mine = exec_.aux_dict.get(name)
+                if mine is not None and donor.shape == mine.shape:
+                    exec_.aux_dict[name] = donor
+            exec_.aux_arrays = [exec_.aux_dict[n] for n in self.aux_names]
         # replicate params over the mesh so GSPMD sees them as shared
         if len(unique) > 1:
             for name, arr in exec_.arg_dict.items():

@@ -29,6 +29,9 @@ CASES = [
      "VOC07_mAP"),
     ("image-classification", "score.py", [], "SCORE OK"),
     ("gan", "cgan.py", ["--num-batches", "400"], "CGAN OK"),
+    ("bayesian-methods", "bdk_toy.py",
+     ["--burn-in", "400", "--samples", "100", "--thin", "8",
+      "--student-epochs", "200"], "BDK OK"),
     ("recommenders", "implicit.py", ["--epochs", "8"], "IMPLICIT OK"),
 ]
 

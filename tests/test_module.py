@@ -295,3 +295,41 @@ def test_uneven_batch_warns_and_uses_divisor_devices(caplog):
             inputs_need_grad=False)
     assert "not divisible" in caplog.text
     assert len(grp.mesh.devices.ravel()) == 3  # largest divisor of 6 <= 4
+
+
+def test_shared_module_params_track_donor_updates():
+    """Reference parity (module.py:346-349 + the shared memory pool):
+    a module bound with shared_module SHARES parameter storage — donor
+    updates are visible through the sharee WITHOUT any re-sync call
+    (bucketing and train-then-serve sharing rely on this)."""
+    net = sym.LinearRegressionOutput(
+        sym.Flatten(sym.FullyConnected(sym.Variable("data"), num_hidden=1,
+                                       name="f")),
+        sym.Variable("y_label"), name="reg")
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("y_label",))
+    mod.bind(data_shapes=[("data", (4, 1))],
+             label_shapes=[("y_label", (4,))])
+    mod.init_params(mx.init.Uniform(0.5))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    pred = mx.mod.Module(net, data_names=("data",),
+                         label_names=("y_label",))
+    pred.bind(data_shapes=[("data", (2, 1))],
+              label_shapes=[("y_label", (2,))],
+              for_training=False, shared_module=mod)
+
+    x = np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+    y = 2 * x[:, 0]
+    outs = []
+    for _ in range(3):
+        mod.forward_backward(mx.io.DataBatch([nd.array(x)], [nd.array(y)]))
+        mod.update()
+        pred.forward(mx.io.DataBatch([nd.array(x[:2])], [nd.zeros(2)]),
+                     is_train=False)
+        w = mod.get_params()[0]["f_weight"].asnumpy().item()
+        b = mod.get_params()[0]["f_bias"].asnumpy().item()
+        got = pred.get_outputs()[0].asnumpy().ravel()
+        np.testing.assert_allclose(got, w * x[:2, 0] + b, rtol=1e-5,
+                                   atol=1e-5)
+        outs.append(got.copy())
+    assert not np.allclose(outs[0], outs[-1])  # it really moved
