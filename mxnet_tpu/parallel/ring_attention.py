@@ -18,6 +18,7 @@ ring direction automatically).
 """
 from __future__ import annotations
 
+import os
 
 import jax
 import jax.numpy as jnp
@@ -158,11 +159,38 @@ def attention(q, k, v, causal=False, scale=None, impl="auto"):
     (XLA still fuses well, but the (T, T) scores hit HBM)."""
     from ..ops import flash_attention as fa
 
+    # kernel tile sizes are a measured quantity, not a constant:
+    # MXTPU_FLASH_BLOCK_Q/K let the on-silicon sweeps
+    # (tools/probe_lm_mfu.py) tune them without code edits.  Clamped to
+    # T (matching flash_attention's own clamp) BEFORE the supports()
+    # check so an oversized tile cannot silently demote a
+    # flash-compatible shape to the O(T^2) lax path.
+    bq = min(_env_block("MXTPU_FLASH_BLOCK_Q"), q.shape[2])
+    bk = min(_env_block("MXTPU_FLASH_BLOCK_K"), q.shape[2])
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
-        impl = "flash" if on_tpu and fa.supports(q.shape) else "lax"
+        impl = "flash" if on_tpu and fa.supports(q.shape, bq, bk) else "lax"
     if impl == "flash":
-        return fa.flash_attention(q, k, v, causal, scale)
+        return fa.flash_attention(q, k, v, causal, scale, bq, bk)
     if impl == "flash_interpret":  # CPU test path for the kernels
-        return fa.flash_attention(q, k, v, causal, scale, 128, 128, True)
+        return fa.flash_attention(q, k, v, causal, scale, bq, bk, True)
     return full_attention(q, k, v, causal=causal, scale=scale)
+
+
+def _env_block(name, default=128):
+    """Tile-size env knob: malformed or non-positive values fall back to
+    the default with a warning instead of crashing unrelated paths."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        val = 0
+    if val <= 0:
+        import warnings
+
+        warnings.warn(f"{name}={raw!r} is not a positive integer; "
+                      f"using {default}", stacklevel=3)
+        return default
+    return val

@@ -108,15 +108,34 @@ def main():
         ("lm-small-b8", dict(L=4, H=8, D=512, d_ff=2048, T=512,
                              V=8192, B=8)),  # bench.py extras continuity
     ]
-    best = (None, 0.0)
+    best = (None, 0.0, None)
     for name, cfg in configs:
         try:
             mfu = run_config(name, iters=args.iters, **cfg)
             if mfu > best[1]:
-                best = (name, mfu)
+                best = (name, mfu, cfg)
         except Exception as exc:  # noqa: BLE001 — keep sweeping
             print(f"{name}: FAILED {exc!r}", flush=True)
     print(f"best: {best[0]} mfu={best[1]:.3f}", flush=True)
+
+    # flash-attention tile sweep on the winner (MXTPU_FLASH_BLOCK_Q/K
+    # are read at trace time, so each setting builds a fresh trainer)
+    if best[2] is not None:
+        tile_best = ("128x128", best[1])
+        for bq, bk in ((256, 256), (128, 512), (512, 128)):
+            os.environ["MXTPU_FLASH_BLOCK_Q"] = str(bq)
+            os.environ["MXTPU_FLASH_BLOCK_K"] = str(bk)
+            try:
+                mfu = run_config(f"{best[0]}-blk{bq}x{bk}",
+                                 iters=args.iters, **best[2])
+                if mfu > tile_best[1]:
+                    tile_best = (f"{bq}x{bk}", mfu)
+            except Exception as exc:  # noqa: BLE001
+                print(f"blk{bq}x{bk}: FAILED {exc!r}", flush=True)
+        os.environ.pop("MXTPU_FLASH_BLOCK_Q", None)
+        os.environ.pop("MXTPU_FLASH_BLOCK_K", None)
+        print(f"best-tiles: {best[0]} blk{tile_best[0]} "
+              f"mfu={tile_best[1]:.3f}", flush=True)
 
 
 if __name__ == "__main__":
