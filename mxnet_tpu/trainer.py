@@ -80,7 +80,35 @@ def _adam_rule(opt_params):
     return init_state, update
 
 
-_RULES = {"sgd": _sgd_rule, "adam": _adam_rule}
+def _rmsprop_rule(opt_params):
+    from .base import parse_bool
+
+    if parse_bool(opt_params.get("centered", False)) \
+            or "gamma2" in opt_params:
+        # the centered (Alex Graves) variant carries 3 state slots and
+        # different math — silently training the plain variant under a
+        # centered config would diverge from the Module path
+        raise ValueError("FusedTrainer's rmsprop rule is the plain "
+                         "(Tieleman-Hinton) variant; use Module for "
+                         "centered RMSProp")
+    base_wd = float(opt_params.get("wd", 0.0))
+    attrs = {k: opt_params[k] for k in ("rescale_grad", "clip_gradient",
+                                        "gamma1", "epsilon",
+                                        "clip_weights") if k in opt_params}
+
+    def init_state(w):
+        return (jnp.zeros_like(w),)
+
+    def update(w, g, state, lr, wd_mult=1.0):
+        octx = ops.OpCtx()
+        new_w, n = ops.get("rmsprop_update").fn(
+            octx, w, g, state[0], lr=lr, wd=base_wd * wd_mult, **attrs)
+        return new_w, (n,)
+
+    return init_state, update
+
+
+_RULES = {"sgd": _sgd_rule, "adam": _adam_rule, "rmsprop": _rmsprop_rule}
 
 
 class FusedTrainer:

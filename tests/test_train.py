@@ -146,3 +146,44 @@ def test_fused_trainer_bf16_cache_tracks_masters():
     tr2.aux = dict(tr.aux)
     tr2._refresh_compute_cache()
     np.testing.assert_array_equal(out_live, np.asarray(tr2.eval(data=x)[0]))
+
+
+def test_fused_trainer_rmsprop_matches_module():
+    """FusedTrainer's rmsprop rule == the Module/optimizer path after
+    identical steps (the same oracle discipline the sgd/adam rules
+    carry)."""
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.trainer import FusedTrainer
+
+    rs = np.random.RandomState(5)
+    x = rs.rand(32, 6).astype(np.float32)
+    y = rs.randint(0, 3, 32).astype(np.float32)
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=3, name="fc"), name="softmax")
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    tr = FusedTrainer(net, optimizer="rmsprop",
+                      optimizer_params={"lr": 0.01, "gamma1": 0.9})
+    tr.init(data=(32, 6))
+    start = {k: np.asarray(v).copy() for k, v in tr.params.items()}
+    for _ in range(4):
+        tr.step(data=x, softmax_label=y)
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (32, 6))],
+             label_shapes=[("softmax_label", (32,))])
+    mod.init_params(arg_params={k: nd.array(v) for k, v in start.items()},
+                    aux_params={})
+    mod.init_optimizer(optimizer="rmsprop",
+                       optimizer_params={"learning_rate": 0.01,
+                                         "gamma1": 0.9})
+    for _ in range(4):
+        mod.forward_backward(mx.io.DataBatch([nd.array(x)], [nd.array(y)]))
+        mod.update()
+    want, _ = mod.get_params()
+    for k, v in tr.params.items():
+        np.testing.assert_allclose(np.asarray(v), want[k].asnumpy(),
+                                   rtol=2e-5, atol=2e-5, err_msg=k)
