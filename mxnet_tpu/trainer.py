@@ -31,7 +31,7 @@ from . import ops
 from . import random as _random
 from .executor import _build_graph_fn
 from .initializer import Uniform
-from .base import MXNetError
+from .base import MXNetError, parse_bool
 from .ndarray import NDArray
 
 
@@ -81,13 +81,12 @@ def _adam_rule(opt_params):
 
 
 def _rmsprop_rule(opt_params):
-    from .base import parse_bool
-
-    if parse_bool(opt_params.get("centered", False)) \
-            or "gamma2" in opt_params:
+    if parse_bool(opt_params.get("centered", False)):
         # the centered (Alex Graves) variant carries 3 state slots and
         # different math — silently training the plain variant under a
-        # centered config would diverge from the Module path
+        # centered config would diverge from the Module path (a bare
+        # gamma2 key with centered=False is fine: the Module path also
+        # ignores it for the plain variant)
         raise ValueError("FusedTrainer's rmsprop rule is the plain "
                          "(Tieleman-Hinton) variant; use Module for "
                          "centered RMSProp")

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Keepalive for tools/tpu_window.sh: relaunches the watcher if it dies
+# (observed once this round during a restart shuffle), stops for good
+# once the watcher reports ALL DONE.
+#
+# Usage: nohup setsid bash tools/tpu_keepalive.sh >/tmp/tpu_window/keepalive.log 2>&1 &
+OUT=/tmp/tpu_window
+mkdir -p "$OUT"
+cd /root/repo || exit 1
+while true; do
+  if [ -f "$OUT/alldone" ]; then
+    echo "[keepalive] alldone marker present; exiting $(date -u +%H:%M:%S)"
+    exit 0
+  fi
+  if ! pgrep -f "tools/tpu_window.sh" > /dev/null; then
+    echo "[keepalive] watcher not running; relaunching $(date -u +%H:%M:%S)"
+    setsid bash /root/repo/tools/tpu_window.sh \
+      >> "$OUT/driver.log" 2>&1 < /dev/null &
+  fi
+  sleep 300
+done

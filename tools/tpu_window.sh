@@ -96,6 +96,7 @@ while true; do
      && { [ ! -f tools/tpu_train_check.py ] || [ -f "$OUT/trainchk.ok" ]; } \
      && [ -f "$OUT/score.ok" ]; then
     echo "[window] attempt $attempt: ALL DONE" >> "$OUT/driver.log"
+    touch "$OUT/alldone"  # tells tpu_keepalive.sh to stand down
     exit 0
   fi
   echo "[window] attempt $attempt: partial, retrying" >> "$OUT/driver.log"
