@@ -18,6 +18,33 @@ cd /root/repo || exit 1
 export PYTHONPATH=/root/.axon_site:/root/repo
 export JAX_PLATFORMS=axon
 
+stage_one() {  # $1 = payload name, $2 = destination filename
+  cp -f "$OUT/$1" "/root/repo/docs/measured/$2" \
+    && git -C /root/repo add "docs/measured/$2" \
+    || echo "[window] stage $1 -> $2 FAILED" >> "$OUT/driver.log"
+}
+
+stage_all() {
+  # successful payload outputs land in the repo's artifact tree AND the
+  # git index: if the round ends moments later, even a commit -a style
+  # end-of-round snapshot captures them.  Idempotent — re-run each loop
+  # so a transient cp/git failure heals on the next pass.
+  for n in tputests trainchk peak profile variants predict lmmfu gap \
+           score; do
+    [ -f "$OUT/$n.ok" ] && stage_one "$n" "${n}_r05.txt"
+  done
+  # names match their consumers: bench.py's artifact glob wants
+  # bench_r*_tpu*.json (the one JSON line, not raw stdout), and
+  # bench_models.py documents bench_models_r*.txt
+  [ -f "$OUT/modelbench.ok" ] && stage_one modelbench bench_models_r05.txt
+  if [ -f "$OUT/bench.ok" ]; then
+    grep '"resnet50_train' "$OUT/bench" | tail -1 \
+      > /root/repo/docs/measured/bench_r05_tpu_v5e.json \
+      && git -C /root/repo add docs/measured/bench_r05_tpu_v5e.json \
+      || echo "[window] stage bench FAILED" >> "$OUT/driver.log"
+  fi
+}
+
 attempt=0
 while true; do
   attempt=$((attempt + 1))
@@ -95,10 +122,12 @@ while true; do
      && { [ ! -f tools/bench_models.py ] || [ -f "$OUT/modelbench.ok" ]; } \
      && { [ ! -f tools/tpu_train_check.py ] || [ -f "$OUT/trainchk.ok" ]; } \
      && [ -f "$OUT/score.ok" ]; then
+    stage_all
     echo "[window] attempt $attempt: ALL DONE" >> "$OUT/driver.log"
     touch "$OUT/alldone"  # tells tpu_keepalive.sh to stand down
     exit 0
   fi
+  stage_all
   echo "[window] attempt $attempt: partial, retrying" >> "$OUT/driver.log"
   sleep 120
 done
