@@ -189,3 +189,20 @@ def test_predictor_bf16_wire_upload(tmp_path):
     assert np.allclose(a, b, atol=2e-2)
     t = p16.forward_async(data=x[:8])
     assert np.allclose(p16.get_async(t), b, atol=2e-2)
+
+
+def test_predictor_discard_and_inflight_bound(tmp_path):
+    """discard_async frees a ticket without fetching; the in-flight map
+    stays bounded when a client never fetches."""
+    prefix, x = _trained_checkpoint(tmp_path)
+    p = pred_create(prefix, 1, {"data": (4, 8)})
+    t = p.forward_async(data=x[:4])
+    p.discard_async(t)
+    with pytest.raises(mx.MXNetError):
+        p.get_async(t)
+    p.discard_async(12345)  # unknown ticket: no-op
+    tickets = [p.forward_async(data=x[:4]) for _ in range(70)]
+    assert len(p._inflight) == 64  # exact cap, oldest evicted first
+    with pytest.raises(mx.MXNetError):
+        p.get_async(tickets[0])    # evicted
+    assert p.get_async(tickets[-1]) is not None  # newest survives
