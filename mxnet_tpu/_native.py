@@ -1,10 +1,28 @@
-"""ctypes bindings for libmxtpu, the native C++ runtime.
+"""FFI bindings for libmxtpu, the native C++ runtime.
 
 Parity rationale (SURVEY.md §2.1): the reference's engine, storage
 manager and RecordIO layer are C++; this module loads our TPU-native C++
 equivalents (src/*.cc) and exposes them to Python.  Everything degrades
 gracefully: if the library is missing it is built on demand with g++, and
 if that fails the callers fall back to their pure-Python paths.
+
+Two interchangeable FFI backends (parity: SURVEY.md §2.3, the
+reference's `_ctypes/` vs `cython/` pair selected by
+MXNET_ENABLE_CYTHON, `python/mxnet/base.py`):
+
+- ``ctypes`` — the CDLL bindings below; always available wherever the
+  native library itself is.
+- ``cext`` — `_mxtpu_ext.so` (src/py_ext.cc), a CPython-C-API module
+  linked against the SAME libmxtpu (rpath $ORIGIN), so both backends
+  drive one engine scheduler and one storage pool and are
+  interchangeable mid-process.  Record batches come back as a list of
+  bytes built in one crossing, and engine ops carry a plain INCREF'd
+  callable instead of a per-op ctypes CFUNCTYPE trampoline.
+
+The global default is the compiled backend when it loads, like the
+reference; ``MXTPU_FFI=ctypes|cext`` pins it, and every wrapper class
+takes ``backend=`` for per-object override (tests A/B them in-process,
+tests/test_ffi_backends.py).
 """
 from __future__ import annotations
 
@@ -134,6 +152,63 @@ def available() -> bool:
 
 
 # --------------------------------------------------------------------------
+# Compiled FFI backend (_mxtpu_ext.so)
+# --------------------------------------------------------------------------
+_EXT = None
+_EXT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "lib", "_mxtpu_ext.so")
+
+
+def get_ext():
+    """The compiled FFI module, or None when it cannot be loaded."""
+    global _EXT
+    if _EXT is not None:
+        return _EXT if _EXT is not False else None
+    # resolve the core lib BEFORE taking the lock: get_lib() takes the
+    # same non-reentrant _LIB_LOCK (and triggers the on-demand make,
+    # which builds the ext too)
+    if get_lib() is None:
+        _EXT = False
+        return None
+    with _LIB_LOCK:
+        if _EXT is not None:
+            return _EXT if _EXT is not False else None
+        if not os.path.isfile(_EXT_PATH):
+            _build()
+        try:
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader(
+                "_mxtpu_ext", _EXT_PATH)
+            spec = importlib.util.spec_from_loader("_mxtpu_ext", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+            _EXT = mod
+        except (OSError, ImportError):
+            _EXT = False
+            return None
+        return _EXT
+
+
+def ffi_backend(override=None) -> str:
+    """Resolve the FFI backend name: explicit override > MXTPU_FFI env >
+    compiled-if-available (the reference's MXNET_ENABLE_CYTHON default)."""
+    choice = override or os.environ.get("MXTPU_FFI", "").strip().lower()
+    if choice == "cext":
+        if get_ext() is None:
+            raise RuntimeError("MXTPU_FFI=cext but _mxtpu_ext.so is "
+                               "unavailable")
+        return "cext"
+    if choice == "ctypes":
+        return "ctypes"
+    if choice:
+        raise ValueError(f"unknown FFI backend {choice!r} "
+                         "(expected 'ctypes' or 'cext')")
+    return "cext" if get_ext() is not None else "ctypes"
+
+
+# --------------------------------------------------------------------------
 # Engine wrapper
 # --------------------------------------------------------------------------
 class NativeEngine:
@@ -144,9 +219,24 @@ class NativeEngine:
     (mutable_vars) dependencies; the C++ scheduler guarantees writers
     serialize and readers parallelize per var.  Exceptions inside
     callbacks are captured and re-raised at the next wait point.
+
+    Runs on either FFI backend (``backend='ctypes'|'cext'``, default
+    per ffi_backend()); the engine semantics are identical — the cext
+    path just skips the per-op CFUNCTYPE trampoline and the Python-side
+    closure-lifetime registry (the C module owns the op's ref).
     """
 
-    def __init__(self, num_threads=0):
+    def __init__(self, num_threads=0, backend=None):
+        self._be = ffi_backend(backend)
+        if self._be == "cext":
+            ext = get_ext()
+            self._ext = ext
+            self._handle = ext.eng_create(int(num_threads))
+            self._errors = []
+            import atexit
+
+            atexit.register(self._shutdown)
+            return
         lib = get_lib()
         if lib is None:
             raise RuntimeError("libmxtpu unavailable")
@@ -171,15 +261,25 @@ class NativeEngine:
         atexit.register(self._shutdown)
 
     def _shutdown(self):
-        if getattr(self, "_handle", None):
+        if getattr(self, "_handle", None) is None:
+            return
+        if self._be == "cext":
             try:
-                self._lib.mxe_wait_all(self._handle)
-                self._reap()
-                self._lib.mxe_destroy(self._handle)
+                self._ext.eng_wait_all(self._handle)
+                self._ext.eng_destroy(self._handle)
             finally:
                 self._handle = None
+            return
+        try:
+            self._lib.mxe_wait_all(self._handle)
+            self._reap()
+            self._lib.mxe_destroy(self._handle)
+        finally:
+            self._handle = None
 
     def new_var(self) -> int:
+        if self._be == "cext":
+            return int(self._ext.eng_new_var(self._handle))
         return int(self._lib.mxe_new_var(self._handle))
 
     def _on_retire(self, token_ptr):
@@ -188,6 +288,11 @@ class NativeEngine:
             self._retired.append(int(token_ptr or 0))
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        if self._be == "cext":
+            self._ext.eng_push(self._handle, fn, tuple(const_vars),
+                               tuple(mutable_vars), self._errors,
+                               int(priority))
+            return
         self._reap()
         with self._cb_lock:
             self._cb_id += 1
@@ -229,16 +334,24 @@ class NativeEngine:
             self._retired.clear()
 
     def wait_for_var(self, var: int):
-        self._lib.mxe_wait_for_var(self._handle, int(var))
-        self._reap()
+        if self._be == "cext":
+            self._ext.eng_wait_for_var(self._handle, int(var))
+        else:
+            self._lib.mxe_wait_for_var(self._handle, int(var))
+            self._reap()
         self._raise_pending()
 
     def wait_all(self):
-        self._lib.mxe_wait_all(self._handle)
-        self._reap()
+        if self._be == "cext":
+            self._ext.eng_wait_all(self._handle)
+        else:
+            self._lib.mxe_wait_all(self._handle)
+            self._reap()
         self._raise_pending()
 
     def pending(self) -> int:
+        if self._be == "cext":
+            return int(self._ext.eng_pending(self._handle))
         return int(self._lib.mxe_pending(self._handle))
 
     def _raise_pending(self):
@@ -260,7 +373,13 @@ class NativeRecordReader:
     """Sharded sequential RecordIO reader (parity: dmlc::InputSplit +
     RecordIOChunkReader as used by iter_image_recordio.cc:259-368)."""
 
-    def __init__(self, path, part_index=0, num_parts=1):
+    def __init__(self, path, part_index=0, num_parts=1, backend=None):
+        self._be = ffi_backend(backend)
+        if self._be == "cext":
+            self._ext = get_ext()
+            self._handle = self._ext.rec_open(path, int(part_index),
+                                              int(num_parts))
+            return
         lib = get_lib()
         if lib is None:
             raise RuntimeError("libmxtpu unavailable")
@@ -272,6 +391,8 @@ class NativeRecordReader:
 
     def read(self):
         """Next record payload as bytes, or None at end of shard."""
+        if self._be == "cext":
+            return self._ext.rec_next(self._handle)
         length = ctypes.c_uint64()
         ptr = self._lib.mxr_next(self._handle, ctypes.byref(length))
         if not ptr:
@@ -281,7 +402,10 @@ class NativeRecordReader:
     def read_batch(self, max_records=1024, buf_bytes=1 << 24):
         """Up to max_records payloads with ONE FFI crossing (the
         per-record crossing is what makes naive native readers lose to
-        Python's buffered file IO)."""
+        Python's buffered file IO).  The cext backend builds the bytes
+        list inside the crossing — no staging buffer at all."""
+        if self._be == "cext":
+            return self._ext.rec_next_batch(self._handle, int(max_records))
         if not hasattr(self, "_batch_buf") or len(self._batch_buf) < buf_bytes:
             self._batch_buf = (ctypes.c_uint8 * buf_bytes)()
             self._batch_lens = (ctypes.c_uint64 * max(max_records, 1024))()
@@ -306,12 +430,19 @@ class NativeRecordReader:
         return [bytes(raw[int(s):int(e)]) for s, e in zip(starts, ends)]
 
     def reset(self):
+        if self._be == "cext":
+            self._ext.rec_reset(self._handle)
+            return
         self._lib.mxr_reset(self._handle)
 
     def close(self):
-        if self._handle:
+        if self._handle is None:
+            return
+        if self._be == "cext":
+            self._ext.rec_close(self._handle)
+        else:
             self._lib.mxr_close(self._handle)
-            self._handle = None
+        self._handle = None
 
     def __del__(self):
         try:
@@ -327,11 +458,13 @@ class NativeRecordReader:
             yield rec
 
 
-def native_index(path):
+def native_index(path, backend=None):
     """Offsets of every record in a RecordIO file (fast .idx rebuild).
 
     Two-pass: mxr_index counts records past the cap without writing, so
     a cap-0 call sizes the buffer exactly (no 128MB worst-case alloc)."""
+    if ffi_backend(backend) == "cext":
+        return np.asarray(get_ext().rec_index(path), dtype=np.uint64)
     lib = get_lib()
     if lib is None:
         raise RuntimeError("libmxtpu unavailable")
@@ -347,7 +480,12 @@ def native_index(path):
 
 
 class NativeRecordWriter:
-    def __init__(self, path):
+    def __init__(self, path, backend=None):
+        self._be = ffi_backend(backend)
+        if self._be == "cext":
+            self._ext = get_ext()
+            self._handle = self._ext.rec_writer_open(path)
+            return
         lib = get_lib()
         if lib is None:
             raise RuntimeError("libmxtpu unavailable")
@@ -357,14 +495,21 @@ class NativeRecordWriter:
             raise IOError(f"cannot open {path} for writing")
 
     def write(self, buf: bytes):
+        if self._be == "cext":
+            self._ext.rec_write(self._handle, buf)
+            return
         arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
         if self._lib.mxr_write(self._handle, arr, len(buf)) != 0:
             raise IOError("record write failed")
 
     def close(self):
-        if self._handle:
+        if self._handle is None:
+            return
+        if self._be == "cext":
+            self._ext.rec_writer_close(self._handle)
+        else:
             self._lib.mxr_writer_close(self._handle)
-            self._handle = None
+        self._handle = None
 
     def __del__(self):
         try:
@@ -381,7 +526,12 @@ class NativeArena:
     GPUPooledStorageManager recycling).  Returns numpy views over
     arena-owned memory; free() recycles into the size-class pool."""
 
-    def __init__(self):
+    def __init__(self, backend=None):
+        self._be = ffi_backend(backend)
+        if self._be == "cext":
+            self._ext = get_ext()
+            self._ptr_of = {}
+            return
         lib = get_lib()
         if lib is None:
             raise RuntimeError("libmxtpu unavailable")
@@ -391,12 +541,19 @@ class NativeArena:
 
     def alloc(self, shape, dtype=np.float32):
         dtype = np.dtype(dtype)
-        nbytes = int(np.prod(shape)) * dtype.itemsize
+        count = int(np.prod(shape))
+        nbytes = count * dtype.itemsize
+        if self._be == "cext":
+            ptr, view = self._ext.storage_alloc(max(nbytes, 1))
+            arr = np.frombuffer(view, dtype=dtype, count=count)
+            arr = arr.reshape(shape)
+            self._ptr_of[ptr] = ptr
+            return arr
         ptr = self._lib.mxs_alloc(max(nbytes, 1))
         if not ptr:
             raise MemoryError(f"arena alloc of {nbytes} bytes failed")
         buf = (ctypes.c_uint8 * max(nbytes, 1)).from_address(ptr)
-        arr = np.frombuffer(buf, dtype=dtype, count=int(np.prod(shape)))
+        arr = np.frombuffer(buf, dtype=dtype, count=count)
         arr = arr.reshape(shape)
         arr.flags.writeable = True
         # key by the stable buffer address: id(arr) can be reused by CPython
@@ -406,13 +563,22 @@ class NativeArena:
 
     def free(self, arr):
         ptr = self._ptr_of.pop(int(arr.ctypes.data), None)
-        if ptr is not None:
+        if ptr is None:
+            return
+        if self._be == "cext":
+            self._ext.storage_free(ptr)
+        else:
             self._lib.mxs_free(ptr)
 
     def pool_bytes(self) -> int:
+        if self._be == "cext":
+            return int(self._ext.storage_pool_bytes())
         return int(self._lib.mxs_pool_bytes())
 
     def release_all(self):
+        if self._be == "cext":
+            self._ext.storage_release_all()
+            return
         self._lib.mxs_release_all()
 
 

@@ -117,6 +117,13 @@ const uint8_t *mxr_next(void *reader, uint64_t *len) {
   if (pad) std::fseek(r->fp, pad, SEEK_CUR);
   r->pos += 8 + length + pad;
   *len = length;
+  if (length == 0) {
+    // vector::data() of an empty vector may be null, and callers use a
+    // null return to mean end-of-shard; hand back a non-null sentinel
+    // so zero-length records stay distinguishable from EOF
+    static const uint8_t kEmpty = 0;
+    return &kEmpty;
+  }
   return r->buf.data();
 }
 

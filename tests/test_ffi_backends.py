@@ -1,0 +1,218 @@
+"""Dual-FFI backend parity (parity: SURVEY.md §2.3 `_ctypes/` vs
+`cython/` — two interchangeable FFI backends for the hot paths, selected
+by MXNET_ENABLE_CYTHON in the reference's base.py; here the compiled
+backend is `_mxtpu_ext.so` from src/py_ext.cc, selected by MXTPU_FFI,
+and both backends drive the same libmxtpu runtime).
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import _native
+
+pytestmark = pytest.mark.skipif(not _native.available(),
+                                reason="libmxtpu unavailable")
+
+BOTH = ("ctypes", "cext")
+
+
+def _need(backend):
+    if backend == "cext" and _native.get_ext() is None:
+        pytest.skip("_mxtpu_ext.so unavailable")
+
+
+def _write_records(path, payloads, backend):
+    w = _native.NativeRecordWriter(str(path), backend=backend)
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+
+PAYLOADS = [b"", b"x", b"hello world", b"\x00" * 37, os.urandom(4096),
+            b"tail-record"]
+
+
+def test_backend_selection_env(monkeypatch):
+    _need("cext")
+    monkeypatch.setenv("MXTPU_FFI", "ctypes")
+    assert _native.ffi_backend() == "ctypes"
+    monkeypatch.setenv("MXTPU_FFI", "cext")
+    assert _native.ffi_backend() == "cext"
+    monkeypatch.setenv("MXTPU_FFI", "parrot")
+    with pytest.raises(ValueError):
+        _native.ffi_backend()
+    monkeypatch.delenv("MXTPU_FFI")
+    assert _native.ffi_backend() in BOTH
+    # per-object override beats the env
+    monkeypatch.setenv("MXTPU_FFI", "cext")
+    assert _native.ffi_backend("ctypes") == "ctypes"
+
+
+@pytest.mark.parametrize("backend", BOTH)
+def test_record_roundtrip(tmp_path, backend):
+    _need(backend)
+    path = tmp_path / f"rt_{backend}.rec"
+    _write_records(path, PAYLOADS, backend)
+    r = _native.NativeRecordReader(str(path), backend=backend)
+    assert list(r) == PAYLOADS
+    r.reset()
+    assert r.read() == PAYLOADS[0]
+    r.close()
+    r.close()  # idempotent
+
+
+def test_backends_interchange_on_one_file(tmp_path):
+    """A file written through one backend reads identically through the
+    other, record-for-record — they are the same runtime."""
+    _need("cext")
+    p1 = tmp_path / "via_ctypes.rec"
+    p2 = tmp_path / "via_cext.rec"
+    _write_records(p1, PAYLOADS, "ctypes")
+    _write_records(p2, PAYLOADS, "cext")
+    assert p1.read_bytes() == p2.read_bytes()
+    a = _native.NativeRecordReader(str(p1), backend="cext")
+    b = _native.NativeRecordReader(str(p2), backend="ctypes")
+    assert list(a) == list(b) == PAYLOADS
+
+
+@pytest.mark.parametrize("backend", BOTH)
+def test_read_batch(tmp_path, backend):
+    _need(backend)
+    path = tmp_path / f"batch_{backend}.rec"
+    payloads = [os.urandom(np.random.randint(1, 2000)) for _ in range(257)]
+    _write_records(path, payloads, backend)
+    r = _native.NativeRecordReader(str(path), backend=backend)
+    got = []
+    while True:
+        chunk = r.read_batch(max_records=100)
+        if not chunk:
+            break
+        assert len(chunk) <= 100
+        got.extend(chunk)
+    assert got == payloads
+    r.close()
+
+
+@pytest.mark.parametrize("backend", BOTH)
+def test_index_parity(tmp_path, backend):
+    _need(backend)
+    path = tmp_path / f"idx_{backend}.rec"
+    _write_records(path, PAYLOADS, backend)
+    offs = _native.native_index(str(path), backend=backend)
+    assert len(offs) == len(PAYLOADS)
+    assert offs[0] == 0
+    assert np.all(np.diff(np.asarray(offs, dtype=np.int64)) > 0)
+
+
+def test_index_backends_agree(tmp_path):
+    _need("cext")
+    path = tmp_path / "agree.rec"
+    _write_records(path, PAYLOADS, "ctypes")
+    a = np.asarray(_native.native_index(str(path), backend="ctypes"))
+    b = np.asarray(_native.native_index(str(path), backend="cext"))
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("backend", BOTH)
+def test_engine_ordering_and_exceptions(backend):
+    _need(backend)
+    eng = _native.NativeEngine(num_threads=4, backend=backend)
+    try:
+        v = eng.new_var()
+        order = []
+        lock = threading.Lock()
+
+        def op(i):
+            with lock:
+                order.append(i)
+
+        # writers on one var serialize in push order
+        for i in range(50):
+            eng.push(lambda i=i: op(i), mutable_vars=[v])
+        eng.wait_for_var(v)
+        assert order == list(range(50))
+
+        # exceptions surface at the next wait point
+        def boom():
+            raise RuntimeError("op failed on purpose")
+
+        eng.push(boom, mutable_vars=[v])
+        with pytest.raises(RuntimeError, match="on purpose"):
+            eng.wait_all()
+        assert eng.pending() == 0
+
+        # bad dependency lists are rejected at push
+        with pytest.raises(ValueError):
+            eng.push(lambda: None, const_vars=[v], mutable_vars=[v])
+        with pytest.raises(ValueError):
+            eng.push(lambda: None, mutable_vars=[10 ** 9])
+    finally:
+        eng._shutdown()
+
+
+@pytest.mark.parametrize("backend", BOTH)
+def test_engine_reader_writer_parallelism(backend):
+    _need(backend)
+    eng = _native.NativeEngine(num_threads=4, backend=backend)
+    try:
+        v = eng.new_var()
+        seen = []
+        lock = threading.Lock()
+        eng.push(lambda: seen.append("w1"), mutable_vars=[v])
+        for _ in range(8):
+            eng.push(lambda: seen.append("r"), const_vars=[v])
+        eng.push(lambda: seen.append("w2"), mutable_vars=[v], priority=1)
+        eng.wait_for_var(v)
+        assert seen[0] == "w1" and seen[-1] == "w2"
+        assert seen.count("r") == 8
+        del lock
+    finally:
+        eng._shutdown()
+
+
+@pytest.mark.parametrize("backend", BOTH)
+def test_arena_roundtrip(backend):
+    _need(backend)
+    arena = _native.NativeArena(backend=backend)
+    arr = arena.alloc((16, 16), np.float32)
+    assert arr.shape == (16, 16) and arr.dtype == np.float32
+    arr[:] = 7.5
+    assert float(arr.sum()) == 7.5 * 256
+    arena.free(arr)
+    # the freed block recycles through the shared size-class pool
+    assert arena.pool_bytes() >= arr.nbytes
+    arena.release_all()
+    assert arena.pool_bytes() == 0
+
+
+def test_arena_pool_is_shared_across_backends():
+    """free() through one backend must be visible to pool_bytes()
+    through the other: one storage manager, two FFIs."""
+    _need("cext")
+    a = _native.NativeArena(backend="ctypes")
+    b = _native.NativeArena(backend="cext")
+    b.release_all()
+    arr = a.alloc((1024,), np.float32)
+    a.free(arr)
+    assert b.pool_bytes() >= 4096
+    b.release_all()
+    assert a.pool_bytes() == 0
+
+
+def test_cext_push_overhead_smoke():
+    """Not a timing assertion (CI noise) — just proves the compiled
+    push path sustains a burst of small ops without the ctypes
+    trampoline registry."""
+    _need("cext")
+    eng = _native.NativeEngine(num_threads=2, backend="cext")
+    try:
+        v = eng.new_var()
+        counter = []
+        for _ in range(2000):
+            eng.push(lambda: counter.append(1), mutable_vars=[v])
+        eng.wait_all()
+        assert len(counter) == 2000
+    finally:
+        eng._shutdown()
