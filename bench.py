@@ -197,13 +197,38 @@ def _bench(dev, kind):
     fetch_barrier()
 
     iters = int(os.environ.get("BENCH_ITERS", "60"))
-    tic = time.perf_counter()
-    for i in range(iters):
-        tr.step(**staged[i % len(staged)])
-    fetch_barrier()
-    dt = time.perf_counter() - tic
-
-    img_s = batch * iters / dt
+    # steps-per-call: k steps fused into one dispatch (FusedTrainer.
+    # step_multi, a lax.scan over the step body).  Per-call dispatch is
+    # the dominant cost of small-batch steps on this tunneled rig
+    # (tools/probe_gap.py: 82% of a b32 step), and amortizing it is a
+    # framework feature, not a bench trick — the training math is
+    # step-for-step identical (tests/test_train.py::
+    # test_step_multi_matches_sequential_steps).
+    spc_env = os.environ.get("BENCH_STEPS_PER_CALL", "auto")
+    spc = (8 if batch <= 64 else 1) if spc_env == "auto" else max(1, int(spc_env))
+    if spc > 1:
+        stacked = {
+            k_: jnp.stack([staged[i % len(staged)][k_] for i in range(spc)])
+            for k_ in ("data", "softmax_label")
+        }
+        tr.step_multi(**stacked)  # compile
+        fetch_barrier()
+        tr.step_multi(**stacked)  # settle
+        fetch_barrier()
+        calls = max(iters // spc, 1)
+        tic = time.perf_counter()
+        for _ in range(calls):
+            tr.step_multi(**stacked)
+        fetch_barrier()
+        dt = time.perf_counter() - tic
+        img_s = batch * spc * calls / dt
+    else:
+        tic = time.perf_counter()
+        for i in range(iters):
+            tr.step(**staged[i % len(staged)])
+        fetch_barrier()
+        dt = time.perf_counter() - tic
+        img_s = batch * iters / dt
     peak = _peak_flops(kind)
     mfu = (img_s * TRAIN_FLOPS_PER_IMG / peak) if peak else None
     payload = {
@@ -216,6 +241,7 @@ def _bench(dev, kind):
         "batch": batch,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "model_tflops_per_sec": round(img_s * TRAIN_FLOPS_PER_IMG / 1e12, 2),
+        "steps_per_call": spc,
     }
 
     if os.environ.get("BENCH_EXTRAS", "1") == "1":

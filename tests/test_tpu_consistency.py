@@ -210,11 +210,16 @@ def test_tpu_module_training_end_to_end():
     script = """
         import numpy as np
         import mxnet_tpu as mx
+        from mxnet_tpu.test_utils import get_synthetic_mnist
 
-        rs = np.random.RandomState(0)
-        X = rs.uniform(0, 1, (512, 1, 28, 28)).astype(np.float32)
-        w = rs.normal(size=(784, 5)).astype(np.float32)
-        Y = (X.reshape(512, -1) @ w).argmax(1).astype(np.float32)
+        # template-based synthetic digits: the same recipe the adversary
+        # example trains to ~1.0 accuracy in two epochs on CPU.  Batches
+        # are the scarce resource here — every Module.fit batch is a
+        # stack of host->device dispatches, and on a tunneled chip the
+        # per-call latency (not compute) dominates; the jitted-step
+        # training path is covered separately by tools/tpu_train_check.py
+        mx.random.seed(0)
+        (X, Y), _ = get_synthetic_mnist(2048, 16)
 
         net = mx.sym.Variable("data")
         net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=8)
@@ -222,15 +227,13 @@ def test_tpu_module_training_end_to_end():
         net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
                              pool_type="max")
         net = mx.sym.Flatten(net)
-        net = mx.sym.FullyConnected(net, num_hidden=64)
-        net = mx.sym.Activation(net, act_type="relu")
-        net = mx.sym.FullyConnected(net, num_hidden=5)
+        net = mx.sym.FullyConnected(net, num_hidden=10)
         net = mx.sym.SoftmaxOutput(net, name="softmax")
 
         it = mx.io.NDArrayIter(X, Y, 64, shuffle=True)
         mod = mx.mod.Module(net, context=mx.tpu(0))
-        mod.fit(it, num_epoch=6, optimizer="adam",
-                optimizer_params={"learning_rate": 0.003},
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
                 initializer=mx.init.Xavier())
         acc = mx.metric.Accuracy()
         it.reset()
@@ -239,7 +242,7 @@ def test_tpu_module_training_end_to_end():
         assert acc.get()[1] > 0.9
         print("FAMILY OK")
     """
-    _run_script(script)
+    _run_script(script, timeout=1800)
 
 
 def test_tpu_consistency_channels_last_chain():

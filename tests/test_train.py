@@ -187,3 +187,54 @@ def test_fused_trainer_rmsprop_matches_module():
     for k, v in tr.params.items():
         np.testing.assert_allclose(np.asarray(v), want[k].asnumpy(),
                                    rtol=2e-5, atol=2e-5, err_msg=k)
+
+def test_step_multi_matches_sequential_steps():
+    """step_multi(k stacked batches) must land on exactly the params that
+    k sequential step() calls produce — same RNG folds, same lr
+    schedule, same optimizer math — so the two are interchangeable
+    mid-run (step_multi exists to amortize per-call dispatch latency,
+    tools/probe_gap.py)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.trainer import FusedTrainer
+
+    (xtr, ytr), _ = get_synthetic_mnist(256, 16)
+    k, b = 4, 32
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+
+    def make():
+        mx.random.seed(7)
+        tr = FusedTrainer(_conv_sym(), optimizer="sgd",
+                          optimizer_params={"lr": 0.1, "momentum": 0.9,
+                                            "rescale_grad": 1.0 / b,
+                                            "lr_scheduler": sched},
+                          initializer=mx.init.Xavier(),
+                          dtype=jnp.bfloat16)
+        tr.init(data=(b, 1, 28, 28))
+        return tr
+
+    batches = [(xtr[i * b:(i + 1) * b], ytr[i * b:(i + 1) * b])
+               for i in range(k)]
+
+    seq = make()
+    for x, y in batches:
+        seq.step(data=x, softmax_label=y)
+
+    multi = make()
+    outs = multi.step_multi(
+        data=np.stack([x for x, _ in batches]),
+        softmax_label=np.stack([y for _, y in batches]))
+    assert np.asarray(outs[0]).shape[0] == k
+    assert multi._step == seq._step == k
+
+    for name in seq.params:
+        np.testing.assert_allclose(np.asarray(seq.params[name]),
+                                   np.asarray(multi.params[name]),
+                                   rtol=2e-5, atol=2e-5, err_msg=name)
+    # and a further plain step() continues cleanly from the scanned state
+    multi.step(data=batches[0][0], softmax_label=batches[0][1])
+    seq.step(data=batches[0][0], softmax_label=batches[0][1])
+    name = sorted(seq.params)[0]
+    np.testing.assert_allclose(np.asarray(seq.params[name]),
+                               np.asarray(multi.params[name]),
+                               rtol=2e-5, atol=2e-5)

@@ -73,6 +73,21 @@ def framework(batch, iters=40):
           f"step {dev_dt * 1e3:6.2f} ms   host-side {host_dt * 1e3:5.2f} ms "
           f"({host_dt / dev_dt * 100:4.1f}%)", flush=True)
 
+    # the fix the host-side split motivates: k steps per dispatch
+    # (FusedTrainer.step_multi) pays the call cost once per k steps
+    k = 8
+    stacked = {k_: jnp.stack([v] * k) for k_, v in staged.items()}
+    tr.step_multi(**stacked)  # compile
+    barrier()
+    calls = max(iters // k, 2)
+    tic = time.perf_counter()
+    for _ in range(calls):
+        tr.step_multi(**stacked)
+    barrier()
+    multi_dt = (time.perf_counter() - tic) / (calls * k)
+    print(f"framework b{batch} multi(k={k}): {batch / multi_dt:8.1f} img/s   "
+          f"step {multi_dt * 1e3:6.2f} ms", flush=True)
+
 
 if __name__ == "__main__":
     import jax

@@ -17,7 +17,9 @@ Run on the bench chip:  python tools/probe_lm_mfu.py
 CPU smoke:  MXTPU_PLATFORM=cpu python tools/probe_lm_mfu.py --smoke
 """
 import argparse
+import json
 import os
+import subprocess
 import sys
 import time
 
@@ -75,12 +77,43 @@ def run_config(name, L, H, D, d_ff, T, V, B, iters=12, peak=PEAK_BF16):
     return mfu
 
 
+def run_one_subprocess(name, cfg, iters, extra_env=None, timeout=900):
+    """One config in its own process: a failed/OOMed config must not
+    poison the rest of the sweep (the first on-silicon capture lost 3
+    configs to a RESOURCE_EXHAUSTED cascade after one real OOM — the
+    tunnel backend does not reliably free buffers across configs)."""
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    spec = json.dumps({"name": name, "cfg": cfg, "iters": iters})
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--one", spec], env=env, capture_output=True,
+                           text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"{name}: FAILED timeout", flush=True)
+        return 0.0
+    for line in r.stdout.splitlines():
+        if "mfu=" in line:
+            print(line, flush=True)
+            return float(line.rsplit("mfu=", 1)[1])
+    tail = (r.stdout + r.stderr).strip().splitlines()
+    print(f"{name}: FAILED {tail[-1] if tail else 'no output'}", flush=True)
+    return 0.0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config on cpu (plumbing check only)")
     ap.add_argument("--iters", type=int, default=12)
+    ap.add_argument("--one", type=str, default=None,
+                    help="(internal) JSON spec: run one config and exit")
     args = ap.parse_args()
+
+    if args.one:
+        spec = json.loads(args.one)
+        run_config(spec["name"], iters=spec["iters"], **spec["cfg"])
+        return
 
     if os.environ.get("MXTPU_PLATFORM") == "cpu" or args.smoke:
         import jax
@@ -107,15 +140,15 @@ def main():
                              V=32768, B=8)),
         ("lm-small-b8", dict(L=4, H=8, D=512, d_ff=2048, T=512,
                              V=8192, B=8)),  # bench.py extras continuity
+        ("lm-220m-T2048-b8", dict(L=12, H=16, D=1024, d_ff=4096,
+                                  T=2048, V=32768, B=8)),
+        ("lm-220m-b24", dict(head, B=24)),
     ]
     best = (None, 0.0, None)
     for name, cfg in configs:
-        try:
-            mfu = run_config(name, iters=args.iters, **cfg)
-            if mfu > best[1]:
-                best = (name, mfu, cfg)
-        except Exception as exc:  # noqa: BLE001 — keep sweeping
-            print(f"{name}: FAILED {exc!r}", flush=True)
+        mfu = run_one_subprocess(name, cfg, args.iters)
+        if mfu > best[1]:
+            best = (name, mfu, cfg)
     print(f"best: {best[0]} mfu={best[1]:.3f}", flush=True)
 
     # flash-attention tile sweep on the winner (MXTPU_FLASH_BLOCK_Q/K
@@ -123,17 +156,12 @@ def main():
     if best[2] is not None:
         tile_best = ("128x128", best[1])
         for bq, bk in ((256, 256), (128, 512), (512, 128)):
-            os.environ["MXTPU_FLASH_BLOCK_Q"] = str(bq)
-            os.environ["MXTPU_FLASH_BLOCK_K"] = str(bk)
-            try:
-                mfu = run_config(f"{best[0]}-blk{bq}x{bk}",
-                                 iters=args.iters, **best[2])
-                if mfu > tile_best[1]:
-                    tile_best = (f"{bq}x{bk}", mfu)
-            except Exception as exc:  # noqa: BLE001
-                print(f"blk{bq}x{bk}: FAILED {exc!r}", flush=True)
-        os.environ.pop("MXTPU_FLASH_BLOCK_Q", None)
-        os.environ.pop("MXTPU_FLASH_BLOCK_K", None)
+            mfu = run_one_subprocess(
+                f"{best[0]}-blk{bq}x{bk}", best[2], args.iters,
+                extra_env={"MXTPU_FLASH_BLOCK_Q": str(bq),
+                           "MXTPU_FLASH_BLOCK_K": str(bk)})
+            if mfu > tile_best[1]:
+                tile_best = (f"{bq}x{bk}", mfu)
         print(f"best-tiles: {best[0]} blk{tile_best[0]} "
               f"mfu={tile_best[1]:.3f}", flush=True)
 

@@ -5,6 +5,12 @@
 2. HBM corner — streaming read+write bandwidth via y = a*x + y over
    arrays far larger than VMEM (v5e datasheet: 819 GB/s).
 
+The iteration loop runs ON DEVICE (lax.fori_loop) so one dispatch
+covers all iterations: on a tunneled chip, per-call dispatch latency is
+hundreds of ms and a host-side loop measures the transport, not the
+silicon (the first capture of this probe did exactly that — 45 GB/s
+"HBM bandwidth" that was really 30 serialized round trips).
+
 Together with tools/probe_nhwc.py (the ResNet-50 train step itself)
 these pin where that workload sits on the roofline: if matmul MFU is
 high and the train step's implied bytes/s ~= the measured stream
@@ -23,7 +29,7 @@ PEAK_TFLOPS = 197.0   # v5e bf16 datasheet
 PEAK_GBS = 819.0      # v5e HBM datasheet
 
 
-def matmul_mfu(n, iters=20):
+def matmul_mfu(n, iters=50):
     a = jnp.asarray(np.random.RandomState(0).normal(size=(n, n)),
                     jnp.bfloat16)
     b = jnp.asarray(np.random.RandomState(1).normal(size=(n, n)),
@@ -31,39 +37,41 @@ def matmul_mfu(n, iters=20):
 
     @jax.jit
     def chain(a, b):
-        # two chained matmuls so the loop body can't be folded away
-        c = jax.lax.dot(a, b, preferred_element_type=jnp.float32)
-        return c.astype(jnp.bfloat16)
+        # chained matmuls (each consumes the last result) so the device
+        # loop can't be folded away or overlapped into nothing
+        def body(_, c):
+            return jax.lax.dot(
+                c, b, preferred_element_type=jnp.float32
+            ).astype(jnp.bfloat16)
 
-    out = chain(a, b)
-    jax.block_until_ready(out)
+        return jax.lax.fori_loop(0, iters, body, a)
+
+    jax.block_until_ready(chain(a, b))          # compile + warm
     tic = time.perf_counter()
-    for _ in range(iters):
-        out = chain(out, b)
-    _ = float(jnp.asarray(out[0, 0], jnp.float32))  # fetch = real barrier
+    jax.block_until_ready(chain(a, b))          # ONE dispatch, iters matmuls
     dt = time.perf_counter() - tic
     tflops = 2.0 * n * n * n * iters / dt / 1e12
     print(f"matmul {n}x{n}x{n} bf16: {tflops:8.1f} TFLOP/s  "
           f"mfu={tflops / PEAK_TFLOPS:.3f}", flush=True)
 
 
-def hbm_bandwidth(mb=512, iters=30):
+def hbm_bandwidth(mb=512, iters=50):
     n = mb * 1024 * 1024 // 4
     x = jnp.zeros((n,), jnp.float32)
     y = jnp.ones((n,), jnp.float32)
 
     @jax.jit
-    def axpy(x, y):
-        return 1.0001 * x + y
+    def axpy_loop(x, y):
+        def body(_, c):
+            return 1.0001 * c + y
 
-    out = axpy(x, y)
-    jax.block_until_ready(out)
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    jax.block_until_ready(axpy_loop(x, y))
     tic = time.perf_counter()
-    for _ in range(iters):
-        out = axpy(out, y)
-    _ = float(out[0])
+    jax.block_until_ready(axpy_loop(x, y))
     dt = time.perf_counter() - tic
-    # per iter: read x, read y, write out = 3 * mb
+    # per iter: read c, read y, write out = 3 * mb
     gbs = 3 * mb * iters / 1024 / dt
     print(f"hbm axpy {mb}MB: {gbs:8.1f} GB/s  "
           f"of datasheet {PEAK_GBS:.0f} ({gbs / PEAK_GBS:.2f})", flush=True)
