@@ -1,7 +1,14 @@
 #!/usr/bin/env python
-"""FGSM adversarial examples (parity: example/adversary/): train a small
-net, then bind with inputs_need_grad=True and perturb inputs along
-sign(dLoss/dx) to flip predictions."""
+"""Adversarial example generation (parity: example/adversary/
+adversary_generation.ipynb): train a small convnet, then craft FGSM,
+targeted-FGSM and PGD perturbations through a second Module bound with
+inputs_need_grad=True that SHARES the trained module's parameter
+storage (shared_module), so no weight copying is ever needed.
+
+Self-asserting: the untargeted attacks must collapse accuracy well
+below clean accuracy, PGD at least as hard as FGSM, and the targeted
+attack must steer a majority of examples to the chosen class.
+"""
 import argparse
 import logging
 import os
@@ -16,6 +23,8 @@ import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import sym  # noqa: E402
 from mxnet_tpu.test_utils import get_synthetic_mnist  # noqa: E402
 
+import attacks  # noqa: E402
+
 
 def build_net():
     data = sym.Variable("data")
@@ -26,43 +35,69 @@ def build_net():
     return sym.SoftmaxOutput(net, name="softmax")
 
 
-if __name__ == "__main__":
+def bind_attacker(net, train_mod, batch_size, shape):
+    """A Module sharing train_mod's live parameter storage, with input
+    gradients enabled — updates to the donor are visible here without
+    any set_params round trip."""
+    atk = mx.mod.Module(net)
+    atk.bind(data_shapes=[("data", (batch_size,) + shape)],
+             label_shapes=[("softmax_label", (batch_size,))],
+             for_training=True, inputs_need_grad=True,
+             shared_module=train_mod)
+    return atk
+
+
+def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epsilon", type=float, default=0.15)
+    ap.add_argument("--epsilon", type=float, default=0.5)
     ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--pgd-steps", type=int, default=8)
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
+    mx.random.seed(42)  # param init draws from the global RNG
 
     (xtr, ytr), (xte, yte) = get_synthetic_mnist(2048, 256)
     train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch_size,
                               shuffle=True)
     net = build_net()
     mod = mx.mod.Module(net)
-    mod.fit(train, num_epoch=3, optimizer="sgd",
+    mod.fit(train, num_epoch=args.epochs, optimizer="sgd",
             optimizer_params={"learning_rate": 0.1})
-    arg_params, aux_params = mod.get_params()
 
-    # rebind with input grads enabled
     b = args.batch_size
-    atk = mx.mod.Module(net)
-    atk.bind(data_shapes=[("data", (b,) + xte.shape[1:])],
-             label_shapes=[("softmax_label", (b,))],
-             for_training=True, inputs_need_grad=True)
-    atk.set_params(arg_params, aux_params)
-
+    atk = bind_attacker(net, mod, b, xte.shape[1:])
     x, y = xte[:b], yte[:b]
-    atk.forward(mx.io.DataBatch([mx.nd.array(x)], [mx.nd.array(y)]),
-                is_train=True)
-    clean_pred = atk.get_outputs()[0].asnumpy().argmax(axis=1)
-    atk.backward()
-    grad = atk.get_input_grads()[0].asnumpy()
+    rng = np.random.RandomState(7)
+    # adversarial images stay inside the data's own valid range
+    clip = (float(xtr.min()), float(xtr.max()))
 
-    x_adv = np.clip(x + args.epsilon * np.sign(grad), 0, 1)
-    atk.forward(mx.io.DataBatch([mx.nd.array(x_adv)], [mx.nd.array(y)]),
+    clean_acc = attacks.accuracy(atk, x, y)
+    x_fgsm = attacks.fgsm(atk, x, y, args.epsilon, clip=clip)
+    fgsm_acc = attacks.accuracy(atk, x_fgsm, y)
+    x_pgd = attacks.pgd(atk, x, y, args.epsilon, steps=args.pgd_steps,
+                        rng=rng, clip=clip)
+    pgd_acc = attacks.accuracy(atk, x_pgd, y)
+
+    target = np.full_like(y, 3)
+    x_tgt = attacks.targeted_fgsm(atk, x, target, args.epsilon, clip=clip)
+    atk.forward(mx.io.DataBatch([mx.nd.array(x_tgt)], [mx.nd.array(y)]),
                 is_train=False)
-    adv_pred = atk.get_outputs()[0].asnumpy().argmax(axis=1)
+    tgt_pred = atk.get_outputs()[0].asnumpy().argmax(axis=1)
+    hit = float((tgt_pred == 3).mean())
 
-    clean_acc = float((clean_pred == y).mean())
-    adv_acc = float((adv_pred == y).mean())
-    logging.info("clean acc %.3f -> adversarial acc %.3f (eps=%.2f)",
-                 clean_acc, adv_acc, args.epsilon)
+    logging.info("clean %.3f | fgsm %.3f | pgd %.3f | targeted->3 %.3f",
+                 clean_acc, fgsm_acc, pgd_acc, hit)
+    # perturbations stay inside the eps-ball by construction
+    assert np.abs(x_fgsm - x).max() <= args.epsilon + 1e-6
+    assert np.abs(x_pgd - x).max() <= args.epsilon + 1e-6
+    # the attacks must actually work
+    assert clean_acc >= 0.85, clean_acc
+    assert fgsm_acc <= clean_acc - 0.3, (clean_acc, fgsm_acc)
+    assert pgd_acc <= fgsm_acc + 0.05, (fgsm_acc, pgd_acc)
+    assert hit >= 0.5, hit
+    print("ADVERSARY OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Gradient-based adversarial attacks over the Module input-grad path.
+
+Parity: example/adversary/adversary_generation.ipynb — the reference
+crafts FGSM perturbations from an executor bound with input gradients
+enabled; this library generalizes that to the standard attack family
+(FGSM, targeted FGSM, PGD) against any bound Module.
+
+`clip` bounds the valid data range (e.g. (0, 1) for unit images);
+None (default) skips range clipping, keeping perturbations exactly in
+the eps-ball whatever the input scaling.
+
+Every attack drives the same framework surface:
+    mod.bind(..., for_training=True, inputs_need_grad=True)
+    mod.forward(batch, is_train=True); mod.backward()
+    g = mod.get_input_grads()[0]
+so the attacks double as a workout for input-gradient plumbing through
+the fused forward+backward executor.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def input_grad(mod, x, y):
+    """dLoss/dx for a batch, via one fused forward+backward."""
+    mod.forward(mx.io.DataBatch([mx.nd.array(x)], [mx.nd.array(y)]),
+                is_train=True)
+    mod.backward()
+    return mod.get_input_grads()[0].asnumpy()
+
+
+def _range_clip(x_adv, clip):
+    if clip is None:
+        return x_adv
+    return np.clip(x_adv, clip[0], clip[1])
+
+
+def fgsm(mod, x, y, eps, clip=None):
+    """Fast gradient sign: one step of size eps up the loss surface."""
+    g = input_grad(mod, x, y)
+    return _range_clip(x + eps * np.sign(g), clip).astype(x.dtype)
+
+
+def targeted_fgsm(mod, x, target, eps, clip=None):
+    """Step DOWN the loss toward a chosen target class: the perturbation
+    pushes predictions to `target` rather than merely off the truth."""
+    g = input_grad(mod, x, target)
+    return _range_clip(x - eps * np.sign(g), clip).astype(x.dtype)
+
+
+def pgd(mod, x, y, eps, alpha=None, steps=8, random_start=True,
+        clip=None, rng=None):
+    """Projected gradient descent inside the L-inf eps-ball around x.
+
+    The strongest first-order attack (Madry et al.): `steps` FGSM steps
+    of size alpha, each followed by projection back into the ball."""
+    if alpha is None:
+        alpha = 2.5 * eps / steps
+    rng = rng or np.random
+    if random_start:
+        x_adv = x + rng.uniform(-eps, eps, size=x.shape).astype(x.dtype)
+        x_adv = _range_clip(x_adv, clip)
+    else:
+        x_adv = x.copy()
+    for _ in range(steps):
+        g = input_grad(mod, x_adv, y)
+        x_adv = x_adv + alpha * np.sign(g)
+        x_adv = np.clip(x_adv, x - eps, x + eps)  # project into the ball
+        x_adv = _range_clip(x_adv, clip)
+    return x_adv.astype(x.dtype)
+
+
+def accuracy(mod, x, y, batch_size=None):
+    """Clean-forward accuracy of a bound module on (x, y)."""
+    b = batch_size or x.shape[0]
+    correct = 0
+    for i in range(0, x.shape[0] - b + 1, b):
+        mod.forward(mx.io.DataBatch([mx.nd.array(x[i:i + b])],
+                                    [mx.nd.array(y[i:i + b])]),
+                    is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        correct += int((pred == y[i:i + b]).sum())
+    n = (x.shape[0] // b) * b
+    return correct / float(n)

@@ -1,0 +1,127 @@
+"""Stacked (denoising) autoencoder as a reusable model class (parity:
+example/autoencoder/autoencoder.py — the reference's AutoEncoderModel
+builds per-layer encode/decode symbols from an `internals` walk, trains
+layers greedily with masking-noise corruption, then finetunes the whole
+stack; example/dec/dec.py imports it for its pretraining stage).
+"""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+from model import MXModel  # noqa: E402
+from solver import Solver  # noqa: E402
+
+
+class AutoEncoderModel(MXModel):
+    """dims = [input, h1, ..., bottleneck]; relu between layers.
+
+    Symbols built once and shared by parameter NAME:
+      self.loss     — full stack, MSE against target_label
+      self.encoder  — data -> bottleneck
+      layer pairs   — shallow (d_i -> d_{i+1} -> d_i) AEs for greedy
+                      pretraining, reusing the stack's own param names
+                      so their training writes the stack directly.
+    """
+
+    def setup(self, dims, corruption=0.0):
+        self.dims = list(dims)
+        self.corruption = float(corruption)
+        self.loss = self._stack_sym()
+        self.encoder = self._encoder_sym(len(dims) - 1)
+        self.init_params(data_shapes={"data": (1, dims[0]),
+                                      "target_label": (1, dims[0])})
+
+    # ---- symbols ----------------------------------------------------
+    def _encoder_sym(self, depth):
+        net = sym.Variable("data")
+        for i in range(depth):
+            net = sym.FullyConnected(net, num_hidden=self.dims[i + 1],
+                                     name="enc%d" % i)
+            if i < depth - 1:
+                net = sym.Activation(net, act_type="relu")
+        return net
+
+    def _stack_sym(self):
+        net = self._encoder_sym(len(self.dims) - 1)
+        net = sym.Activation(net, act_type="relu")
+        for j, d in enumerate(reversed(self.dims[:-1])):
+            net = sym.FullyConnected(net, num_hidden=d, name="dec%d" % j)
+            if j < len(self.dims) - 2:
+                net = sym.Activation(net, act_type="relu")
+        return sym.LinearRegressionOutput(net, sym.Variable("target_label"),
+                                          name="rec")
+
+    def _pair_sym(self, i):
+        """Shallow AE for layer i, named so its params ARE the stack's."""
+        net = sym.Variable("data")
+        net = sym.FullyConnected(net, num_hidden=self.dims[i + 1],
+                                 name="enc%d" % i)
+        net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=self.dims[i],
+                                 name="dec%d" % (len(self.dims) - 2 - i))
+        return sym.LinearRegressionOutput(net, sym.Variable("target_label"),
+                                          name="rec")
+
+    # ---- data plumbing ----------------------------------------------
+    def _corrupt(self, x, rng):
+        """Masking noise: zero a random fraction of inputs (the
+        denoising-AE corruption; reconstruction target stays clean)."""
+        if self.corruption <= 0:
+            return x
+        mask = rng.uniform(size=x.shape) >= self.corruption
+        return (x * mask).astype(x.dtype)
+
+    def encode(self, x, depth=None):
+        """Bottleneck features (or the first `depth` layers' output)."""
+        symb = (self.encoder if depth is None
+                else self._encoder_sym(depth))
+        return self.predict_feature(symb, x)
+
+    def reconstruct_mse(self, x, batch_size=256):
+        rec = self.predict_feature(self.loss, x, batch_size)
+        return float(np.mean((rec - x) ** 2))
+
+    # ---- training ---------------------------------------------------
+    def layerwise_pretrain(self, x, batch_size, epochs, lr,
+                           monitor=None):
+        """Greedy per-layer training (reference: AutoEncoderModel's
+        l-wise stage): layer i trains on the (clean) encoding of the
+        layers below it, with corruption applied to its own input."""
+        rng = np.random.RandomState(1)
+        for i in range(len(self.dims) - 1):
+            # post-ReLU features: that is what layer i consumes in the
+            # full stack (_encoder_sym applies relu between layers)
+            h = np.maximum(self.encode(x, depth=i), 0.0) if i else x
+            pair = AutoEncoderModel.__new__(AutoEncoderModel)
+            pair.ctx = self.ctx
+            pair.loss = self._pair_sym(i)
+            pair.args = {k: v for k, v in self.args.items()
+                         if k in pair.loss.list_arguments()}
+            pair.auxs = {}
+            solver = Solver("adam", learning_rate=lr)
+            solver.set_metric(mx.metric.MSE())
+            if monitor is not None:
+                solver.set_monitor(monitor)
+            mse = solver.solve(pair, h, h, batch_size, epochs,
+                               transform=lambda xb: self._corrupt(xb, rng))
+            logging.info("pretrain layer %d mse %.5f", i, mse)
+
+    def finetune(self, x, batch_size, epochs, lr, monitor=None):
+        """End-to-end reconstruction training of the whole stack; a
+        fresh corruption mask is drawn for every batch (the denoising
+        property needs the mask to vary, not a fixed corrupted copy)."""
+        rng = np.random.RandomState(2)
+        solver = Solver("adam", learning_rate=lr)
+        solver.set_metric(mx.metric.MSE())
+        if monitor is not None:
+            solver.set_monitor(monitor)
+        return solver.solve(self, x, x, batch_size, epochs,
+                            transform=lambda xb: self._corrupt(xb, rng))

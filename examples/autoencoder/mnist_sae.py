@@ -1,8 +1,12 @@
 #!/usr/bin/env python
-"""Stacked autoencoder with layerwise pretraining (parity:
-example/autoencoder/): each layer pretrained as a shallow
-encoder/decoder with LinearRegressionOutput, then the full stack
-finetuned end-to-end."""
+"""Stacked denoising autoencoder on (synthetic) MNIST (parity:
+example/autoencoder/mnist_sae.py — greedy layerwise pretraining, then
+end-to-end finetuning, driven through the Solver/MXModel system).
+
+Self-asserting A/B: finetuning must improve reconstruction over the
+purely-layerwise stack, the final MSE must beat a fixed floor, and the
+denoising corruption must not destroy either property.
+"""
 import argparse
 import logging
 import os
@@ -14,71 +18,56 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import numpy as np  # noqa: E402
 
 import mxnet_tpu as mx  # noqa: E402
-from mxnet_tpu import sym  # noqa: E402
 from mxnet_tpu.test_utils import get_synthetic_mnist  # noqa: E402
 
-
-def ae_symbol(dims, out_name="decoded"):
-    """Encoder dims[0]->dims[-1] then mirrored decoder, MSE loss against
-    the input itself."""
-    data = sym.Variable("data")
-    target = sym.Variable("target_label")
-    net = data
-    for i, d in enumerate(dims[1:]):
-        net = sym.FullyConnected(net, num_hidden=d, name=f"enc{i}")
-        net = sym.Activation(net, act_type="relu")
-    for i, d in enumerate(reversed(dims[:-1])):
-        net = sym.FullyConnected(net, num_hidden=d, name=f"dec{i}")
-        if i < len(dims) - 2:
-            net = sym.Activation(net, act_type="relu")
-    return sym.LinearRegressionOutput(net, target, name=out_name)
+from autoencoder import AutoEncoderModel  # noqa: E402
 
 
-def train_ae(x, dims, num_epochs, batch_size, lr, arg_params=None):
-    net = ae_symbol(dims)
-    it = mx.io.NDArrayIter({"data": x}, {"target_label": x},
-                           batch_size=batch_size, shuffle=True)
-    mod = mx.mod.Module(net, data_names=("data",),
-                        label_names=("target_label",))
-    mod.fit(it, num_epoch=num_epochs, optimizer="adam",
-            optimizer_params={"learning_rate": lr},
-            arg_params=arg_params, allow_missing=True,
-            eval_metric="mse")
-    args_out, _ = mod.get_params()
-    score = mod.score(it, "mse")[0][1]
-    return args_out, score
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--pretrain-epochs", type=int, default=3)
+    ap.add_argument("--finetune-epochs", type=int, default=5)
+    ap.add_argument("--dims", type=str, default="784,128,32")
+    ap.add_argument("--corruption", type=float, default=0.3)
+    ap.add_argument("--max-mse", type=float, default=0.025)
+    ap.add_argument("--monitor", action="store_true",
+                    help="print per-batch stat taps via mx.mon.Monitor")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
+
+    dims = [int(d) for d in args.dims.split(",")]
+    (xtr, _), (xte, _) = get_synthetic_mnist(2048, 256)
+    x = xtr.reshape(len(xtr), -1).astype(np.float32)
+    xt = xte.reshape(len(xte), -1).astype(np.float32)
+
+    monitor = (mx.mon.Monitor(50, pattern=".*weight") if args.monitor
+               else None)
+    model = AutoEncoderModel(dims, corruption=args.corruption)
+
+    model.layerwise_pretrain(x, args.batch_size, args.pretrain_epochs,
+                             1e-3, monitor=monitor)
+    pre_mse = model.reconstruct_mse(xt)
+    logging.info("pretrain-only test mse %.5f", pre_mse)
+
+    model.finetune(x, args.batch_size, args.finetune_epochs, 1e-3,
+                   monitor=monitor)
+    fin_mse = model.reconstruct_mse(xt)
+    logging.info("finetuned   test mse %.5f", fin_mse)
+
+    ckpt = "/tmp/mnist_sae_params.nd"
+    model.save(ckpt)
+    reloaded = AutoEncoderModel(dims, corruption=0.0)
+    reloaded.load(ckpt)
+    assert abs(reloaded.reconstruct_mse(xt) - fin_mse) < 1e-6
+
+    z = model.encode(xt)
+    assert z.shape == (len(xt), dims[-1])
+    assert fin_mse <= pre_mse + 1e-6, (pre_mse, fin_mse)
+    assert fin_mse <= args.max_mse, fin_mse
+    print("SAE OK pre %.5f -> fine %.5f" % (pre_mse, fin_mse))
 
 
 if __name__ == "__main__":
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=64)
-    ap.add_argument("--pretrain-epochs", type=int, default=2)
-    ap.add_argument("--finetune-epochs", type=int, default=3)
-    ap.add_argument("--dims", type=str, default="784,128,32")
-    args = ap.parse_args()
-    logging.basicConfig(level=logging.INFO)
-
-    dims = [int(d) for d in args.dims.split(",")]
-    (xtr, _), _ = get_synthetic_mnist(2048, 16)
-    x = xtr.reshape(len(xtr), -1).astype(np.float32)
-
-    # layerwise pretraining: train each (d_i -> d_{i+1}) pair alone
-    pretrained = {}
-    h = x
-    for i in range(len(dims) - 1):
-        pair_args, mse = train_ae(h, [dims[i], dims[i + 1]],
-                                  args.pretrain_epochs, args.batch_size,
-                                  1e-3)
-        logging.info("layer %d pretrain mse %.4f", i, mse)
-        pretrained[f"enc{i}_weight"] = pair_args["enc0_weight"]
-        pretrained[f"enc{i}_bias"] = pair_args["enc0_bias"]
-        pretrained[f"dec{len(dims) - 2 - i}_weight"] = pair_args["dec0_weight"]
-        pretrained[f"dec{len(dims) - 2 - i}_bias"] = pair_args["dec0_bias"]
-        # encode h for the next layer with the trained encoder
-        w = pair_args["enc0_weight"].asnumpy()
-        bset = pair_args["enc0_bias"].asnumpy()
-        h = np.maximum(h @ w.T + bset, 0.0)
-
-    _, final_mse = train_ae(x, dims, args.finetune_epochs, args.batch_size,
-                            1e-4, arg_params=pretrained)
-    logging.info("finetuned stack mse %.4f", final_mse)
+    main()

@@ -1,0 +1,81 @@
+"""Minimal model container for the unsupervised examples (parity:
+example/autoencoder/model.py — the reference's MXModel holds a symbol,
+its arg/aux arrays and a simple save/load; solvers operate on it).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class MXModel(object):
+    """A symbol plus its materialized parameters.
+
+    Subclasses implement setup(*args) to build self.loss (a training
+    symbol) and may add more symbols sharing the same parameter names;
+    all parameters live in self.args / self.auxs as NDArrays keyed by
+    name, so any number of executors can be bound against them.
+    """
+
+    def __init__(self, *args, **kwargs):
+        self.loss = None
+        self.args = {}
+        self.auxs = {}
+        self.ctx = kwargs.pop("ctx", None) or mx.context.cpu()
+        self.setup(*args, **kwargs)
+
+    def setup(self, *args, **kwargs):
+        raise NotImplementedError("subclass builds symbols + params here")
+
+    def init_params(self, initializer=None, data_shapes=None):
+        """Materialize every argument of self.loss except data/labels."""
+        initializer = initializer or mx.init.Xavier()
+        arg_shapes, _, aux_shapes = self.loss.infer_shape(**data_shapes)
+        arg_names = self.loss.list_arguments()
+        aux_names = self.loss.list_auxiliary_states()
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in data_shapes:
+                continue
+            arr = mx.nd.empty(shape, ctx=self.ctx)
+            initializer(name, arr)
+            self.args[name] = arr
+        for name, shape in zip(aux_names, aux_shapes):
+            self.auxs[name] = mx.nd.zeros(shape, ctx=self.ctx)
+
+    def save(self, fname):
+        mx.nd.save(fname, {("arg:%s" % k): v for k, v in self.args.items()}
+                   | {("aux:%s" % k): v for k, v in self.auxs.items()})
+
+    def load(self, fname):
+        for k, v in mx.nd.load(fname).items():
+            tag, name = k.split(":", 1)
+            (self.args if tag == "arg" else self.auxs)[name] = v
+
+    def predict_feature(self, symbol, x, batch_size=256):
+        """Run `symbol` (sharing this model's param names) over x.
+
+        Executors are cached per (symbol, input shape) — callers like
+        DEC's refinement loop predict through the same symbol dozens of
+        times, and only the param VALUES change between calls."""
+        cache = self.__dict__.setdefault("_exec_cache", {})
+        outs = []
+        n = x.shape[0]
+        for i in range(0, n, batch_size):
+            xb = x[i:i + batch_size]
+            key = (id(symbol), xb.shape)
+            ex = cache.get(key)
+            if ex is None:
+                ex = symbol.simple_bind(ctx=self.ctx, grad_req="null",
+                                        data=xb.shape)
+                cache[key] = ex
+            for name, arr in self.args.items():
+                if name in ex.arg_dict:
+                    ex.arg_dict[name][:] = arr
+            ex.forward(is_train=False, data=xb)
+            outs.append(ex.outputs[0].asnumpy())
+        return np.concatenate(outs, axis=0)

@@ -1,0 +1,107 @@
+"""Generic low-level training loop (parity: example/autoencoder/
+solver.py — the reference's Solver binds an executor over an MXModel's
+arrays, drives forward/backward with an updater, and reports through a
+metric + optional Monitor).
+
+Deliberately NOT Module.fit: the examples use this to exercise the
+executor / optimizer.get_updater / Monitor surfaces directly.
+"""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class Solver(object):
+    def __init__(self, optimizer, **opt_params):
+        self.optimizer = mx.optimizer.create(optimizer, **opt_params)
+        self.metric = None
+        self.monitor = None
+        self.iter_end_callback = None
+
+    def set_metric(self, metric):
+        self.metric = metric
+
+    def set_monitor(self, monitor):
+        self.monitor = monitor
+
+    def set_iter_end_callback(self, cb):
+        self.iter_end_callback = cb
+
+    def solve(self, model, train_x, train_y, batch_size, num_epochs,
+              data_name="data", label_name="target_label",
+              trainable=None, transform=None):
+        """SGD over (train_x, train_y) against model.loss.
+
+        trainable: optional name filter — only these args get grads and
+        updates (the stacked AE freezes earlier layers this way).
+        transform: optional fn applied to each INPUT batch right before
+        forward (labels untouched) — the denoising AE draws a fresh
+        corruption mask per batch here.
+        """
+        b = batch_size
+        shapes = {data_name: (b,) + train_x.shape[1:],
+                  label_name: (b,) + train_y.shape[1:]}
+        grad_req = {}
+        for name in model.loss.list_arguments():
+            if name in shapes:
+                grad_req[name] = "null"
+            elif trainable is not None and name not in trainable:
+                grad_req[name] = "null"
+            else:
+                grad_req[name] = "write"
+        ex = model.loss.simple_bind(ctx=model.ctx, grad_req=grad_req,
+                                    **shapes)
+        for name, arr in model.args.items():
+            if name in ex.arg_dict:
+                ex.arg_dict[name][:] = arr
+        for name, arr in model.auxs.items():
+            if name in ex.aux_dict:
+                ex.aux_dict[name][:] = arr
+        if self.monitor is not None:
+            self.monitor.install(ex)
+
+        updater = mx.optimizer.get_updater(self.optimizer)
+        updated = [n for n in sorted(ex.arg_dict)
+                   if grad_req.get(n) == "write"]
+        rng = np.random.RandomState(0)
+        idx = np.arange(train_x.shape[0])
+        last = None
+        for epoch in range(num_epochs):
+            rng.shuffle(idx)
+            if self.metric is not None:
+                self.metric.reset()
+            for i in range(0, len(idx) - b + 1, b):
+                xb = train_x[idx[i:i + b]]
+                yb = train_y[idx[i:i + b]]
+                if transform is not None:
+                    xb = transform(xb)
+                if self.monitor is not None:
+                    self.monitor.tic()
+                ex.forward(is_train=True, **{data_name: xb, label_name: yb})
+                ex.backward()
+                for j, name in enumerate(updated):
+                    updater(j, ex.grad_dict[name], ex.arg_dict[name])
+                if self.monitor is not None:
+                    self.monitor.toc_print()
+                if self.metric is not None:
+                    self.metric.update([mx.nd.array(yb)],
+                                       [ex.outputs[0]])
+            if self.metric is not None:
+                name, last = self.metric.get()
+                logging.info("epoch %d %s %.5f", epoch, name, last)
+            if self.iter_end_callback is not None:
+                self.iter_end_callback(epoch)
+        # fold the trained values back into the model's arrays
+        for name in ex.arg_dict:
+            if name in model.args:
+                model.args[name][:] = ex.arg_dict[name]
+        for name in ex.aux_dict:
+            model.auxs[name][:] = ex.aux_dict[name]
+        return last

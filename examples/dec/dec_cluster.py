@@ -1,44 +1,45 @@
 #!/usr/bin/env python
-"""Deep Embedded Clustering (parity: example/dec/dec.py, Xie et al. 2016).
+"""Deep Embedded Clustering (parity: example/dec/dec.py, Xie et al. 2016
+— the reference's dec.py imports example/autoencoder/ for its
+pretraining stage; this file does the same against our
+examples/autoencoder system).
 
-Stage 1: pretrain an autoencoder on the data.  Stage 2: k-means in the
-embedding initializes cluster centroids; then the encoder is refined by
-matching the soft assignment q (Student-t kernel to centroids) to the
-sharpened target p = q^2 / freq, with KL(p||q) gradients flowing into
-both encoder and centroids.  The reference hand-codes dL/dz; here the
-loss is expressed symbolically and autodiff does the rest.  Synthetic
-Gaussian blobs stand in for MNIST; clustering accuracy must improve over
-the k-means initialization.
+Stage 1: pretrain a stacked autoencoder (AutoEncoderModel: greedy
+layerwise + finetune through the Solver).  Stage 2: k-means in the
+bottleneck embedding initializes cluster centroids; then the encoder is
+refined by matching the soft assignment q (Student-t kernel to
+centroids) to the sharpened target p = q^2 / freq, with KL(p||q)
+gradients flowing into both encoder and centroids.  The reference
+hand-codes dL/dz; here the loss is expressed symbolically and autodiff
+does the rest.  Synthetic Gaussian blobs stand in for MNIST; clustering
+accuracy must improve over the k-means initialization.
 """
 import argparse
 import os
 import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "..", ".."))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+sys.path.insert(0, os.path.join(_HERE, "..", "autoencoder"))
 
 import numpy as np  # noqa: E402
 
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import sym  # noqa: E402
 
+from autoencoder import AutoEncoderModel  # noqa: E402
+
 DIM, EMBED, K = 20, 2, 3
+DIMS = [DIM, 32, EMBED]
 
 
 def encoder_sym():
-    data = sym.Variable("data")
-    net = sym.FullyConnected(data, num_hidden=32, name="enc1")
+    """Same topology/param names as AutoEncoderModel(DIMS)._encoder_sym:
+    enc0 -> relu -> enc1 (bottleneck, linear)."""
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=DIMS[1], name="enc0")
     net = sym.Activation(net, act_type="relu")
-    return sym.FullyConnected(net, num_hidden=EMBED, name="enc2")
-
-
-def autoencoder_sym():
-    z = encoder_sym()
-    net = sym.FullyConnected(z, num_hidden=32, name="dec1")
-    net = sym.Activation(net, act_type="relu")
-    net = sym.FullyConnected(net, num_hidden=DIM, name="dec2")
-    return sym.LinearRegressionOutput(net, sym.Variable("rec_label"),
-                                      name="rec")
+    return sym.FullyConnected(net, num_hidden=EMBED, name="enc1")
 
 
 def dec_sym(batch):
@@ -79,56 +80,50 @@ def cluster_acc(assign, y, k):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=600)
+    ap.add_argument("--pretrain-epochs", type=int, default=8)
+    ap.add_argument("--finetune-epochs", type=int, default=22)
     args = ap.parse_args()
     rs = np.random.RandomState(0)
+    mx.random.seed(0)
 
     # blobs in DIM-d space
     centers = rs.randn(K, DIM) * 2.0
     y = rs.randint(0, K, args.n)
-    x = (centers[y] + rs.randn(args.n, DIM) * 0.9).astype(np.float32)
+    x = (centers[y] + rs.randn(args.n, DIM) * 1.5).astype(np.float32)
 
-    ctx = mx.context.default_accelerator_context()
-    # ---- stage 1: autoencoder pretrain
-    mod = mx.mod.Module(autoencoder_sym(), data_names=("data",),
-                        label_names=("rec_label",), context=ctx)
-    it = mx.io.NDArrayIter({"data": x}, {"rec_label": x}, batch_size=60,
-                           shuffle=True)
-    mod.fit(it, num_epoch=30, optimizer="adam",
-            optimizer_params={"learning_rate": 2e-3},
-            initializer=mx.init.Xavier(), eval_metric="rmse")
+    # ---- stage 1: stacked-AE pretraining through the shared system
+    model = AutoEncoderModel(DIMS, corruption=0.0)
+    model.layerwise_pretrain(x, batch_size=60,
+                             epochs=args.pretrain_epochs, lr=2e-3)
+    model.finetune(x, batch_size=60, epochs=args.finetune_epochs, lr=2e-3)
 
     # ---- embed + k-means init
-    args_p, _ = mod.get_params()
-    feat = mx.mod.Module(sym.Group([encoder_sym()]), data_names=("data",),
-                         label_names=(), context=ctx)
-    feat.bind([("data", (args.n, DIM))], None, for_training=False)
-    feat.set_params({k_: v for k_, v in args_p.items() if "enc" in k_}, {})
-    feat.forward(mx.io.DataBatch([mx.nd.array(x)], None), is_train=False)
-    z0 = feat.get_outputs()[0].asnumpy()
+    z0 = model.encode(x)
     mu, assign0 = kmeans(z0.copy(), K, rs)
     acc0 = cluster_acc(assign0, y, K)
     print(f"k-means init acc {acc0:.3f}")
 
     # ---- stage 2: DEC refinement
+    ctx = mx.context.default_accelerator_context()
     loss, _ = dec_sym(args.n)
     ex = loss.simple_bind(ctx=ctx, grad_req="write", data=(args.n, DIM),
                           centroids=(K, EMBED), p_target=(args.n, K))
-    for k_, v in args_p.items():
-        if "enc" in k_:
-            ex.arg_dict[k_][:] = v.asnumpy()
+    for k_, arr in model.args.items():
+        if k_ in ex.arg_dict:
+            ex.arg_dict[k_][:] = arr
     ex.arg_dict["centroids"][:] = mu
     trainable = {k_: ex.arg_dict[k_] for k_ in ex.arg_dict
                  if "enc" in k_ or k_ == "centroids"}
     opt = mx.optimizer.create("adam", learning_rate=2e-3)
     upd = mx.optimizer.get_updater(opt)
 
+    z = z0
     for it_ in range(40):
         # soft assignment q from the current encoder/centroids (host side)
-        feat.set_params({k_: mx.nd.array(ex.arg_dict[k_].asnumpy())
-                         for k_ in ex.arg_dict if "enc" in k_}, {},
-                        allow_missing=True)
-        feat.forward(mx.io.DataBatch([mx.nd.array(x)], None), is_train=False)
-        z = feat.get_outputs()[0].asnumpy()
+        for k_ in model.args:
+            if k_ in ex.arg_dict and "enc" in k_:
+                model.args[k_][:] = ex.arg_dict[k_]
+        z = model.encode(x)
         d2 = ((z[:, None] - ex.arg_dict["centroids"].asnumpy()[None]) ** 2).sum(-1)
         qu = 1.0 / (1.0 + d2)
         q = qu / qu.sum(1, keepdims=True)

@@ -33,6 +33,10 @@ CASES = [
      ["--burn-in", "400", "--samples", "100", "--thin", "8",
       "--student-epochs", "200"], "BDK OK"),
     ("recommenders", "implicit.py", ["--epochs", "8"], "IMPLICIT OK"),
+    ("adversary", "adversary_generation.py", [], "ADVERSARY OK"),
+    ("adversary", "adversarial_training.py", [], "ADVTRAIN OK"),
+    ("autoencoder", "mnist_sae.py", [], "SAE OK"),
+    ("dec", "dec_cluster.py", [], "DEC OK"),
 ]
 
 
