@@ -44,7 +44,11 @@ def fit_model(xtr, ytr, batch_size, epochs, eps=None, pgd_steps=4,
     # defended run stable across seeds and XLA:CPU thread nondeterminism
     mod.init_optimizer(optimizer="adam",
                        optimizer_params={"learning_rate": 2e-3})
-    atk = bind_attacker(net, mod, b, xtr.shape[1:]) if eps else None
+    # the attacker only ever crafts the adversarial HALF of each batch,
+    # so bind it at b//2 — PGD's fwd+bwd loop dominates defended
+    # training and crafting then discarding a full batch doubles it
+    atk = (bind_attacker(net, mod, b // 2, xtr.shape[1:])
+           if eps else None)
     rng = np.random.RandomState(seed)
     idx = np.arange(xtr.shape[0])
     metric = mx.metric.Accuracy()
@@ -64,8 +68,8 @@ def fit_model(xtr, ytr, batch_size, epochs, eps=None, pgd_steps=4,
                 eps_e = eps * min(1.0, epoch / max(epochs - 3, 1))
                 x = x.copy()
                 h = b // 2
-                x[:h] = attacks.pgd(atk, x, y, eps_e, steps=pgd_steps,
-                                    rng=rng, clip=clip)[:h]
+                x[:h] = attacks.pgd(atk, x[:h], y[:h], eps_e,
+                                    steps=pgd_steps, rng=rng, clip=clip)
             batch = mx.io.DataBatch([mx.nd.array(x)], [mx.nd.array(y)])
             mod.forward(batch, is_train=True)
             mod.update_metric(metric, batch.label)

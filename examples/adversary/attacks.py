@@ -71,14 +71,20 @@ def pgd(mod, x, y, eps, alpha=None, steps=8, random_start=True,
 
 
 def accuracy(mod, x, y, batch_size=None):
-    """Clean-forward accuracy of a bound module on (x, y)."""
+    """Clean-forward accuracy of a bound module on ALL of (x, y); a
+    trailing partial batch is padded to the bound batch size and only
+    its valid rows counted."""
     b = batch_size or x.shape[0]
     correct = 0
-    for i in range(0, x.shape[0] - b + 1, b):
-        mod.forward(mx.io.DataBatch([mx.nd.array(x[i:i + b])],
-                                    [mx.nd.array(y[i:i + b])]),
+    for i in range(0, x.shape[0], b):
+        xb, yb = x[i:i + b], y[i:i + b]
+        valid = len(xb)
+        if valid < b:
+            pad = b - valid
+            xb = np.concatenate([xb, np.repeat(xb[:1], pad, axis=0)])
+            yb = np.concatenate([yb, np.repeat(yb[:1], pad, axis=0)])
+        mod.forward(mx.io.DataBatch([mx.nd.array(xb)], [mx.nd.array(yb)]),
                     is_train=False)
         pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
-        correct += int((pred == y[i:i + b]).sum())
-    n = (x.shape[0] // b) * b
-    return correct / float(n)
+        correct += int((pred[:valid] == y[i:i + b]).sum())
+    return correct / float(x.shape[0])

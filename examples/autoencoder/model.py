@@ -68,11 +68,14 @@ class MXModel(object):
         for i in range(0, n, batch_size):
             xb = x[i:i + batch_size]
             key = (id(symbol), xb.shape)
-            ex = cache.get(key)
+            hit = cache.get(key)
+            # the cached entry keeps a reference to its symbol: that both
+            # pins the id (no reuse after gc) and lets identity be checked
+            ex = hit[1] if hit is not None and hit[0] is symbol else None
             if ex is None:
                 ex = symbol.simple_bind(ctx=self.ctx, grad_req="null",
                                         data=xb.shape)
-                cache[key] = ex
+                cache[key] = (symbol, ex)
             for name, arr in self.args.items():
                 if name in ex.arg_dict:
                     ex.arg_dict[name][:] = arr

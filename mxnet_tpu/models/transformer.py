@@ -99,8 +99,12 @@ def get_symbol(num_classes=1000, **kwargs):
 
 # the compute-bound headline config (~220M params): big enough matmuls to
 # feed the MXU, small enough that Adam state + activations fit one v5e
-MFU_HEADLINE_CONFIG = dict(num_layers=12, num_heads=16, d_model=1024,
-                           d_ff=4096, seq_len=1024, vocab_size=32768)
+# chosen by the on-silicon sweep (docs/measured/lmmfu_r05.txt): the
+# d2048 8-layer config more than doubles the d1024 12-layer's MFU
+# (0.47-0.53 vs 0.24 at b8 on v5e) — wider matmuls feed the MXU better
+# than more layers at the same parameter budget
+MFU_HEADLINE_CONFIG = dict(num_layers=8, num_heads=16, d_model=2048,
+                           d_ff=8192, seq_len=1024, vocab_size=32768)
 
 
 def lm_train_flops_per_token(num_layers, d_model, d_ff, seq_len,

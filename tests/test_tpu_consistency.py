@@ -219,7 +219,7 @@ def test_tpu_module_training_end_to_end():
         # per-call latency (not compute) dominates; the jitted-step
         # training path is covered separately by tools/tpu_train_check.py
         mx.random.seed(0)
-        (X, Y), _ = get_synthetic_mnist(2048, 16)
+        (X, Y), _ = get_synthetic_mnist(1536, 16)
 
         net = mx.sym.Variable("data")
         net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=8)
@@ -230,14 +230,14 @@ def test_tpu_module_training_end_to_end():
         net = mx.sym.FullyConnected(net, num_hidden=10)
         net = mx.sym.SoftmaxOutput(net, name="softmax")
 
-        it = mx.io.NDArrayIter(X, Y, 64, shuffle=True)
+        it = mx.io.NDArrayIter(X, Y, 128, shuffle=True)
         mod = mx.mod.Module(net, context=mx.tpu(0))
         mod.fit(it, num_epoch=2, optimizer="sgd",
-                optimizer_params={"learning_rate": 0.1},
+                optimizer_params={"learning_rate": 0.15},
                 initializer=mx.init.Xavier())
         acc = mx.metric.Accuracy()
-        it.reset()
-        mod.score(it, acc)
+        sc = mx.io.NDArrayIter(X[:512], Y[:512], 128)
+        mod.score(sc, acc)
         print("TPU train accuracy:", acc.get()[1])
         assert acc.get()[1] > 0.9
         print("FAMILY OK")

@@ -133,16 +133,16 @@ def main():
     # medium-first: if the big config OOMs or hangs, the smaller numbers
     # are already on stdout
     configs = [
-        ("lm-220m-b8",  dict(head, B=8)),   # bench.py's headline config
-        ("lm-220m-b16", dict(head, B=16)),
-        ("lm-220m-b32", dict(head, B=32)),
-        ("lm-560m-b8",  dict(L=8, H=16, D=2048, d_ff=8192, T=1024,
+        ("lm-560m-b8",  dict(head, B=8)),   # bench.py's headline config
+        ("lm-560m-b16", dict(head, B=16)),
+        ("lm-220m-b8",  dict(L=12, H=16, D=1024, d_ff=4096, T=1024,
                              V=32768, B=8)),
+        ("lm-220m-b16", dict(L=12, H=16, D=1024, d_ff=4096, T=1024,
+                             V=32768, B=16)),
         ("lm-small-b8", dict(L=4, H=8, D=512, d_ff=2048, T=512,
                              V=8192, B=8)),  # bench.py extras continuity
-        ("lm-220m-T2048-b8", dict(L=12, H=16, D=1024, d_ff=4096,
-                                  T=2048, V=32768, B=8)),
-        ("lm-220m-b24", dict(head, B=24)),
+        ("lm-1b-b4",   dict(L=12, H=16, D=2560, d_ff=10240, T=1024,
+                            V=32768, B=4)),
     ]
     best = (None, 0.0, None)
     for name, cfg in configs:

@@ -29,7 +29,11 @@ PEAK_TFLOPS = 197.0   # v5e bf16 datasheet
 PEAK_GBS = 819.0      # v5e HBM datasheet
 
 
-def matmul_mfu(n, iters=50):
+def matmul_mfu(n, iters=None):
+    if iters is None:
+        # constant total FLOP across sizes, so the single dispatch+fetch
+        # round trip is amortized equally (~55 TFLOP ≈ 300ms at peak)
+        iters = max(1, round(50 * (8192 / n) ** 3))
     a = jnp.asarray(np.random.RandomState(0).normal(size=(n, n)),
                     jnp.bfloat16)
     b = jnp.asarray(np.random.RandomState(1).normal(size=(n, n)),
@@ -46,16 +50,22 @@ def matmul_mfu(n, iters=50):
 
         return jax.lax.fori_loop(0, iters, body, a)
 
-    jax.block_until_ready(chain(a, b))          # compile + warm
+    def fetch(out):
+        # block_until_ready can acknowledge at dispatch on tunneled
+        # backends (bench.py's discipline) — pulling real bytes is the
+        # only barrier that can't lie
+        return float(np.asarray(out[0, 0], np.float32))
+
+    fetch(chain(a, b))                          # compile + warm
     tic = time.perf_counter()
-    jax.block_until_ready(chain(a, b))          # ONE dispatch, iters matmuls
+    fetch(chain(a, b))                          # ONE dispatch, iters matmuls
     dt = time.perf_counter() - tic
     tflops = 2.0 * n * n * n * iters / dt / 1e12
     print(f"matmul {n}x{n}x{n} bf16: {tflops:8.1f} TFLOP/s  "
           f"mfu={tflops / PEAK_TFLOPS:.3f}", flush=True)
 
 
-def hbm_bandwidth(mb=512, iters=50):
+def hbm_bandwidth(mb=512, iters=100):
     n = mb * 1024 * 1024 // 4
     x = jnp.zeros((n,), jnp.float32)
     y = jnp.ones((n,), jnp.float32)
@@ -67,9 +77,12 @@ def hbm_bandwidth(mb=512, iters=50):
 
         return jax.lax.fori_loop(0, iters, body, x)
 
-    jax.block_until_ready(axpy_loop(x, y))
+    def fetch(out):
+        return float(np.asarray(out[0], np.float32))
+
+    fetch(axpy_loop(x, y))
     tic = time.perf_counter()
-    jax.block_until_ready(axpy_loop(x, y))
+    fetch(axpy_loop(x, y))
     dt = time.perf_counter() - tic
     # per iter: read c, read y, write out = 3 * mb
     gbs = 3 * mb * iters / 1024 / dt
