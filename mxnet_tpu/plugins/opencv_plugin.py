@@ -1,15 +1,18 @@
-"""OpenCV-plugin equivalent (parity: plugin/opencv — imdecode / resize /
+"""OpenCV plugin (parity: plugin/opencv — imdecode / resize /
 copyMakeBorder NDArray functions plus the python augment helpers in
 plugin/opencv/opencv.py).
 
-The reference plugin shells out to libopencv; this image lacks cv2, so
-the kernels ride the framework's own decode path (native libjpeg in
-src/jpeg_decode.cc when built, PIL otherwise — mxnet_tpu/image.py) and
-numpy/PIL for geometry.  API names and flag conventions follow the
-reference so scripts written against ``mx.plugins.opencv`` port over,
-with ONE deliberate deviation: channel order is **RGB** (matching the
-rest of mxnet_tpu's image pipeline), not cv2's BGR — ported scripts
-must flip any BGR-ordered mean/std constants.
+Like the reference plugin, the kernels call real libopencv (cv2) when
+it is importable: imdecode, resize and copyMakeBorder go straight to
+cv2 with the reference's flag values (which match cv2's numerically).
+Without cv2 the same API rides the framework's own decode path (native
+libjpeg in src/jpeg_decode.cc when built, PIL otherwise —
+mxnet_tpu/image.py) and numpy/PIL for geometry; results agree within
+interpolation tolerance (pinned by tests/test_plugins.py).
+
+ONE deliberate deviation from cv2 either way: channel order is **RGB**
+(matching the rest of mxnet_tpu's image pipeline), not BGR — ported
+scripts must flip any BGR-ordered mean/std constants.
 """
 from __future__ import annotations
 
@@ -18,6 +21,11 @@ import numpy as np
 from .. import image as _image
 from ..base import MXNetError
 from ..ndarray import NDArray, array
+
+try:  # real OpenCV when present — the reference plugin's backend
+    import cv2 as _cv2
+except ImportError:  # pragma: no cover - depends on image
+    _cv2 = None
 
 # cv2 flag parity
 INTER_NEAREST = 0
@@ -33,6 +41,18 @@ def imdecode(str_img, flag=1):
     """Decode a jpeg/png byte string into an HWC uint8 NDArray.
     flag=1 color, flag=0 grayscale (cv2.imdecode convention)."""
     raw = bytes(str_img)
+    if _cv2 is not None:
+        buf = np.frombuffer(raw, np.uint8)
+        mode = (_cv2.IMREAD_UNCHANGED if flag < 0
+                else _cv2.IMREAD_COLOR if flag else _cv2.IMREAD_GRAYSCALE)
+        img = _cv2.imdecode(buf, mode)
+        if img is None:
+            raise MXNetError("cv2.imdecode failed (corrupt stream?)")
+        if img.ndim == 2:
+            img = img[..., None]
+        elif img.shape[-1] >= 3:  # RGB contract (alpha stays last)
+            img = img[..., [2, 1, 0] + list(range(3, img.shape[-1]))]
+        return array(np.ascontiguousarray(img))
     img = _image.imdecode_np(raw)  # HWC uint8 (native libjpeg or PIL)
     if flag == 0:
         # ITU-R BT.601 luma over RGB-ordered channels
@@ -43,9 +63,17 @@ def imdecode(str_img, flag=1):
 
 def resize(src, size, interpolation=INTER_LINEAR):
     """Resize HWC image to `size` = (w, h) (cv2 size convention)."""
-    from PIL import Image
-
     data = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    if _cv2 is not None and data.dtype in (np.uint8, np.uint16,
+                                           np.float32, np.float64):
+        # flag values match cv2's numerically (INTER_* = 0/1/2); other
+        # dtypes (int64 from np.asarray of ints, float16, ...) fall
+        # through to the PIL plane path, which casts and restores
+        out = _cv2.resize(data, tuple(size), interpolation=interpolation)
+        if data.ndim == 3 and data.shape[-1] == 1:
+            out = out[..., None]  # cv2 drops the singleton channel
+        return array(np.ascontiguousarray(out))
+    from PIL import Image
     interp = _PIL_INTERP.get(interpolation, 2)
     if data.dtype == np.uint8:
         squeeze = data.shape[-1] == 1
@@ -76,6 +104,15 @@ def copyMakeBorder(src, top, bot, left, right, border_type=BORDER_CONSTANT,
                    value=0):
     """Pad an HWC image (cv2.copyMakeBorder)."""
     data = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    if _cv2 is not None and border_type in (BORDER_CONSTANT,
+                                            BORDER_REPLICATE):
+        # flag values match cv2's numerically (BORDER_* = 0/1)
+        val = value if isinstance(value, (tuple, list)) else [value] * 4
+        out = _cv2.copyMakeBorder(data, top, bot, left, right, border_type,
+                                  value=val)
+        if data.ndim == 3 and data.shape[-1] == 1 and out.ndim == 2:
+            out = out[..., None]
+        return array(np.ascontiguousarray(out))
     pads = ((top, bot), (left, right), (0, 0))
     if border_type == BORDER_CONSTANT:
         out = np.pad(data, pads, constant_values=value)

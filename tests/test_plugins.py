@@ -154,3 +154,48 @@ def test_opencv_crops_and_normalize():
     norm = cv.color_normalize(img, mean=(1.0, 2.0, 3.0), std=(2.0, 2.0, 2.0))
     expect = (img.asnumpy().astype(np.float32) - [1, 2, 3]) / 2.0
     assert np.allclose(norm.asnumpy(), expect)
+
+
+def test_opencv_cv2_and_fallback_agree():
+    """With real cv2 present (this image ships it), the cv2-backed
+    kernels and the PIL/native fallback must agree: exactly for
+    lossless decode and constant-pad, and in shape for resize (cv2 and
+    PIL nearest use different sampling grids, so pixel-exact resize
+    agreement is not a contract) — scripts keep working when the
+    plugin's backend changes."""
+    import io as _io
+
+    from PIL import Image
+
+    from mxnet_tpu.plugins import opencv_plugin as cv
+
+    if cv._cv2 is None:
+        import pytest
+
+        pytest.skip("cv2 not in this image")
+
+    rs = np.random.RandomState(4)
+    img = rs.randint(0, 255, (21, 17, 3), dtype=np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    raw = buf.getvalue()
+
+    via_cv2 = cv.imdecode(raw).asnumpy()
+    real_cv2, cv._cv2 = cv._cv2, None
+    try:
+        via_pil = cv.imdecode(raw).asnumpy()
+        small_pil = cv.resize(mx.nd.array(img), (8, 10),
+                              cv.INTER_NEAREST).asnumpy()
+        pad_pil = cv.copyMakeBorder(mx.nd.array(img), 1, 2, 3, 4,
+                                    value=9).asnumpy()
+    finally:
+        cv._cv2 = real_cv2
+    assert np.array_equal(via_cv2, via_pil)  # both lossless RGB
+
+    small_cv2 = cv.resize(mx.nd.array(img), (8, 10),
+                          cv.INTER_NEAREST).asnumpy()
+    assert small_cv2.shape == small_pil.shape == (10, 8, 3)
+
+    pad_cv2 = cv.copyMakeBorder(mx.nd.array(img), 1, 2, 3, 4,
+                                value=9).asnumpy()
+    assert np.array_equal(pad_cv2, pad_pil)

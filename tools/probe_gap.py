@@ -69,9 +69,15 @@ def framework(batch, iters=40):
         tr.step(**staged)
     host_dt = (time.perf_counter() - tic) / iters
     barrier()
+    note = ""
+    if host_dt >= dev_dt:
+        # the no-barrier loop came out SLOWER than the barriered one:
+        # the split's premise (dev >> host) failed this window — the
+        # call is host/transport-bound and the % is not a clean split
+        note = "  [host-bound window: split premise failed]"
     print(f"framework b{batch}: {batch / dev_dt:8.1f} img/s   "
           f"step {dev_dt * 1e3:6.2f} ms   host-side {host_dt * 1e3:5.2f} ms "
-          f"({host_dt / dev_dt * 100:4.1f}%)", flush=True)
+          f"({host_dt / dev_dt * 100:4.1f}%){note}", flush=True)
 
     # the fix the host-side split motivates: k steps per dispatch
     # (FusedTrainer.step_multi) pays the call cost once per k steps
