@@ -414,6 +414,20 @@ def _bench(dev, kind):
                 ddt = time.perf_counter() - dtic
                 extras["kv_decode_tokens_per_sec"] = round(
                     bsz * dn / ddt, 1)
+                # fused decode: the WHOLE n-token loop in one dispatch
+                # (generate_scan) — decode's analog of steps-per-call.
+                # The timed window includes the 8-token prefill dispatch
+                # generate_scan performs internally, so the reported
+                # rate (still counting only the 64 generated tokens) is
+                # a conservative lower bound on the scan loop itself
+                fn_tok = 64
+                dec.generate_scan(np.zeros((bsz, 8), np.int64),
+                                  fn_tok)           # compile
+                ftic = time.perf_counter()
+                dec.generate_scan(np.zeros((bsz, 8), np.int64), fn_tok)
+                fdt = time.perf_counter() - ftic
+                extras["kv_decode_fused_tokens_per_sec"] = round(
+                    bsz * fn_tok / fdt, 1)
             elif os.environ.get("BENCH_LM", "1") == "1":
                 extras["lm_skipped"] = "insufficient extras budget"
         except Exception as exc:  # noqa: BLE001

@@ -92,6 +92,7 @@ class KVDecoder:
         self._reorder_jit = jax.jit(
             lambda kc, vc, idx: (kc[:, idx], vc[:, idx]))
         self._prefill_cache = {}
+        self._scan_cache = {}
 
     def _cache_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -198,18 +199,26 @@ class KVDecoder:
             kc, vc, pos, jnp.asarray(token).reshape(-1, 1))
         return (kc, vc, pos + 1), logits[:, 0]
 
-    def generate(self, prompt, n_tokens, temperature=1.0, top_k=None,
-                 rng=None):
-        """Greedy/temperature sampling loop; returns (B, n_tokens)."""
-        rng = rng or np.random.RandomState(0)
+    def _check_generation_budget(self, prompt, n_tokens):
+        """Shared generate()/generate_scan() prologue: normalized prompt
+        plus the empty-result short-circuit (None when real work remains)."""
         prompt = np.asarray(prompt)
         total = prompt.shape[1] + n_tokens
         if total > self.max_len:
             raise ValueError(
                 f"prompt+n_tokens = {total} exceeds max_len "
                 f"{self.max_len} (the checkpoint's positional table)")
-        if n_tokens <= 0:
-            return np.zeros((prompt.shape[0], 0), np.int64)
+        empty = (np.zeros((prompt.shape[0], 0), np.int64)
+                 if n_tokens <= 0 else None)
+        return prompt, empty
+
+    def generate(self, prompt, n_tokens, temperature=1.0, top_k=None,
+                 rng=None):
+        """Greedy/temperature sampling loop; returns (B, n_tokens)."""
+        rng = rng or np.random.RandomState(0)
+        prompt, empty = self._check_generation_budget(prompt, n_tokens)
+        if empty is not None:
+            return empty
         state, logits = self.prefill(prompt)
         last = logits[:, -1]
         out = []
@@ -230,6 +239,62 @@ class KVDecoder:
             if i + 1 < n_tokens:  # the last sampled token needs no step
                 state, last = self.step(state, nxt)
         return np.stack(out, axis=1)
+
+    def generate_scan(self, prompt, n_tokens, temperature=0.0,
+                      top_k=None, seed=0):
+        """generate(), but the WHOLE autoregressive loop is one compiled
+        lax.scan — one dispatch for n_tokens steps instead of one per
+        token.  On high-latency links (the bench tunnel) per-token
+        dispatch dominates decode throughput the same way it dominated
+        small-batch training (trainer.step_multi); on a local host it
+        simply removes n-1 dispatches.  Greedy when temperature<=0,
+        otherwise categorical sampling (jax.random, seeded) with
+        optional static top_k.  Token-for-token equal to generate() in
+        greedy mode (pinned by tests/test_decode.py)."""
+        prompt, empty = self._check_generation_budget(prompt, n_tokens)
+        if empty is not None:
+            return empty
+        state, logits = self.prefill(prompt)
+        kc, vc, pos = state
+        key = (prompt.shape[0], n_tokens, float(temperature),
+               top_k or 0)
+        fn = self._scan_cache.get(key)
+        if fn is None:
+            greedy = temperature <= 0
+
+            def pick(lg, k_):
+                if top_k:
+                    kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+                    lg = jnp.where(lg < kth, NEG_INF, lg)
+                if greedy:
+                    return jnp.argmax(lg, axis=-1)
+                return jax.random.categorical(k_, lg / temperature)
+
+            def loop(kc, vc, pos0, last_logits, rng_key):
+                k0, krest = jax.random.split(rng_key)
+                first = pick(last_logits, k0)
+
+                def body(carry, i):
+                    kc, vc, tok, k_ = carry
+                    (kc, vc), lg = self._forward_positions(
+                        kc, vc, pos0 + i, tok[:, None], n=1)
+                    k_, sub = jax.random.split(k_)
+                    nxt = pick(lg[:, 0], sub)
+                    return (kc, vc, nxt, k_), nxt
+
+                (kc, vc, _, _), rest = jax.lax.scan(
+                    body, (kc, vc, first, krest),
+                    jnp.arange(n_tokens - 1, dtype=jnp.int32))
+                toks = jnp.concatenate(
+                    [first[:, None], rest.transpose(1, 0)], axis=1)
+                return kc, vc, toks
+
+            fn = jax.jit(loop)
+            self._scan_cache[key] = fn
+        kc, vc, toks = fn(kc, vc, jnp.int32(pos),
+                          logits[:, -1].astype(jnp.float32),
+                          jax.random.PRNGKey(seed))
+        return np.asarray(toks, np.int64)
 
     def beam_search(self, prompt, n_tokens, beam_size=4,
                     length_penalty=0.0, eos_id=None):
