@@ -241,7 +241,7 @@ class KVDecoder:
         return np.stack(out, axis=1)
 
     def generate_scan(self, prompt, n_tokens, temperature=0.0,
-                      top_k=None, seed=0):
+                      top_k=None, seed=0, eos_id=None):
         """generate(), but the WHOLE autoregressive loop is one compiled
         lax.scan — one dispatch for n_tokens steps instead of one per
         token.  On high-latency links (the bench tunnel) per-token
@@ -250,14 +250,20 @@ class KVDecoder:
         simply removes n-1 dispatches.  Greedy when temperature<=0,
         otherwise categorical sampling (jax.random, seeded) with
         optional static top_k.  Token-for-token equal to generate() in
-        greedy mode (pinned by tests/test_decode.py)."""
+        greedy mode (pinned by tests/test_decode.py).
+
+        With ``eos_id``, rows that emit it are eos-padded from then on
+        (beam_search's convention) and the loop becomes a
+        lax.while_loop that EXITS as soon as every row has finished —
+        early stopping happens on device, still within the single
+        dispatch."""
         prompt, empty = self._check_generation_budget(prompt, n_tokens)
         if empty is not None:
             return empty
         state, logits = self.prefill(prompt)
         kc, vc, pos = state
         key = (prompt.shape[0], n_tokens, float(temperature),
-               top_k or 0)
+               top_k or 0, eos_id if eos_id is not None else -1)
         fn = self._scan_cache.get(key)
         if fn is None:
             greedy = temperature <= 0
@@ -270,16 +276,21 @@ class KVDecoder:
                     return jnp.argmax(lg, axis=-1)
                 return jax.random.categorical(k_, lg / temperature)
 
+            def step_once(kc, vc, pos, tok, k_):
+                """ONE decode position + next-token pick — shared by the
+                scan and while_loop bodies so they cannot diverge."""
+                (kc, vc), lg = self._forward_positions(
+                    kc, vc, pos, tok[:, None], n=1)
+                k_, sub = jax.random.split(k_)
+                return kc, vc, pick(lg[:, 0], sub), k_
+
             def loop(kc, vc, pos0, last_logits, rng_key):
                 k0, krest = jax.random.split(rng_key)
                 first = pick(last_logits, k0)
 
                 def body(carry, i):
                     kc, vc, tok, k_ = carry
-                    (kc, vc), lg = self._forward_positions(
-                        kc, vc, pos0 + i, tok[:, None], n=1)
-                    k_, sub = jax.random.split(k_)
-                    nxt = pick(lg[:, 0], sub)
+                    kc, vc, nxt, k_ = step_once(kc, vc, pos0 + i, tok, k_)
                     return (kc, vc, nxt, k_), nxt
 
                 (kc, vc, _, _), rest = jax.lax.scan(
@@ -289,7 +300,33 @@ class KVDecoder:
                     [first[:, None], rest.transpose(1, 0)], axis=1)
                 return kc, vc, toks
 
-            fn = jax.jit(loop)
+            def loop_eos(kc, vc, pos0, last_logits, rng_key):
+                B = last_logits.shape[0]
+                k0, krest = jax.random.split(rng_key)
+                first = pick(last_logits, k0)
+                done0 = first == eos_id
+                buf = jnp.full((n_tokens, B), eos_id, jnp.int32)
+                buf = buf.at[0].set(first.astype(jnp.int32))
+
+                def cond(carry):
+                    i, kc, vc, tok, k_, done, buf = carry
+                    return jnp.logical_and(i < n_tokens - 1,
+                                           jnp.logical_not(done.all()))
+
+                def body(carry):
+                    i, kc, vc, tok, k_, done, buf = carry
+                    kc, vc, nxt, k_ = step_once(kc, vc, pos0 + i, tok, k_)
+                    nxt = jnp.where(done, eos_id, nxt)  # freeze finished
+                    done = jnp.logical_or(done, nxt == eos_id)
+                    buf = buf.at[i + 1].set(nxt.astype(jnp.int32))
+                    return (i + 1, kc, vc, nxt, k_, done, buf)
+
+                (_, kc, vc, _, _, _, buf) = jax.lax.while_loop(
+                    cond, body,
+                    (jnp.int32(0), kc, vc, first, krest, done0, buf))
+                return kc, vc, buf.transpose(1, 0)
+
+            fn = jax.jit(loop if eos_id is None else loop_eos)
             self._scan_cache[key] = fn
         kc, vc, toks = fn(kc, vc, jnp.int32(pos),
                           logits[:, -1].astype(jnp.float32),

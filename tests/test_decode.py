@@ -173,3 +173,35 @@ def test_generate_scan_matches_generate_greedy():
     # single-token edge: no scan iterations at all
     one = dec.generate_scan(prompt, 1, temperature=0.0)
     np.testing.assert_array_equal(one, ref[:, :1])
+
+
+def test_generate_scan_eos_early_exit():
+    """eos rows freeze to eos-padding (beam_search's convention) and the
+    device while_loop exits once every row finished: the no-eos scan
+    output must agree with the eos run up to each row's first eos."""
+    _, params, rs = _bound_model()
+    dec = KVDecoder(params, num_layers=L, num_heads=H, max_len=T)
+    prompt = rs.randint(0, V, (3, 3))
+    n = 8
+    free = dec.generate_scan(prompt, n, temperature=0.0)
+    # choose an eos the greedy run actually emits mid-sequence
+    eos = int(free[0, 2])
+    got = dec.generate_scan(prompt, n, temperature=0.0, eos_id=eos)
+    assert got.shape == free.shape
+    for r in range(free.shape[0]):
+        hits = np.where(free[r] == eos)[0]
+        cut = (hits[0] + 1) if len(hits) else n
+        np.testing.assert_array_equal(got[r, :cut], free[r, :cut])
+        assert (got[r, cut:] == eos).all()
+    # sampling path of the eos loop: same prefix property vs the
+    # identically-seeded no-eos sampled run (rng key handling must not
+    # diverge between the scan and while_loop bodies)
+    s_free = dec.generate_scan(prompt, n, temperature=0.8, seed=5)
+    s_eos = int(s_free[1, 1])
+    s_got = dec.generate_scan(prompt, n, temperature=0.8, seed=5,
+                              eos_id=s_eos)
+    for r in range(s_free.shape[0]):
+        hits = np.where(s_free[r] == s_eos)[0]
+        cut = (hits[0] + 1) if len(hits) else n
+        np.testing.assert_array_equal(s_got[r, :cut], s_free[r, :cut])
+        assert (s_got[r, cut:] == s_eos).all()
