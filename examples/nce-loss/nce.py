@@ -1,0 +1,90 @@
+"""Shared NCE building blocks (parity: example/nce-loss/nce.py — the
+reference's NceOutput construction reused by its toy/wordvec/LSTM
+scripts).
+
+Noise-contrastive estimation trains a large-vocabulary output layer by
+scoring the true class against k sampled noise classes: the graph
+embeds (target ∪ negatives) through the OUTPUT embedding + bias, dots
+with the hidden vector, and trains binary targets [1, 0, ..., 0] with
+LogisticRegressionOutput — O(k) per example instead of O(V).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import sym  # noqa: E402
+
+
+def nce_output(hidden, cand, nce_label, batch, k, vocab, embed,
+               prefix="out"):
+    """Score `hidden` (N, embed) against k+1 candidate classes.
+
+    cand (N, k+1) carries [target, negatives]; returns the sigmoid
+    probabilities symbol (N, k+1) trained against nce_label."""
+    out_embed = sym.Embedding(cand, input_dim=vocab, output_dim=embed,
+                              name=f"{prefix}_embed")   # (N, k+1, E)
+    out_bias = sym.Embedding(cand, input_dim=vocab, output_dim=1,
+                             name=f"{prefix}_bias")     # (N, k+1, 1)
+    h = sym.Reshape(hidden, shape=(batch, 1, embed))
+    logits = sym.batch_dot(out_embed, h, transpose_b=True)
+    logits = sym.Reshape(logits + out_bias, shape=(batch, k + 1))
+    return sym.LogisticRegressionOutput(logits, nce_label,
+                                        name=f"{prefix}_nce")
+
+
+def nce_labels(batch, k):
+    """The fixed binary targets: column 0 (the true class) is 1."""
+    labels = np.zeros((batch, k + 1), np.float32)
+    labels[:, 0] = 1.0
+    return labels
+
+
+class UnigramSampler:
+    """Negative sampler over the word2vec-standard unigram^0.75
+    distribution (parity: the reference's frequency-weighted negative
+    table in nce.py's data layers)."""
+
+    def __init__(self, counts, power=0.75, seed=0):
+        p = np.asarray(counts, np.float64) ** power
+        self._p = p / p.sum()
+        self._rs = np.random.RandomState(seed)
+        self._n = len(counts)
+
+    def draw(self, shape):
+        return self._rs.choice(self._n, size=shape,
+                               p=self._p).astype(np.float32)
+
+
+def init_and_updater(ex, lr, seed=None):
+    """Shared trainer plumbing for the example scripts: Xavier-init all
+    *_weight args of a bound executor and return (params, update_fn)
+    where update_fn() applies the adam step over them in sorted order."""
+    import mxnet_tpu as mx
+
+    init = mx.init.Xavier()
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name.endswith("weight"):
+            init(name, arr)
+            params[name] = arr
+    opt = mx.optimizer.create("adam", learning_rate=lr)
+    updater = mx.optimizer.get_updater(opt)
+    ordered = sorted(params.items())
+
+    def update():
+        for i, (name, arr) in enumerate(ordered):
+            updater(i, ex.grad_dict[name], arr)
+
+    return params, update
+
+
+def full_vocab_accuracy(ctx_ids, tgt_ids, in_w, out_w, out_b):
+    """Eval an NCE-trained model the honest way: score ALL classes with
+    the learned output embedding and take the argmax."""
+    h = in_w[ctx_ids.astype(int)]                      # (N, E)
+    logits = h @ out_w.T + out_b[:, 0][None, :]        # (N, V)
+    return float((logits.argmax(1) == tgt_ids.astype(int)).mean())

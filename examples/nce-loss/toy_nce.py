@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""NCE on the toy association task (parity: example/nce-loss/toy_nce.py
+— identical task to toy_softmax.py, but the V-way softmax is replaced
+by noise-contrastive estimation over k=8 unigram^0.75-sampled
+negatives, O(k) instead of O(V) per example).
+
+Self-asserting the approximation claim: evaluated by FULL-vocabulary
+scoring (nce.full_vocab_accuracy), the NCE-trained embeddings must
+reach accuracy comparable to the exact-softmax twin.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+import nce  # noqa: E402
+from toy_softmax import VOCAB, EMBED, synth_corpus  # noqa: E402
+
+K = 8  # negatives per positive
+
+
+def build(batch):
+    data = sym.Variable("data")            # (N,) context word id
+    cand = sym.Variable("cand")            # (N, K+1) [target, negatives]
+    nce_label = sym.Variable("nce_label")  # (N, K+1) [1, 0, ...]
+    hidden = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                           name="in_embed")           # (N, EMBED)
+    return nce.nce_output(hidden, cand, nce_label, batch, K, VOCAB,
+                          EMBED)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--min-acc", type=float, default=0.85)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+
+    net = build(args.batch)
+    ex = net.simple_bind(ctx=mx.context.default_accelerator_context(),
+                         grad_req="write", data=(args.batch,),
+                         cand=(args.batch, K + 1),
+                         nce_label=(args.batch, K + 1))
+    params, update = nce.init_and_updater(ex, lr=0.01)
+    labels = nce.nce_labels(args.batch, K)
+
+    # negatives by unigram^0.75 over the Zipf corpus frequencies
+    big_ctx, _ = synth_corpus(rs, 20000)
+    counts = np.bincount(big_ctx.astype(int), minlength=VOCAB) + 1
+    sampler = nce.UnigramSampler(counts, seed=1)
+
+    first = last = None
+    for step in range(args.steps):
+        ctx, tgt = synth_corpus(rs, args.batch)
+        negs = sampler.draw((args.batch, K))
+        cand = np.concatenate([tgt[:, None], negs], axis=1)
+        ex.forward(is_train=True, data=ctx, cand=cand, nce_label=labels)
+        ex.backward()
+        update()
+        p = ex.outputs[0].asnumpy()
+        loss = -(labels * np.log(np.maximum(p, 1e-8))
+                 + (1 - labels) * np.log(np.maximum(1 - p, 1e-8))).mean()
+        first = loss if first is None else first
+        last = loss
+        if step % 100 == 0:
+            print(f"step {step}: nce loss {loss:.4f}")
+    assert last < first * 0.7, (first, last)
+
+    # honest eval: score the FULL vocabulary with the learned tables
+    ctx, tgt = synth_corpus(rs, 512)
+    acc = nce.full_vocab_accuracy(
+        ctx, tgt,
+        ex.arg_dict["in_embed_weight"].asnumpy(),
+        ex.arg_dict["out_embed_weight"].asnumpy(),
+        ex.arg_dict["out_bias_weight"].asnumpy())
+    assert acc >= args.min_acc, acc
+    print("NCE OK acc %.3f (k=%d vs V=%d)" % (acc, K, VOCAB))
+
+
+if __name__ == "__main__":
+    main()

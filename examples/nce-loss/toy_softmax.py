@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Full-softmax baseline for the toy association task (parity:
+example/nce-loss/toy_softmax.py — the reference pairs every NCE script
+with its exact-softmax twin so the approximation quality is visible).
+
+The task: learn tgt = (ctx * 7 + 1) mod V from (ctx, tgt) pairs drawn
+with Zipf-distributed contexts.  toy_nce.py trains the same task with
+k=8 sampled negatives instead of the V-way softmax; run both and
+compare the printed accuracies.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+VOCAB, EMBED = 500, 32
+
+
+def synth_corpus(rs, n):
+    """Skip-gram pairs from a Zipf corpus with strong co-occurrence."""
+    ctx = rs.zipf(1.5, n).clip(1, VOCAB - 1)
+    tgt = (ctx * 7 + 1) % VOCAB  # deterministic association to learn
+    return ctx.astype(np.float32), tgt.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--min-acc", type=float, default=0.9)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+
+    data = sym.Variable("data")
+    net = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                        name="in_embed")
+    net = sym.FullyConnected(net, num_hidden=VOCAB, name="out")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (args.batch,))],
+             label_shapes=[("softmax_label", (args.batch,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.Accuracy()
+    for step in range(args.steps):
+        ctx, tgt = synth_corpus(rs, args.batch)
+        batch = mx.io.DataBatch([mx.nd.array(ctx)], [mx.nd.array(tgt)])
+        mod.forward(batch, is_train=True)
+        mod.update_metric(metric, batch.label)
+        mod.backward()
+        mod.update()
+        if step % 100 == 0:
+            print(f"step {step}: train acc {metric.get()[1]:.3f}")
+            metric.reset()
+
+    ctx, tgt = synth_corpus(rs, 512)
+    correct = n_eval = 0
+    # full batches only: the Module is bound to a fixed batch shape
+    for i in range(0, 512 - args.batch + 1, args.batch):
+        b = mx.io.DataBatch([mx.nd.array(ctx[i:i + args.batch])],
+                            [mx.nd.array(tgt[i:i + args.batch])])
+        mod.forward(b, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(1)
+        correct += int((pred == tgt[i:i + args.batch]).sum())
+        n_eval += args.batch
+    acc = correct / float(n_eval)
+    assert acc >= args.min_acc, acc
+    print("SOFTMAX OK acc %.3f" % acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Skip-gram word vectors with NCE (parity: example/nce-loss/wordvec.py
+— word2vec-style embeddings trained with sampled negatives instead of
+the full-vocabulary softmax).
+
+Synthetic corpus with known topical structure: the vocabulary is split
+into C topics and every sentence stays inside one topic, so skip-gram
+co-occurrence is purely intra-topic.  After training, embeddings must
+recover that structure: mean intra-topic cosine similarity has to beat
+inter-topic by a clear margin.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+import nce  # noqa: E402
+
+VOCAB, EMBED, K, TOPICS = 240, 24, 6, 8
+
+
+def make_pairs(rs, n_pairs):
+    """Skip-gram (center, context) pairs, both from the same topic."""
+    words_per = VOCAB // TOPICS
+    topic = rs.randint(0, TOPICS, n_pairs)
+    center = topic * words_per + rs.randint(0, words_per, n_pairs)
+    context = topic * words_per + rs.randint(0, words_per, n_pairs)
+    return center.astype(np.float32), context.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=500)
+    ap.add_argument("--min-margin", type=float, default=0.2)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+
+    data = sym.Variable("data")
+    cand = sym.Variable("cand")
+    nce_label = sym.Variable("nce_label")
+    hidden = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                           name="in_embed")
+    net = nce.nce_output(hidden, cand, nce_label, args.batch, K, VOCAB,
+                         EMBED)
+    ex = net.simple_bind(ctx=mx.context.default_accelerator_context(),
+                         grad_req="write", data=(args.batch,),
+                         cand=(args.batch, K + 1),
+                         nce_label=(args.batch, K + 1))
+    params, update = nce.init_and_updater(ex, lr=0.02)
+    labels = nce.nce_labels(args.batch, K)
+    sampler = nce.UnigramSampler(np.ones(VOCAB), seed=1)  # uniform corpus
+
+    for step in range(args.steps):
+        center, context = make_pairs(rs, args.batch)
+        negs = sampler.draw((args.batch, K))
+        candv = np.concatenate([context[:, None], negs], axis=1)
+        ex.forward(is_train=True, data=center, cand=candv,
+                   nce_label=labels)
+        ex.backward()
+        update()
+
+    w = ex.arg_dict["in_embed_weight"].asnumpy()
+    w = w / np.maximum(np.linalg.norm(w, axis=1, keepdims=True), 1e-8)
+    sim = w @ w.T
+    words_per = VOCAB // TOPICS
+    topic_of = np.arange(VOCAB) // words_per
+    same = topic_of[:, None] == topic_of[None, :]
+    np.fill_diagonal(same, False)
+    intra = float(sim[same].mean())
+    inter = float(sim[~same & ~np.eye(VOCAB, dtype=bool)].mean())
+    margin = intra - inter
+    print(f"intra-topic cos {intra:.3f}  inter-topic {inter:.3f}  "
+          f"margin {margin:.3f}")
+    assert margin >= args.min_margin, (intra, inter)
+    print("WORDVEC OK margin %.3f" % margin)
+
+
+if __name__ == "__main__":
+    main()

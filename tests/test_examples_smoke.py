@@ -37,6 +37,9 @@ CASES = [
     ("adversary", "adversarial_training.py", [], "ADVTRAIN OK"),
     ("autoencoder", "mnist_sae.py", [], "SAE OK"),
     ("dec", "dec_cluster.py", [], "DEC OK"),
+    ("nce-loss", "toy_softmax.py", [], "SOFTMAX OK"),
+    ("nce-loss", "toy_nce.py", [], "NCE OK"),
+    ("nce-loss", "wordvec.py", ["--steps", "350"], "WORDVEC OK"),
 ]
 
 
