@@ -1,0 +1,88 @@
+"""Text-data plumbing for the CNN classifier (parity:
+example/cnn_text_classification/data_helpers.py — the reference's
+loader cleans raw sentences, builds a vocabulary, pads to a fixed
+length, and yields shuffled (x, y) arrays; same pipeline here over any
+iterable of (text, label) pairs, with a synthetic sentiment corpus
+generator standing in for the MR dataset this image cannot download).
+"""
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+PAD, UNK = "<pad>", "<unk>"
+
+
+def clean_str(s):
+    """Reference-style token normalization (punctuation split,
+    lowercase)."""
+    s = re.sub(r"[^A-Za-z0-9(),!?'`]", " ", s)
+    for p in ("'s", "'ve", "n't", "'re", "'d", "'ll"):
+        s = s.replace(p, " " + p)
+    s = re.sub(r"([(),!?])", r" \1 ", s)
+    s = re.sub(r"\s{2,}", " ", s)
+    return s.strip().lower()
+
+
+def build_vocab(sentences, max_vocab=None):
+    """token -> id, with <pad>=0 and <unk>=1, most-frequent-first."""
+    from collections import Counter
+
+    counts = Counter(tok for s in sentences for tok in s.split())
+    items = counts.most_common(None if max_vocab is None
+                               else max_vocab - 2)
+    vocab = {PAD: 0, UNK: 1}
+    for tok, _ in items:
+        vocab[tok] = len(vocab)
+    return vocab
+
+
+def pad_and_index(sentences, vocab, seq_len):
+    """(N, seq_len) int array: tokens -> ids, truncated/right-padded."""
+    out = np.zeros((len(sentences), seq_len), np.float32)
+    unk = vocab[UNK]
+    for i, s in enumerate(sentences):
+        for j, tok in enumerate(s.split()[:seq_len]):
+            out[i, j] = vocab.get(tok, unk)
+    return out
+
+
+def load_corpus(pairs, seq_len, max_vocab=None, seed=0):
+    """(texts, labels) -> shuffled (x (N,seq_len), y (N,), vocab)."""
+    texts = [clean_str(t) for t, _ in pairs]
+    y = np.asarray([l for _, l in pairs], np.float32)
+    vocab = build_vocab(texts, max_vocab)
+    x = pad_and_index(texts, vocab, seq_len)
+    rs = np.random.RandomState(seed)
+    idx = rs.permutation(len(x))
+    return x[idx], y[idx], vocab
+
+
+# --------------------------------------------------------------------------
+# Synthetic sentiment corpus (the MR dataset needs a download this image
+# cannot make; the generator produces raw TEXT so the whole pipeline
+# above still runs for real)
+# --------------------------------------------------------------------------
+_POS = ("great wonderful moving superb delightful brilliant touching "
+        "charming").split()
+_NEG = ("dull tedious lifeless boring clumsy shallow bland stale").split()
+_FILL = ("the a this that film movie plot actor scene story it was is "
+         "with and of really quite very").split()
+
+
+def synthetic_reviews(n, rs=None):
+    """n raw (sentence, label) pairs with injected sentiment words."""
+    rs = rs or np.random.RandomState(0)
+    pairs = []
+    for _ in range(n):
+        y = int(rs.randint(0, 2))
+        words = list(rs.choice(_FILL, rs.randint(8, 16)))
+        bank = _POS if y else _NEG
+        for w in rs.choice(bank, 3):
+            words.insert(int(rs.randint(0, len(words) + 1)), w)
+        pairs.append((" ".join(words) + ("!" if y else "."), y))
+    return pairs

@@ -1,11 +1,14 @@
 #!/usr/bin/env python
-"""CNN text classification (parity: example/cnn_text_classification/).
+"""CNN text classification (parity: example/cnn_text_classification/
+text_cnn.py, Kim 2014).
 
-Kim-2014 architecture as in the reference's text_cnn.py: embedding ->
-parallel conv branches with filter widths 3/4/5 over the token axis ->
-max-over-time pooling -> concat -> dropout -> FC -> softmax.  Synthetic
-sentiment task: sentences containing "positive" token clusters vs
-"negative" ones.
+Architecture as in the reference: embedding -> parallel conv branches
+with filter widths 3/4/5 over the token axis -> max-over-time pooling
+-> concat -> dropout -> FC -> softmax.  The data path is the full
+data_helpers pipeline (clean raw text, build vocab, pad+index) over a
+synthetic review corpus; training keeps the best-dev checkpoint and the
+final score runs through a RELOADED module, proving the save/load round
+trip the reference's deployment path relies on.
 """
 import argparse
 import os
@@ -19,13 +22,15 @@ import numpy as np  # noqa: E402
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import sym  # noqa: E402
 
-VOCAB, SEQ, EMBED = 120, 24, 16
+import data_helpers  # noqa: E402
+
+SEQ, EMBED = 24, 16
 
 
-def build(batch):
+def build(batch, vocab_size):
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
-    embed = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+    embed = sym.Embedding(data, input_dim=vocab_size, output_dim=EMBED,
                           name="embed")
     # (N, 1, SEQ, EMBED) image-like layout, as the reference reshapes
     x = sym.Reshape(embed, shape=(batch, 1, SEQ, EMBED))
@@ -43,39 +48,62 @@ def build(batch):
     return sym.SoftmaxOutput(fc, label, name="softmax")
 
 
-def synth(rs, n):
-    x = rs.randint(20, VOCAB, (n, SEQ)).astype(np.float32)
-    y = rs.randint(0, 2, n).astype(np.float32)
-    for i in range(n):
-        # sentiment tokens: ids 1-9 positive, 10-18 negative
-        toks = rs.randint(1, 10, 4) if y[i] > 0 else rs.randint(10, 19, 4)
-        pos = rs.choice(SEQ, 4, replace=False)
-        x[i, pos] = toks
-    return x, y
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=32)
-    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--prefix", type=str, default="/tmp/text_cnn")
     args = ap.parse_args()
     rs = np.random.RandomState(0)
-    xtr, ytr = synth(rs, 512)
-    xte, yte = synth(rs, 128)
+    mx.random.seed(0)
 
-    mod = mx.mod.Module(build(args.batch),
-                        context=mx.context.default_accelerator_context())
-    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch, shuffle=True)
-    val = mx.io.NDArrayIter(xte, yte, batch_size=args.batch)
-    mod.fit(train, eval_data=val, num_epoch=args.epochs,
-            optimizer="adam", optimizer_params={"learning_rate": 2e-3},
-            initializer=mx.init.Xavier(),
-            eval_metric="acc",
-            batch_end_callback=mx.callback.Speedometer(args.batch, 8))
-    score = mod.score(val, mx.metric.create("acc"))
-    acc = dict(score)["accuracy"]
-    print(f"val acc {acc:.3f}")
-    assert acc > 0.8, acc
+    # raw text -> cleaned/indexed/padded arrays through data_helpers
+    pairs = data_helpers.synthetic_reviews(768, rs)
+    x, y, vocab = data_helpers.load_corpus(pairs, SEQ)
+    n_dev = 128
+    xtr, ytr = x[:-n_dev], y[:-n_dev]
+    xde, yde = x[-n_dev:], y[-n_dev:]
+    print(f"vocab {len(vocab)} train {len(xtr)} dev {len(xde)}")
+
+    net = build(args.batch, len(vocab))
+    ctx = mx.context.default_accelerator_context()
+    mod = mx.mod.Module(net, context=ctx)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch,
+                              shuffle=True)
+    dev = mx.io.NDArrayIter(xde, yde, batch_size=args.batch)
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 2e-3})
+
+    best = (-1.0, -1)  # (dev acc, epoch) — keep the best checkpoint
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.epochs):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        dev.reset()
+        dev_acc = dict(mod.score(dev, mx.metric.create("acc")))["accuracy"]
+        print(f"epoch {epoch}: train acc {metric.get()[1]:.3f} "
+              f"dev acc {dev_acc:.3f}")
+        if dev_acc > best[0]:
+            best = (dev_acc, epoch)
+            mod.save_checkpoint(args.prefix, epoch)
+
+    # deployment path: reload the BEST checkpoint into a fresh module
+    loaded = mx.mod.Module.load(args.prefix, best[1], context=ctx)
+    loaded.bind(data_shapes=dev.provide_data,
+                label_shapes=dev.provide_label, for_training=False)
+    dev.reset()
+    acc = dict(loaded.score(dev, mx.metric.create("acc")))["accuracy"]
+    print(f"reloaded best (epoch {best[1]}) dev acc {acc:.3f}")
+    assert abs(acc - best[0]) < 1e-6, (acc, best)
+    assert acc > 0.85, acc
     print("TRAIN OK")
 
 

@@ -112,6 +112,11 @@ class Module(BaseModule):
              grad_req="write"):
         """Parity: Module.bind (module.py:276)."""
         if force_rebind:
+            if self.binded and self.params_initialized:
+                # pull the trained values out of the executors BEFORE
+                # discarding them — the push below would otherwise hand
+                # the fresh executors stale init-time _arg_params
+                self._sync_params_from_devices()
             self._exec_group = None
             self.binded = False
         if self.binded:
@@ -133,6 +138,12 @@ class Module(BaseModule):
             fixed_param_names=self._fixed_param_names, grad_req=grad_req)
         if shared_module is not None and shared_module.params_initialized:
             self.set_params(*shared_module.get_params())
+        elif self.params_initialized and self._arg_params is not None:
+            # re-bind (or Module.load -> bind): the fresh executors must
+            # receive the parameters this module already holds — the
+            # reference's bind pushes them the same way (module.py:276)
+            self._exec_group.set_params(self._arg_params,
+                                        self._aux_params or {})
 
     # ----------------------------------------------------------------- params
     def get_params(self):

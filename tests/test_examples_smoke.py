@@ -40,6 +40,7 @@ CASES = [
     ("nce-loss", "toy_softmax.py", [], "SOFTMAX OK"),
     ("nce-loss", "toy_nce.py", [], "NCE OK"),
     ("nce-loss", "wordvec.py", ["--steps", "350"], "WORDVEC OK"),
+    ("cnn_text_classification", "text_cnn.py", [], "TRAIN OK"),
 ]
 
 

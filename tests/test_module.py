@@ -333,3 +333,55 @@ def test_shared_module_params_track_donor_updates():
                                    atol=1e-5)
         outs.append(got.copy())
     assert not np.allclose(outs[0], outs[-1])  # it really moved
+
+
+def test_module_load_then_bind_restores_params():
+    """Module.load -> bind -> score must run with the CHECKPOINT's
+    parameters: bind() on a params_initialized module pushes the held
+    params into the fresh executors (parity: the reference's bind,
+    module.py:276 — this exact flow is every deployment script's
+    first three lines)."""
+    import tempfile
+
+    (xtr, ytr), _ = get_synthetic_mnist(256, 64)
+    net = _mlp_sym()
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(xtr, ytr, batch_size=32, shuffle=True)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier())
+    it.reset()
+    ref = mod.score(it, "acc")[0][1]
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = d + "/m"
+        mod.save_checkpoint(prefix, 0)
+        loaded = mx.mod.Module.load(prefix, 0)
+        loaded.bind(data_shapes=it.provide_data,
+                    label_shapes=it.provide_label, for_training=False)
+        it.reset()
+        got = loaded.score(it, "acc")[0][1]
+    assert abs(got - ref) < 1e-6, (got, ref)
+
+
+def test_module_force_rebind_keeps_trained_params():
+    """force_rebind after training must carry the TRAINED parameters
+    into the fresh executors (bind syncs from devices before discarding
+    them), e.g. re-binding to a new batch size for deployment."""
+    (xtr, ytr), _ = get_synthetic_mnist(256, 64)
+    net = _mlp_sym()
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(xtr, ytr, batch_size=32, shuffle=True)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier())
+    it.reset()
+    ref = mod.score(it, "acc")[0][1]
+    assert ref > 0.8, ref
+
+    big = mx.io.NDArrayIter(xtr, ytr, batch_size=64)
+    mod.bind(data_shapes=big.provide_data,
+             label_shapes=big.provide_label, for_training=False,
+             force_rebind=True)
+    got = mod.score(big, "acc")[0][1]
+    assert abs(got - ref) < 0.02, (got, ref)
