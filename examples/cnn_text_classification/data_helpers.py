@@ -5,14 +5,9 @@ length, and yields shuffled (x, y) arrays; same pipeline here over any
 iterable of (text, label) pairs, with a synthetic sentiment corpus
 generator standing in for the MR dataset this image cannot download).
 """
-import os
 import re
-import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "..", ".."))
-
-import numpy as np  # noqa: E402
+import numpy as np
 
 PAD, UNK = "<pad>", "<unk>"
 

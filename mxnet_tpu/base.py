@@ -185,3 +185,33 @@ def mxu_precision(*arrays):
         if dt is not None and str(dt) in low:
             return jax.lax.Precision.DEFAULT
     return None
+
+
+def conv_precision(*arrays):
+    """Per-op precision for CONVOLUTIONS: single MXU pass unless opted out.
+
+    Convs deliberately do NOT inherit the fp32 multi-pass policy that
+    matmuls get from ``jax_default_matmul_precision=float32``:
+
+    - XLA:TPU lowers a multi-pass (bf16x3/x6 emulated-fp32) convolution
+      through a rewrite whose compile time blows up superlinearly in
+      spatial size — measured on v5e: a single f32 5x5 conv on
+      (128,1,28,28) compiles in ~27 s single-pass but did not finish in
+      >8 min at HIGH or HIGHEST (forward alone), while 16x16 still
+      compiled in ~70 s.  Training-shaped conv nets in fp32 were
+      effectively uncompilable.
+    - bf16 inputs with fp32 accumulation is the canonical TPU conv path;
+      consistency vs fp32 reference math holds to ~1e-2 relative
+      (tests/test_tpu_consistency.py gates at 2e-2).
+
+    ``MXNET_TPU_CONV_PRECISION=float32`` (or ``highest``/``high``)
+    restores emulated wide-precision convs for small-shape use.
+    """
+    import jax
+
+    pref = os.environ.get("MXNET_TPU_CONV_PRECISION", "").lower()
+    if pref in ("float32", "highest"):
+        return jax.lax.Precision.HIGHEST
+    if pref in ("high", "bfloat16_3x", "tensorfloat32"):
+        return jax.lax.Precision.HIGH
+    return jax.lax.Precision.DEFAULT

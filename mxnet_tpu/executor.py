@@ -139,11 +139,12 @@ def _cl_adapt(node, ins, lay):
     return [(_to_nchw(x) if l else x) for x, l in zip(ins, inlay)], attrs, False
 
 
-def _eval_node(node, topo_index, env, key, is_train, lay=None):
+def _eval_node(node, topo_index, env, key, is_train, lay=None, platform=None):
     """Evaluate one op node into env; returns {aux_name: new_val} updates.
 
     ``lay`` (entry -> is_nhwc) enables the channels-last pass; None keeps
-    plain NCHW evaluation (the placed/segment path).
+    plain NCHW evaluation (the placed/segment path).  ``platform`` is the
+    execution platform threaded into OpCtx (see registry.OpCtx).
     """
     od = ops.get(node.op)
     ins = [env[id(src)][oidx] for src, oidx in node.inputs]
@@ -154,6 +155,7 @@ def _eval_node(node, topo_index, env, key, is_train, lay=None):
     octx = ops.OpCtx(
         is_train=is_train,
         key=jax.random.fold_in(key, topo_index) if od.needs_rng else None,
+        platform=platform,
     )
     res = od.fn(octx, *ins, **attrs)
     aux_updates = {}
@@ -171,7 +173,8 @@ def _eval_node(node, topo_index, env, key, is_train, lay=None):
     return aux_updates
 
 
-def _build_graph_fn(symbol: Symbol, channels_last: Optional[bool] = None):
+def _build_graph_fn(symbol: Symbol, channels_last: Optional[bool] = None,
+                    platform: Optional[str] = None):
     """Build f(arg_dict, aux_dict, key, is_train) -> (outputs, new_aux_dict).
 
     This is the tracing equivalent of GraphExecutor::InitCachedOps
@@ -179,7 +182,9 @@ def _build_graph_fn(symbol: Symbol, channels_last: Optional[bool] = None):
     jax.jit so every node fuses into a single XLA program.  With
     ``channels_last`` (default from MXTPU_CONV_LAYOUT) 4D activation
     chains execute NHWC; graph outputs are always converted back to the
-    logical NCHW layout.
+    logical NCHW layout.  ``platform`` tells platform-sensitive ops
+    (FlashAttention: Pallas vs lax) what they will lower for; None means
+    "the default backend".
     """
     if channels_last is None:
         channels_last = channels_last_default()
@@ -197,7 +202,8 @@ def _build_graph_fn(symbol: Symbol, channels_last: Optional[bool] = None):
                 else:
                     env[id(node)] = (arg_vals[node.name],)
                 continue
-            new_aux.update(_eval_node(node, i, env, key, is_train, lay))
+            new_aux.update(_eval_node(node, i, env, key, is_train, lay,
+                                      platform))
         outputs = [
             _to_nchw(env[id(n)][i]) if lay and lay.get((id(n), i))
             else env[id(n)][i]
@@ -274,13 +280,16 @@ class _Segment:
         nodes, indices = self.nodes, self.indices
         inputs, outputs = self.inputs, self.outputs
 
+        platform = getattr(self.device, "platform", None)
+
         def seg_fn(in_vals, key, is_train):
             env = {}
             for (nid, oidx), v in zip(inputs, in_vals):
                 env.setdefault(nid, {})[oidx] = v
             aux_updates = {}
             for node, gi in zip(nodes, indices):
-                aux_updates.update(_eval_node(node, gi, env, key, is_train))
+                aux_updates.update(_eval_node(node, gi, env, key, is_train,
+                                              platform=platform))
             return tuple(env[nid][oidx] for nid, oidx in outputs), aux_updates
 
         self.jit_fn = jax.jit(seg_fn, static_argnums=(2,))
@@ -357,6 +366,13 @@ def _build_placed_fn(symbol: Symbol, node_ctx, var_ctx, default_ctx):
 class Executor:
     """Parity: include/mxnet/executor.h Executor + python/mxnet/executor.py."""
 
+    def _platform(self):
+        """Platform of this executor's bind device, for OpCtx threading."""
+        try:
+            return self._ctx.jax_device.platform
+        except Exception:  # noqa: BLE001 — unresolvable ctx: defer to default
+            return None
+
     def __init__(self, symbol: Symbol, ctx: Optional[Context], args, args_grad,
                  grad_req="write", aux_states=None, group2ctx=None,
                  shared_exec: "Executor" = None):
@@ -432,11 +448,11 @@ class Executor:
             self._jit_fwd = self._graph_fn
             self._jit_fwdbwd = self._make_fwdbwd()
         elif shared_exec is not None and shared_exec._symbol is symbol:
-            self._graph_fn = _build_graph_fn(symbol)
+            self._graph_fn = _build_graph_fn(symbol, platform=self._platform())
             self._jit_fwd = shared_exec._jit_fwd
             self._jit_fwdbwd = shared_exec._jit_fwdbwd
         else:
-            self._graph_fn = _build_graph_fn(symbol)
+            self._graph_fn = _build_graph_fn(symbol, platform=self._platform())
             self._jit_fwd = jax.jit(
                 lambda a, x, k, t: self._graph_fn(a, x, k, t), static_argnums=(3,)
             )

@@ -126,7 +126,6 @@ class Module(BaseModule):
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
 
-        self._data_shapes = [x if isinstance(x, tuple) else tuple(x) for x in data_shapes]
         self._data_shapes = [tuple(x) for x in data_shapes]
         self._label_shapes = [tuple(x) for x in label_shapes] if label_shapes else None
 

@@ -40,12 +40,19 @@ class OpCtx:
     explicit key (pure & replayable inside jit).
     """
 
-    __slots__ = ("is_train", "_key", "_nsplit")
+    __slots__ = ("is_train", "_key", "_nsplit", "platform")
 
-    def __init__(self, is_train: bool = False, key=None):
+    def __init__(self, is_train: bool = False, key=None, platform=None):
         self.is_train = is_train
         self._key = key
         self._nsplit = 0
+        # the platform this graph will EXECUTE on ("tpu"/"cpu"), threaded
+        # from the executor's bind ctx / the trainer's mesh.  Ops that
+        # pick platform-specific lowerings (Pallas vs lax) must use this,
+        # not jax.default_backend(): a registered accelerator plugin can
+        # be the default backend while the computation is being lowered
+        # for a CPU mesh (e.g. dryrun_multichip on a TPU-attached host).
+        self.platform = platform
 
     def rng(self):
         if self._key is None:

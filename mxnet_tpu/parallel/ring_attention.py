@@ -150,13 +150,19 @@ def full_attention(q, k, v, causal=False, scale=None):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v, precision=mxu_precision(p, v))
 
 
-def attention(q, k, v, causal=False, scale=None, impl="auto"):
+def attention(q, k, v, causal=False, scale=None, impl="auto", platform=None):
     """Single-device attention dispatcher.
 
     impl='flash' (or 'auto' on TPU with block-compatible shapes) runs the
     Pallas flash kernels (ops/flash_attention.py) — O(T·D) memory, score
     tiles live only in VMEM.  Everything else falls back to the lax path
-    (XLA still fuses well, but the (T, T) scores hit HBM)."""
+    (XLA still fuses well, but the (T, T) scores hit HBM).
+
+    ``platform`` is the platform this call will lower FOR (threaded from
+    OpCtx by the symbol-graph path); None falls back to the process
+    default backend.  The distinction matters whenever a computation
+    targets non-default devices — a CPU mesh on a TPU-attached host
+    would otherwise pick the Pallas kernel and fail to lower."""
     from ..ops import flash_attention as fa
 
     # kernel tile sizes are a measured quantity, not a constant:
@@ -168,7 +174,7 @@ def attention(q, k, v, causal=False, scale=None, impl="auto"):
     bq = min(_env_block("MXTPU_FLASH_BLOCK_Q"), q.shape[2])
     bk = min(_env_block("MXTPU_FLASH_BLOCK_K"), q.shape[2])
     if impl == "auto":
-        on_tpu = jax.default_backend() == "tpu"
+        on_tpu = (platform or jax.default_backend()) == "tpu"
         impl = "flash" if on_tpu and fa.supports(q.shape, bq, bk) else "lax"
     if impl == "flash":
         return fa.flash_attention(q, k, v, causal, scale, bq, bk)

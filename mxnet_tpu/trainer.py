@@ -178,7 +178,17 @@ class FusedTrainer:
                 self._lr_mult[name] = float(attr["__lr_mult__"])
             if "__wd_mult__" in attr:
                 self._wd_mult[name] = float(attr["__wd_mult__"])
-        self._graph_fn = _build_graph_fn(symbol)
+        # platform-sensitive ops (FlashAttention) must lower for the mesh
+        # this trainer will run on, NOT jax.default_backend(): with an
+        # accelerator plugin registered, a CPU-device mesh (the multichip
+        # dryrun, multi-process CPU workers) still sees backend "tpu"
+        platform = None
+        if mesh is not None:
+            try:
+                platform = next(iter(mesh.devices.flat)).platform
+            except Exception:  # noqa: BLE001
+                platform = None
+        self._graph_fn = _build_graph_fn(symbol, platform=platform)
         self.params: Dict[str, jax.Array] = {}
         self.aux: Dict[str, jax.Array] = {}
         self.opt_state: Dict[str, tuple] = {}

@@ -148,3 +148,27 @@ def test_fused_trainer_fit_loop():
     import re
     accs = [float(m) for m in re.findall(r"Train-accuracy=([0-9.]+)", text)]
     assert accs[-1] > 0.8, accs  # the separable task is learned
+
+
+def test_attention_auto_respects_execution_platform(monkeypatch):
+    """impl='auto' must follow the platform the computation lowers FOR
+    (threaded from the trainer mesh / executor ctx via OpCtx), not
+    jax.default_backend(): with an accelerator plugin registered the
+    default backend can be 'tpu' while a CPU-device mesh is being
+    compiled (dryrun_multichip on a TPU-attached host) — picking the
+    Pallas kernel there fails at lowering with 'Only interpret mode is
+    supported on CPU backend'."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import ring_attention as ra
+
+    q = jnp.asarray(np.random.RandomState(0).randn(1, 2, 128, 16),
+                    jnp.float32)
+    monkeypatch.setattr(ra.jax, "default_backend", lambda: "tpu")
+    # platform='cpu' must force the lax path; flash would raise at lowering
+    out = jax.jit(lambda a: ra.attention(a, a, a, causal=True, impl="auto",
+                                         platform="cpu"))(q)
+    ref = ra.full_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)

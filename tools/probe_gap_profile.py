@@ -1,0 +1,151 @@
+"""Probe: xprof A/B of the framework ResNet-50 train step vs the raw-JAX
+NHWC probe step at the SAME batch, one session, one chip state.
+
+probe_gap.py shows the framework's compiled b32 step is heavier than the
+raw ceiling (14.1 vs 11.6 ms in the r05 window) — a delta that is by
+construction framework HLO, not roofline.  This dumps the top HLO ops by
+self time for each side so the delta can be attributed (layout transposes,
+master-weight casts, BN stat traffic, optimizer fusion misses).
+
+Run on the bench chip:  python tools/probe_gap_profile.py [batch]
+"""
+import glob
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+LOGBASE = "/tmp/mxtpu_gapprof"
+
+
+def _capture(tag, stepper, barrier):
+    logdir = os.path.join(LOGBASE, tag)
+    shutil.rmtree(logdir, ignore_errors=True)
+    import jax
+
+    for _ in range(5):
+        stepper()
+    barrier()
+    with jax.profiler.trace(logdir):
+        for _ in range(10):
+            stepper()
+        barrier()
+    return glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+
+
+def _top_ops(xplanes, n=22):
+    """Sum self-time per HLO op name over the capture; return the top n."""
+    from xprof.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data(xplanes, "hlo_stats", {})
+    if isinstance(data, (bytes, bytearray)):
+        data = data.decode("utf-8", "replace")
+    import json
+
+    rows = json.loads(data)
+    # hlo_stats JSON: list with a header row then data rows; locate the
+    # columns by name so a schema shuffle doesn't silently mis-attribute
+    header = rows[0]
+    cols = {name: i for i, name in enumerate(header)}
+    icat = cols.get("HLO op category", cols.get("category", 1))
+    iname = cols.get("HLO op name", cols.get("name", 2))
+    itime = None
+    for key in ("Total self time (us)", "self_time_us", "Self time (us)"):
+        if key in cols:
+            itime = cols[key]
+            break
+    agg = {}
+    for r in rows[1:]:
+        try:
+            t = float(r[itime])
+        except (TypeError, ValueError, IndexError):
+            continue
+        cat = str(r[icat])
+        agg[cat] = agg.get(cat, 0.0) + t
+    total = sum(agg.values()) or 1.0
+    out = sorted(agg.items(), key=lambda kv: -kv[1])[:n]
+    return [(cat, t, t / total) for cat, t in out], total
+
+
+def framework():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import models
+    from mxnet_tpu.trainer import FusedTrainer
+
+    net = models.get_symbol("resnet-50", num_classes=1000)
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.1, "momentum": 0.9,
+                                        "rescale_grad": 1.0 / BATCH},
+                      dtype=jnp.bfloat16)
+    tr.init(data=(BATCH, 3, 224, 224))
+    rs = np.random.RandomState(0)
+    batch = {"data": jax.device_put(
+        rs.uniform(0, 1, (BATCH, 3, 224, 224)).astype(np.float32)),
+        "softmax_label": jax.device_put(
+            rs.randint(0, 1000, BATCH).astype(np.float32))}
+    pname = sorted(tr.params)[0]
+    return (lambda: tr.step(**batch),
+            lambda: float(np.asarray(tr.params[pname]).ravel()[0]))
+
+
+def raw():
+    import importlib.util
+
+    import jax.numpy as jnp
+
+    spec = importlib.util.spec_from_file_location(
+        "probe_nhwc", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "probe_nhwc.py"))
+    probe_nhwc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(probe_nhwc)
+    rng = np.random.RandomState(0)
+    params = probe_nhwc.make_params("NHWC", rng)
+    mom = {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()}
+    x = jnp.asarray(rng.uniform(0, 1, (BATCH, 224, 224, 3)), jnp.bfloat16)
+    y = jnp.asarray(rng.randint(0, 1000, BATCH), jnp.int32)
+    state = {"p": params, "m": mom, "loss": None}
+
+    def stepper():
+        state["p"], state["m"], state["loss"] = probe_nhwc.train_step(
+            state["p"], state["m"], x, y, "NHWC")
+
+    return stepper, lambda: float(np.asarray(state["loss"]))
+
+
+def main():
+    import jax
+
+    print("devices:", jax.devices(), flush=True)
+    sides = {}
+    for tag, build in (("framework", framework), ("raw", raw)):
+        stepper, barrier = build()
+        xplanes = _capture(tag, stepper, barrier)
+        if not xplanes:
+            print(tag, "capture produced no xplane files")
+            return
+        sides[tag], total = _top_ops(xplanes)
+        print(f"\n== {tag} b{BATCH}: device self-time by HLO category "
+              f"(total {total / 1e3:.2f} ms over capture) ==", flush=True)
+        for cat, t, frac in sides[tag]:
+            print(f"  {t / 1e3:8.2f} ms  {frac * 100:5.1f}%  {cat}")
+    # the diff the probe exists for: categories where the framework spends
+    # materially more device time than the raw step
+    fw = dict((c, t) for c, t, _ in sides["framework"])
+    rw = dict((c, t) for c, t, _ in sides["raw"])
+    print("\n== framework minus raw (ms over capture; +ve = framework heavier) ==")
+    for cat in sorted(set(fw) | set(rw),
+                      key=lambda c: -(fw.get(c, 0.0) - rw.get(c, 0.0))):
+        d = fw.get(cat, 0.0) - rw.get(cat, 0.0)
+        if abs(d) > 100:  # > 0.1 ms over the 10-step capture
+            print(f"  {d / 1e3:+8.2f} ms  {cat}")
+
+
+if __name__ == "__main__":
+    main()
