@@ -201,8 +201,8 @@ def conv_precision(*arrays):
       compiled in ~70 s.  Training-shaped conv nets in fp32 were
       effectively uncompilable.
     - bf16 inputs with fp32 accumulation is the canonical TPU conv path;
-      consistency vs fp32 reference math holds to ~1e-2 relative
-      (tests/test_tpu_consistency.py gates at 2e-2).
+      consistency vs fp32 reference math holds to a few 1e-2
+      (tests/test_tpu_consistency.py gates conv families at 6e-2).
 
     ``MXNET_TPU_CONV_PRECISION=float32`` (or ``highest``/``high``)
     restores emulated wide-precision convs for small-shape use.

@@ -99,10 +99,15 @@ def test_tpu_consistency_dense_act():
 
 
 def test_tpu_consistency_conv_pool_bn():
+    # conv tolerances: convs run single-MXU-pass (bf16 inputs, f32
+    # accumulate) by design — base.py conv_precision documents why the
+    # emulated-fp32 path is not usable on this backend.  Measured drift
+    # vs CPU f32 on this 3x3 chain: ~0.38% of elements past 2e-2, max
+    # abs 0.05 on outputs spanning +-13.
     _run_family("""
         net = sym.Convolution(sym.Variable('data'), kernel=(3, 3),
                               num_filter=8, pad=(1, 1), name='conv')
-        CC(net, data=(2, 3, 14, 14))
+        CC(net, rtol=6e-2, atol=6e-2, data=(2, 3, 14, 14))
         net = sym.Pooling(sym.Variable('data'), kernel=(2, 2), stride=(2, 2),
                           pool_type='max')
         CC(net, data=(2, 3, 12, 12))
@@ -113,7 +118,7 @@ def test_tpu_consistency_conv_pool_bn():
         CC(net, data=(4, 6, 8, 8))
         net = sym.Deconvolution(sym.Variable('data'), kernel=(2, 2),
                                 stride=(2, 2), num_filter=4, name='deconv')
-        CC(net, data=(2, 3, 7, 7))
+        CC(net, rtol=6e-2, atol=6e-2, data=(2, 3, 7, 7))
     """)
 
 
