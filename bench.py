@@ -432,6 +432,34 @@ def _bench(dev, kind):
                 extras["lm_skipped"] = "insufficient extras budget"
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))
+        # the MFU config is the bench's biggest resident (560M params:
+        # ~7.8 GB of masters + Adam slots + bf16 cache on a 16 GB chip):
+        # drop every earlier section's device state first, or their live
+        # buffers + compiled-executable scratch tip it into
+        # RESOURCE_EXHAUSTED (observed once the fused-decode extra
+        # joined the lineup)
+        import gc
+
+        # (plain del per name: locals() is a snapshot in CPython, so
+        # dynamic deletion would silently do nothing; the barrier
+        # lambdas close over their trainers and must go too)
+        try:
+            del big_tr, bdata, bbarrier
+        except NameError:
+            pass
+        try:
+            del lm_tr, toks, labs, lbarrier
+        except NameError:
+            pass
+        try:
+            del dec, dstate, dlog, dlog2, dwarm, tok
+        except NameError:
+            pass
+        try:
+            del tr, staged, fetch_barrier
+        except NameError:
+            pass
+        gc.collect()
         try:
             # compute-bound MFU headline: a ~220M-param LM config where
             # the MXU is actually fed (ResNet-50-with-BN is HBM-roofline-

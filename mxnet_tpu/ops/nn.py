@@ -90,8 +90,13 @@ def _convolution(ctx, data, weight, bias=None, **attrs):
     precision = conv_precision(data, weight)
     if attrs.get("__layout__") == "NHWC" and nd == 2:
         kernel_arr = weight
+        # __wlayout__="HWIO": the weight ARRAY is physically stored HWIO
+        # (FusedTrainer keeps masters/momentum/cache in consumption
+        # layout); otherwise it arrives logical OIHW and the kernel spec
+        # permutation tells XLA — no transpose op either way
+        wspec = attrs.get("__wlayout__", "OIHW")
         dn = jax.lax.conv_dimension_numbers(
-            data.shape, weight.shape, ("NHWC", "OIHW", "NHWC"))
+            data.shape, weight.shape, ("NHWC", wspec, "NHWC"))
         bias_shape = (1,) * (nd + 1) + (-1,)
     else:
         kernel_arr = weight

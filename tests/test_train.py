@@ -238,3 +238,83 @@ def test_step_multi_matches_sequential_steps():
     np.testing.assert_allclose(np.asarray(seq.params[name]),
                                np.asarray(multi.params[name]),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_hwio_storage_excludes_multi_consumer_weights():
+    """A conv weight with ANY consumer besides NHWC convs must stay in
+    logical OIHW storage: the second reader (an in-graph weight norm
+    here) would silently misread transposed axes otherwise."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.trainer import FusedTrainer
+
+    data = sym.Variable("data")
+    w = sym.Variable("c_weight")
+    net = sym.Convolution(data, weight=w, kernel=(3, 3), num_filter=4,
+                          pad=(1, 1), name="c")
+    plain = sym.Convolution(net, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                            name="c2")
+    pooled = sym.Pooling(plain, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    head = sym.SoftmaxOutput(sym.FullyConnected(sym.Flatten(pooled),
+                                                num_hidden=3),
+                             name="softmax")
+    # second consumer of c_weight: an L2 penalty folded into the outputs
+    penalty = sym.sum(sym.square(w))
+    grouped = sym.Group([head, penalty])
+    tr = FusedTrainer(grouped, optimizer="sgd",
+                      optimizer_params={"lr": 0.01})
+    tr.init(data=(2, 3, 8, 8))
+    assert "c_weight" not in tr._hwio       # tied second use -> OIHW
+    assert "c2_weight" in tr._hwio          # single-consumer -> HWIO
+    rs = np.random.RandomState(0)
+    outs = tr.step(data=rs.rand(2, 3, 8, 8).astype(np.float32),
+                   softmax_label=rs.randint(0, 3, 2).astype(np.float32))
+    assert all(np.isfinite(np.asarray(o)).all() for o in outs)
+    # stored layouts match the discovery decision
+    assert tr.params["c_weight"].shape == (4, 3, 3, 3)
+    assert tr.params["c2_weight"].shape == (3, 3, 4, 4)
+
+
+def test_hwio_states_checkpoint_is_layout_portable(tmp_path, monkeypatch):
+    """Optimizer-state files are logical OIHW on disk: a checkpoint
+    saved by an HWIO-storage trainer must load into a trainer with
+    MXTPU_HWIO_STORAGE=0 (and vice versa) with identical slot values."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import models
+    from mxnet_tpu.trainer import FusedTrainer
+
+    net = models.get_symbol("resnet-18", num_classes=10,
+                            image_shape=(3, 16, 16))
+
+    def make():
+        t = FusedTrainer(net, optimizer="sgd",
+                         optimizer_params={"lr": 0.1, "momentum": 0.9})
+        return t.init(data=(2, 3, 16, 16))
+
+    tr = make()
+    assert tr._hwio  # HWIO storage active by default
+    rs = np.random.RandomState(0)
+    for _ in range(2):
+        tr.step(data=rs.rand(2, 3, 16, 16).astype(np.float32),
+                softmax_label=rs.randint(0, 10, 2).astype(np.float32))
+    prefix = str(tmp_path / "ck")
+    tr.save_checkpoint(prefix, 1, save_optimizer_states=True)
+
+    monkeypatch.setenv("MXTPU_HWIO_STORAGE", "0")
+    tr2 = make()
+    assert not tr2._hwio
+    tr2.load_checkpoint(prefix, 1, load_optimizer_states=True)
+    name = sorted(tr._hwio)[0]
+    # params: tr stores HWIO, tr2 stores OIHW — logically equal
+    np.testing.assert_allclose(
+        np.transpose(np.asarray(tr.params[name]), (3, 2, 0, 1)),
+        np.asarray(tr2.params[name]), rtol=0, atol=0)
+    # momentum slots likewise
+    np.testing.assert_allclose(
+        np.transpose(np.asarray(tr.opt_state[name][0]), (3, 2, 0, 1)),
+        np.asarray(tr2.opt_state[name][0]), rtol=0, atol=0)
+    # and tr2 keeps training without shape errors
+    tr2.step(data=rs.rand(2, 3, 16, 16).astype(np.float32),
+             softmax_label=rs.randint(0, 10, 2).astype(np.float32))

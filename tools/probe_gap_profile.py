@@ -47,25 +47,20 @@ def _top_ops(xplanes, n=22):
         data = data.decode("utf-8", "replace")
     import json
 
-    rows = json.loads(data)
-    # hlo_stats JSON: list with a header row then data rows; locate the
-    # columns by name so a schema shuffle doesn't silently mis-attribute
-    header = rows[0]
-    cols = {name: i for i, name in enumerate(header)}
-    icat = cols.get("HLO op category", cols.get("category", 1))
-    iname = cols.get("HLO op name", cols.get("name", 2))
-    itime = None
-    for key in ("Total self time (us)", "self_time_us", "Self time (us)"):
-        if key in cols:
-            itime = cols[key]
-            break
+    table = json.loads(data)
+    # google-viz table: {"cols": [{label}], "rows": [{"c": [{"v"}]}]};
+    # locate columns by label so a schema shuffle can't mis-attribute
+    labels = [c.get("label", "") for c in table["cols"]]
+    icat = labels.index("HLO op category")
+    itime = labels.index("Total self time (us)")
     agg = {}
-    for r in rows[1:]:
+    for row in table["rows"]:
+        cells = row["c"]
         try:
-            t = float(r[itime])
-        except (TypeError, ValueError, IndexError):
+            t = float(cells[itime]["v"])
+            cat = str((cells[icat] or {}).get("v"))  # gviz null cells
+        except (TypeError, ValueError, KeyError, IndexError, AttributeError):
             continue
-        cat = str(r[icat])
         agg[cat] = agg.get(cat, 0.0) + t
     total = sum(agg.values()) or 1.0
     out = sorted(agg.items(), key=lambda kv: -kv[1])[:n]
