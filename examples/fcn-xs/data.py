@@ -1,0 +1,69 @@
+"""Segmentation data iterator (parity: example/fcn-xs/data.py — the
+reference's FileIter subclasses mx.io.DataIter to stream (image, pixel
+label) pairs with provide_data/provide_label shapes).
+
+Same DataIter contract here over a synthetic shape corpus (this image
+cannot download PASCAL VOC): each sample composites a square (class 1)
+and a disk (class 2) onto noise, the label is the per-pixel class map
+flattened to (H*W,) for multi_output SoftmaxOutput.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+IM = 32
+NCLS = 3
+
+
+def render(rs, n, im=IM):
+    x = rs.rand(n, 3, im, im).astype(np.float32) * 0.2
+    y = np.zeros((n, im, im), np.float32)
+    yy, xx = np.mgrid[0:im, 0:im]
+    for i in range(n):
+        s = rs.randint(6, 12)
+        x0, y0 = rs.randint(0, im - s, 2)
+        x[i, 0, y0:y0 + s, x0:x0 + s] += 0.8
+        y[i, y0:y0 + s, x0:x0 + s] = 1
+        r = rs.randint(4, 7)
+        cx, cy = rs.randint(r, im - r, 2)
+        mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= r * r
+        x[i, 1][mask] += 0.8
+        y[i][mask] = 2
+    return np.clip(x, 0, 1), y.reshape(n, -1)
+
+
+class ShapeSegIter(mx.io.DataIter):
+    """FileIter-shaped iterator: fixed epoch of `num_batches` batches,
+    reset() re-seeds to the epoch start so every epoch sees the same
+    corpus (deterministic convergence assertions)."""
+
+    def __init__(self, batch_size=8, num_batches=24, seed=0, im=IM):
+        super().__init__()
+        self.batch_size = batch_size
+        self.num_batches = num_batches
+        self.seed = seed
+        self.im = im
+        self._cursor = 0
+        self._rs = np.random.RandomState(seed)
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size, 3, self.im, self.im))]
+
+    @property
+    def provide_label(self):
+        return [("softmax_label", (self.batch_size, self.im * self.im))]
+
+    def reset(self):
+        self._cursor = 0
+        self._rs = np.random.RandomState(self.seed)
+
+    def next(self):
+        if self._cursor >= self.num_batches:
+            raise StopIteration
+        self._cursor += 1
+        x, y = render(self._rs, self.batch_size, self.im)
+        return mx.io.DataBatch([mx.nd.array(x)], [mx.nd.array(y)],
+                               pad=0, index=None,
+                               provide_data=self.provide_data,
+                               provide_label=self.provide_label)

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Segmentation inference demo (parity:
+example/fcn-xs/image_segmentaion.py — the reference loads the trained
+FCN checkpoint, forwards one image, argmaxes the score map into a
+palette PNG).
+
+Loads the fcn8s checkpoint fcn_xs.py saved (trains a quick one if
+absent), forwards a fresh batch, reports per-class IoU, and writes the
+predicted masks as .npy (no image codecs needed).
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+import data  # noqa: E402
+
+
+def iou(pred, truth, cls):
+    p, t = pred == cls, truth == cls
+    inter, union = (p & t).sum(), (p | t).sum()
+    return inter / union if union else float("nan")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--work", default="/tmp/fcnxs")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--min-mean-iou", type=float, default=0.45)
+    args = ap.parse_args()
+    prefix = os.path.join(args.work, "fcn8s")
+    if not os.path.exists(prefix + "-symbol.json"):
+        subprocess.run([sys.executable,
+                        os.path.join(os.path.dirname(
+                            os.path.abspath(__file__)), "fcn_xs.py"),
+                        "--work", args.work], check=True)
+    net, arg, aux = mx.model.load_checkpoint(prefix, 1)
+    mod = mx.mod.Module(net, context=mx.context.default_accelerator_context())
+    mod.bind(data_shapes=[("data", (args.batch, 3, data.IM, data.IM))],
+             label_shapes=[("softmax_label",
+                            (args.batch, data.IM * data.IM))],
+             for_training=False)
+    mod.set_params(arg, aux)
+    rs = np.random.RandomState(7)
+    x, y = data.render(rs, args.batch)
+    mod.forward(mx.io.DataBatch([mx.nd.array(x)],
+                                [mx.nd.array(np.zeros_like(y))]),
+                is_train=False)
+    scores = mod.get_outputs()[0].asnumpy()           # (N, C, H*W)
+    pred = scores.argmax(1).reshape(args.batch, data.IM, data.IM)
+    truth = y.reshape(args.batch, data.IM, data.IM)
+    ious = [iou(pred, truth, c) for c in range(data.NCLS)]
+    mean_iou = float(np.nanmean(ious))
+    print("per-class IoU:", [round(v, 3) for v in ious],
+          "mean:", round(mean_iou, 3))
+    np.save(os.path.join(args.work, "masks.npy"), pred)
+    assert mean_iou >= args.min_mean_iou, ious
+    print("SEG OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Stage-wise FCN initialization (parity: example/fcn-xs/init_fcnxs.py
+— the reference carries every weight of the coarser stage forward,
+zero-fills the NEW score heads (background dominates, so zero output is
+the right prior), and fills every NEW Deconvolution with a frozen-shape
+bilinear interpolation kernel).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def upsample_filt(size):
+    """Bilinear interpolation kernel of side `size` (init_fcnxs.py:11-19
+    — the standard tent filter every FCN implementation shares)."""
+    factor = (size + 1) // 2
+    center = factor - 1.0 if size % 2 == 1 else factor - 0.5
+    og = np.ogrid[:size, :size]
+    return ((1 - abs(og[0] - center) / factor)
+            * (1 - abs(og[1] - center) / factor))
+
+
+def _bilinear_weight(shape):
+    """(C, C, k, k) deconv weight applying per-channel bilinear
+    upsampling: diagonal channels get the tent filter."""
+    w = np.zeros(shape, np.float32)
+    filt = upsample_filt(shape[3])
+    for c in range(min(shape[0], shape[1])):
+        w[c, c] = filt
+    return w
+
+
+def init_from_fcnxs(symbol, args_from, auxs_from, data_shape):
+    """Build the finer stage's (args, auxs) from the coarser stage's:
+    shared names carry over, new `score_pool*` heads start at zero, new
+    deconv weights start bilinear (init_fcnxs.py:47-89's
+    rest_params/deconv_params split, driven by name here instead of a
+    per-stage hardcoded list)."""
+    arg_names = symbol.list_arguments()
+    arg_shapes, _, aux_shapes = symbol.infer_shape(data=data_shape)
+    shapes = dict(zip(arg_names, arg_shapes))
+    args = {}
+    for name in arg_names:
+        if name in ("data", "softmax_label"):
+            continue
+        if name in args_from and tuple(args_from[name].shape) == tuple(
+                shapes[name]):
+            args[name] = args_from[name].copy()
+        elif name.endswith("_weight") and (
+                name.startswith("bigscore") or name.startswith("score2")
+                or name.startswith("score4")):
+            args[name] = mx.nd.array(_bilinear_weight(shapes[name]))
+        else:  # new score head (score_poolN_*): zero prior
+            args[name] = mx.nd.zeros(shapes[name])
+    auxs = {k: v.copy() for k, v in auxs_from.items()}
+    for name, shape in zip(symbol.list_auxiliary_states(), aux_shapes):
+        if name not in auxs:
+            auxs[name] = mx.nd.zeros(shape)
+    return args, auxs
+
+
+def init_fcn32s(symbol, data_shape, seed=0):
+    """From-scratch fcn32s init: Xavier trunk, zero score, bilinear
+    deconv (the reference's init_from_vgg16 with the trunk replaced by
+    fresh Xavier, since there is no downloaded VGG here)."""
+    arg_names = symbol.list_arguments()
+    arg_shapes, _, aux_shapes = symbol.infer_shape(data=data_shape)
+    init = mx.init.Xavier(magnitude=2.0)
+    mx.random.seed(seed)
+    args = {}
+    for name, shape in zip(arg_names, arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        arr = mx.nd.zeros(shape)
+        if name.startswith("bigscore") and name.endswith("_weight"):
+            arr = mx.nd.array(_bilinear_weight(shape))
+        else:
+            # the reference zero-inits score heads because its trunk is
+            # PRETRAINED VGG (zero logits on good features escape the
+            # background optimum fast); from a random trunk that sits at
+            # the all-background floor, so the from-scratch stage gets
+            # Xavier score heads — zero-init stays the rule for the
+            # stage-wise transfers (init_from_fcnxs), matching the
+            # reference where it matters
+            init(name, arr)
+        args[name] = arr
+    auxs = {name: mx.nd.zeros(shape) for name, shape in
+            zip(symbol.list_auxiliary_states(), aux_shapes)}
+    return args, auxs

@@ -1,0 +1,63 @@
+"""Training solver (parity: example/fcn-xs/solver.py — the reference
+wraps the Module-style loop in a Solver class holding symbol + initial
+params, with SGD, an epoch callback, and a custom eval metric).
+
+Adds the piece the reference solver leaves implicit: a per-pixel
+accuracy EvalMetric (multi_output softmax emits (N, C, H*W)).
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class PixelAccuracy(mx.metric.EvalMetric):
+    """Fraction of pixels whose argmax class matches the label."""
+
+    def __init__(self):
+        super().__init__("pixel-acc")
+
+    def update(self, labels, preds):
+        y = labels[0].asnumpy()            # (N, H*W)
+        p = preds[0].asnumpy().argmax(1)   # (N, H*W)
+        self.sum_metric += float((p == y).mean()) * y.shape[0]
+        self.num_inst += y.shape[0]
+
+
+class Solver:
+    def __init__(self, symbol, args, auxs, ctx=None, lr=0.5, momentum=0.9):
+        self.symbol = symbol
+        self.args = args
+        self.auxs = auxs
+        self.ctx = ctx or mx.context.default_accelerator_context()
+        self.lr = lr
+        self.momentum = momentum
+
+    def fit(self, train_iter, epochs=2, log=None):
+        log = log or logging.getLogger("fcn-xs")
+        batch = train_iter.provide_data[0][1][0]
+        mod = mx.mod.Module(self.symbol, context=self.ctx)
+        mod.bind(data_shapes=train_iter.provide_data,
+                 label_shapes=train_iter.provide_label)
+        # no init_params first: set_params on the freshly-bound module
+        # keeps allow_missing=False meaningful (a name init_fcnxs missed
+        # must fail loudly, not fall back to leftover random values)
+        mod.set_params(self.args, self.auxs, allow_missing=False)
+        mod.init_optimizer(optimizer="sgd", optimizer_params={
+            "learning_rate": self.lr, "momentum": self.momentum,
+            "rescale_grad": 1.0 / batch})
+        metric = PixelAccuracy()
+        acc = None
+        for epoch in range(epochs):
+            train_iter.reset()
+            metric.reset()
+            for b in train_iter:
+                mod.forward(b, is_train=True)
+                mod.update_metric(metric, b.label)
+                mod.backward()
+                mod.update()
+            acc = metric.get()[1]
+            log.info("epoch %d: pixel-acc %.3f", epoch, acc)
+        self.args, self.auxs = mod.get_params()
+        return acc

@@ -1,0 +1,103 @@
+"""FCN-32s/16s/8s symbols (parity: example/fcn-xs/symbol_fcnxs.py —
+the reference builds three segmentation heads over one VGG trunk:
+1x1 "score" convs, stride-f Deconvolution upsampling with kernel 2f,
+Crop back to the input geometry, and elementwise skip fusion).
+
+Toy-scale trunk here (three conv/pool stages instead of VGG16), same
+head topology and the same stage-naming contract init_fcnxs.py keys on:
+each finer stage ADDS `score_poolN` + one deconv, so stage-wise
+initialization can carry every coarser weight forward.
+"""
+import sys
+
+from mxnet_tpu import sym
+
+NCLS = 3  # background, square, disk (data.py)
+
+
+def _trunk(data):
+    """Shared feature trunk: /2, /4, /8 pyramid (stands in for VGG16's
+    pool3/pool4/pool5 in symbol_fcnxs.py:14-96)."""
+    h = data
+    pools = {}
+    for i, nf in ((1, 16), (2, 32), (3, 64)):
+        h = sym.Activation(sym.Convolution(h, kernel=(3, 3), pad=(1, 1),
+                                           num_filter=nf, name=f"conv{i}"),
+                           act_type="relu")
+        h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name=f"pool{i}")
+        pools[i] = h
+    return pools
+
+
+def _upscore(score, factor, name):
+    """Stride-f bilinear-shaped upsampling head: Deconvolution with
+    kernel 2f (the shape upsample_filt() fills), followed by Crop to the
+    reference geometry (symbol_fcnxs.py:150-160 bigscore + crop)."""
+    return sym.Deconvolution(score, kernel=(2 * factor, 2 * factor),
+                             stride=(factor, factor),
+                             pad=(factor // 2, factor // 2),
+                             num_filter=NCLS, no_bias=True, name=name)
+
+
+def _head(up, data, label):
+    crop = sym.Crop(up, data, num_args=2, name="crop_final")
+    flat = sym.Reshape(crop, shape=(0, NCLS, -1), name="score_flat")
+    return sym.SoftmaxOutput(flat, label, multi_output=True,
+                             normalization="valid", name="softmax")
+
+
+def get_fcn32s():
+    """Coarsest head: score at /8, one x8 upsample (fcn32s in
+    symbol_fcnxs.py:99-117)."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    pools = _trunk(data)
+    score = sym.Convolution(pools[3], kernel=(1, 1), num_filter=NCLS,
+                            name="score")
+    up = _upscore(score, 8, "bigscore")
+    return _head(up, data, label)
+
+
+def get_fcn16s():
+    """Adds score_pool2 (/4) skip: score x2 up, fuse, x4 up
+    (fcn16s in symbol_fcnxs.py:119-143)."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    pools = _trunk(data)
+    score = sym.Convolution(pools[3], kernel=(1, 1), num_filter=NCLS,
+                            name="score")
+    score2 = _upscore(score, 2, "score2")          # /8 -> /4
+    skip4 = sym.Convolution(pools[2], kernel=(1, 1), num_filter=NCLS,
+                            name="score_pool4")
+    fuse = sym.Crop(score2, skip4, num_args=2, name="crop_pool4") + skip4
+    up = _upscore(fuse, 4, "bigscore")
+    return _head(up, data, label)
+
+
+def get_fcn8s():
+    """Adds score_pool3 (/2) skip on top of fcn16s: one more x2 stage
+    (fcn8s in symbol_fcnxs.py:145-189)."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    pools = _trunk(data)
+    score = sym.Convolution(pools[3], kernel=(1, 1), num_filter=NCLS,
+                            name="score")
+    score2 = _upscore(score, 2, "score2")          # /8 -> /4
+    skip4 = sym.Convolution(pools[2], kernel=(1, 1), num_filter=NCLS,
+                            name="score_pool4")
+    fuse4 = sym.Crop(score2, skip4, num_args=2, name="crop_pool4") + skip4
+    score4 = _upscore(fuse4, 2, "score4")          # /4 -> /2
+    skip3 = sym.Convolution(pools[1], kernel=(1, 1), num_filter=NCLS,
+                            name="score_pool3")
+    fuse3 = sym.Crop(score4, skip3, num_args=2, name="crop_pool3") + skip3
+    up = _upscore(fuse3, 2, "bigscore")
+    return _head(up, data, label)
+
+
+def get_symbol(stage):
+    try:
+        return {"fcn32s": get_fcn32s, "fcn16s": get_fcn16s,
+                "fcn8s": get_fcn8s}[stage]()
+    except KeyError:
+        sys.exit(f"unknown stage {stage!r} (fcn32s|fcn16s|fcn8s)")

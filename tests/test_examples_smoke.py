@@ -41,6 +41,9 @@ CASES = [
     ("nce-loss", "toy_nce.py", [], "NCE OK"),
     ("nce-loss", "wordvec.py", ["--steps", "350"], "WORDVEC OK"),
     ("cnn_text_classification", "text_cnn.py", [], "TRAIN OK"),
+    ("fcn-xs", "fcn_xs.py", ["--work", "/tmp/smoke_fcnxs"], "FCNXS OK"),
+    ("fcn-xs", "image_segmentaion.py", ["--work", "/tmp/smoke_fcnxs_seg"],
+     "SEG OK"),  # own dir: self-trains, no ordering coupling
 ]
 
 
