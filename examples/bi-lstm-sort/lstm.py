@@ -1,0 +1,71 @@
+"""Bi-LSTM sort symbols (parity: example/bi-lstm-sort/lstm.py — the
+reference hand-builds the forward and backward LSTM stacks step by step
+and concatenates per-position states).
+
+Two equivalent builders here, both returning the same multi_output
+softmax head:
+
+- ``build_cells``: the reference's shape, expressed through the cell
+  API — explicit ``mx.rnn.LSTMCell`` pair under a ``BidirectionalCell``
+  unroll (each timestep is its own symbol node, like the reference's
+  per-step ``lstm()`` calls).
+- ``build_fused``: the TPU-native fast path — one ``sym.RNN`` op whose
+  whole bidirectional scan compiles as a single fused XLA loop.
+
+lstm_sort.py trains either and infer_sort.py loads either; agreement
+between the two is asserted by the per-position accuracy floors.
+"""
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+from sort_io import SEQ, VOCAB
+
+EMBED, HIDDEN = 16, 64
+
+
+def _head(h2, batch, seq):
+    """(N*seq, 2H) feature rows -> per-position VOCAB softmax."""
+    fc = sym.FullyConnected(h2, num_hidden=VOCAB, name="fc")
+    fc = sym.Reshape(fc, shape=(batch, seq, VOCAB))
+    fc = sym.transpose(fc, axes=(0, 2, 1))          # (N, VOCAB, seq)
+    label = sym.Variable("softmax_label")
+    return sym.SoftmaxOutput(fc, label, multi_output=True,
+                             normalization="valid", name="softmax")
+
+
+def build_cells(batch, seq=SEQ):
+    data = sym.Variable("data")
+    embed = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                          name="embed")             # (N, seq, EMBED)
+    bi = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(HIDDEN, prefix="l_"),
+        mx.rnn.LSTMCell(HIDDEN, prefix="r_"))
+    # constant zero initial states (sym.zeros) instead of begin_state
+    # Variables: no extra bind inputs, so Module sees only data/label
+    zeros = [sym.zeros(shape=(batch, HIDDEN)) for _ in range(4)]
+    outputs, _ = bi.unroll(seq, inputs=embed, begin_state=zeros,
+                           layout="NTC")
+    steps = [sym.expand_dims(o, axis=1) for o in outputs]
+    h = sym.Concat(*steps, dim=1)                   # (N, seq, 2H)
+    h2 = sym.Reshape(h, shape=(-1, 2 * HIDDEN))
+    return _head(h2, batch, seq)
+
+
+def build_fused(batch, seq=SEQ):
+    data = sym.Variable("data")
+    embed = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                          name="embed")
+    x = sym.transpose(embed, axes=(1, 0, 2))        # (seq, N, EMBED)
+    rnn = sym.RNN(x, state_size=HIDDEN, num_layers=1, mode="lstm",
+                  bidirectional=True, name="bilstm")  # (seq, N, 2H)
+    h = sym.transpose(rnn, axes=(1, 0, 2))
+    h2 = sym.Reshape(h, shape=(-1, 2 * HIDDEN))
+    return _head(h2, batch, seq)
+
+
+def build(impl, batch, seq=SEQ):
+    if impl == "cells":
+        return build_cells(batch, seq)
+    if impl == "fused":
+        return build_fused(batch, seq)
+    raise ValueError(f"impl must be cells|fused, got {impl!r}")

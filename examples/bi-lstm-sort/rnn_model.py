@@ -1,0 +1,43 @@
+"""Inference wrapper (parity: example/bi-lstm-sort/rnn_model.py — the
+reference's BiLSTMInferenceModel binds the trained symbol at batch 1
+and exposes a forward() that returns per-position probabilities)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+from sort_io import SEQ
+
+
+class BiLSTMSortModel:
+    def __init__(self, prefix, epoch, impl="fused", seq=SEQ, ctx=None):
+        # like the reference's BiLSTMInferenceModel, REBUILD the symbol
+        # at batch 1 and load only the params — the training symbol has
+        # the train batch baked into its head reshape
+        import lstm
+
+        _, arg, aux = mx.model.load_checkpoint(prefix, epoch)
+        net = lstm.build(impl, 1, seq)
+        self._mod = mx.mod.Module(
+            net, context=ctx or mx.context.default_accelerator_context())
+        self._mod.bind(data_shapes=[("data", (1, seq))],
+                       label_shapes=[("softmax_label", (1, seq))],
+                       for_training=False)
+        # the fused RNN's begin-state args were saved at TRAIN batch
+        # shape ((dirs, 64, H)); inference starts from zero states at
+        # batch 1, so drop them and let Zero() init fill the slots
+        expected = dict(zip(net.list_arguments(), net.infer_shape(
+            data=(1, seq), softmax_label=(1, seq))[0]))
+        arg = {k: v for k, v in arg.items()
+               if "state" not in k or v.shape == tuple(expected[k])}
+        self._mod.init_params(mx.init.Zero())
+        self._mod.set_params(arg, aux, allow_missing=True)
+        self._seq = seq
+
+    def sort(self, x):
+        """(1, seq) token ids -> (seq,) predicted sorted ids."""
+        batch = mx.io.DataBatch(
+            [mx.nd.array(x)],
+            [mx.nd.array(np.zeros((1, self._seq), np.float32))])
+        self._mod.forward(batch, is_train=False)
+        probs = self._mod.get_outputs()[0].asnumpy()  # (1, VOCAB, seq)
+        return probs[0].argmax(0)

@@ -1,0 +1,58 @@
+"""Sort-task data plumbing (parity: example/bi-lstm-sort/sort_io.py —
+the reference builds a vocabulary over number tokens and an iterator
+yielding (sequence, sorted-sequence) batches for per-position softmax).
+
+Same contract: integer token ids 1..VOCAB-1 (0 reserved for padding,
+as in the reference's vocab), labels are the same tokens sorted, and a
+DataIter subclass feeds Module.fit.  encode/decode map printable number
+strings to ids for the inference demo.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+VOCAB, SEQ = 30, 5
+
+
+def make_data(rs, n, seq=SEQ):
+    x = rs.randint(1, VOCAB, (n, seq)).astype(np.float32)
+    y = np.sort(x, axis=1)
+    return x, y
+
+
+def encode(numbers, seq=SEQ):
+    """List of ints (1..VOCAB-1) -> (1, seq) float array."""
+    assert len(numbers) == seq and all(1 <= v < VOCAB for v in numbers)
+    return np.asarray(numbers, np.float32).reshape(1, seq)
+
+
+def decode(ids):
+    return [int(v) for v in np.asarray(ids).ravel()]
+
+
+class SortIter(mx.io.DataIter):
+    """Fixed-corpus iterator: deterministic given the seed, reset()
+    rewinds (the reference shuffles buckets; one fixed-length bucket
+    here keeps the toy graph static)."""
+
+    def __init__(self, num, batch_size, seed=0, seq=SEQ):
+        super().__init__()
+        self.batch_size = batch_size
+        self.seq = seq
+        x, y = make_data(np.random.RandomState(seed), num, seq)
+        self._inner = mx.io.NDArrayIter(x, y, batch_size=batch_size,
+                                        shuffle=True)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()

@@ -44,6 +44,11 @@ CASES = [
     ("fcn-xs", "fcn_xs.py", ["--work", "/tmp/smoke_fcnxs"], "FCNXS OK"),
     ("fcn-xs", "image_segmentaion.py", ["--work", "/tmp/smoke_fcnxs_seg"],
      "SEG OK"),  # own dir: self-trains, no ordering coupling
+    ("bi-lstm-sort", "lstm_sort.py",
+     ["--impl", "fused", "--work", "/tmp/smoke_bilstm"], "SORT OK"),
+    ("bi-lstm-sort", "infer_sort.py",
+     ["--impl", "cells", "--epochs", "14", "--work", "/tmp/smoke_bilstm_c"],
+     "INFER OK"),  # own dir; covers the cell-API path end to end
 ]
 
 
