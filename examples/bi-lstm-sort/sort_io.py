@@ -31,9 +31,11 @@ def decode(ids):
 
 
 class SortIter(mx.io.DataIter):
-    """Fixed-corpus iterator: deterministic given the seed, reset()
-    rewinds (the reference shuffles buckets; one fixed-length bucket
-    here keeps the toy graph static)."""
+    """Fixed-corpus iterator: the CORPUS is deterministic given the
+    seed; batch order comes from NDArrayIter's shuffle, which draws the
+    global numpy RNG (seed np.random for a fully deterministic run, as
+    lstm_sort.py does).  One fixed-length bucket keeps the toy graph
+    static where the reference shuffles buckets."""
 
     def __init__(self, num, batch_size, seed=0, seq=SEQ):
         super().__init__()

@@ -1,0 +1,135 @@
+"""Module-level stochastic depth (parity:
+example/stochastic-depth/sd_module.py — the reference implements the
+Huang et al. 2016 layer-drop as a BaseModule subclass: a residual block
+whose COMPUTE branch is a wrapped Module, gated per batch by a
+Bernoulli draw, with the skip branch carrying the identity; at
+inference the compute branch is scaled by its survival probability).
+
+Composable inside SequentialModule exactly like the reference's: the
+wrapper forwards/backwards through the inner Module only when the gate
+is open, passes input gradients through the identity either way, and
+exposes the data/output plumbing SequentialModule wires on.
+
+sd_resnet.py in this directory is the TPU-native alternative (the gate
+as a Dropout inside ONE fused graph — no per-block Module dispatch);
+this file exists to prove the module-composition surface the reference
+example is about.
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.module.base_module import BaseModule
+
+
+class StochasticDepthModule(BaseModule):
+    """Identity-skip residual wrapper: out = x + gate * compute(x).
+
+    The compute symbol must map its input ('data') to an output of the
+    SAME shape (identity skip only, like the reference's default
+    symbol_skip=None path).
+    """
+
+    def __init__(self, symbol_compute, data_names=("data",),
+                 logger=logging, context=None, death_rate=0.0, seed=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol_compute
+        self._module = mx.mod.Module(
+            symbol_compute, data_names=data_names, label_names=[],
+            logger=logger,
+            context=context or mx.context.default_accelerator_context())
+        self._open_rate = 1.0 - float(death_rate)
+        self._gate_open = True
+        self._rs = np.random.RandomState(seed)
+        self.open_count = 0
+        self.closed_count = 0
+        self._outputs = None
+        self._input_grads = None
+
+    # ---- plumbing SequentialModule wires on -------------------------
+    @property
+    def data_names(self):
+        return self._module.data_names
+
+    @property
+    def output_names(self):
+        return self._module.output_names
+
+    @property
+    def data_shapes(self):
+        return self._module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return None
+
+    @property
+    def output_shapes(self):
+        return self._module.output_shapes
+
+    def get_params(self):
+        return self._module.get_params()
+
+    def init_params(self, *args, **kwargs):
+        self._module.init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        # the identity branch always needs the input grad path when
+        # training, and the inner module's input grads are ADDED to it
+        self._module.bind(data_shapes, None, for_training=for_training,
+                          inputs_need_grad=True,
+                          force_rebind=force_rebind, grad_req=grad_req)
+        self.binded = True
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+    def init_optimizer(self, **kwargs):
+        self._module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    # ---- the stochastic part ----------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        x = data_batch.data
+        if is_train:
+            self._gate_open = float(self._rs.rand()) < self._open_rate
+            self.open_count += self._gate_open
+            self.closed_count += not self._gate_open
+            if self._gate_open:
+                self._module.forward(data_batch, is_train=True)
+                comp = self._module.get_outputs()
+                self._outputs = [a + b for a, b in zip(x, comp)]
+            else:
+                self._outputs = list(x)
+        else:
+            # expectation at inference: x + p_survive * compute(x)
+            self._module.forward(data_batch, is_train=False)
+            comp = self._module.get_outputs()
+            self._outputs = [a + self._open_rate * b
+                             for a, b in zip(x, comp)]
+
+    def backward(self, out_grads=None):
+        self._input_grads = list(out_grads)
+        if self._gate_open:
+            self._module.backward(out_grads=out_grads)
+            comp = self._module.get_input_grads()
+            self._input_grads = [a + b
+                                 for a, b in zip(self._input_grads, comp)]
+
+    def update(self):
+        if self._gate_open:
+            self._module.update()
+
+    def update_metric(self, eval_metric, labels):
+        pass  # interior block: no labels
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._input_grads

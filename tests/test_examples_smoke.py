@@ -46,6 +46,7 @@ CASES = [
      "SEG OK"),  # own dir: self-trains, no ordering coupling
     ("bi-lstm-sort", "lstm_sort.py",
      ["--impl", "fused", "--work", "/tmp/smoke_bilstm"], "SORT OK"),
+    ("stochastic-depth", "sd_mnist.py", [], "SD OK"),
     ("bi-lstm-sort", "infer_sort.py",
      ["--impl", "cells", "--epochs", "14", "--work", "/tmp/smoke_bilstm_c"],
      "INFER OK"),  # own dir; covers the cell-API path end to end
