@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Profile the image-record pipeline (parity:
+example/profiler/profiler_imageiter.py — the reference runs
+ImageRecordIter under the profiler so batch production shows up in the
+trace).
+
+Writes a small synthetic .rec, iterates it with the profiler running,
+and asserts the data-io events are in the dump.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img  # noqa: E402
+
+
+def write_rec(prefix, n, side):
+    rs = np.random.RandomState(0)
+    w = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n):
+        img = (rs.rand(side, side, 3) * 255).astype(np.uint8)
+        w.write_idx(i, pack_img(IRHeader(0, float(i % 10), i, 0), img,
+                                quality=90))
+    w.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--filename", default="/tmp/profile_imageiter.json")
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "toy")
+        write_rec(prefix, args.images, 32)
+        it = mx.io.ImageRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=(3, 28, 28), batch_size=args.batch_size,
+            rand_crop=True, shuffle=True, preprocess_threads=2)
+
+        mx.profiler.profiler_set_config(mode="all",
+                                        filename=args.filename)
+        mx.profiler.profiler_set_state("run")
+        batches = 0
+        for batch in it:
+            batch.data[0].wait_to_read()
+            batches += 1
+        mx.profiler.profiler_set_state("stop")
+        mx.profiler.dump_profile()
+
+    with open(args.filename) as f:
+        events = json.load(f)["traceEvents"]
+    io_events = [e for e in events if e["cat"] == "data-io"]
+    total = sum(e["dur"] for e in io_events) / 1e3
+    print(f"{batches} batches, {len(io_events)} data-io events, "
+          f"{total:.1f} ms in the pipeline")
+    assert len(io_events) == batches > 0, (len(io_events), batches)
+    print("PROF OK")
+
+
+if __name__ == "__main__":
+    main()

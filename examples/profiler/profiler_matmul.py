@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Profile imperative matmuls (parity: example/profiler/profiler_matmul.py
+— the reference times a loop of nd.dot calls under the profiler and
+dumps chrome-trace JSON)."""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=512)
+    ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--filename", default="/tmp/profile_matmul.json")
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    a = nd.array(rs.rand(args.n, args.n).astype(np.float32))
+    b = nd.array(rs.rand(args.n, args.n).astype(np.float32))
+    nd.dot(a, b).wait_to_read()  # compile outside the trace
+
+    mx.profiler.profiler_set_config(mode="all", filename=args.filename)
+    mx.profiler.profiler_set_state("run")
+    c = None
+    for _ in range(args.iterations):
+        c = nd.dot(a, b)
+    c.wait_to_read()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+
+    with open(args.filename) as f:
+        events = json.load(f)["traceEvents"]
+    dots = [e for e in events if e["name"] == "dot"]
+    total = sum(e["dur"] for e in dots) / 1e3
+    print(f"{len(dots)} dot events, {total:.2f} ms total "
+          f"-> open {args.filename} in chrome://tracing")
+    assert len(dots) == args.iterations, len(dots)
+    print("PROF OK")
+
+
+if __name__ == "__main__":
+    main()

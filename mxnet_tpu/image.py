@@ -340,6 +340,12 @@ class ImageIter(DataIter):
         return img.transpose(2, 0, 1)  # HWC -> CHW
 
     def next(self):
+        from . import profiler as _prof
+
+        with _prof.span("ImageIter.next", category="data-io"):
+            return self._next_impl()
+
+    def _next_impl(self):
         from . import storage
 
         # pooled staging (parity: pooled_storage_manager.h recycling):
@@ -508,23 +514,28 @@ class ImageRecordIter(DataIter):
         return pad
 
     def next(self):
+        from . import profiler as _prof
         from . import storage
 
         if self.cur >= len(self.order):
             raise StopIteration
-        # decode/augment on the thread pool; workers write straight into
-        # the pooled staging buffer (copy-on-stage recycles it below)
-        data = storage.staging_empty((self.batch_size,) + self.data_shape,
-                                     np.float32)
-        labels = np.empty((self.batch_size, self.label_width), np.float32)
-        try:
-            pad = self._next_into(data, labels)
-        except Exception:
-            storage.staging_free(data)  # decode error must not leak block
-            raise
-        label_out = labels[:, 0] if self.label_width == 1 else labels
-        return DataBatch([nd.NDArray(storage.stage_to_device(data))],
-                         [nd.array(label_out)], pad=pad)
+        # data-io profiling (reference parity: profiler_imageiter.py —
+        # iterator batches show up as events when the profiler runs)
+        with _prof.span("ImageRecordIter.next", category="data-io"):
+            # decode/augment on the thread pool; workers write straight
+            # into the pooled staging buffer (copy-on-stage recycles it)
+            data = storage.staging_empty(
+                (self.batch_size,) + self.data_shape, np.float32)
+            labels = np.empty((self.batch_size, self.label_width),
+                              np.float32)
+            try:
+                pad = self._next_into(data, labels)
+            except Exception:
+                storage.staging_free(data)  # decode error must not leak
+                raise
+            label_out = labels[:, 0] if self.label_width == 1 else labels
+            return DataBatch([nd.NDArray(storage.stage_to_device(data))],
+                             [nd.array(label_out)], pad=pad)
 
 
 # sharded-host multi-process pipeline (N decode processes -> shared-memory

@@ -203,6 +203,13 @@ class MultiProcessImageRecordIter(DataIter):
         pass
 
     def next(self):
+        from . import profiler as _prof
+
+        with _prof.span("MultiProcessImageRecordIter.next",
+                        category="data-io"):
+            return self._next_impl()
+
+    def _next_impl(self):
         from . import storage
 
         if self._closed:

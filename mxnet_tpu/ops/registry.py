@@ -210,7 +210,7 @@ def invoke(name: str, inputs, attrs=None, is_train: bool = True, key=None):
             if "out" in holder:
                 _jax.block_until_ready(holder["out"])
 
-        with _prof.span(op.name, sync=_sync):
+        with _prof.span(op.name, category="imperative", sync=_sync):
             holder["out"] = out = fn(key, *inputs) if key is not None else fn(*inputs)
     else:
         out = fn(key, *inputs) if key is not None else fn(*inputs)

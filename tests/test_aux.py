@@ -168,3 +168,65 @@ def test_perplexity_global_applies_exp():
     m.update([lab], [pred])
     glob = m.get_global_name_value()[0][1]
     assert abs(local - 2.0) < 1e-6 and abs(glob - 2.0) < 1e-6
+
+
+def test_profiler_mode_all_records_imperative_and_data_io(tmp_path):
+    """mode='all' captures imperative nd ops (category 'imperative') and
+    record-iterator batches (category 'data-io'); mode='symbolic' must
+    NOT record imperative ops (reference parity: profile_imperative is
+    gated by MXSetProfilerConfig mode)."""
+    import json
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    a = nd.array(np.ones((8, 8), np.float32))
+    nd.dot(a, a).wait_to_read()  # compile outside the trace
+
+    fname = str(tmp_path / "prof_all.json")
+    mx.profiler.profiler_set_config(mode="all", filename=fname)
+    mx.profiler.profiler_set_state("run")
+    nd.dot(a, a).wait_to_read()
+    nd.exp(a).wait_to_read()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    events = json.load(open(fname))["traceEvents"]
+    imp = {e["name"] for e in events if e["cat"] == "imperative"}
+    assert "dot" in imp and "exp" in imp, imp
+
+    # symbolic mode: imperative ops stay out of the trace
+    fname2 = str(tmp_path / "prof_sym.json")
+    mx.profiler.profiler_set_config(mode="symbolic", filename=fname2)
+    mx.profiler.profiler_set_state("run")
+    nd.dot(a, a).wait_to_read()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    events2 = json.load(open(fname2))["traceEvents"]
+    assert not [e for e in events2 if e["cat"] == "imperative"], events2
+
+    # data-io events: a record iterator batch must show up under 'data-io'
+    from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+    prefix = str(tmp_path / "toy")
+    w = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rs = np.random.RandomState(0)
+    for i in range(8):
+        w.write_idx(i, pack_img(IRHeader(0, float(i), i, 0),
+                                (rs.rand(16, 16, 3) * 255).astype(np.uint8),
+                                quality=80))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               path_imgidx=prefix + ".idx",
+                               data_shape=(3, 16, 16), batch_size=4)
+    fname3 = str(tmp_path / "prof_io.json")
+    mx.profiler.profiler_set_config(mode="all", filename=fname3)
+    mx.profiler.profiler_set_state("run")
+    for b in it:
+        b.data[0].wait_to_read()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    io_ev = [e for e in json.load(open(fname3))["traceEvents"]
+             if e["cat"] == "data-io"]
+    assert len(io_ev) == 2, io_ev  # 8 imgs / batch 4

@@ -47,6 +47,9 @@ CASES = [
     ("bi-lstm-sort", "lstm_sort.py",
      ["--impl", "fused", "--work", "/tmp/smoke_bilstm"], "SORT OK"),
     ("stochastic-depth", "sd_mnist.py", [], "SD OK"),
+    ("profiler", "profiler_matmul.py", [], "PROF OK"),
+    ("profiler", "profiler_ndarray.py", [], "PROF OK"),
+    ("profiler", "profiler_imageiter.py", [], "PROF OK"),
     ("bi-lstm-sort", "infer_sort.py",
      ["--impl", "cells", "--epochs", "14", "--work", "/tmp/smoke_bilstm_c"],
      "INFER OK"),  # own dir; covers the cell-API path end to end
