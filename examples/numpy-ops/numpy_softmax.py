@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Softmax through the legacy NumpyOp protocol (parity:
+example/numpy-ops/numpy_softmax.py — the reference's older
+forward(in_data, out_data) API, pre-CustomOp; mxnet_tpu keeps the shim
+so old user operators run unchanged on the CustomOp machinery).
+
+Trains the same toy classifier as custom_softmax.py, through the other
+frontend, and asserts it learns.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.test_utils import get_synthetic_mnist  # noqa: E402
+
+
+class NumpySoftmax(mx.operator.NumpyOp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape]
+
+    def forward(self, in_data, out_data):
+        x = in_data[0]
+        y = out_data[0]
+        y[:] = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        l = in_data[1].astype(int)
+        y = out_data[0]
+        dx = in_grad[0]
+        dx[:] = y
+        dx[np.arange(l.shape[0]), l] -= 1.0
+
+
+def main():
+    (X, Y), _ = get_synthetic_mnist(512, 8)
+    mysoftmax = NumpySoftmax()
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(sym.Flatten(data), num_hidden=10, name="fc")
+    label = sym.Variable("softmax_label")
+    net = mysoftmax(fc, label, name="softmax")
+    mod = mx.mod.Module(net, label_names=["softmax_label"],
+                        context=mx.context.default_accelerator_context())
+    it = mx.io.NDArrayIter(X, Y, 64, shuffle=True)
+    mod.fit(it, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    acc = dict(mod.score(it, mx.metric.create("acc")))["accuracy"]
+    print(f"train acc {acc:.3f}")
+    assert acc > 0.7, acc
+    print("NUMPYOP OK")
+
+
+if __name__ == "__main__":
+    main()

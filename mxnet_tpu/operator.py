@@ -98,12 +98,23 @@ def register(reg_name):
     return deco
 
 
-def get_prop(op_type: str) -> CustomOpProp:
+def get_prop(op_type: str, config=None) -> CustomOpProp:
+    """Instantiate the registered Prop.  ``config`` carries the user
+    kwargs from the sym.Custom call, passed to the Prop constructor AS
+    STRINGS (reference parity: custom-inl.h forwards the symbol's
+    key/value attrs to CustomOpProp.__init__ — e.g.
+    weighted_logistic_regression's pos_grad_scale)."""
     try:
-        return _PROPS[op_type]()
+        cls = _PROPS[op_type]
     except KeyError:
         raise MXNetError(f"custom op type '{op_type}' is not registered "
                          "(use @mx.operator.register)") from None
+    # canonical text for sequence kwargs: the imperative jit cache
+    # round-trips attrs through frozen_attrs (list -> tuple), so both
+    # frontends must stringify to the same form
+    kwargs = {k: (str(list(v)) if isinstance(v, (list, tuple)) else str(v))
+              for k, v in (config or {}).items()}
+    return cls(**kwargs)
 
 
 class _HostArray:
@@ -188,8 +199,10 @@ class NumpyOp(PythonOp):
                 return outer.list_outputs()
 
             def infer_shape(self, in_shape):
-                res = outer.infer_shape(in_shape)
-                return (res[0], res[1], []) if len(res) == 2 else res
+                # 2-tuple returns are normalized at the Custom op's
+                # call site (ops/custom.py), the single shim for both
+                # the NumpyOp and direct-CustomOpProp paths
+                return outer.infer_shape(in_shape)
 
             def create_operator(self, ctx, in_shapes, in_dtypes):
                 class _Op(CustomOp):

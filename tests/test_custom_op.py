@@ -145,3 +145,48 @@ def test_unregistered_op_type_errors():
     import pytest
     with pytest.raises(mx.MXNetError):
         nd.Custom(nd.array(np.zeros((2, 2), np.float32)), op_type="nope")
+
+
+def test_custom_prop_receives_symbol_kwargs_as_strings():
+    """Reference parity (custom-inl.h): the sym.Custom call's extra
+    kwargs reach the CustomOpProp constructor AS STRINGS; framework
+    attrs (op_type/num_args/name) never do."""
+    seen = {}
+
+    @mx.operator.register("kwarg_probe_op")
+    class KwargProbeProp(mx.operator.CustomOpProp):
+        def __init__(self, alpha, mode="x"):
+            seen["alpha"] = alpha
+            seen["mode"] = mode
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0]], [in_shape[0]]  # 2-tuple form is legal
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                mx.nd.array(in_data[0].asnumpy() * 2.0))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                mx.nd.array(out_grad[0].asnumpy() * 2.0))
+
+            return _Op()
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data, alpha=1.5, mode="fast",
+                        op_type="kwarg_probe_op")
+    exe = net.simple_bind(mx.cpu(), data=(2, 3))
+    exe.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, 2.0 * np.ones((2, 3)))
+    assert seen["alpha"] == "1.5" and seen["mode"] == "fast", seen

@@ -47,6 +47,8 @@ CASES = [
     ("bi-lstm-sort", "lstm_sort.py",
      ["--impl", "fused", "--work", "/tmp/smoke_bilstm"], "SORT OK"),
     ("stochastic-depth", "sd_mnist.py", [], "SD OK"),
+    ("numpy-ops", "numpy_softmax.py", [], "NUMPYOP OK"),
+    ("numpy-ops", "weighted_logistic_regression.py", [], "WLR OK"),
     ("profiler", "profiler_matmul.py", [], "PROF OK"),
     ("profiler", "profiler_ndarray.py", [], "PROF OK"),
     ("profiler", "profiler_imageiter.py", [], "PROF OK"),
