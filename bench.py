@@ -442,21 +442,72 @@ def _bench(dev, kind):
 
         # (plain del per name: locals() is a snapshot in CPython, so
         # dynamic deletion would silently do nothing; the barrier
-        # lambdas close over their trainers and must go too)
+        # lambdas close over their trainers and must go too.  One guarded
+        # del PER NAME — a grouped `del a, b, c` aborts at the first
+        # unbound name, leaving the rest of a partially-initialized
+        # section alive and defeating this cleanup's purpose)
         try:
-            del big_tr, bdata, bbarrier
+            del big_tr
         except NameError:
             pass
         try:
-            del lm_tr, toks, labs, lbarrier
+            del bdata
         except NameError:
             pass
         try:
-            del dec, dstate, dlog, dlog2, dwarm, tok
+            del bbarrier
         except NameError:
             pass
         try:
-            del tr, staged, fetch_barrier
+            del lm_tr
+        except NameError:
+            pass
+        try:
+            del toks
+        except NameError:
+            pass
+        try:
+            del labs
+        except NameError:
+            pass
+        try:
+            del lbarrier
+        except NameError:
+            pass
+        try:
+            del dec
+        except NameError:
+            pass
+        try:
+            del dstate
+        except NameError:
+            pass
+        try:
+            del dlog
+        except NameError:
+            pass
+        try:
+            del dlog2
+        except NameError:
+            pass
+        try:
+            del dwarm
+        except NameError:
+            pass
+        try:
+            del tok
+        except NameError:
+            pass
+        try:
+            del tr
+        except NameError:
+            pass
+        try:
+            del staged
+        except NameError:
+            pass
+        try:
+            del fetch_barrier
         except NameError:
             pass
         gc.collect()

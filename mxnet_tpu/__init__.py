@@ -73,6 +73,7 @@ from .resource import ResourceRequest, ResourceManager
 from . import rnn
 from . import operator
 from . import profiler
+from . import telemetry
 from . import rtc
 from . import visualization
 from . import visualization as viz

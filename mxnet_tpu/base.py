@@ -187,6 +187,9 @@ def mxu_precision(*arrays):
     return None
 
 
+_conv_precision_warned = False
+
+
 def conv_precision(*arrays):
     """Per-op precision for CONVOLUTIONS: single MXU pass unless opted out.
 
@@ -204,14 +207,32 @@ def conv_precision(*arrays):
       consistency vs fp32 reference math holds to a few 1e-2
       (tests/test_tpu_consistency.py gates conv families at 6e-2).
 
-    ``MXNET_TPU_CONV_PRECISION=float32`` (or ``highest``/``high``)
-    restores emulated wide-precision convs for small-shape use.
+    ``MXTPU_CONV_PRECISION=float32`` (or ``highest``/``high``) restores
+    emulated wide-precision convs for small-shape use (the pre-rename
+    spelling ``MXNET_TPU_CONV_PRECISION`` is still accepted).  Because
+    the reduced default silently changes fp32 conv numerics vs the
+    reference (drift up to ~5e-2), the first fp32 conv lowered at
+    reduced precision emits a one-time warning naming the knob.
     """
     import jax
 
-    pref = os.environ.get("MXNET_TPU_CONV_PRECISION", "").lower()
+    pref = os.environ.get(
+        "MXTPU_CONV_PRECISION",
+        os.environ.get("MXNET_TPU_CONV_PRECISION", "")).lower()
     if pref in ("float32", "highest"):
         return jax.lax.Precision.HIGHEST
     if pref in ("high", "bfloat16_3x", "tensorfloat32"):
         return jax.lax.Precision.HIGH
+    global _conv_precision_warned
+    if not _conv_precision_warned and any(
+            str(getattr(a, "dtype", "")) == "float32" for a in arrays):
+        _conv_precision_warned = True
+        import warnings
+
+        warnings.warn(
+            "fp32 convolution lowered at reduced precision (single-pass "
+            "bf16-input MXU math; drift vs true-fp32 up to ~5e-2).  Set "
+            "MXTPU_CONV_PRECISION=float32 to restore emulated wide-"
+            "precision convs (slow/uncompilable at training shapes).",
+            stacklevel=2)
     return jax.lax.Precision.DEFAULT

@@ -22,6 +22,17 @@ from __future__ import annotations
 import logging
 import time
 
+from . import telemetry as _tm
+
+# Speedometer parity through the registry: the same windowed samples/sec
+# the log line reports, scrapeable from /metrics (docs/telemetry.md)
+_TM_SPEED = _tm.gauge(
+    "speedometer_samples_per_sec",
+    "throughput of the last completed Speedometer window")
+_TM_SPEED_SAMPLES = _tm.counter(
+    "speedometer_samples_total",
+    "samples covered by completed Speedometer windows")
+
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     """Epoch-end checkpoint callback bound to a Module.
@@ -108,6 +119,8 @@ class Speedometer:
             self._mark = (now, param.nbatch)
             return
         speed = nbatches * self.batch_size / elapsed
+        _TM_SPEED.set(speed)
+        _TM_SPEED_SAMPLES.inc(nbatches * self.batch_size)
         if param.eval_metric is not None:
             parts = "".join(
                 "\tTrain-%s=%f" % nv

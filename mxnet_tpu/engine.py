@@ -22,18 +22,35 @@ the same bisection tool for ruling out async effects.
 """
 from __future__ import annotations
 
+import time
 import weakref
 
 import jax
 
+from . import telemetry as _tm
 from .base import get_env
 
 _live_arrays: "weakref.WeakValueDictionary[int, object]" = weakref.WeakValueDictionary()
 _counter = 0
 
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_LIVE = _tm.gauge(
+    "engine_live_arrays",
+    "live device arrays currently tracked for wait_for_all")
+_TM_NAIVE = _tm.gauge(
+    "engine_naive_mode",
+    "1 when MXNET_ENGINE_TYPE=NaiveEngine (every dispatch blocks)")
+_TM_WAIT_SEC = _tm.histogram(
+    "engine_wait_seconds",
+    "time the host blocked on device results (wait_to_read / "
+    "wait_for_all)", labels=("call",))
+
 
 def _engine_is_naive() -> bool:
-    return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") == "NaiveEngine"
+    naive = get_env("MXNET_ENGINE_TYPE",
+                    "ThreadedEnginePerDevice") == "NaiveEngine"
+    _TM_NAIVE.set(1.0 if naive else 0.0)
+    return naive
 
 
 def track(arr) -> int:
@@ -44,6 +61,8 @@ def track(arr) -> int:
         _live_arrays[_counter] = arr
     except TypeError:
         pass
+    if _tm.enabled():
+        _TM_LIVE.set(len(_live_arrays))
     return _counter
 
 
@@ -61,12 +80,18 @@ def on_push(result):
 
 def wait_for_var(arr):
     """Parity: Engine::WaitForVar (include/mxnet/engine.h:180)."""
+    if _tm.enabled():
+        t0 = time.perf_counter()
+        jax.block_until_ready(arr)
+        _TM_WAIT_SEC.observe(time.perf_counter() - t0, call="wait_for_var")
+        return
     jax.block_until_ready(arr)
 
 
 def wait_for_all():
     """Parity: Engine::WaitForAll (include/mxnet/engine.h:184) — drains
     both the device stream (live arrays) and the host task engine."""
+    t0 = time.perf_counter() if _tm.enabled() else None
     for arr in list(_live_arrays.values()):
         try:
             jax.block_until_ready(arr)
@@ -74,6 +99,8 @@ def wait_for_all():
             pass
     if _host_engine:
         _host_engine.wait_all()
+    if t0 is not None:
+        _TM_WAIT_SEC.observe(time.perf_counter() - t0, call="wait_for_all")
 
 
 class _Variable:

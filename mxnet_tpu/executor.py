@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -35,12 +36,49 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import ops
+from . import telemetry as _tm
 from .base import MXNetError
 from .context import Context, current_context
 from .ndarray import NDArray
 from .symbol import Symbol, _topo_order
 
 _GRAD_REQ = ("write", "add", "null")
+
+# --- telemetry families (zero-cost when disabled; docs/telemetry.md) -------
+_TM_COMPILE = _tm.counter(
+    "executor_compile_total",
+    "graph traces handed to XLA: one per jit cache miss, including "
+    "per-shape recompiles", labels=("kind",))
+_TM_COMPILE_SEC = _tm.histogram(
+    "executor_compile_seconds",
+    "Python-trace portion of each XLA compile (seconds)", labels=("kind",))
+_TM_GRAPH_CACHE = _tm.counter(
+    "executor_graph_cache_total",
+    "compiled graph-fn reuse: hit = shared_exec donor reused, miss = "
+    "fresh jit built", labels=("result",))
+_TM_FWD_SEC = _tm.histogram(
+    "executor_forward_seconds",
+    "Executor.forward wall time (dispatch; device-complete only under "
+    "the profiler's sync mode)")
+_TM_BWD_SEC = _tm.histogram(
+    "executor_backward_seconds", "Executor.backward wall time (dispatch)")
+
+
+def _count_traces(fn, kind):
+    """Wrap a to-be-jitted callable so each trace (= each XLA compile,
+    including per-shape recompiles) increments the compile counter and
+    times the Python-trace slice.  Runs at trace time only — compiled
+    executions never reach this code."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        _TM_COMPILE.inc(kind=kind)
+        t0 = time.perf_counter()
+        res = fn(*args, **kwargs)
+        _TM_COMPILE_SEC.observe(time.perf_counter() - t0, kind=kind)
+        return res
+
+    return wrapper
 
 # ---------------------------------------------------------------------------
 # Channels-last (NHWC) execution pass.
@@ -319,7 +357,8 @@ class _Segment:
                                               platform=platform))
             return tuple(env[nid][oidx] for nid, oidx in outputs), aux_updates
 
-        self.jit_fn = jax.jit(seg_fn, static_argnums=(2,))
+        self.jit_fn = jax.jit(_count_traces(seg_fn, "segment"),
+                              static_argnums=(2,))
 
 
 def _build_placed_fn(symbol: Symbol, node_ctx, var_ctx, default_ctx):
@@ -474,16 +513,23 @@ class Executor:
             # un-jitted or GSPMD would re-place everything on one device
             self._jit_fwd = self._graph_fn
             self._jit_fwdbwd = self._make_fwdbwd()
+            _TM_GRAPH_CACHE.inc(result="miss")
         elif shared_exec is not None and shared_exec._symbol is symbol:
             self._graph_fn = _build_graph_fn(symbol, platform=self._platform())
             self._jit_fwd = shared_exec._jit_fwd
             self._jit_fwdbwd = shared_exec._jit_fwdbwd
+            _TM_GRAPH_CACHE.inc(result="hit")
         else:
             self._graph_fn = _build_graph_fn(symbol, platform=self._platform())
             self._jit_fwd = jax.jit(
-                lambda a, x, k, t: self._graph_fn(a, x, k, t), static_argnums=(3,)
+                _count_traces(lambda a, x, k, t: self._graph_fn(a, x, k, t),
+                              "fwd"),
+                static_argnums=(3,)
             )
-            self._jit_fwdbwd = jax.jit(self._make_fwdbwd(), static_argnames=("gnames",))
+            self._jit_fwdbwd = jax.jit(
+                _count_traces(self._make_fwdbwd(), "fwdbwd"),
+                static_argnames=("gnames",))
+            _TM_GRAPH_CACHE.inc(result="miss")
         self._step = 0
         self._pending = None  # (args_raw, aux_raw, key) of last train forward
         self._outputs_cache: Optional[List] = None
@@ -551,6 +597,7 @@ class Executor:
         else:
             from . import profiler as _prof
 
+            t0 = time.perf_counter() if _tm.enabled() else None
             with _prof.span(f"forward[{self._symbol.name or 'graph'}]",
                             device=str(self._ctx),
                             sync=lambda: jax.block_until_ready(
@@ -559,6 +606,8 @@ class Executor:
                 outs, new_aux = self._jit_fwd(args, aux, key, False)
                 self._pending = None
                 self._outputs_cache = [NDArray(o) for o in outs]
+            if t0 is not None:
+                _TM_FWD_SEC.observe(time.perf_counter() - t0)
             if self._monitor_callback is not None:
                 self._run_monitor(args, aux, key)
         return self.outputs
@@ -570,12 +619,15 @@ class Executor:
             raise MXNetError("backward() requires forward(is_train=True) first")
         from . import profiler as _prof
 
+        t0 = time.perf_counter() if _tm.enabled() else None
         with _prof.span(f"forward_backward[{self._symbol.name or 'graph'}]",
                         device=str(self._ctx),
                         sync=lambda: jax.block_until_ready(
                             self._outputs_cache[0]._read())
                         if self._outputs_cache else None):
             self._backward_impl(out_grads)
+        if t0 is not None:
+            _TM_BWD_SEC.observe(time.perf_counter() - t0)
 
     def _backward_impl(self, out_grads):
         args, aux, key = self._pending
@@ -637,7 +689,10 @@ class Executor:
             if self._pending is None:
                 raise MXNetError("no forward has been run")
             args, aux, key = self._pending
+            t0 = time.perf_counter() if _tm.enabled() else None
             outs, new_aux = self._jit_fwd(args, aux, key, True)
+            if t0 is not None:
+                _TM_FWD_SEC.observe(time.perf_counter() - t0)
             self._outputs_cache = [NDArray(o) for o in outs]
             self._write_aux(new_aux)
         return self._outputs_cache

@@ -340,10 +340,20 @@ class ImageIter(DataIter):
         return img.transpose(2, 0, 1)  # HWC -> CHW
 
     def next(self):
-        from . import profiler as _prof
+        from . import telemetry as _tm
+        from .io import _TM_BATCHES
 
-        with _prof.span("ImageIter.next", category="data-io"):
-            return self._next_impl()
+        if self.seq is not None and self.cur >= len(self.seq):
+            # exhaustion check BEFORE the span (mirroring ImageRecordIter):
+            # the epoch-end StopIteration must not record a spurious
+            # data-io event on its way out
+            raise StopIteration
+        with _tm.span("ImageIter.next", category="data-io",
+                      histogram_name="data_batch_wait_seconds",
+                      iterator="ImageIter"):
+            batch = self._next_impl()
+        _TM_BATCHES.inc(iterator="ImageIter")
+        return batch
 
     def _next_impl(self):
         from . import storage
@@ -514,14 +524,18 @@ class ImageRecordIter(DataIter):
         return pad
 
     def next(self):
-        from . import profiler as _prof
+        from . import telemetry as _tm
         from . import storage
+        from .io import _TM_BATCHES
 
         if self.cur >= len(self.order):
             raise StopIteration
         # data-io profiling (reference parity: profiler_imageiter.py —
-        # iterator batches show up as events when the profiler runs)
-        with _prof.span("ImageRecordIter.next", category="data-io"):
+        # iterator batches show up as events when the profiler runs);
+        # the span also feeds data_batch_wait_seconds when telemetry is on
+        with _tm.span("ImageRecordIter.next", category="data-io",
+                      histogram_name="data_batch_wait_seconds",
+                      iterator="ImageRecordIter"):
             # decode/augment on the thread pool; workers write straight
             # into the pooled staging buffer (copy-on-stage recycles it)
             data = storage.staging_empty(
@@ -534,8 +548,10 @@ class ImageRecordIter(DataIter):
                 storage.staging_free(data)  # decode error must not leak
                 raise
             label_out = labels[:, 0] if self.label_width == 1 else labels
-            return DataBatch([nd.NDArray(storage.stage_to_device(data))],
-                             [nd.array(label_out)], pad=pad)
+            batch = DataBatch([nd.NDArray(storage.stage_to_device(data))],
+                              [nd.array(label_out)], pad=pad)
+        _TM_BATCHES.inc(iterator="ImageRecordIter")
+        return batch
 
 
 # sharded-host multi-process pipeline (N decode processes -> shared-memory

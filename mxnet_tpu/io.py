@@ -13,15 +13,36 @@ import gzip
 import os
 import struct
 import threading
+import time
 from collections import namedtuple
 
 import numpy as np
 
 from . import ndarray as nd
+from . import telemetry as _tm
 from .base import MXNetError
 from .ndarray import NDArray
 
 DataDesc = namedtuple("DataDesc", ["name", "shape"])
+
+# --- telemetry families (docs/telemetry.md).  Stacked pipelines (e.g.
+# ImageRecordIter -> PrefetchingIter) report per stage: filter by the
+# `iterator` label for the stage you care about. -----------------------------
+_TM_BATCHES = _tm.counter(
+    "data_batches_total", "batches produced, per iterator class",
+    labels=("iterator",))
+_TM_BATCH_WAIT = _tm.histogram(
+    "data_batch_wait_seconds",
+    "time the consumer spent inside next() waiting for a batch "
+    "(input-pipeline starvation when the upstream stage is prefetched)",
+    labels=("iterator",))
+
+
+def _record_batch(it, t0):
+    """One produced batch: count it and record the consumer wait."""
+    name = type(it).__name__
+    _TM_BATCHES.inc(iterator=name)
+    _TM_BATCH_WAIT.observe(time.perf_counter() - t0, iterator=name)
 
 
 class DataBatch:
@@ -51,11 +72,15 @@ class DataIter:
         pass
 
     def next(self):
+        t0 = time.perf_counter() if _tm.enabled() else None
         if self.iter_next():
-            return DataBatch(
+            batch = DataBatch(
                 data=self.getdata(), label=self.getlabel(),
                 pad=self.getpad(), index=self.getindex(),
             )
+            if t0 is not None:
+                _record_batch(self, t0)
+            return batch
         raise StopIteration
 
     def __next__(self):
@@ -309,7 +334,10 @@ class PrefetchingIter(DataIter):
         return True
 
     def next(self):
+        t0 = time.perf_counter() if _tm.enabled() else None
         if self.iter_next():
+            if t0 is not None:
+                _record_batch(self, t0)
             return self.current_batch
         raise StopIteration
 
@@ -434,6 +462,7 @@ class DevicePrefetchIter(DataIter):
             # the producer is dead and the sentinel consumed; a blocking
             # get() here would hang forever
             raise StopIteration
+        t0 = time.perf_counter() if _tm.enabled() else None
         item = self._q.get()
         if item is None:
             self._exhausted = True
@@ -442,6 +471,8 @@ class DevicePrefetchIter(DataIter):
             self._exhausted = True
             raise item
         self._current = item
+        if t0 is not None:
+            _record_batch(self, t0)
         return item
 
 

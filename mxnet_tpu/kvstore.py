@@ -28,13 +28,40 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from . import ndarray as nd
+from . import telemetry as _tm
 from .base import MXNetError
 from .ndarray import NDArray
+
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_PUSH = _tm.counter(
+    "kvstore_push_total", "per-key push operations", labels=("store",))
+_TM_PUSH_BYTES = _tm.counter(
+    "kvstore_push_bytes_total",
+    "logical payload bytes pushed (per key, post-merge)", labels=("store",))
+_TM_PUSH_SEC = _tm.histogram(
+    "kvstore_push_seconds",
+    "per-key push latency (local: reduce+update dispatch; dist: the RPC)",
+    labels=("store",))
+_TM_PULL = _tm.counter(
+    "kvstore_pull_total", "per-key pull operations", labels=("store",))
+_TM_PULL_BYTES = _tm.counter(
+    "kvstore_pull_bytes_total",
+    "logical payload bytes pulled (per key, one copy per out array)",
+    labels=("store",))
+_TM_PULL_SEC = _tm.histogram(
+    "kvstore_pull_seconds",
+    "per-key pull latency (local: broadcast dispatch; dist: the RPC)",
+    labels=("store",))
+
+
+def _nbytes(arr) -> int:
+    return int(arr.size) * np.dtype(arr.dtype).itemsize
 
 
 def _key_list(key):
@@ -96,6 +123,7 @@ class KVStore:
         else:
             values = value
         for k, v in zip(keys, values):
+            t0 = time.perf_counter() if _tm.enabled() else None
             if isinstance(v, (list, tuple)):
                 if self._device_mode:
                     # reduce on the key's merge device: async copies in
@@ -112,6 +140,9 @@ class KVStore:
                         merged += other.as_in_context(merged.context)
             else:
                 merged = v.copy()
+            if t0 is not None:
+                _TM_PUSH.inc(store=self.type)
+                _TM_PUSH_BYTES.inc(_nbytes(merged), store=self.type)
             if self._updater is not None:
                 # the update must run where the stored weight lives: for
                 # 'local' stores that is host memory (parity: CommCPU
@@ -124,6 +155,9 @@ class KVStore:
             else:
                 # aggregation-only mode: stored value replaced by merged grad
                 self._store[k]._set(merged._read())
+            if t0 is not None:
+                _TM_PUSH_SEC.observe(time.perf_counter() - t0,
+                                     store=self.type)
 
     def pull(self, key, out=None, priority=0):
         """Parity: KVStore::Pull — copy current value into every out array
@@ -133,13 +167,27 @@ class KVStore:
         if single and isinstance(out, (list, tuple)):
             for o in out:
                 self._store[keys[0]].copyto(o)
+            self._record_pull(keys[0], len(out))
             return
         for k, o in zip(keys, outs):
+            t0 = time.perf_counter() if _tm.enabled() else None
             if isinstance(o, (list, tuple)):
                 for oo in o:
                     self._store[k].copyto(oo)
+                ncopies = len(o)
             else:
                 self._store[k].copyto(o)
+                ncopies = 1
+            if t0 is not None:
+                self._record_pull(k, ncopies)
+                _TM_PULL_SEC.observe(time.perf_counter() - t0,
+                                     store=self.type)
+
+    def _record_pull(self, k, ncopies):
+        if _tm.enabled():
+            _TM_PULL.inc(store=self.type)
+            _TM_PULL_BYTES.inc(_nbytes(self._store[k]) * ncopies,
+                               store=self.type)
 
     # -------------------------------------------------------------- optimizer
     def set_optimizer(self, optimizer):
@@ -495,7 +543,13 @@ class KVStoreDist(KVStore):
             if k not in self._shapes:
                 self._shapes[k] = (merged.shape, np.dtype(merged.dtype))
             if self._engine is None:
+                t0 = time.perf_counter() if _tm.enabled() else None
                 self._client.push(k, merged.asnumpy())
+                if t0 is not None:
+                    _TM_PUSH.inc(store=self.type)
+                    _TM_PUSH_BYTES.inc(_nbytes(merged), store=self.type)
+                    _TM_PUSH_SEC.observe(time.perf_counter() - t0,
+                                         store=self.type)
                 continue
             # snapshot the immutable jax.Array NOW: the caller may mutate
             # the NDArray right after push() returns (zero the grad, next
@@ -509,10 +563,16 @@ class KVStoreDist(KVStore):
             def _do_push(k=k, raw=raw):
                 from . import profiler as _prof
 
+                t0 = time.perf_counter() if _tm.enabled() else None
                 with _prof.span(f"kvstore_push[{k}]", category="kvstore"):
                     # the device->host fetch happens HERE, on the engine
                     # worker — the caller thread never blocks on the RPC
                     self._client.push(k, np.asarray(raw))
+                if t0 is not None:
+                    _TM_PUSH.inc(store=self.type)
+                    _TM_PUSH_BYTES.inc(_nbytes(raw), store=self.type)
+                    _TM_PUSH_SEC.observe(time.perf_counter() - t0,
+                                         store=self.type)
 
             self._engine.push(_do_push, mutable_vars=[self._var(k)],
                               priority=priority)
@@ -528,18 +588,32 @@ class KVStoreDist(KVStore):
             shape, dtype = self._shapes[k]
             targets = o if isinstance(o, (list, tuple)) else [o]
             if self._engine is None:
+                t0 = time.perf_counter() if _tm.enabled() else None
                 val = self._client.pull(k, shape, dtype)
                 for oo in targets:
                     oo._set(val)
+                if t0 is not None:
+                    _TM_PULL.inc(store=self.type)
+                    _TM_PULL_BYTES.inc(_nbytes(val) * len(targets),
+                                       store=self.type)
+                    _TM_PULL_SEC.observe(time.perf_counter() - t0,
+                                         store=self.type)
                 continue
 
             def _do_pull(k=k, shape=shape, dtype=dtype, targets=targets):
                 from . import profiler as _prof
 
+                t0 = time.perf_counter() if _tm.enabled() else None
                 with _prof.span(f"kvstore_pull[{k}]", category="kvstore"):
                     val = self._client.pull(k, shape, dtype)
                     for oo in targets:
                         oo._set(val, _from_engine=True)
+                if t0 is not None:
+                    _TM_PULL.inc(store=self.type)
+                    _TM_PULL_BYTES.inc(_nbytes(val) * len(targets),
+                                       store=self.type)
+                    _TM_PULL_SEC.observe(time.perf_counter() - t0,
+                                         store=self.type)
 
             eng = self._engine
             # each out chunk carries its own write-serialization var:

@@ -14,7 +14,13 @@ import numpy as np
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import telemetry as _tm
 from ..base import MXNetError
+
+# same family the fused path uses (trainer.py); loop label tells them apart
+_TM_SAMPLES = _tm.counter(
+    "trainer_samples_total", "training samples dispatched",
+    labels=("loop",))
 
 BatchEndParam = namedtuple("BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
 
@@ -148,6 +154,10 @@ class BaseModule:
                 self.forward_backward(data_batch)
                 self.update()
                 self.update_metric(eval_metric, data_batch.label)
+                if _tm.enabled() and data_batch.data:
+                    _TM_SAMPLES.inc(
+                        data_batch.data[0].shape[0]
+                        - (data_batch.pad or 0), loop="module")
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

@@ -203,11 +203,16 @@ class MultiProcessImageRecordIter(DataIter):
         pass
 
     def next(self):
-        from . import profiler as _prof
+        from . import telemetry as _tm
+        from .io import _TM_BATCHES
 
-        with _prof.span("MultiProcessImageRecordIter.next",
-                        category="data-io"):
-            return self._next_impl()
+        with _tm.span("MultiProcessImageRecordIter.next",
+                      category="data-io",
+                      histogram_name="data_batch_wait_seconds",
+                      iterator="MultiProcessImageRecordIter"):
+            batch = self._next_impl()
+        _TM_BATCHES.inc(iterator="MultiProcessImageRecordIter")
+        return batch
 
     def _next_impl(self):
         from . import storage

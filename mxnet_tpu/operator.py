@@ -93,6 +93,11 @@ def register(reg_name):
         if not issubclass(prop_cls, CustomOpProp):
             raise MXNetError("can only register subclasses of CustomOpProp")
         _PROPS[reg_name] = prop_cls
+        # a re-registered op_type may change list_outputs(); stale cached
+        # counts would mis-shape every later sym.Custom graph pass
+        from .ops.custom import invalidate_num_outputs_cache
+
+        invalidate_num_outputs_cache(reg_name)
         return prop_cls
 
     return deco

@@ -38,6 +38,12 @@ def _now_us() -> float:
     return (time.monotonic() - _t0) * 1e6
 
 
+def now_us() -> float:
+    """Microseconds on the profiler's timeline (shared timebase for
+    telemetry spans, so host metrics and op traces line up)."""
+    return _now_us()
+
+
 def profiler_set_config(mode="symbolic", filename="profile.json",
                         xla_trace_dir=None):
     """Parity: MXSetProfilerConfig (src/c_api/c_api.cc).  mode is

@@ -1,0 +1,41 @@
+"""Unified telemetry runtime.
+
+One process-wide metrics registry (labeled Counter/Gauge/Histogram), a
+``span()``/``@timed`` API feeding the profiler's chrome-trace sink, and
+exporters (Prometheus text + ``/metrics`` endpoint, JSON snapshot,
+periodic logging).  Every subsystem — executor, engine, kvstore, io,
+trainer — emits through this package; see ``docs/telemetry.md`` for the
+metric catalog.
+
+Quickstart::
+
+    import mxnet_tpu as mx
+    mx.telemetry.enable()                       # or MXTPU_TELEMETRY=1
+    srv = mx.telemetry.start_http_server(9100)  # GET /metrics
+    ... train ...
+    print(mx.telemetry.generate_text())         # Prometheus exposition
+
+Env knobs: ``MXTPU_TELEMETRY=1`` enables recording at import;
+``MXTPU_TELEMETRY_HTTP_PORT=<port>`` additionally serves ``/metrics``.
+Disabled (the default) every record call is a single flag check — safe
+to leave instrumentation on hot paths.
+"""
+from __future__ import annotations
+
+import os as _os
+
+from .registry import (  # noqa: F401
+    Counter, Gauge, Histogram, Registry, DEFAULT_BUCKETS,
+    counter, gauge, histogram, get_registry, reset,
+    enabled, enable, disable, span, timed, sanitize_name,
+)
+from .exporters import (  # noqa: F401
+    generate_text, json_snapshot, dump_json, start_http_server,
+    LoggingReporter,
+)
+
+_http_server = None
+_port = _os.environ.get("MXTPU_TELEMETRY_HTTP_PORT")
+if _port:
+    enable()
+    _http_server = start_http_server(int(_port))

@@ -29,10 +29,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import ops
 from . import random as _random
+from . import telemetry as _tm
 from .executor import _build_graph_fn
 from .initializer import Uniform
 from .base import MXNetError, parse_bool
 from .ndarray import NDArray
+
+# --- telemetry families (docs/telemetry.md).  The `loop` label separates
+# the fused whole-step path from the Module fit loop. -----------------------
+_TM_SAMPLES = _tm.counter(
+    "trainer_samples_total", "training samples dispatched",
+    labels=("loop",))
+_TM_STEP_SEC = _tm.histogram(
+    "trainer_step_seconds",
+    "train-step dispatch wall time (async: device completion not "
+    "included)", labels=("loop",))
 
 
 # pure update rules reusing the fused optimizer kernels from ops/optimizer_ops;
@@ -455,13 +466,20 @@ class FusedTrainer:
 
     def step(self, **batch):
         """Run one fused train step; returns outputs (list of jax arrays)."""
+        import time as _time
+
         lr = np.float32(self.current_lr())  # single source of lr truth
         self._step += 1
+        t0 = _time.perf_counter() if _tm.enabled() else None
+        sb = self._shard_batch(batch)
         (self.params, self._cparams, self.aux, self.opt_state,
          outs) = self._step_fn(
             self.params, self._cparams, self.aux, self.opt_state,
-            self._shard_batch(batch), _random.current_key(),
+            sb, _random.current_key(),
             np.int32(self._step), lr)
+        if t0 is not None:
+            _TM_STEP_SEC.observe(_time.perf_counter() - t0, loop="fused")
+            _TM_SAMPLES.inc(next(iter(sb.values())).shape[0], loop="fused")
         return outs
 
     def step_multi(self, **stacked):
@@ -498,10 +516,17 @@ class FusedTrainer:
             lrs = np.full((k,), self._base_lr, np.float32)
         step0 = np.int32(self._step)
         self._step += k
+        import time as _time
+
+        t0 = _time.perf_counter() if _tm.enabled() else None
         (self.params, self._cparams, self.aux, self.opt_state,
          outs) = self._multi_fn(
             self.params, self._cparams, self.aux, self.opt_state,
             sb, _random.current_key(), step0, lrs)
+        if t0 is not None:
+            _TM_STEP_SEC.observe(_time.perf_counter() - t0, loop="fused")
+            first = next(iter(sb.values()))
+            _TM_SAMPLES.inc(int(np.prod(first.shape[:2])), loop="fused")
         return outs
 
     def eval(self, **batch):
