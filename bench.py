@@ -153,6 +153,63 @@ def main():
         return 2
 
 
+def _dispatch_micro():
+    """Executor hot-path micro-bench (round 6): Python-overhead-per-step
+    of the Module-path train step and recompiles across re-binds.
+
+    Times 100 fused train-step dispatches on a tiny (near-no-op) graph —
+    the graph computes nothing worth measuring, so the per-step cost IS
+    the host-side overhead (input gather, jit cache lookup, dispatch).
+    Then re-binds the same symbol structure across 3 bucket shapes twice:
+    with the program cache on, the second sweep must hit the cache and
+    the `recompiles` delta should be 0.
+    """
+    import jax
+
+    from mxnet_tpu import sym, telemetry as tm
+    from mxnet_tpu.context import default_accelerator_context
+
+    was_enabled = tm.enabled()
+    tm.enable()
+    try:
+        ctx = default_accelerator_context()
+        net = sym.SoftmaxOutput(
+            sym.FullyConnected(sym.Variable("data"), num_hidden=8,
+                               name="bench_fc"),
+            name="softmax")
+        shapes = [(8, 16), (8, 32), (8, 64)]
+        compile_ctr = tm.get_registry().get("executor_compile_total")
+
+        def sweep():
+            last = None
+            for shp in shapes:
+                last = net.simple_bind(ctx, data=shp)
+                last.forward(is_train=True)
+                last.backward()
+            return last
+
+        ex = sweep()                      # warm: one trace per shape
+        before = compile_ctr.total()
+        ex = sweep()                      # re-bind the same 3 structures
+        recompiles = compile_ctr.total() - before
+
+        ex.forward(is_train=True)
+        ex.backward()
+        jax.block_until_ready(ex.outputs[0]._read())
+        n = 100
+        tic = time.perf_counter()
+        for _ in range(n):
+            ex.forward(is_train=True)
+            ex.backward()
+        jax.block_until_ready(ex.outputs[0]._read())
+        dt = time.perf_counter() - tic
+        return {"dispatch_us_per_step": round(dt / n * 1e6, 1),
+                "recompiles": int(recompiles)}
+    finally:
+        if not was_enabled:
+            tm.disable()
+
+
 def _bench(dev, kind):
     import jax
     import jax.numpy as jnp
@@ -430,6 +487,17 @@ def _bench(dev, kind):
                     bsz * fn_tok / fdt, 1)
             elif os.environ.get("BENCH_LM", "1") == "1":
                 extras["lm_skipped"] = "insufficient extras budget"
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # executor hot-path: dispatch_us_per_step (Python overhead of
+            # a fused train-step) + recompiles across bucket-shape
+            # re-binds (program cache regression tracker, ISSUE 2)
+            if os.environ.get("BENCH_DISPATCH", "1") == "1":
+                # per-key sets (dict.update bypasses _Extras.__setitem__,
+                # which is what lands keys in the payload immediately)
+                for k_, v_ in _dispatch_micro().items():
+                    extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))
         # the MFU config is the bench's biggest resident (560M params:

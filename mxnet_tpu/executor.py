@@ -23,12 +23,19 @@ Executors created with ``shared_exec`` reuse the donor's compiled cache —
 the TPU analogue of bucketing's shared memory pool
 (GraphExecutor::Init(shared_exec), graph_executor.cc:330-334): what's
 shared on TPU is compilation + params, while XLA reuses buffers per-call.
+Beyond that object-identity path, a process-wide program cache keyed on
+``Symbol.structural_signature()`` lets ANY bind of a structurally-equal
+graph reuse the jitted executables (MXTPU_PROGRAM_CACHE, bounded LRU) —
+repeated simple_bind, reshape, bucket regeneration, and serving rebinds
+stop retracing/recompiling.
 """
 from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 import jax
@@ -79,6 +86,86 @@ def _count_traces(fn, kind):
         return res
 
     return wrapper
+
+
+# ---------------------------------------------------------------------------
+# Process-wide compiled-program cache.
+#
+# The reference amortizes graph setup with shared memory pools
+# (GraphExecutor::Init(shared_exec)); on TPU the expensive artifact is the
+# XLA executable, and the jit holding it was reachable only through
+# object-identity ``shared_exec`` — BucketingModule regenerating a bucket
+# symbol, executor_manager, Executor.reshape, and repeated simple_bind in
+# tests/serving all retraced and recompiled structurally-identical graphs
+# (the compile-amortization problem TVM/nGraph solve with artifact caches
+# keyed on graph signature).  This cache keys the jitted fwd / fused
+# fwd+bwd pair on Symbol.structural_signature() (+ platform + layout
+# pass), so ANY bind of an equal-structure graph reuses the executables;
+# jax.jit's own per-aval cache then handles shape/dtype variations under
+# each entry.  Bounded LRU; MXTPU_PROGRAM_CACHE=0/off disables, =N sets
+# capacity (docs/how_to/env_var.md).
+# ---------------------------------------------------------------------------
+_PROGRAM_CACHE_DEFAULT_CAPACITY = 64
+_program_cache: "OrderedDict" = OrderedDict()
+_program_cache_lock = threading.Lock()
+
+
+def program_cache_capacity() -> int:
+    """Resolved MXTPU_PROGRAM_CACHE capacity (0 = cache disabled)."""
+    raw = os.environ.get("MXTPU_PROGRAM_CACHE", "").strip().lower()
+    if raw in ("", "on", "true", "yes", "default"):
+        return _PROGRAM_CACHE_DEFAULT_CAPACITY
+    if raw in ("0", "off", "false", "no", "disable", "disabled"):
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return _PROGRAM_CACHE_DEFAULT_CAPACITY
+
+
+def program_cache_clear():
+    """Drop every cached program (test isolation; frees held symbols)."""
+    with _program_cache_lock:
+        _program_cache.clear()
+
+
+def _compiled_programs(symbol: Symbol, platform: Optional[str]):
+    """(graph_fn, jit_fwd, jit_fwdbwd) for a symbol, through the cache.
+
+    Cache-key discipline: everything that changes the traced computation
+    and is not already a jit cache axis must be in the key — the layout
+    pass (channels_last) and the lowering platform are; grad reqs are not
+    (they are static jit arguments of the fwdbwd program), and input
+    avals are not (jax.jit keys on them per call).
+    """
+    channels_last = channels_last_default()
+    capacity = program_cache_capacity()
+    key = None
+    if capacity > 0:
+        key = (symbol.structural_signature(), platform, channels_last)
+        with _program_cache_lock:
+            entry = _program_cache.get(key)
+            if entry is not None:
+                _program_cache.move_to_end(key)
+        if entry is not None:
+            _TM_GRAPH_CACHE.inc(result="hit")
+            return entry
+    graph_fn = _build_graph_fn(symbol, channels_last=channels_last,
+                               platform=platform)
+    jit_fwd = jax.jit(_count_traces(graph_fn, "fwd"), static_argnums=(3,))
+    jit_fwdbwd = jax.jit(
+        _count_traces(_make_fwdbwd(graph_fn, placed=False), "fwdbwd"),
+        static_argnames=("gnames", "add_names"))
+    entry = (graph_fn, jit_fwd, jit_fwdbwd)
+    if key is not None:
+        with _program_cache_lock:
+            _program_cache[key] = entry
+            _program_cache.move_to_end(key)
+            while len(_program_cache) > capacity:
+                _program_cache.popitem(last=False)
+    _TM_GRAPH_CACHE.inc(result="miss")
+    return entry
+
 
 # ---------------------------------------------------------------------------
 # Channels-last (NHWC) execution pass.
@@ -429,6 +516,61 @@ def _build_placed_fn(symbol: Symbol, node_ctx, var_ctx, default_ctx):
     return fn
 
 
+def _make_fwdbwd(graph_fn, placed: bool):
+    """Build the fused fwd+bwd evaluator over ``graph_fn``.
+
+    ``gnames`` (args needing grads) and ``add_names`` (the grad_req="add"
+    subset) are static arguments: every write/add/null combination lowers
+    to its own fully-fused XLA program.  ``grad_ins`` carries the current
+    grad buffers for ``add_names`` so accumulation happens INSIDE the
+    compiled program (reference OpReqType kAddTo semantics,
+    include/mxnet/op_attr_types.h) instead of an eager read-add-write
+    round trip per param.  An empty ``head_grads`` means "seed with ones":
+    the cotangents are built in-trace from the forward outputs — a
+    loss-graph backward() therefore costs no per-call jax.eval_shape and
+    no extra host dispatches for the seed arrays.
+    """
+
+    def fwdbwd(arg_vals, aux_vals, key, head_grads, grad_ins,
+               gnames: tuple, add_names: tuple):
+        def fwd_for_grad(grad_args):
+            merged = dict(arg_vals)
+            merged.update(grad_args)
+            outs, new_aux = graph_fn(merged, aux_vals, key, True)
+            return outs, new_aux
+
+        grad_args = {k: arg_vals[k] for k in gnames}
+        (outs, new_aux), vjp_fn = jax.vjp(
+            lambda ga: fwd_for_grad(ga), grad_args, has_aux=False
+        )
+        if not head_grads:
+            # ones seed — custom_vjp loss ops discard it (parity with
+            # reference loss-op backward semantics); placement follows
+            # each output, so the placed path needs no device_put either
+            head_grads = [jnp.ones_like(o) for o in outs]
+        elif placed:
+            # the seed cotangent must sit where its primal output sits,
+            # or the last segment's transposed pjit sees mixed device
+            # commitments; interior cotangents then follow the
+            # transposed device_put edges automatically
+            head_grads = [
+                jax.device_put(h, next(iter(o.devices())))
+                for h, o in zip(head_grads, outs)
+            ]
+        # cotangent: (outputs_cot, aux_cot=zeros)
+        aux_cot = jax.tree_util.tree_map(jnp.zeros_like, new_aux)
+        (grads,) = vjp_fn((list(head_grads), aux_cot))
+        if add_names:
+            grads = dict(grads)
+            for k in add_names:
+                # grad_in + g, matching the retired eager path's operand
+                # order bit-for-bit
+                grads[k] = grad_ins[k] + grads[k]
+        return outs, new_aux, grads
+
+    return fwdbwd
+
+
 class Executor:
     """Parity: include/mxnet/executor.h Executor + python/mxnet/executor.py."""
 
@@ -507,72 +649,68 @@ class Executor:
             if self._placed:
                 self._plan = (node_dev, var_dev)
         self._grad_names = [k for k in arg_names if self.grad_req.get(k) != "null"]
+        # static arguments of the fused fwd+bwd program: which args need
+        # grads, and which of those accumulate (grad_req="add") INSIDE the
+        # compiled program — fixed at bind time, so precomputed once
+        self._gnames = tuple(self._grad_names)
+        self._add_names = tuple(
+            k for k in self._grad_names if self.grad_req.get(k) == "add")
         if self._placed:
             self._graph_fn = _build_placed_fn(symbol, node_dev, var_dev, self._ctx)
             # segments carry their own jits; the outer pipeline must stay
-            # un-jitted or GSPMD would re-place everything on one device
+            # un-jitted or GSPMD would re-place everything on one device —
+            # and the program cache is skipped: the plan is keyed by
+            # concrete devices, not graph structure
             self._jit_fwd = self._graph_fn
-            self._jit_fwdbwd = self._make_fwdbwd()
+            self._jit_fwdbwd = _make_fwdbwd(self._graph_fn, placed=True)
             _TM_GRAPH_CACHE.inc(result="miss")
         elif shared_exec is not None and shared_exec._symbol is symbol:
-            self._graph_fn = _build_graph_fn(symbol, platform=self._platform())
+            # object-identity fast path (no signature hash); the donor's
+            # entry already sits in the program cache when it is enabled
+            self._graph_fn = shared_exec._graph_fn
             self._jit_fwd = shared_exec._jit_fwd
             self._jit_fwdbwd = shared_exec._jit_fwdbwd
             _TM_GRAPH_CACHE.inc(result="hit")
         else:
-            self._graph_fn = _build_graph_fn(symbol, platform=self._platform())
-            self._jit_fwd = jax.jit(
-                _count_traces(lambda a, x, k, t: self._graph_fn(a, x, k, t),
-                              "fwd"),
-                static_argnums=(3,)
-            )
-            self._jit_fwdbwd = jax.jit(
-                _count_traces(self._make_fwdbwd(), "fwdbwd"),
-                static_argnames=("gnames",))
-            _TM_GRAPH_CACHE.inc(result="miss")
+            self._graph_fn, self._jit_fwd, self._jit_fwdbwd = \
+                _compiled_programs(symbol, self._platform())
         self._step = 0
         self._pending = None  # (args_raw, aux_raw, key) of last train forward
         self._outputs_cache: Optional[List] = None
+        # per-step input-dict reuse (see _gather_inputs): {name: value}
+        # dicts mutated in place + (ndarray, chunk, version) fingerprints
+        self._args_cache = ({}, {})
+        self._aux_cache = ({}, {})
         self._monitor_callback = None
         self._monitor_fn = None   # lazily-compiled internals tap
         self._monitor_names = None
 
-    # ------------------------------------------------------------------ build
-    def _make_fwdbwd(self):
-        graph_fn = self._graph_fn
-        placed = self._placed
-
-        def fwdbwd(arg_vals, aux_vals, key, head_grads, gnames: tuple):
-            def fwd_for_grad(grad_args):
-                merged = dict(arg_vals)
-                merged.update(grad_args)
-                outs, new_aux = graph_fn(merged, aux_vals, key, True)
-                return outs, new_aux
-
-            grad_args = {k: arg_vals[k] for k in gnames}
-            (outs, new_aux), vjp_fn = jax.vjp(
-                lambda ga: fwd_for_grad(ga), grad_args, has_aux=False
-            )
-            if placed:
-                # the seed cotangent must sit where its primal output sits,
-                # or the last segment's transposed pjit sees mixed device
-                # commitments; interior cotangents then follow the
-                # transposed device_put edges automatically
-                head_grads = [
-                    jax.device_put(h, next(iter(o.devices())))
-                    for h, o in zip(head_grads, outs)
-                ]
-            # cotangent: (outputs_cot, aux_cot=zeros)
-            aux_cot = jax.tree_util.tree_map(jnp.zeros_like, new_aux)
-            (grads,) = vjp_fn((head_grads, aux_cot))
-            return outs, new_aux, grads
-
-        return fwdbwd
-
     # ---------------------------------------------------------------- running
+    @staticmethod
+    def _read_through_cache(nd_dict, cache):
+        """Per-step input gather without rebuilding the dict.
+
+        The {name: jax.Array} dict handed to the jit is held and mutated
+        in place; an entry is re-read only when its NDArray object, chunk,
+        or chunk version changed since the last step (optimizer writes
+        bump the version; bind-time storage sharing swaps the object).  A
+        pending host_waiter (async kvstore pull) always forces the read so
+        deferred engine writes land before dispatch.
+        """
+        vals, fps = cache
+        for k, v in nd_dict.items():
+            ch = v._chunk
+            fp = fps.get(k)
+            if (fp is None or ch.host_waiter is not None or fp[0] is not v
+                    or fp[1] is not ch or fp[2] != ch.version):
+                vals[k] = v._read()
+                ch = v._chunk
+                fps[k] = (v, ch, ch.version)
+        return vals
+
     def _gather_inputs(self):
-        args = {k: v._read() for k, v in self.arg_dict.items()}
-        aux = {k: v._read() for k, v in self.aux_dict.items()}
+        args = self._read_through_cache(self.arg_dict, self._args_cache)
+        aux = self._read_through_cache(self.aux_dict, self._aux_cache)
         from . import random as _random
 
         key = jax.random.fold_in(_random.current_key(), self._step)
@@ -588,7 +726,13 @@ class Executor:
             if isinstance(v, NDArray):
                 self.arg_dict[k]._set(v._read())
             else:
-                self.arg_dict[k]._set(jnp.asarray(np.asarray(v, dtype=np.float32)))
+                arr = np.asarray(v)
+                if arr.dtype == np.float64:
+                    # untyped Python floats arrive as f64; the framework
+                    # default is f32.  Everything else (int labels, f16
+                    # inputs, ...) keeps its dtype
+                    arr = arr.astype(np.float32)
+                self.arg_dict[k]._set(jnp.asarray(arr))
         args, aux, key = self._gather_inputs()
         if is_train:
             # lazy: defer compute so backward() can run the fused fwd+bwd
@@ -631,11 +775,11 @@ class Executor:
 
     def _backward_impl(self, out_grads):
         args, aux, key = self._pending
-        outs_shapes = None
         if out_grads is None:
-            # loss-output graphs: ops define their own grads (custom_vjp) and
-            # ignore this; plain graphs get ones like sum-of-outputs loss
-            outs, new_aux, grads = self._run_fwdbwd_with_ones(args, aux, key)
+            # loss-output graphs: ops define their own grads (custom_vjp)
+            # and ignore the seed; plain graphs get an in-trace ones seed
+            # (sum-of-outputs loss) — see _make_fwdbwd
+            head = []
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
@@ -652,9 +796,11 @@ class Executor:
                     else h
                     for h in head
                 ]
-            outs, new_aux, grads = self._jit_fwdbwd(
-                args, aux, key, head, gnames=tuple(self._grad_names)
-            )
+        grad_ins = {k: self.grad_dict[k]._read() for k in self._add_names}
+        outs, new_aux, grads = self._jit_fwdbwd(
+            args, aux, key, head, grad_ins,
+            gnames=self._gnames, add_names=self._add_names
+        )
         self._outputs_cache = [NDArray(o) for o in outs]
         self._write_aux(new_aux)
         for k, g in grads.items():
@@ -662,21 +808,11 @@ class Executor:
             tgt = self.grad_dict.get(k)
             if tgt is None or req == "null":
                 continue
-            if req == "add":
-                tgt._set(tgt._read() + g)
-            else:
-                tgt._set(g)
+            # grad_req="add" was already accumulated inside the compiled
+            # program (grad_ins); every req lands with a plain write
+            tgt._set(g)
         if self._monitor_callback is not None:
             self._run_monitor(args, aux, key)
-
-    def _run_fwdbwd_with_ones(self, args, aux, key):
-        # head grads of ones — custom_vjp loss ops discard them (parity with
-        # reference loss-op backward semantics)
-        outs_aval, _ = jax.eval_shape(
-            lambda a, x, k: self._graph_fn(a, x, k, True), args, aux, key
-        )
-        head = [jnp.ones(o.shape, o.dtype) for o in outs_aval]
-        return self._jit_fwdbwd(args, aux, key, head, gnames=tuple(self._grad_names))
 
     def _write_aux(self, new_aux):
         for k, v in new_aux.items():
@@ -747,8 +883,12 @@ class Executor:
         just a fresh simple_bind (jit handles per-shape compilation cache)."""
         shapes = {k: v.shape for k, v in self.arg_dict.items()}
         shapes.update(kwargs)
+        # carry the bound dtypes over (type_dict is honored now), so a
+        # reshaped executor keeps e.g. integer-label buffers integer
+        types = {k: v.dtype for k, v in self.arg_dict.items()}
+        types.update({k: v.dtype for k, v in self.aux_dict.items()})
         return simple_bind(self._symbol, self._ctx, grad_req=self.grad_req,
-                           group2ctx=self._group2ctx or None,
+                           type_dict=types, group2ctx=self._group2ctx or None,
                            shared_exec=self, **shapes)
 
     @property
@@ -759,13 +899,28 @@ class Executor:
 def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
                 group2ctx=None, shared_exec=None, **kwargs) -> Executor:
     """Parity: Symbol.simple_bind (python/mxnet/symbol.py:726): infer
-    shapes, allocate arrays (+grads per grad_req), bind."""
+    shapes, allocate arrays (+grads per grad_req), bind.
+
+    ``type_dict`` assigns per-name dtypes to args/aux (parity: the
+    reference's simple_bind type inference); a ``Variable(dtype=...)``
+    annotation is the per-symbol default, and anything undeclared
+    allocates float32.  Grad arrays always match their arg's dtype.
+    """
     ctx = ctx or current_context()
     arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
     if arg_shapes is None:
         raise MXNetError(f"simple_bind: cannot infer shapes from {kwargs}")
     arg_names = symbol.list_arguments()
     aux_names = symbol.list_auxiliary_states()
+    dtypes = {}
+    for node in symbol.nodes:
+        if node.is_variable and "__dtype__" in node.extra_attrs:
+            dtypes[node.name] = node.extra_attrs["__dtype__"]
+    dtypes.update(type_dict or {})
+
+    def _dtype(name):
+        return jnp.dtype(np.dtype(dtypes.get(name, np.float32)))
+
     # ctx_group-annotated graphs: allocate each variable on its group's
     # device so params/grads live where their layer computes
     var_ctx = {}
@@ -773,11 +928,11 @@ def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
         _, var_ctx, _ = placement_plan(symbol, group2ctx, ctx)
     args = {}
     for name, shape in zip(arg_names, arg_shapes):
-        args[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32),
+        args[name] = NDArray(jnp.zeros(shape, dtype=_dtype(name)),
                              ctx=var_ctx.get(name, ctx))
     aux = {}
     for name, shape in zip(aux_names, aux_shapes):
-        aux[name] = NDArray(jnp.zeros(shape, dtype=jnp.float32),
+        aux[name] = NDArray(jnp.zeros(shape, dtype=_dtype(name)),
                             ctx=var_ctx.get(name, ctx))
 
     if isinstance(grad_req, str):
@@ -787,7 +942,8 @@ def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
     else:
         req = {k: grad_req.get(k, "null") for k in arg_names}
     grads = {
-        k: NDArray(jnp.zeros(dict(zip(arg_names, arg_shapes))[k], dtype=jnp.float32),
+        k: NDArray(jnp.zeros(dict(zip(arg_names, arg_shapes))[k],
+                             dtype=_dtype(k)),
                    ctx=var_ctx.get(k, ctx))
         for k in arg_names
         if req.get(k, "null") != "null"

@@ -246,14 +246,22 @@ class BucketingModule(BaseModule):
         ]
         label_shapes = data_batch.provide_label
         self.switch_bucket(bucket_key, data_shapes, label_shapes)
-        # propagate latest params into the bucket's executor
+        default_mod = self._buckets[self._default_bucket_key]
         if self._curr_module.params_initialized is False:
             self._curr_module.params_initialized = True
-        self._curr_module._exec_group.set_params(
-            self._buckets[self._default_bucket_key]._arg_params or {},
-            self._buckets[self._default_bucket_key]._aux_params or {})
-        self._curr_module._arg_params = self._buckets[self._default_bucket_key]._arg_params
-        self._curr_module._aux_params = self._buckets[self._default_bucket_key]._aux_params
+        # propagate latest params into the bucket's executor — but only
+        # when this bucket does NOT live-share param storage with the
+        # default bucket (executor_group same-mesh sharing): shared chunks
+        # already see every optimizer write, and re-pushing the master
+        # copy was a full param-set device_put on every batch
+        if (self._curr_module is not default_mod
+                and not getattr(self._curr_module._exec_group,
+                                "shares_param_storage", False)):
+            self._curr_module._exec_group.set_params(
+                default_mod._arg_params or {},
+                default_mod._aux_params or {})
+        self._curr_module._arg_params = default_mod._arg_params
+        self._curr_module._aux_params = default_mod._aux_params
         self._curr_module.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):

@@ -98,12 +98,22 @@ class DataParallelExecutorGroup:
                 req[name] = "null"
             else:
                 req[name] = grad_req if for_training else "null"
+        # shared_exec is the object-identity fast path (same Symbol =>
+        # donor's jits); a regenerated bucket symbol misses it but still
+        # reuses compiled programs through the executor's process-wide
+        # program cache (structural signature), so switch_bucket never
+        # recompiles a structure it has seen
         shared_exec = shared_group.execs[0] if shared_group is not None else None
         exec_ = simple_bind(symbol, contexts[0], grad_req=req,
                             shared_exec=shared_exec, **input_shapes)
         same_mesh = (shared_group is not None
                      and list(shared_group.mesh.devices.flat)
                      == list(self.mesh.devices.flat))
+        # set True below only when EVERY param this group holds live-shares
+        # the donor's storage; BucketingModule consults it to skip the
+        # per-forward master-param push (a partially-shared group — e.g. a
+        # shape-mismatched param — must keep receiving pushes)
+        self.shares_param_storage = False
         if shared_exec is not None and same_mesh:
             # LIVE param/aux sharing (reference parity: shared_module
             # executors share parameter storage, module.py:346-349 +
@@ -115,18 +125,24 @@ class DataParallelExecutorGroup:
             # device mesh: a sharee on a trimmed mesh (smaller batch)
             # would re-shard the donor's live chunks out from under its
             # compiled step — there, snapshot semantics remain.
+            shared_all = True
             for name in self.param_names:
                 donor = shared_exec.arg_dict.get(name)
                 mine = exec_.arg_dict.get(name)
                 if donor is not None and mine is not None \
                         and donor.shape == mine.shape:
                     exec_.arg_dict[name] = donor
+                elif mine is not None:
+                    shared_all = False
             exec_.arg_arrays = [exec_.arg_dict[n] for n in arg_names]
             for name, donor in shared_exec.aux_dict.items():
                 mine = exec_.aux_dict.get(name)
                 if mine is not None and donor.shape == mine.shape:
                     exec_.aux_dict[name] = donor
+                elif mine is not None:
+                    shared_all = False
             exec_.aux_arrays = [exec_.aux_dict[n] for n in self.aux_names]
+            self.shares_param_storage = shared_all
         # replicate params over the mesh so GSPMD sees them as shared
         if len(unique) > 1:
             for name, arr in exec_.arg_dict.items():

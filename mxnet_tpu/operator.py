@@ -98,6 +98,12 @@ def register(reg_name):
         from .ops.custom import invalidate_num_outputs_cache
 
         invalidate_num_outputs_cache(reg_name)
+        # a structurally-identical graph bound after a re-register must
+        # not reuse programs traced through the OLD prop — the signature
+        # only sees op_type, not the class behind it
+        from .executor import program_cache_clear
+
+        program_cache_clear()
         return prop_cls
 
     return deco

@@ -17,6 +17,7 @@ JSON round-trip keeps the reference's nodes/arg_nodes/heads structure
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import sys
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -134,6 +135,35 @@ class Symbol:
     def __iter__(self):
         for i in range(len(self._outputs)):
             yield self[i]
+
+    def structural_signature(self) -> str:
+        """Structure hash for the executor's compiled-program cache.
+
+        Two symbols with equal signatures evaluate identically through
+        ``_build_graph_fn``: same op topology, op types, op attrs,
+        variable names / aux flags / declared shapes+dtypes (``__shape__``
+        and ``__dtype__`` live in extra_attrs), and the same output
+        entries.  Node identity is deliberately NOT part of the key — a
+        graph rebuilt from scratch (fresh ``simple_bind`` in tests or
+        serving, a re-generated bucket symbol) hashes equal and reuses
+        the already-jitted executables.  Runtime input shapes/dtypes stay
+        out of the key: ``jax.jit`` already caches per-aval under one
+        compiled callable, which is exactly the reuse this enables.
+        """
+        nodes = self.nodes
+        index = {id(n): i for i, n in enumerate(nodes)}
+        parts = []
+        for n in nodes:
+            parts.append((
+                n.op or "null",
+                n.name,
+                n.is_aux,
+                tuple(sorted((k, repr(v)) for k, v in n.attrs.items())),
+                tuple(sorted((k, repr(v)) for k, v in n.extra_attrs.items())),
+                tuple((index[id(src)], oidx) for src, oidx in n.inputs),
+            ))
+        heads = tuple((index[id(n)], i) for n, i in self._outputs)
+        return hashlib.sha256(repr((parts, heads)).encode()).hexdigest()
 
     def get_internals(self) -> "Symbol":
         """Parity: Symbol.get_internals — every node's outputs, topo order."""

@@ -128,7 +128,8 @@ def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4,
         ctx = spec["ctx"]
         shapes = {k: v for k, v in spec.items() if k != "ctx" and k != "type_dict"}
         _random.seed(0)
-        ex = sym.simple_bind(ctx, grad_req="write", **shapes)
+        ex = sym.simple_bind(ctx, grad_req="write",
+                             type_dict=spec.get("type_dict"), **shapes)
         rs = np.random.RandomState(0)
         for k in sorted(ex.arg_dict):
             if arg_params and k in arg_params:

@@ -179,6 +179,194 @@ def test_shared_exec_bucketing_cache():
     assert ex2._jit_fwd is ex1._jit_fwd  # compilation cache shared
 
 
+# ---------------------------------------------------------------------------
+# process-wide program cache + in-jit gradient accumulation (ISSUE 2)
+# ---------------------------------------------------------------------------
+def _uniquely_named_net(tag, num_hidden=4):
+    """A small train graph rebuilt from scratch per call.  Explicit names
+    keyed on ``tag`` make the structure unique per test (the program
+    cache is process-wide) while two calls with the SAME tag hash equal."""
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name=f"{tag}_fc", num_hidden=num_hidden)
+    return sym.SoftmaxOutput(fc, name=f"{tag}_softmax")
+
+
+@pytest.fixture
+def _telemetry():
+    from mxnet_tpu import telemetry as tm
+
+    tm.reset()
+    tm.enable()
+    yield tm.get_registry()
+    tm.reset()
+    tm.disable()
+
+
+def test_program_cache_rebind_zero_retraces(_telemetry):
+    """Binding a structurally-identical symbol twice reuses the jitted
+    programs: graph-cache hit recorded, compile counter stays flat."""
+    reg = _telemetry
+    ex1 = _uniquely_named_net("pc0").simple_bind(mx.cpu(), data=(4, 6))
+    ex1.forward(is_train=True)
+    ex1.backward()
+    compiles = reg.get("executor_compile_total").total()
+    hits = reg.get("executor_graph_cache_total").value(result="hit")
+    # a FRESH symbol object with the same structure — object-identity
+    # shared_exec cannot help here, only the program cache can
+    ex2 = _uniquely_named_net("pc0").simple_bind(mx.cpu(), data=(4, 6))
+    assert ex2._jit_fwd is ex1._jit_fwd
+    assert ex2._jit_fwdbwd is ex1._jit_fwdbwd
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert reg.get("executor_graph_cache_total").value(result="hit") == hits + 1
+    assert reg.get("executor_compile_total").total() == compiles
+
+
+def test_program_cache_disable_knob(monkeypatch):
+    from mxnet_tpu.executor import program_cache_clear
+
+    monkeypatch.setenv("MXTPU_PROGRAM_CACHE", "off")
+    program_cache_clear()
+    ex1 = _uniquely_named_net("pc1").simple_bind(mx.cpu(), data=(2, 3))
+    ex2 = _uniquely_named_net("pc1").simple_bind(mx.cpu(), data=(2, 3))
+    assert ex2._jit_fwd is not ex1._jit_fwd  # cache off: fresh jits
+
+
+def test_program_cache_lru_bound(monkeypatch):
+    from mxnet_tpu.executor import program_cache_clear
+
+    monkeypatch.setenv("MXTPU_PROGRAM_CACHE", "1")  # capacity 1
+    program_cache_clear()
+    ex_a = _uniquely_named_net("pc2a").simple_bind(mx.cpu(), data=(2, 3))
+    ex_b = _uniquely_named_net("pc2b").simple_bind(mx.cpu(), data=(2, 3))
+    assert ex_b._jit_fwd is not ex_a._jit_fwd
+    # binding A's structure again must MISS: B evicted it (capacity 1)
+    ex_a2 = _uniquely_named_net("pc2a").simple_bind(mx.cpu(), data=(2, 3))
+    assert ex_a2._jit_fwd is not ex_a._jit_fwd
+    # ... and A, now resident again, hits
+    ex_a3 = _uniquely_named_net("pc2a").simple_bind(mx.cpu(), data=(2, 3))
+    assert ex_a3._jit_fwd is ex_a2._jit_fwd
+
+
+def test_grad_req_add_accumulates_inside_jit():
+    """grad_req="add" must land through the fused fwd+bwd program (no
+    eager per-param add): the grad buffer receives EXACTLY the program's
+    returned grad, and accumulation matches eager float32 bitwise."""
+    rs = np.random.RandomState(11)
+    a_val = rs.randn(3, 4).astype(np.float32)
+    b_val = rs.randn(3, 4).astype(np.float32)
+    a, b = sym.Variable("a"), sym.Variable("b")
+    net = a * b
+    ex = net.simple_bind(mx.cpu(), grad_req="add", a=(3, 4), b=(3, 4))
+    ex.arg_dict["a"][:] = a_val
+    ex.arg_dict["b"][:] = b_val
+
+    calls = []
+    orig = ex._jit_fwdbwd
+
+    def spy(*args, **kwargs):
+        res = orig(*args, **kwargs)
+        calls.append((args, kwargs, res))
+        return res
+
+    ex._jit_fwdbwd = spy
+    head = nd.ones((3, 4))
+    expected = np.zeros((3, 4), np.float32)
+    for _ in range(3):
+        ex.forward(is_train=True)
+        ex.backward([head])
+        expected = expected + b_val  # eager float32 reference, in order
+    assert len(calls) == 3
+    _, kwargs, res = calls[-1]
+    assert set(kwargs["add_names"]) == {"a", "b"}
+    # the written grad IS the program output — no eager post-add happened
+    np.testing.assert_array_equal(
+        np.asarray(res[2]["a"]), ex.grad_dict["a"].asnumpy())
+    # and the fused accumulation is bitwise-equal to the eager path
+    np.testing.assert_array_equal(ex.grad_dict["a"].asnumpy(), expected)
+
+
+def test_backward_without_head_grads_single_jit_call():
+    """The ones-seed backward builds cotangents in-trace: no separate
+    eval_shape / ones dispatch per step, and repeat steps never retrace."""
+    from mxnet_tpu import telemetry as tm
+
+    tm.reset()
+    tm.enable()
+    try:
+        reg = tm.get_registry()
+        net = _uniquely_named_net("pc3")
+        ex = net.simple_bind(mx.cpu(), data=(4, 6))
+        ex.forward(is_train=True)
+        ex.backward()
+        compiles = reg.get("executor_compile_total").total()
+        for _ in range(5):
+            ex.forward(is_train=True)
+            ex.backward()
+        assert reg.get("executor_compile_total").total() == compiles
+    finally:
+        tm.reset()
+        tm.disable()
+
+
+def test_input_gather_cache_sees_updates():
+    """The per-step input-dict cache must never serve stale values: an
+    in-place write (version bump) and a wholesale NDArray replacement
+    both invalidate the cached entry."""
+    a = sym.Variable("a")
+    net = a * 2.0
+    ex = net.simple_bind(mx.cpu(), grad_req="null", a=(2,))
+    ex.arg_dict["a"][:] = 1.0
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [2, 2])
+    ex.arg_dict["a"][:] = 3.0  # same chunk, bumped version
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [6, 6])
+    ex.arg_dict["a"] = nd.array([5.0, 5.0])  # replaced NDArray object
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [10, 10])
+
+
+def test_simple_bind_honors_type_dict():
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    net = data * w
+    ex = net.simple_bind(mx.cpu(), type_dict={"data": np.int32},
+                         data=(2, 2), w=(2, 2))
+    assert ex.arg_dict["data"].dtype == np.int32
+    assert ex.arg_dict["w"].dtype == np.float32  # undeclared stays fp32
+    assert ex.grad_dict["w"].dtype == np.float32
+    # grads allocate in their arg's dtype
+    ex16 = net.simple_bind(mx.cpu(), type_dict={"w": np.float16},
+                           data=(2, 2), w=(2, 2))
+    assert ex16.arg_dict["w"].dtype == np.float16
+    assert ex16.grad_dict["w"].dtype == np.float16
+
+
+def test_simple_bind_variable_dtype_attr():
+    data = sym.Variable("data", dtype=np.int32)
+    net = sym.BlockGrad(data)
+    ex = net.simple_bind(mx.cpu(), grad_req="null", data=(3,))
+    assert ex.arg_dict["data"].dtype == np.int32
+    # explicit type_dict overrides the Variable annotation
+    ex2 = net.simple_bind(mx.cpu(), grad_req="null",
+                          type_dict={"data": np.float32}, data=(3,))
+    assert ex2.arg_dict["data"].dtype == np.float32
+
+
+def test_forward_kwargs_preserve_dtype():
+    """Executor.forward(**kwargs) must not force-cast typed inputs to
+    fp32 — integer labels keep an integer dtype; plain Python floats
+    still default to fp32."""
+    data = sym.Variable("data")
+    net = sym.BlockGrad(data)
+    ex = net.simple_bind(mx.cpu(), grad_req="null", data=(3,))
+    out = ex.forward(data=np.array([1, 2, 3], dtype=np.int32))[0]
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out.asnumpy(), [1, 2, 3])
+    out = ex.forward(data=[1.0, 2.0, 3.0])[0]
+    assert out.dtype == np.float32
+    out = ex.forward(data=np.array([1, 2, 3], dtype=np.float16))[0]
+    assert out.dtype == np.float16
+
+
 def test_check_consistency_multi_ctx():
     data = sym.Variable("data")
     fc = sym.FullyConnected(data, name="fc", num_hidden=4)
