@@ -210,6 +210,72 @@ def _dispatch_micro():
             tm.disable()
 
 
+def _kv_update_micro():
+    """KVStore update-path micro-bench (round 7): eager per-key push/pull
+    vs the bucketed jit-fused engine (kvstore_fused.py) on a ~100-param
+    model.
+
+    Each timed step is the Module-path kvstore half: one batched
+    ``push(keys, grads)`` (reduce + optimizer update) + one batched
+    ``pull(keys, outs)``.  Eager pays ~6 tiny dispatches per key; fused
+    pays one compiled program per bucket — the reported ratio is the
+    per-step dispatch-overhead win.  ``kv_buckets`` records the fused
+    plan size under the default MXTPU_KV_BUCKET_MB.
+    """
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rng = np.random.RandomState(7)
+    # ~100 keys, conv/bias-shaped mix (~1.7MB total) like a small convnet
+    shapes = ([(128, 32), (32,), (64, 64), (64,)] * 25)
+    weights = [rng.uniform(-1, 1, s).astype(np.float32) for s in shapes]
+    grads = [rng.uniform(-1, 1, s).astype(np.float32) for s in shapes]
+    keys = list(range(len(shapes)))
+
+    def run(fused):
+        prev = os.environ.get("MXTPU_FUSED_UPDATE")
+        os.environ["MXTPU_FUSED_UPDATE"] = "1" if fused else "0"
+        try:
+            kv = mx.kv.create("local")
+            kv.set_optimizer(mx.optimizer.create(
+                "sgd", learning_rate=0.05, momentum=0.9,
+                rescale_grad=1.0 / 32))
+            kv.init(keys, [nd.array(w) for w in weights])
+            gnds = [[nd.array(g)] for g in grads]
+            outs = [nd.zeros(s) for s in shapes]
+
+            def step():
+                kv.push(keys, gnds)
+                kv.pull(keys, outs)
+
+            for _ in range(3):  # warmup: plan build + bucket compiles
+                step()
+            jax.block_until_ready([o._read() for o in outs])
+            n = 30
+            tic = time.perf_counter()
+            for _ in range(n):
+                step()
+            jax.block_until_ready([o._read() for o in outs])
+            dt = (time.perf_counter() - tic) / n
+            nbuckets = kv._fused.num_buckets if kv._fused is not None else 0
+            return dt, nbuckets
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_FUSED_UPDATE", None)
+            else:
+                os.environ["MXTPU_FUSED_UPDATE"] = prev
+
+    eager_dt, _ = run(False)
+    fused_dt, nbuckets = run(True)
+    return {"kv_update_us_per_step": round(fused_dt * 1e6, 1),
+            "kv_update_us_per_step_eager": round(eager_dt * 1e6, 1),
+            "kv_update_speedup": round(eager_dt / max(fused_dt, 1e-9), 1),
+            "kv_buckets": nbuckets}
+
+
 def _bench(dev, kind):
     import jax
     import jax.numpy as jnp
@@ -497,6 +563,14 @@ def _bench(dev, kind):
                 # per-key sets (dict.update bypasses _Extras.__setitem__,
                 # which is what lands keys in the payload immediately)
                 for k_, v_ in _dispatch_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # kvstore update hot-path: eager per-key push/pull vs the
+            # bucketed jit-fused engine on a ~100-param model (ISSUE 3)
+            if os.environ.get("BENCH_KV", "1") == "1":
+                for k_, v_ in _kv_update_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

@@ -129,6 +129,43 @@ def program_cache_clear():
         _program_cache.clear()
 
 
+def program_cache_get(key):
+    """Look up an entry by explicit key in the process-wide program LRU.
+
+    Non-bind subsystems (the kvstore's bucketed fused-update engine)
+    key their jitted programs into the same LRU so engine rebuilds,
+    Module rebinds, and bucket-plan regeneration reuse executables; a
+    hit counts in ``executor_graph_cache_total`` like a bind-time hit.
+    Returns ``None`` when absent or when the cache is disabled (the
+    caller builds and should then call :func:`program_cache_put`)."""
+    if program_cache_capacity() <= 0:
+        return None
+    with _program_cache_lock:
+        entry = _program_cache.get(key)
+        if entry is not None:
+            _program_cache.move_to_end(key)
+    if entry is not None:
+        _TM_GRAPH_CACHE.inc(result="hit")
+    return entry
+
+
+def program_cache_put(key, entry):
+    """Insert an entry built after a :func:`program_cache_get` miss.
+
+    Counts the miss and evicts least-recently-used entries past
+    capacity; insertion is skipped (miss still counted) when the cache
+    is disabled — the caller keeps its own reference either way."""
+    _TM_GRAPH_CACHE.inc(result="miss")
+    capacity = program_cache_capacity()
+    if capacity <= 0:
+        return
+    with _program_cache_lock:
+        _program_cache[key] = entry
+        _program_cache.move_to_end(key)
+        while len(_program_cache) > capacity:
+            _program_cache.popitem(last=False)
+
+
 def _compiled_programs(symbol: Symbol, platform: Optional[str]):
     """(graph_fn, jit_fwd, jit_fwdbwd) for a symbol, through the cache.
 

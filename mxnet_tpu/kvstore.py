@@ -22,6 +22,14 @@ Push/pull keep the reference's per-key priority contract (each layer's
 gradient communicated as soon as backward emits it — SURVEY.md §3.4): on
 TPU, XLA's async dispatch provides the overlap, and the fused-step path
 turns per-key psums into one bucketed all-reduce.
+
+Batched ``push(keys, grads)`` / ``pull(keys, outs)`` calls on a store
+whose optimizer exposes a fused rule are routed to the bucketed
+jit-fused update engine (kvstore_fused.py): size-capped flat buckets,
+one compiled reduction + one jitted multi-tensor optimizer program per
+bucket, device-resident state.  ``MXTPU_FUSED_UPDATE=0`` restores the
+eager per-key loops, which also remain the path for ``dist_*`` stores,
+custom updaters, and unsupported optimizers.
 """
 from __future__ import annotations
 
@@ -68,6 +76,16 @@ def _key_list(key):
     return (key if isinstance(key, (list, tuple)) else [key]), not isinstance(key, (list, tuple))
 
 
+def _check_pairs(keys, values, op, what="values"):
+    """A key list zipped against a mismatched value list would silently
+    truncate to the shorter side — drop the check and a caller passing
+    99 grads for 100 keys trains 99 params and never learns why."""
+    if values is None or len(keys) != len(values):
+        got = "None" if values is None else str(len(values))
+        raise MXNetError(
+            f"KVStore.{op}: got {len(keys)} keys but {got} {what}")
+
+
 class KVStore:
     """Parity: include/mxnet/kvstore.h:26-286 + python/mxnet/kvstore.py."""
 
@@ -82,6 +100,9 @@ class KVStore:
         self._device_mode = kv_type in ("device", "local_allreduce_device")
         self._merge_ctx: Dict = {}
         self._merge_load: Dict = {}
+        # bucketed jit-fused update engine (kvstore_fused.py), built by
+        # set_optimizer when the optimizer has a fused rule
+        self._fused = None
 
     def _merge_context(self, k, vals):
         """Pick (once per key) the least-loaded device among the pushed
@@ -108,6 +129,7 @@ class KVStore:
         """Parity: KVStore::Init — must be called once per key."""
         keys, _ = _key_list(key)
         values = value if isinstance(value, (list, tuple)) else [value]
+        _check_pairs(keys, values, "init")
         for k, v in zip(keys, values):
             if k in self._store:
                 raise MXNetError(f"duplicate init of key {k}")
@@ -122,6 +144,10 @@ class KVStore:
             values = [value]
         else:
             values = value
+            _check_pairs(keys, values, "push")
+        if (self._fused is not None and not single
+                and self._fused.handle_push(keys, values)):
+            return
         for k, v in zip(keys, values):
             t0 = time.perf_counter() if _tm.enabled() else None
             if isinstance(v, (list, tuple)):
@@ -165,9 +191,21 @@ class KVStore:
         keys, single = _key_list(key)
         outs = [out] if isinstance(out, NDArray) else out
         if single and isinstance(out, (list, tuple)):
+            # single-key fan-out fast path — timed like the main loop
+            # (it used to record count/bytes but skip the latency
+            # histogram, leaving kvstore_pull_seconds under-counted)
+            t0 = time.perf_counter() if _tm.enabled() else None
             for o in out:
                 self._store[keys[0]].copyto(o)
-            self._record_pull(keys[0], len(out))
+            if t0 is not None:
+                self._record_pull(keys[0], len(out))
+                _TM_PULL_SEC.observe(time.perf_counter() - t0,
+                                     store=self.type)
+            return
+        if not single:
+            _check_pairs(keys, outs, "pull", what="out arrays")
+        if (self._fused is not None and not single
+                and self._fused.handle_pull(keys, outs)):
             return
         for k, o in zip(keys, outs):
             t0 = time.perf_counter() if _tm.enabled() else None
@@ -192,14 +230,33 @@ class KVStore:
     # -------------------------------------------------------------- optimizer
     def set_optimizer(self, optimizer):
         """Parity: kvstore.py set_optimizer — runs the optimizer inside the
-        store (update_on_kvstore mode; server-side for dist)."""
+        store (update_on_kvstore mode; server-side for dist).  When the
+        optimizer exposes a fused rule, batched pushes route through the
+        bucketed jit-fused update engine (kvstore_fused.py)."""
         from . import optimizer as opt
 
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
+        self._maybe_init_fused()
+
+    def _maybe_init_fused(self):
+        self._fused = None
+        if "dist" in self.type or self._optimizer is None:
+            return  # dist stores keep the per-key RPC/priority contract
+        from . import kvstore_fused as kvf
+
+        if not kvf.fused_update_enabled():
+            return
+        if self._optimizer.fused_rule() is None:
+            return  # no fused rule (NAG, centered RMSProp, ...) -> eager
+        self._fused = kvf.FusedUpdateEngine(self, self._optimizer,
+                                            self._updater)
 
     def _set_updater(self, updater):
+        # a custom Python updater has no fused rule — eager per-key path
         self._updater = updater
+        self._optimizer = None
+        self._fused = None
 
     set_updater = _set_updater
 
@@ -519,6 +576,7 @@ class KVStoreDist(KVStore):
             return super().init(key, value)
         keys, _ = _key_list(key)
         values = value if isinstance(value, (list, tuple)) else [value]
+        _check_pairs(keys, values, "init")
         for k, v in zip(keys, values):
             self._shapes[k] = (v.shape, np.dtype(v.dtype))
             if self._rank == 0 and not self._recovery:
@@ -533,6 +591,8 @@ class KVStoreDist(KVStore):
             return super().push(key, value, priority)
         keys, single = _key_list(key)
         values = [value] if single else value
+        if not single:
+            _check_pairs(keys, values, "push")
         for k, v in zip(keys, values):
             if isinstance(v, (list, tuple)):
                 merged = v[0].copy()
@@ -584,6 +644,8 @@ class KVStoreDist(KVStore):
         outs = [out] if isinstance(out, NDArray) else out
         if single and isinstance(out, (list, tuple)):
             outs = [out]
+        elif not single:
+            _check_pairs(keys, outs, "pull", what="out arrays")
         for k, o in zip(keys, outs):
             shape, dtype = self._shapes[k]
             targets = o if isinstance(o, (list, tuple)) else [o]

@@ -1,0 +1,379 @@
+"""Bucketed, jit-fused KVStore update engine.
+
+The eager update path (kvstore.py push/pull loops + optimizer.py
+per-key ``update()``) pays one Python round-trip, one device copy, one
+reduction, and one updater dispatch **per parameter** per step — ~300
+tiny dispatches for a 100-param net.  This engine restructures the
+Module step's kvstore half the way arXiv:2004.13336 restructures the
+weight update and TVM (arXiv:1802.04799) argues for operator fusion:
+
+- registered keys are grouped into size-capped **flat buckets**
+  (``MXTPU_KV_BUCKET_MB``, default ~4MB; stable key order,
+  dtype-segregated — a param bigger than the cap gets its own bucket),
+- each bucket's per-device gradient copies are reduced with **one
+  compiled reduction per bucket** (flatten+concat per source device,
+  one transfer per device to the bucket's least-loaded merge device,
+  one flat add) instead of one reduction per key,
+- the optimizer update for every key in the bucket runs inside a
+  **single jitted program** — the multi-tensor rules from
+  optim_rules.py (shared with FusedTrainer) tree-mapped over the
+  bucket's slices; optimizer state lives in the same NDArrays the eager
+  ``Updater`` owns but stays **device-resident** (placed once, never
+  re-materialized through ``as_in_context`` per step),
+- pull becomes a bucket-sliced broadcast: out arrays adopt the updated
+  buffers by chunk rebind when they share the store's devices (zero
+  dispatches), with an explicit device_put only across device sets.
+
+Per-step lr (including Adam's host-side bias correction) enters the
+program as a traced scalar, so lr schedules never retrace; everything
+else (bucket layout, optimizer hyperparams, per-key wd) is static and
+forms the program's key in the executor's process-wide LRU
+(``program_cache_get/put``) — rebinds, plan rebuilds, and new engine
+instances reuse the compiled programs, visible as
+``executor_graph_cache_total`` hits.
+
+Eager per-key behavior stays available via ``MXTPU_FUSED_UPDATE=0`` and
+remains the fallback for ``dist_*`` stores, custom Python updaters,
+optimizers without a fused rule (``Optimizer.fused_rule()`` is None),
+and pushes the engine cannot bucket (unregistered keys, ragged
+per-device copy lists).  Interleaving eager and fused steps is safe:
+both paths share the ``Updater``'s state store and the kvstore's value
+NDArrays.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import executor as _executor
+from . import telemetry as _tm
+from .ndarray import NDArray
+from .optim_rules import _RULES
+
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_FUSED_SEC = _tm.histogram(
+    "kvstore_fused_update_seconds",
+    "wall time of one batched fused push (bucket reductions + jitted "
+    "multi-tensor optimizer updates; dispatch, not device completion)",
+    labels=("store",))
+_TM_BUCKET_COUNT = _tm.gauge(
+    "kvstore_bucket_count",
+    "flat buckets in the current fused-update plan", labels=("store",))
+_TM_BUCKET_BYTES = _tm.histogram(
+    "kvstore_bucket_bytes",
+    "bytes per flat bucket at plan build (dtype-segregated, capped by "
+    "MXTPU_KV_BUCKET_MB)", labels=("store",),
+    buckets=(1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20,
+             1 << 22, 1 << 23, 1 << 24, 1 << 26))
+
+_DEFAULT_BUCKET_MB = 4.0
+
+
+def fused_update_enabled() -> bool:
+    """MXTPU_FUSED_UPDATE gate (default on)."""
+    from .base import parse_bool
+
+    return parse_bool(os.environ.get("MXTPU_FUSED_UPDATE", "1"))
+
+
+def bucket_cap_bytes() -> int:
+    """Resolved MXTPU_KV_BUCKET_MB cap in bytes (fractions allowed)."""
+    raw = os.environ.get("MXTPU_KV_BUCKET_MB", "").strip()
+    try:
+        mb = float(raw) if raw else _DEFAULT_BUCKET_MB
+    except ValueError:
+        mb = _DEFAULT_BUCKET_MB
+    return max(int(mb * (1 << 20)), 1)
+
+
+def _lead_device(raw):
+    """Deterministic representative device of a (possibly sharded) array."""
+    return sorted(raw.sharding.device_set, key=lambda d: d.id)[0]
+
+
+def _state_slots(state) -> Tuple[NDArray, ...]:
+    """Updater state container -> the rule's tuple layout (None -> (),
+    single NDArray -> 1 slot, tuple -> as-is)."""
+    if state is None:
+        return ()
+    if isinstance(state, (tuple, list)):
+        return tuple(state)
+    return (state,)
+
+
+def _make_bucket_program(rule_name, opt_params, shapes, sizes, wds):
+    """One jitted program for a bucket: flatten+concat each device's
+    grads, ONE flat reduction across devices, then the per-key slices
+    run the shared update rule — XLA fuses the whole chain.  ``lrs``
+    are traced scalars; shapes/sizes/wds/hyperparams are static."""
+    init_state, update = _RULES[rule_name](dict(opt_params))
+    del init_state  # states come pre-created through the Updater
+
+    def bucket_step(dev_parts, weights, states, lrs):
+        flats = []
+        for part in dev_parts:
+            if isinstance(part, (tuple, list)):
+                segs = [jnp.ravel(g) for g in part]
+                flats.append(segs[0] if len(segs) == 1
+                             else jnp.concatenate(segs))
+            else:  # pre-concatenated on the source device
+                flats.append(jnp.ravel(part))
+        merged = flats[0]
+        for f in flats[1:]:
+            merged = merged + f
+        new_w, new_s = [], []
+        off = 0
+        for i, shape in enumerate(shapes):
+            g = merged[off:off + sizes[i]].reshape(shape)
+            off += sizes[i]
+            # lrs is ONE stacked traced vector (not n scalar leaves —
+            # pytree flattening cost scales with leaf count on every
+            # dispatch); lrs[i] is the key's traced scalar lr
+            nw, ns = update(weights[i], g, states[i], lrs[i], wds[i])
+            new_w.append(nw)
+            new_s.append(tuple(ns))
+        return tuple(new_w), tuple(new_s)
+
+    return jax.jit(_executor._count_traces(bucket_step, "kv_update"))
+
+
+_concat_flat = None
+
+
+def _concat(parts):
+    """Jitted flatten+concat, run on the parts' (source) device."""
+    global _concat_flat
+    if _concat_flat is None:
+        _concat_flat = jax.jit(_executor._count_traces(
+            lambda ps: jnp.concatenate([jnp.ravel(p) for p in ps]),
+            "kv_concat"))
+    return _concat_flat(tuple(parts))
+
+
+class _Bucket:
+    __slots__ = ("dtype", "keys", "shapes", "sizes", "nbytes",
+                 "target", "tset")
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+        self.keys: List = []
+        self.shapes: List[Tuple[int, ...]] = []
+        self.sizes: List[int] = []
+        self.nbytes = 0
+        self.target = None   # jax Sharding the bucket executes under
+        self.tset = None     # its device_set (cheap placement guard)
+
+
+class FusedUpdateEngine:
+    """Drives the bucketed fused update for one KVStore instance.
+
+    Created by ``KVStore.set_optimizer`` when the optimizer exposes a
+    fused rule; ``handle_push``/``handle_pull`` return False when a call
+    is not bucketable so the store falls back to the eager loops."""
+
+    def __init__(self, kvstore, optimizer, updater):
+        self._kv = kvstore
+        self._opt = optimizer
+        self._updater = updater
+        self._buckets: Optional[List[_Bucket]] = None
+        self._plan_keys: Optional[Tuple] = None
+        self._key_index: Dict = {}
+        self._ndev = 0
+        self._load: Dict = {}       # merge-device -> assigned bucket bytes
+        self._local_programs: Dict = {}  # fallback when the LRU is off
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self._buckets or ())
+
+    # ----------------------------------------------------------------- plan
+    def _build_plan(self, keys, vlists, ndev):
+        cap = bucket_cap_bytes()
+        buckets: List[_Bucket] = []
+        cur = None
+        for i, _k in enumerate(keys):
+            g0 = vlists[i][0]._read()
+            dt = np.dtype(g0.dtype)
+            size = int(g0.size)
+            nbytes = size * dt.itemsize
+            if (cur is None or cur.dtype != dt
+                    or (cur.nbytes and cur.nbytes + nbytes > cap)):
+                cur = _Bucket(dt)
+                buckets.append(cur)
+            cur.keys.append(keys[i])
+            cur.shapes.append(tuple(g0.shape))
+            cur.sizes.append(size)
+            cur.nbytes += nbytes
+        idx = {k: i for i, k in enumerate(keys)}
+        for b in buckets:
+            raws = [vlists[idx[b.keys[0]]][d]._read() for d in range(ndev)]
+            if ndev == 1:
+                # single (possibly mesh-global) grad per key: execute
+                # where the gradients already live — zero grad transfers
+                b.target = raws[0].sharding
+            else:
+                # per-device copies: least-loaded merge device among the
+                # copies' devices, per bucket (parity: CommDevice::
+                # InitMergeBuffer load balancing, comm.h:321-348, lifted
+                # from per-key to per-bucket granularity)
+                cands = sorted({_lead_device(r) for r in raws},
+                               key=lambda d: (d.platform, d.id))
+                dev = min(cands, key=lambda d: self._load.get(d, 0))
+                self._load[dev] = self._load.get(dev, 0) + b.nbytes
+                b.target = jax.sharding.SingleDeviceSharding(dev)
+            b.tset = b.target.device_set
+            if _tm.enabled():
+                _TM_BUCKET_BYTES.observe(b.nbytes, store=self._kv.type)
+        self._buckets = buckets
+        self._plan_keys = tuple(keys)
+        self._key_index = idx
+        self._ndev = ndev
+        if _tm.enabled():
+            _TM_BUCKET_COUNT.set(len(buckets), store=self._kv.type)
+
+    # ----------------------------------------------------------------- push
+    def handle_push(self, keys, values) -> bool:
+        """Run the fused bucketed update for one batched push; False if
+        this call is not bucketable (caller falls back to eager)."""
+        kv = self._kv
+        vlists = [list(v) if isinstance(v, (list, tuple)) else [v]
+                  for v in values]
+        if not vlists:
+            return False
+        ndev = len(vlists[0])
+        if ndev == 0:
+            return False
+        for k, vl in zip(keys, vlists):
+            if k not in kv._store or len(vl) != ndev:
+                return False
+        t0 = time.perf_counter() if _tm.enabled() else None
+        if self._plan_keys != tuple(keys) or self._ndev != ndev:
+            self._build_plan(keys, vlists, ndev)
+        opt = self._opt
+        # host bookkeeping first (eager order: every key of the step sees
+        # the same num_update), then the per-key traced lr / static wd
+        for k in keys:
+            opt._update_count(k)
+        lrs = {k: float(opt.fused_lr(k)) for k in keys}
+        wds = {k: float(opt._get_wd(k)) for k in keys}
+        rule_name, opt_params = opt.fused_rule()
+        for b in self._buckets:
+            self._step_bucket(b, vlists, rule_name, opt_params, lrs, wds)
+        if t0 is not None:
+            _TM_FUSED_SEC.observe(time.perf_counter() - t0,
+                                  store=kv.type)
+        return True
+
+    def _place(self, nd_arr, target, tset):
+        """Device-resident guard: returns the raw array, migrating the
+        NDArray's chunk to the bucket's placement if (and only if) its
+        device set differs — a metadata compare per step, a transfer
+        only on the first fused step or after an eager interlude."""
+        raw = nd_arr._read()
+        if raw.sharding.device_set != tset:
+            raw = jax.device_put(raw, target)
+            nd_arr._chunk.write(raw)
+        return raw
+
+    def _step_bucket(self, b, vlists, rule_name, opt_params, lrs, wds):
+        kv, upd = self._kv, self._updater
+        weights = [kv._store[k] for k in b.keys]
+        slot_lists = [
+            _state_slots(upd.ensure_state(k, w))
+            for k, w in zip(b.keys, weights)
+        ]
+        w_raws = [self._place(w, b.target, b.tset) for w in weights]
+        s_raws = [tuple(self._place(s, b.target, b.tset) for s in slots)
+                  for slots in slot_lists]
+        idx = self._key_index
+        if self._ndev == 1:
+            parts = []
+            for k in b.keys:
+                g = vlists[idx[k]][0]._read()
+                if g.sharding.device_set != b.tset:
+                    g = jax.device_put(g, b.target)
+                parts.append(g)
+            dev_inputs = (tuple(parts),)
+        else:
+            flats = []
+            for d in range(self._ndev):
+                segs = [vlists[idx[k]][d]._read() for k in b.keys]
+                # flatten+concat ON the source device, then ONE transfer
+                # per device per bucket to the merge device
+                flat = jnp.ravel(segs[0]) if len(segs) == 1 \
+                    else _concat(segs)
+                if flat.sharding.device_set != b.tset:
+                    flat = jax.device_put(flat, b.target)
+                flats.append(flat)
+            dev_inputs = tuple(flats)
+        wd_tuple = tuple(wds[k] for k in b.keys)
+        fn = self._program(b, rule_name, opt_params, wd_tuple)
+        lr_vec = np.asarray([lrs[k] for k in b.keys], np.float32)
+        new_w, new_s = fn(dev_inputs, tuple(w_raws), tuple(s_raws), lr_vec)
+        for i, w in enumerate(weights):
+            # outputs carry the bucket's placement by construction:
+            # rebind the chunks directly (NDArray._set would device_put
+            # back to the pre-migration sharding)
+            w._chunk.write(new_w[i])
+            for s_nd, s_raw in zip(slot_lists[i], new_s[i]):
+                s_nd._chunk.write(s_raw)
+        if _tm.enabled():
+            from .kvstore import _TM_PUSH, _TM_PUSH_BYTES
+
+            _TM_PUSH.inc(len(b.keys), store=kv.type)
+            _TM_PUSH_BYTES.inc(b.nbytes, store=kv.type)
+
+    def _program(self, b, rule_name, opt_params, wd_tuple):
+        key = ("kvfused", rule_name, tuple(sorted(opt_params.items())),
+               b.dtype.str, tuple(b.shapes), wd_tuple)
+        fn = _executor.program_cache_get(key)
+        if fn is None:
+            fn = self._local_programs.get(key)
+            if fn is None:
+                fn = _make_bucket_program(rule_name, opt_params,
+                                          tuple(b.shapes), tuple(b.sizes),
+                                          wd_tuple)
+                _executor.program_cache_put(key, fn)
+        self._local_programs[key] = fn
+        return fn
+
+    # ----------------------------------------------------------------- pull
+    def handle_pull(self, keys, outs) -> bool:
+        """Bucket-sliced broadcast of stored values into the out arrays.
+
+        Outs sharing the store's device set adopt the updated buffers by
+        chunk rebind — zero device dispatches per key; cross-device outs
+        get an explicit device_put preserving their placement."""
+        kv = self._kv
+        if any(k not in kv._store for k in keys):
+            return False
+        t0 = time.perf_counter() if _tm.enabled() else None
+        ncopies = 0
+        nbytes = 0
+        for k, o in zip(keys, outs):
+            raw = kv._store[k]._read()
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for oo in targets:
+                if oo._index is not None or oo._shape is not None:
+                    oo._set(raw)  # view targets keep write-through
+                    continue
+                old = oo._chunk.data
+                if old.sharding.device_set != raw.sharding.device_set:
+                    oo._chunk.write(jax.device_put(raw, old.sharding))
+                else:
+                    oo._chunk.write(raw)
+            ncopies += len(targets)
+            nbytes += int(raw.size) * np.dtype(raw.dtype).itemsize \
+                * len(targets)
+        if t0 is not None:
+            from .kvstore import _TM_PULL, _TM_PULL_BYTES, _TM_PULL_SEC
+
+            _TM_PULL.inc(len(keys), store=kv.type)
+            _TM_PULL_BYTES.inc(nbytes, store=kv.type)
+            _TM_PULL_SEC.observe(time.perf_counter() - t0, store=kv.type)
+        return True

@@ -171,8 +171,13 @@ class BaseModule:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
 
+            # the reference re-broadcasts get_params() through set_params
+            # here to reconcile per-device aux divergence (BN stats) —
+            # with ONE mesh-global executor there is nothing to
+            # reconcile, and the round-trip re-uploaded every param+aux
+            # each epoch; get_params alone syncs the host copies the
+            # callbacks consume
             arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
             if epoch_end_callback is not None:
                 for cb in _as_list(epoch_end_callback):
                     cb(epoch, self.symbol, arg_params_, aux_params_)

@@ -225,6 +225,24 @@ class DataParallelExecutorGroup:
         ex = self.execs[0]
         return [[ex.arg_dict[n]] for n in self.param_names if n in ex.arg_dict]
 
+    def get_update_data(self):
+        """(key indices, per-key grad lists, per-key weight arrays) for
+        the module's BATCHED kvstore step: one ``push(keys, grads)`` +
+        ``pull(keys, outs)`` call per step instead of one per key, which
+        the kvstore routes to the bucketed jit-fused update engine when
+        eligible.  Indices match ``init_optimizer``'s enumeration of
+        ``param_names`` (keys the kvstore was initialized with)."""
+        ex = self.execs[0]
+        idxs, grads, weights = [], [], []
+        for idx, name in enumerate(self.param_names):
+            g = ex.grad_dict.get(name)
+            if g is None:
+                continue
+            idxs.append(idx)
+            grads.append([g])
+            weights.append(ex.arg_dict[name])
+        return idxs, grads, weights
+
     def update_metric(self, eval_metric, labels):
         eval_metric.update(labels, self.get_outputs())
 

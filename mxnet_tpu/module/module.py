@@ -301,10 +301,34 @@ class Module(BaseModule):
         self._exec_group.backward(out_grads)
 
     def update(self):
-        """Parity: Module.update (module.py:489) + model.py:88-118."""
+        """Parity: Module.update (module.py:489) + model.py:88-118.
+
+        Non-dist stores take the batched path — ONE ``push(keys, grads)``
+        + ``pull(keys, outs)`` per step, which the kvstore routes to the
+        bucketed jit-fused update engine (kvstore_fused.py) when the
+        optimizer qualifies.  dist stores keep the per-key loop: their
+        comm/compute overlap rides per-key engine priorities (SURVEY
+        §3.4), which a single batched RPC would flatten."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
         ex = self._exec_group.execs[0]
+        dist = self._kvstore is not None and "dist" in self._kvstore.type
+        if self._kvstore is not None and not dist:
+            idxs, grads, weights = self._exec_group.get_update_data()
+            self._kvstore.push(idxs, grads)
+            if self._update_on_kvstore:
+                self._kvstore.pull(idxs, weights)
+            else:
+                # aggregation-only store: pull merged grads back, then
+                # run the local updater (eager per-key — the fallback
+                # contract for custom updaters)
+                self._kvstore.pull(idxs, [g[0] for g in grads])
+                for idx, name in zip(
+                        idxs, (n for n in self._param_names
+                               if n in ex.grad_dict)):
+                    self._updater(idx, ex.grad_dict[name],
+                                  ex.arg_dict[name])
+            return
         if self._update_on_kvstore:
             for idx, name in enumerate(self._param_names):
                 if name not in ex.grad_dict:

@@ -1,0 +1,97 @@
+"""Pure multi-tensor optimizer update rules.
+
+Shared by the two compiled update paths:
+
+- ``trainer.FusedTrainer`` traces one rule per parameter inside the
+  whole-step program (fwd+bwd+update in a single XLA computation),
+- ``kvstore_fused.FusedUpdateEngine`` tree-maps one rule over every key
+  of a flat bucket inside the bucketed kvstore update program (the
+  Module path's jit-fused push).
+
+Each rule builder takes the optimizer's static hyperparameters and
+returns ``(init_state, update)`` closures over the fused jitted kernels
+in ops/optimizer_ops.py, so clip+decay+update stays one XLA kernel per
+tensor.  ``lr`` arrives per-call as a traced scalar — lr schedules (and
+Adam's per-step bias correction, computed on host) never retrace the
+compiled program.  ``wd_mult`` is a static per-tensor float and folds
+into the compile.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import ops
+from .base import parse_bool
+
+
+def _sgd_rule(opt_params):
+    momentum = opt_params.get("momentum", 0.0)
+    base_wd = float(opt_params.get("wd", 0.0))
+    attrs = {k: opt_params[k] for k in ("rescale_grad", "clip_gradient")
+             if k in opt_params}
+
+    def init_state(w):
+        return (jnp.zeros_like(w),) if momentum else ()
+
+    def update(w, g, state, lr, wd_mult=1.0):
+        octx = ops.OpCtx()
+        wd = base_wd * wd_mult
+        if momentum:
+            new_w, new_m = ops.get("sgd_mom_update").fn(
+                octx, w, g, state[0], momentum=momentum, lr=lr, wd=wd,
+                **attrs)
+            return new_w, (new_m,)
+        return ops.get("sgd_update").fn(octx, w, g, lr=lr, wd=wd,
+                                        **attrs), ()
+
+    return init_state, update
+
+
+def _adam_rule(opt_params):
+    base_wd = float(opt_params.get("wd", 0.0))
+    attrs = {k: opt_params[k] for k in ("rescale_grad",
+                                       "clip_gradient", "beta1", "beta2",
+                                       "epsilon") if k in opt_params}
+
+    def init_state(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, state, lr, wd_mult=1.0):
+        octx = ops.OpCtx()
+        new_w, m, v = ops.get("adam_update").fn(octx, w, g, state[0],
+                                                state[1], lr=lr,
+                                                wd=base_wd * wd_mult,
+                                                **attrs)
+        return new_w, (m, v)
+
+    return init_state, update
+
+
+def _rmsprop_rule(opt_params):
+    if parse_bool(opt_params.get("centered", False)):
+        # the centered (Alex Graves) variant carries 3 state slots and
+        # different math — silently training the plain variant under a
+        # centered config would diverge from the Module path (a bare
+        # gamma2 key with centered=False is fine: the Module path also
+        # ignores it for the plain variant)
+        raise ValueError("the fused rmsprop rule is the plain "
+                         "(Tieleman-Hinton) variant; use Module for "
+                         "centered RMSProp")
+    base_wd = float(opt_params.get("wd", 0.0))
+    attrs = {k: opt_params[k] for k in ("rescale_grad", "clip_gradient",
+                                       "gamma1", "epsilon",
+                                       "clip_weights") if k in opt_params}
+
+    def init_state(w):
+        return (jnp.zeros_like(w),)
+
+    def update(w, g, state, lr, wd_mult=1.0):
+        octx = ops.OpCtx()
+        new_w, n = ops.get("rmsprop_update").fn(
+            octx, w, g, state[0], lr=lr, wd=base_wd * wd_mult, **attrs)
+        return new_w, (n,)
+
+    return init_state, update
+
+
+_RULES = {"sgd": _sgd_rule, "adam": _adam_rule, "rmsprop": _rmsprop_rule}

@@ -114,6 +114,24 @@ class Optimizer:
             wd *= self.wd_mult.get(self.idx2name[index], 1.0)
         return wd
 
+    # ------------------------------------------------- fused kvstore path
+    def fused_rule(self):
+        """(rule name, static hyperparams) for the bucketed jit-fused
+        KVStore update path (kvstore_fused.py), or ``None`` when this
+        optimizer must run the eager per-key updater.  The hyperparams
+        must be host floats — they bake into the compiled bucket program
+        (lr arrives separately, traced, via :meth:`fused_lr`; per-key wd
+        is passed as the rule's static ``wd_mult``, so ``wd`` here is
+        the 1.0 base the multiplier scales)."""
+        return None
+
+    def fused_lr(self, index):
+        """Effective per-key lr for the fused path, computed on host
+        AFTER ``_update_count(index)`` and fed to the bucket program as
+        a traced scalar — lr schedules (and Adam's bias correction)
+        never retrace the compiled update."""
+        return self._get_lr(index)
+
 
 # convenience alias (parity: mx.optimizer.create)
 def create(name, **kwargs):
@@ -146,6 +164,15 @@ class SGD(Optimizer):
             state._set(new_mom._read())
         else:
             nd.sgd_update(weight, grad, out=weight, **attrs)
+
+    def fused_rule(self):
+        # exact-type gate: NAG subclasses SGD with different math and
+        # must stay on the eager per-key updater (ccSGD is SGD math)
+        if type(self) not in (SGD, CcSGD):
+            return None
+        return "sgd", {"momentum": float(self.momentum), "wd": 1.0,
+                       "rescale_grad": float(self.rescale_grad),
+                       "clip_gradient": float(self.clip_gradient or 0.0)}
 
 
 @register
@@ -217,6 +244,22 @@ class Adam(Optimizer):
         mean._set(new_mean._read())
         var._set(new_var._read())
 
+    def fused_rule(self):
+        if type(self) is not Adam:
+            return None
+        return "adam", {"wd": 1.0, "rescale_grad": float(self.rescale_grad),
+                        "clip_gradient": float(self.clip_gradient or 0.0),
+                        "beta1": float(self.beta1), "beta2": float(self.beta2),
+                        "epsilon": float(self.epsilon)}
+
+    def fused_lr(self, index):
+        # the bias correction folds into the traced lr, exactly like the
+        # eager update's host-computed lr_t — per-step, zero retraces
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        return self._get_lr(index) * math.sqrt(coef2) / coef1
+
 
 @register
 class AdaGrad(Optimizer):
@@ -282,6 +325,16 @@ class RMSProp(Optimizer):
         g._set((self.gamma1 * g + (1 - self.gamma1) * grad)._read())
         delta._set((self.gamma2 * delta - lr * grad / nd.sqrt(n - g * g + self.epsilon))._read())
         weight._set((weight + delta)._read())
+
+    def fused_rule(self):
+        if type(self) is not RMSProp or self.centered:
+            return None
+        return "rmsprop", {"wd": 1.0,
+                           "rescale_grad": float(self.rescale_grad),
+                           "clip_gradient": float(self.clip_gradient or 0.0),
+                           "gamma1": float(self.gamma1),
+                           "epsilon": float(self.epsilon),
+                           "clip_weights": float(self.clip_weights or 0.0)}
 
 
 @register
@@ -361,10 +414,18 @@ class Updater:
         self.optimizer = optimizer
         self.states: Dict = {}
 
-    def __call__(self, index, grad, weight):
+    def ensure_state(self, index, weight):
+        """Create-or-get the per-key optimizer state (the lazy half of
+        ``__call__``; the fused kvstore engine calls it directly so the
+        eager and bucketed paths share ONE state store — interleaving
+        them mid-run stays consistent)."""
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
-        self.optimizer.update(index, weight, grad, self.states[index])
+        return self.states[index]
+
+    def __call__(self, index, grad, weight):
+        self.optimizer.update(index, weight, grad,
+                              self.ensure_state(index, weight))
 
     def get_states(self):
         import pickle

@@ -27,13 +27,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import ops
 from . import random as _random
 from . import telemetry as _tm
 from .executor import _build_graph_fn
 from .initializer import Uniform
-from .base import MXNetError, parse_bool
+from .base import MXNetError
 from .ndarray import NDArray
+from .optim_rules import (  # noqa: F401 — rules shared with kvstore_fused
+    _RULES, _adam_rule, _rmsprop_rule, _sgd_rule,
+)
 
 # --- telemetry families (docs/telemetry.md).  The `loop` label separates
 # the fused whole-step path from the Module fit loop. -----------------------
@@ -46,79 +48,10 @@ _TM_STEP_SEC = _tm.histogram(
     "included)", labels=("loop",))
 
 
-# pure update rules reusing the fused optimizer kernels from ops/optimizer_ops;
-# `lr` arrives per-call (a traced scalar, so schedules don't recompile)
-def _sgd_rule(opt_params):
-    momentum = opt_params.get("momentum", 0.0)
-    base_wd = float(opt_params.get("wd", 0.0))
-    attrs = {k: opt_params[k] for k in ("rescale_grad", "clip_gradient")
-             if k in opt_params}
-
-    def init_state(w):
-        return (jnp.zeros_like(w),) if momentum else ()
-
-    def update(w, g, state, lr, wd_mult=1.0):
-        octx = ops.OpCtx()
-        wd = base_wd * wd_mult
-        if momentum:
-            new_w, new_m = ops.get("sgd_mom_update").fn(
-                octx, w, g, state[0], momentum=momentum, lr=lr, wd=wd,
-                **attrs)
-            return new_w, (new_m,)
-        return ops.get("sgd_update").fn(octx, w, g, lr=lr, wd=wd,
-                                        **attrs), ()
-
-    return init_state, update
-
-
-def _adam_rule(opt_params):
-    base_wd = float(opt_params.get("wd", 0.0))
-    attrs = {k: opt_params[k] for k in ("rescale_grad",
-                                        "clip_gradient", "beta1", "beta2",
-                                        "epsilon") if k in opt_params}
-
-    def init_state(w):
-        return (jnp.zeros_like(w), jnp.zeros_like(w))
-
-    def update(w, g, state, lr, wd_mult=1.0):
-        octx = ops.OpCtx()
-        new_w, m, v = ops.get("adam_update").fn(octx, w, g, state[0],
-                                                state[1], lr=lr,
-                                                wd=base_wd * wd_mult,
-                                                **attrs)
-        return new_w, (m, v)
-
-    return init_state, update
-
-
-def _rmsprop_rule(opt_params):
-    if parse_bool(opt_params.get("centered", False)):
-        # the centered (Alex Graves) variant carries 3 state slots and
-        # different math — silently training the plain variant under a
-        # centered config would diverge from the Module path (a bare
-        # gamma2 key with centered=False is fine: the Module path also
-        # ignores it for the plain variant)
-        raise ValueError("FusedTrainer's rmsprop rule is the plain "
-                         "(Tieleman-Hinton) variant; use Module for "
-                         "centered RMSProp")
-    base_wd = float(opt_params.get("wd", 0.0))
-    attrs = {k: opt_params[k] for k in ("rescale_grad", "clip_gradient",
-                                        "gamma1", "epsilon",
-                                        "clip_weights") if k in opt_params}
-
-    def init_state(w):
-        return (jnp.zeros_like(w),)
-
-    def update(w, g, state, lr, wd_mult=1.0):
-        octx = ops.OpCtx()
-        new_w, n = ops.get("rmsprop_update").fn(
-            octx, w, g, state[0], lr=lr, wd=base_wd * wd_mult, **attrs)
-        return new_w, (n,)
-
-    return init_state, update
-
-
-_RULES = {"sgd": _sgd_rule, "adam": _adam_rule, "rmsprop": _rmsprop_rule}
+# The pure per-tensor update rules (_sgd_rule/_adam_rule/_rmsprop_rule)
+# live in optim_rules.py — they are shared with the kvstore's bucketed
+# fused-update engine; `lr` arrives per-call (a traced scalar, so
+# schedules don't recompile).
 
 
 class FusedTrainer:

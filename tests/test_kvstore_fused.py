@@ -1,0 +1,330 @@
+"""Bucketed jit-fused KVStore update path (kvstore_fused.py).
+
+Numerical-parity suite: the fused bucketed engine must reproduce the
+eager per-key push/pull loops across stores, optimizers, grad dtypes,
+per-device value lists, and bucket-boundary layouts — plus the engine's
+caching/fallback contracts and the kvstore arg-validation bugfixes.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.context import Context
+from mxnet_tpu.ndarray import NDArray
+
+SHAPES = [(4, 5), (16,), (3, 2, 2), (32, 8), (7,)]
+
+
+def _make_data(seed, n_dev, steps, shapes):
+    rng = np.random.RandomState(seed)
+    weights = [rng.uniform(-1, 1, s).astype(np.float32) for s in shapes]
+    grads = [[[rng.uniform(-1, 1, s).astype(np.float32)
+               for _ in range(n_dev)] for s in shapes]
+             for _ in range(steps)]
+    return weights, grads
+
+
+def _run(kv_type, opt_name, opt_kwargs, fused, monkeypatch, n_dev=1,
+         grad_dtype="float32", steps=4, bucket_mb=None, shapes=SHAPES):
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE", "1" if fused else "0")
+    if bucket_mb is None:
+        monkeypatch.delenv("MXTPU_KV_BUCKET_MB", raising=False)
+    else:
+        monkeypatch.setenv("MXTPU_KV_BUCKET_MB", str(bucket_mb))
+    weights, grads = _make_data(0, n_dev, steps, shapes)
+    kv = mx.kv.create(kv_type)
+    kv.set_optimizer(mx.optimizer.create(opt_name, **dict(opt_kwargs)))
+    keys = list(range(len(shapes)))
+    kv.init(keys, [nd.array(w) for w in weights])
+    outs = [nd.zeros(s) for s in shapes]
+    for t in range(steps):
+        vals = []
+        for i in range(len(shapes)):
+            vals.append([
+                NDArray(jnp.asarray(grads[t][i][d]).astype(
+                    jnp.dtype(grad_dtype)), ctx=Context("cpu", d))
+                for d in range(n_dev)
+            ])
+        kv.push(keys, vals)
+        kv.pull(keys, outs)
+    return kv, [o.asnumpy().astype(np.float32) for o in outs]
+
+
+OPTIMIZERS = [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4,
+             "rescale_grad": 1.0 / 8}),
+    ("sgd", {"learning_rate": 0.05, "clip_gradient": 0.5,
+             "rescale_grad": 1.0 / 8}),
+    ("adam", {"learning_rate": 0.01, "rescale_grad": 1.0 / 8}),
+    ("rmsprop", {"learning_rate": 0.01, "rescale_grad": 1.0 / 8}),
+]
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device"])
+@pytest.mark.parametrize("opt_name,opt_kwargs", OPTIMIZERS)
+def test_fused_matches_eager(kv_type, opt_name, opt_kwargs, monkeypatch):
+    kvf, fused = _run(kv_type, opt_name, opt_kwargs, True, monkeypatch)
+    assert kvf._fused is not None and kvf._fused.num_buckets >= 1
+    kve, eager = _run(kv_type, opt_name, opt_kwargs, False, monkeypatch)
+    assert kve._fused is None
+    for f, e in zip(fused, eager):
+        np.testing.assert_allclose(f, e, rtol=2e-6, atol=2e-7)
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device"])
+@pytest.mark.parametrize("grad_dtype,rtol", [("float32", 2e-6),
+                                             ("bfloat16", 2e-2)])
+def test_fused_multi_device_value_lists(kv_type, grad_dtype, rtol,
+                                        monkeypatch):
+    """Per-device gradient copies reduce through the bucket path (one
+    concat per source device + one flat add) identically to the eager
+    per-key merge loop, for fp32 and bf16 grads."""
+    args = (kv_type, "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9, "rescale_grad": 1.0 / 3})
+    kvf, fused = _run(*args, True, monkeypatch, n_dev=3,
+                      grad_dtype=grad_dtype)
+    assert kvf._fused is not None and kvf._fused._plan_keys is not None
+    _, eager = _run(*args, False, monkeypatch, n_dev=3,
+                    grad_dtype=grad_dtype)
+    for f, e in zip(fused, eager):
+        np.testing.assert_allclose(f, e, rtol=rtol, atol=rtol)
+
+
+def test_fused_bucket_boundary_straddle(monkeypatch):
+    """A param larger than MXTPU_KV_BUCKET_MB gets its own bucket and
+    the split layout still matches eager bit-for-bit-in-tolerance."""
+    shapes = [(8, 8)] * 3 + [(100000,)] + [(4,)] * 3  # 400KB param, 100KB cap
+    args = ("local", "adam", {"learning_rate": 0.01, "rescale_grad": 0.1})
+    kvf, fused = _run(*args, True, monkeypatch, bucket_mb=0.1, shapes=shapes)
+    assert kvf._fused.num_buckets >= 3
+    big_bucket = [b for b in kvf._fused._buckets if 3 in b.keys]
+    assert len(big_bucket) == 1 and big_bucket[0].keys == [3]
+    _, eager = _run(*args, False, monkeypatch, bucket_mb=0.1, shapes=shapes)
+    for f, e in zip(fused, eager):
+        np.testing.assert_allclose(f, e, rtol=2e-6, atol=2e-7)
+
+
+def test_fused_no_retrace_after_warmup_and_cache_hits(monkeypatch):
+    """After the warmup step: zero kv_update retraces across repeated
+    steps AND lr changes (lr is traced), with the bucket programs served
+    from the process-wide LRU (executor_graph_cache_total hits)."""
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE", "1")
+    tm = mx.telemetry
+    was = tm.enabled()
+    tm.enable()
+    try:
+        kv = mx.kv.create("local")
+        opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+        kv.set_optimizer(opt)
+        keys = [0, 1, 2]
+        kv.init(keys, [nd.ones((4, 4)) for _ in keys])
+        g = [[nd.ones((4, 4))] for _ in keys]
+        outs = [nd.zeros((4, 4)) for _ in keys]
+        kv.push(keys, g)
+        kv.pull(keys, outs)
+        reg = tm.get_registry()
+        compiles = reg.get("executor_compile_total")
+        cache = reg.get("executor_graph_cache_total")
+        c0 = compiles.value(kind="kv_update")
+        h0 = cache.value(result="hit")
+        assert c0 >= 1
+        opt.lr = 0.01  # lr is a traced scalar: must NOT retrace
+        for _ in range(5):
+            kv.push(keys, g)
+            kv.pull(keys, outs)
+        assert compiles.value(kind="kv_update") == c0
+        assert cache.value(result="hit") >= h0 + 5
+        # a fresh engine with the same layout+config reuses the programs
+        kv2 = mx.kv.create("local")
+        kv2.set_optimizer(
+            mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9))
+        kv2.init(keys, [nd.ones((4, 4)) for _ in keys])
+        kv2.push(keys, g)
+        kv2.pull(keys, outs)
+        assert compiles.value(kind="kv_update") == c0
+    finally:
+        if not was:
+            tm.disable()
+
+
+def test_fused_telemetry_families(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE", "1")
+    tm = mx.telemetry
+    was = tm.enabled()
+    tm.enable()
+    try:
+        reg = tm.get_registry()
+
+        def count(name):
+            fam = reg.get(name)
+            return fam.count(store="local") if fam is not None else 0
+
+        f0, b0, p0 = (count("kvstore_fused_update_seconds"),
+                      count("kvstore_bucket_bytes"),
+                      count("kvstore_pull_seconds"))
+        kv = mx.kv.create("local")
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+        keys = [0, 1]
+        kv.init(keys, [nd.ones((8,)) for _ in keys])
+        kv.push(keys, [[nd.ones((8,))] for _ in keys])
+        kv.pull(keys, [nd.zeros((8,)) for _ in keys])
+        assert count("kvstore_fused_update_seconds") == f0 + 1
+        assert reg.get("kvstore_bucket_count").value(store="local") == 1
+        assert count("kvstore_bucket_bytes") == b0 + 1
+        assert count("kvstore_pull_seconds") == p0 + 1
+    finally:
+        if not was:
+            tm.disable()
+
+
+def test_fused_fallbacks(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE", "1")
+    # NAG subclasses SGD with different math: no fused rule
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("nag", momentum=0.9))
+    assert kv._fused is None
+    # centered RMSProp: 3-slot state, different math
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("rmsprop", centered=True))
+    assert kv._fused is None
+    # custom Python updater clears the engine
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd"))
+    assert kv._fused is not None
+    kv._set_updater(lambda k, g, w: None)
+    assert kv._fused is None
+    # env opt-out
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE", "0")
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd"))
+    assert kv._fused is None
+
+
+def test_fused_eager_interleave_consistent(monkeypatch):
+    """Single-key (eager) pushes interleaved with batched (fused) pushes
+    share the Updater's state store — the sequence matches an all-eager
+    run."""
+    def run(fused_mid):
+        monkeypatch.setenv("MXTPU_FUSED_UPDATE", "1" if fused_mid else "0")
+        kv = mx.kv.create("local")
+        kv.set_optimizer(mx.optimizer.create(
+            "sgd", learning_rate=0.1, momentum=0.9))
+        keys = [0, 1]
+        kv.init(keys, [nd.ones((4,)) for _ in keys])
+        outs = [nd.zeros((4,)) for _ in keys]
+        for k in keys:  # per-key (always eager) step
+            kv.push(k, [nd.ones((4,))])
+        kv.push(keys, [[nd.ones((4,))] for _ in keys])  # batched step
+        kv.pull(keys, outs)
+        return [o.asnumpy() for o in outs]
+
+    mixed = run(True)
+    eager = run(False)
+    for m, e in zip(mixed, eager):
+        np.testing.assert_allclose(m, e, rtol=2e-6, atol=2e-7)
+
+
+def test_fused_optimizer_states_roundtrip(tmp_path, monkeypatch):
+    """save/load_optimizer_states works mid-run under the fused engine
+    (state NDArrays are shared with the Updater)."""
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE", "1")
+    fname = str(tmp_path / "kv.states")
+    keys = [0, 1]
+    g = [[nd.ones((4,))] for _ in keys]
+
+    def fresh():
+        kv = mx.kv.create("local")
+        kv.set_optimizer(mx.optimizer.create(
+            "sgd", learning_rate=0.1, momentum=0.9))
+        kv.init(keys, [nd.ones((4,)) for _ in keys])
+        return kv
+
+    kv = fresh()
+    kv.push(keys, g)
+    kv.save_optimizer_states(fname)
+    kv.push(keys, g)  # one more step after the save
+    expect = [kv._store[k].asnumpy() for k in keys]
+
+    kv2 = fresh()
+    kv2.push(keys, g)  # reach the same weights as the save point
+    kv2.load_optimizer_states(fname)
+    kv2.push(keys, g)
+    got = [kv2._store[k].asnumpy() for k in keys]
+    for a, b in zip(got, expect):
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=2e-7)
+
+
+def test_module_fused_matches_eager(monkeypatch):
+    """End-to-end Module.fit through the batched update path: fused vs
+    eager training trajectories agree."""
+    from mxnet_tpu import io as mx_io, sym
+
+    def run(fused):
+        monkeypatch.setenv("MXTPU_FUSED_UPDATE", "1" if fused else "0")
+        mx.random.seed(0)
+        np.random.seed(0)
+        X = np.random.RandomState(3).uniform(-1, 1, (64, 10)).astype(np.float32)
+        Y = (X.sum(axis=1) > 0).astype(np.float32)
+        train = mx_io.NDArrayIter(X, Y, batch_size=16)
+        net = sym.SoftmaxOutput(
+            sym.FullyConnected(
+                sym.Activation(
+                    sym.FullyConnected(sym.Variable("data"), num_hidden=8,
+                                       name="fc1"), act_type="relu"),
+                num_hidden=2, name="fc2"),
+            name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu(0))
+        mod.fit(train, optimizer="sgd", kvstore=mx.kv.create("local"),
+                optimizer_params=(("learning_rate", 0.1),
+                                  ("momentum", 0.9)), num_epoch=2)
+        used_fused = (mod._kvstore._fused is not None
+                      and mod._kvstore._fused._plan_keys is not None)
+        args, _ = mod.get_params()
+        return used_fused, {k: v.asnumpy() for k, v in args.items()}
+
+    used, fused = run(True)
+    assert used
+    _, eager = run(False)
+    for k in fused:
+        np.testing.assert_allclose(fused[k], eager[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+# ----------------------------- arg-validation bugfixes ---------------------
+def test_push_pull_init_length_mismatch_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(MXNetError, match="3 keys but 1"):
+        kv.init([3, 4, 5], [nd.ones((2,))])
+    kv.init([0, 1], [nd.ones((2,)), nd.ones((2,))])
+    with pytest.raises(MXNetError, match="2 keys but 1"):
+        kv.push([0, 1], [nd.ones((2,))])
+    with pytest.raises(MXNetError, match="2 keys but 1"):
+        kv.pull([0, 1], out=[nd.zeros((2,))])
+    with pytest.raises(MXNetError, match="2 keys but None"):
+        kv.pull([0, 1], out=None)
+
+
+def test_pull_single_key_fanout_records_seconds():
+    """The single-key/multi-out fast path must observe
+    kvstore_pull_seconds like the main loop (it used to skip it)."""
+    tm = mx.telemetry
+    was = tm.enabled()
+    tm.enable()
+    try:
+        before = tm.get_registry().get("kvstore_pull_seconds")
+        n0 = before.count(store="local") if before is not None else 0
+        kv = mx.kv.create("local")
+        kv.init(0, nd.ones((2, 2)))
+        kv.pull(0, out=[nd.zeros((2, 2)) for _ in range(3)])
+        hist = tm.get_registry().get("kvstore_pull_seconds")
+        assert hist.count(store="local") == n0 + 1
+        assert tm.get_registry().get("kvstore_pull_total").value(
+            store="local") >= 1
+    finally:
+        if not was:
+            tm.disable()
