@@ -276,6 +276,139 @@ def _kv_update_micro():
             "kv_buckets": nbuckets}
 
 
+def _pipeline_micro():
+    """Async-pipeline micro-bench (round 8): the Module-fit hot loop with
+    device-resident fused metrics + the bounded in-flight window
+    (MXTPU_ASYNC_DEPTH) vs the eager per-batch-sync loop, and step_multi
+    vs single-step dispatch on the same workload — the regression
+    tracker for the round-5 finding that step_multi came out SLOWER than
+    single dispatch once its host stacking tax was counted.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, sym, telemetry as tm
+
+    was_enabled = tm.enabled()
+    tm.enable()
+    prevs = {k: os.environ.get(k)
+             for k in ("MXTPU_FUSED_METRICS", "MXTPU_ASYNC_DEPTH")}
+    try:
+        data = sym.Variable("data")
+        net = sym.SoftmaxOutput(
+            sym.FullyConnected(data, name="pipe_fc", num_hidden=64),
+            name="softmax")
+        rs = np.random.RandomState(3)
+        nsteps, b = 16, 64
+        x = rs.uniform(-1, 1, (b * nsteps, 128)).astype(np.float32)
+        y = rs.randint(0, 64, b * nsteps).astype(np.float32)
+
+        def run_loop(fused, depth, epochs=3):
+            os.environ["MXTPU_FUSED_METRICS"] = "1" if fused else "0"
+            os.environ["MXTPU_ASYNC_DEPTH"] = str(depth)
+            it = mx.io.NDArrayIter(x, y, batch_size=b)
+            mod = mx.mod.Module(net)
+            mod.bind(data_shapes=it.provide_data,
+                     label_shapes=it.provide_label)
+            mod.init_params()
+            mod.init_optimizer(optimizer="sgd", optimizer_params=(
+                ("learning_rate", 0.05),))
+            metric = mx.metric.create("acc")
+
+            def epoch():
+                # fit's steady-state body: dispatch, enqueue metric,
+                # bound the window; values only read at the boundary
+                it.reset()
+                metric.reset()
+                window = engine.AsyncWindow()
+                for batch in it:
+                    mod.forward_backward(batch)
+                    mod.update()
+                    mod.update_metric(metric, batch.label)
+                    window.push(mod._output_handles())
+                window.drain()
+                metric.get_global_name_value()
+
+            epoch()  # warm: compiles + metric kernels
+            reg = tm.get_registry()
+            stall = reg.get("trainer_host_stall_seconds")
+            syncs = reg.get("metric_host_sync_total")
+            s0 = stall.sum(site="window") if stall is not None else 0.0
+            c0 = syncs.total() if syncs is not None else 0.0
+            tic = time.perf_counter()
+            for _ in range(epochs):
+                epoch()
+            dt = time.perf_counter() - tic
+            stall_us = ((stall.sum(site="window") - s0) / (epochs * nsteps)
+                        * 1e6 if stall is not None else 0.0)
+            sync_per_epoch = ((syncs.total() - c0) / epochs
+                              if syncs is not None else 0.0)
+            return (dt / (epochs * nsteps) * 1e6, stall_us, sync_per_epoch)
+
+        eager_us, _, eager_syncs = run_loop(fused=False, depth=1)
+        fused_d1_us, _, _ = run_loop(fused=True, depth=1)
+        fused_us, stall_us, fused_syncs = run_loop(fused=True, depth=2)
+
+        # --- step_multi vs single-step dispatch, same workload ---------
+        from mxnet_tpu.trainer import FusedTrainer
+
+        k = 8
+        tr = FusedTrainer(net, optimizer="sgd",
+                          optimizer_params={"lr": 0.05,
+                                            "rescale_grad": 1.0 / b})
+        tr.init(data=(b, 128))
+        xb = jax.device_put(x[:b])
+        yb = jax.device_put(y[:b])
+
+        def barrier():
+            name = sorted(tr.params)[0]
+            return float(np.asarray(tr.params[name]).ravel()[0])
+
+        tr.step(data=xb, softmax_label=yb)  # compile
+        barrier()
+        iters = 48
+        tic = time.perf_counter()
+        for _ in range(iters):
+            tr.step(data=xb, softmax_label=yb)
+        barrier()
+        single_us = (time.perf_counter() - tic) / iters * 1e6
+
+        stacked = {"data": jnp.stack([xb] * k),
+                   "softmax_label": jnp.stack([yb] * k)}
+        tr.step_multi(**stacked)  # compile (pre-stacked, non-donated)
+        barrier()
+        calls = max(iters // k, 1)
+        tic = time.perf_counter()
+        for _ in range(calls):
+            tr.step_multi(**stacked)
+        barrier()
+        multi_us = (time.perf_counter() - tic) / (calls * k) * 1e6
+
+        return {
+            "pipeline_us_per_step": round(fused_us, 1),
+            "pipeline_us_per_step_fused_d1": round(fused_d1_us, 1),
+            "pipeline_us_per_step_eager": round(eager_us, 1),
+            "pipeline_fused_speedup": round(eager_us / max(fused_us, 1e-9), 2),
+            "host_stall_us_per_step": round(stall_us, 1),
+            "metric_sync_per_epoch": round(fused_syncs, 1),
+            "metric_sync_per_epoch_eager": round(eager_syncs, 1),
+            "step_single_us_per_step": round(single_us, 1),
+            "step_multi_us_per_step": round(multi_us, 1),
+            "steps_per_call_speedup": round(
+                single_us / max(multi_us, 1e-9), 2),
+        }
+    finally:
+        for k_, v_ in prevs.items():
+            if v_ is None:
+                os.environ.pop(k_, None)
+            else:
+                os.environ[k_] = v_
+        if not was_enabled:
+            tm.disable()
+
+
 def _bench(dev, kind):
     import jax
     import jax.numpy as jnp
@@ -571,6 +704,15 @@ def _bench(dev, kind):
             # bucketed jit-fused engine on a ~100-param model (ISSUE 3)
             if os.environ.get("BENCH_KV", "1") == "1":
                 for k_, v_ in _kv_update_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # async-pipeline hot loop: fused device metrics + bounded
+            # window vs the eager per-batch-sync loop, and the fixed
+            # step_multi vs single dispatch (ISSUE 4)
+            if os.environ.get("BENCH_PIPELINE", "1") == "1":
+                for k_, v_ in _pipeline_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

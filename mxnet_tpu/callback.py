@@ -102,6 +102,7 @@ class Speedometer:
         self.frequent = frequent
         self.auto_reset = auto_reset
         self._mark = None  # (wall time, nbatch) at the last report/epoch start
+        self._last_stamp = None  # metric state at the last value report
 
     def __call__(self, param):
         now = time.time()
@@ -121,17 +122,36 @@ class Speedometer:
         speed = nbatches * self.batch_size / elapsed
         _TM_SPEED.set(speed)
         _TM_SPEED_SAMPLES.inc(nbatches * self.batch_size)
-        if param.eval_metric is not None:
-            parts = "".join(
-                "\tTrain-%s=%f" % nv
-                for nv in param.eval_metric.get_name_value())
-            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
-                         param.epoch, param.nbatch, speed, parts)
-            if self.auto_reset:
-                # reset only the local window: the epoch-end Train-* log
-                # (base_module.fit -> get_global_name_value) must still
-                # cover the whole epoch
-                param.eval_metric.reset_local()
+        metric = param.eval_metric
+        if metric is not None:
+            # "values needed" boundary guard: get_name_value() is the
+            # device->host sync of the fused-metric pipeline, so with
+            # auto_reset=False only pay it when the metric actually
+            # received updates since the last report — update_stamp() is
+            # sync-free.  auto_reset windows always report (the reset is
+            # part of their contract); metrics without the stamp API
+            # (user subclasses) always report.
+            stamp_fn = getattr(metric, "update_stamp", None)
+            stamp = stamp_fn() if stamp_fn is not None else None
+            if (self.auto_reset or stamp_fn is None
+                    or stamp != self._last_stamp):
+                parts = "".join(
+                    "\tTrain-%s=%f" % nv
+                    for nv in metric.get_name_value())
+                logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                             param.epoch, param.nbatch, speed, parts)
+                if self.auto_reset:
+                    # reset only the local window: the epoch-end Train-*
+                    # log (base_module.fit -> get_global_name_value) must
+                    # still cover the whole epoch
+                    metric.reset_local()
+                # re-stamp AFTER reading: the read itself drains the
+                # fused window into the host accumulators
+                self._last_stamp = (stamp_fn() if stamp_fn is not None
+                                    else None)
+            else:
+                logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                             param.epoch, param.nbatch, speed)
         else:
             logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                          param.epoch, param.nbatch, speed)

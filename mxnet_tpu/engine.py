@@ -22,8 +22,10 @@ the same bisection tool for ruling out async effects.
 """
 from __future__ import annotations
 
+import os
 import time
 import weakref
+from collections import deque
 
 import jax
 
@@ -44,6 +46,15 @@ _TM_WAIT_SEC = _tm.histogram(
     "engine_wait_seconds",
     "time the host blocked on device results (wait_to_read / "
     "wait_for_all)", labels=("call",))
+_TM_PIPE_DEPTH = _tm.gauge(
+    "engine_pipeline_depth",
+    "training steps currently in flight in the loop's bounded async "
+    "window")
+_TM_HOST_STALL = _tm.histogram(
+    "trainer_host_stall_seconds",
+    "host time blocked on an in-flight step (site=window: the async "
+    "window was full; site=boundary: an epoch/checkpoint boundary "
+    "drained it)", labels=("site",))
 
 
 def _engine_is_naive() -> bool:
@@ -101,6 +112,75 @@ def wait_for_all():
         _host_engine.wait_all()
     if t0 is not None:
         _TM_WAIT_SEC.observe(time.perf_counter() - t0, call="wait_for_all")
+
+
+def async_depth(default: int = 2) -> int:
+    """MXTPU_ASYNC_DEPTH — max training steps the host may run ahead of
+    the device (the bounded in-flight window of Module.fit /
+    BaseModule.score / FusedTrainer.fit).  NaiveEngine forces depth 1:
+    every dispatch already blocks, so a deeper window would only hide
+    the bisection tool's effect."""
+    try:
+        depth = int(os.environ.get("MXTPU_ASYNC_DEPTH", default))
+    except ValueError:
+        depth = default
+    if _engine_is_naive():
+        return 1
+    return max(1, depth)
+
+
+class AsyncWindow:
+    """Bounded in-flight step window for training loops.
+
+    PjRt dispatches every jitted call asynchronously, so a loop that
+    never reads values can run arbitrarily far ahead of the device —
+    unbounded queued programs and host-staged batches.  ``push()``
+    registers a handle (the raw output arrays of a dispatched step);
+    once more than ``depth`` steps are in flight the OLDEST step is
+    blocked on, keeping the host at most ``depth`` steps ahead while
+    batches ``depth`` deep still overlap with device compute.  With
+    fused metrics this window is the only place the steady-state loop
+    waits — ``trainer_host_stall_seconds{site=window}`` shows it, and
+    ``engine_pipeline_depth`` tracks the live depth.
+
+    ``drain()`` blocks on everything in flight (epoch end, checkpoint,
+    any boundary that needs the device caught up).
+    """
+
+    def __init__(self, depth=None):
+        self.depth = async_depth() if depth is None else max(1, int(depth))
+        self._dq = deque()
+
+    def __len__(self):
+        return len(self._dq)
+
+    def push(self, handle):
+        """Register a dispatched step; blocks only when the window is
+        full.  ``handle`` is a jax array or a list of them (NDArrays are
+        unwrapped without a sync)."""
+        if isinstance(handle, (list, tuple)):
+            handle = [h._read() if hasattr(h, "_read") else h for h in handle]
+        elif hasattr(handle, "_read"):
+            handle = handle._read()
+        self._dq.append(handle)
+        if _tm.enabled():
+            _TM_PIPE_DEPTH.set(len(self._dq))
+        while len(self._dq) > self.depth:
+            self._wait_one("window")
+
+    def _wait_one(self, site):
+        handle = self._dq.popleft()
+        if _tm.enabled():
+            t0 = time.perf_counter()
+            jax.block_until_ready(handle)
+            _TM_HOST_STALL.observe(time.perf_counter() - t0, site=site)
+            _TM_PIPE_DEPTH.set(len(self._dq))
+            return
+        jax.block_until_ready(handle)
+
+    def drain(self, site: str = "boundary"):
+        while self._dq:
+            self._wait_one(site)
 
 
 class _Variable:

@@ -476,6 +476,47 @@ class DevicePrefetchIter(DataIter):
         return item
 
 
+def step_multi_feeds(data_iter, steps_per_call,
+                     data_names=("data",), label_names=("softmax_label",),
+                     drop_remainder=False):
+    """Group a DataIter's batches into ``FusedTrainer.step_multi`` feeds
+    WITHOUT host re-stacking.
+
+    Yields dicts mapping input name -> a k-tuple of per-step raw device
+    arrays; ``step_multi`` stacks them inside the compiled program, so a
+    pipeline like ``ImageRecordIter -> PrefetchingIter ->
+    DevicePrefetchIter -> step_multi_feeds`` feeds k-step scans entirely
+    from device-resident batches (the round-5 ``step_multi`` regression
+    was exactly the host stack+transfer this path eliminates)::
+
+        for feed in io.step_multi_feeds(it, 8):
+            trainer.step_multi(_donate=True, **feed)
+
+    The per-step arrays are handed to the trainer single-use (pass
+    ``_donate=True`` when nothing else reads the batches).  A trailing
+    group shorter than ``steps_per_call`` is yielded as-is — one extra
+    compile for that k — unless ``drop_remainder``.
+    """
+    from .ndarray import NDArray
+
+    def raw(x):
+        if isinstance(x, NDArray):
+            return x._read()
+        return x
+
+    names = list(data_names) + list(label_names)
+    group = []
+    for batch in data_iter:
+        group.append([raw(a) for a in
+                      list(batch.data) + list(batch.label or [])])
+        if len(group) == int(steps_per_call):
+            yield {n: tuple(g[i] for g in group)
+                   for i, n in enumerate(names)}
+            group = []
+    if group and not drop_remainder:
+        yield {n: tuple(g[i] for g in group) for i, n in enumerate(names)}
+
+
 class MNISTIter(NDArrayIter):
     """MNIST idx-format reader (parity: src/io/iter_mnist.cc:241).
 

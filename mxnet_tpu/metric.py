@@ -1,15 +1,64 @@
 """Evaluation metrics (parity: python/mxnet/metric.py).
 
 EvalMetric registry: Accuracy, TopKAccuracy, F1, MAE/MSE/RMSE,
-CrossEntropy, CustomMetric (+np wrapper), CompositeEvalMetric.  Metrics
-run on host numpy after a device sync — same device→host boundary as the
-reference (SURVEY.md §3.1 update_metric step).
+CrossEntropy, Perplexity, Loss, CustomMetric (+np wrapper),
+CompositeEvalMetric.
+
+Two accumulation paths:
+
+- **fused (default)** — each built-in metric contributes a jitted
+  ``(sum, num) += f(label, pred)`` accumulator whose running totals live
+  as DEVICE scalars: ``update()`` only *enqueues* one async dispatch, and
+  the device→host sync happens when a reader (``get()`` /
+  ``get_name_value()`` / ``reset_local()``) actually needs the values.
+  This is what keeps the training hot loop free of per-batch ``asnumpy``
+  stalls (the reference syncs every batch: SURVEY.md §3.1 update_metric).
+  ``MXTPU_FUSED_METRICS=0`` opts out.
+- **eager** — the reference's host-numpy path, used automatically for
+  ``CustomMetric``/``mx.metric.np`` callbacks, F1/Torch, multi-output
+  (``num=``) metrics, and non-array inputs.
+
+Both paths share the accumulators, so fused and eager updates can
+interleave freely (a fused window is folded in before any eager read).
 """
 from __future__ import annotations
 
+import os
+
 import numpy as _np
 
-from .base import MXNetError
+from . import telemetry as _tm
+from .base import MXNetError, parse_bool
+
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_FUSED = _tm.counter(
+    "metric_fused_update_total",
+    "metric updates accumulated device-side (no host sync)",
+    labels=("metric",))
+_TM_SYNC = _tm.counter(
+    "metric_host_sync_total",
+    "device->host metric syncs: fused-path drains (one per value read "
+    "with pending updates) + eager-path asnumpy updates (one per "
+    "label/pred pair)", labels=("metric",))
+
+
+def fused_metrics_enabled() -> bool:
+    """MXTPU_FUSED_METRICS gate (default on)."""
+    return parse_bool(os.environ.get("MXTPU_FUSED_METRICS", "1"))
+
+
+def _device_raw(x):
+    """The raw jax array behind a metric input, WITHOUT a host sync —
+    or None when the input has no device representation (plain numpy /
+    lists take the eager path)."""
+    import jax
+
+    read = getattr(x, "_read", None)  # NDArray (views resolve lazily)
+    if read is not None:
+        return read()
+    if isinstance(x, jax.Array):
+        return x
+    return None
 
 
 def check_label_shapes(labels, preds, shape=False):
@@ -28,14 +77,32 @@ class EvalMetric:
     reference lacks this split and its epoch log after an auto_reset
     Speedometer covers only the tail window; later MXNet added
     reset_local/get_global, which is the behavior reproduced here.)
+
+    Fused accumulation: a subclass that defines ``_fused_delta(label,
+    pred) -> (sum_delta, num_delta)`` (pure jnp, traceable) gets the
+    device-resident path for free — its ``update`` calls
+    ``_fused_accumulate`` per (label, pred) pair and only falls through
+    to its eager numpy body when the fused path is unavailable.
     """
+
+    # subclasses override with a jnp-traceable method; None = eager-only
+    _fused_delta = None
 
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
+        self._fused_jit = None
+        # bumped on every fused enqueue: together with the (host-cheap)
+        # accumulator values it forms update_stamp(), the sync-free
+        # "anything new since I last looked?" token Speedometer uses
+        self._version = 0
         self.reset()
 
     def reset(self):
+        # pending device window is DISCARDED, not synced — reset means
+        # "forget everything", same as zeroing the host accumulators
+        self._dev_sum = None
+        self._dev_num = None
         if self.num is None:
             self.num_inst = 0
             self.sum_metric = 0.0
@@ -49,6 +116,7 @@ class EvalMetric:
 
     def reset_local(self):
         """Fold the current window into the global totals and clear it."""
+        self._drain()
         if self.num is None:
             self._carried_num += self.num_inst
             self._carried_sum += self.sum_metric
@@ -61,6 +129,115 @@ class EvalMetric:
             self.num_inst = [0] * self.num
             self.sum_metric = [0.0] * self.num
 
+    # ------------------------------------------------------------- fused path
+    def _fused_fn(self):
+        if self._fused_delta is None:
+            return None
+        if self._fused_jit is None:
+            import jax
+
+            delta = self._fused_delta
+
+            def acc(s, n, label, pred):
+                ds, dn = delta(label, pred)
+                return s + ds, n + dn
+
+            self._fused_jit = jax.jit(acc)
+        return self._fused_jit
+
+    def _fused_accumulate(self, label, pred) -> bool:
+        """Try to fold one (label, pred) pair into the device window.
+
+        Returns False (caller runs its eager numpy body) when fused
+        metrics are disabled, the metric has no fused kernel or uses
+        multi-output accumulators, or the inputs are not device arrays.
+        On success the accumulate is ONE async dispatch — no host sync.
+        """
+        if self.num is not None or not fused_metrics_enabled():
+            return False
+        fn = self._fused_fn()
+        if fn is None:
+            return False
+        raw_p = _device_raw(pred)
+        if raw_p is None:
+            return False
+        if label is None:
+            raw_l = 0.0  # label-free metrics (Loss) ignore it
+        else:
+            raw_l = _device_raw(label)
+            if raw_l is None:
+                return False
+        import jax
+        import jax.numpy as jnp
+
+        # sharded preds (data-parallel executor group): every jit input
+        # must live on the same device set, so the accumulators (and a
+        # host-resident label) are replicated over the pred's mesh
+        rep = None
+        sh = getattr(raw_p, "sharding", None)
+        if sh is not None and len(sh.device_set) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            if not isinstance(sh, NamedSharding):
+                return False  # unknown multi-device layout: eager path
+            rep = NamedSharding(sh.mesh, PartitionSpec())
+            if label is None:
+                raw_l = jax.device_put(jnp.float32(0.0), rep)
+            elif len(getattr(raw_l, "sharding",
+                             sh).device_set) != len(sh.device_set):
+                raw_l = jax.device_put(raw_l, rep)
+        if (rep is None and self._dev_sum is not None
+                and len(self._dev_sum.sharding.device_set) > 1):
+            # mesh -> single-device transition (metric reused across
+            # modules): fold the sharded window out rather than mixing
+            self._drain()
+        if self._dev_sum is None:
+            self._dev_sum = jnp.zeros((), jnp.float32)
+            self._dev_num = jnp.zeros((), jnp.float32)
+        if rep is not None and len(
+                self._dev_sum.sharding.device_set) != len(sh.device_set):
+            self._dev_sum = jax.device_put(self._dev_sum, rep)
+            self._dev_num = jax.device_put(self._dev_num, rep)
+        self._dev_sum, self._dev_num = fn(self._dev_sum, self._dev_num,
+                                          raw_l, raw_p)
+        self._version += 1
+        if _tm.enabled():
+            _TM_FUSED.inc(metric=self.name)
+        return True
+
+    def _drain(self):
+        """Fold the device window into the host accumulators.  This is
+        the ONLY device→host sync point of the fused path."""
+        if self._dev_sum is None:
+            return
+        s, n = self._dev_sum, self._dev_num
+        self._dev_sum = None
+        self._dev_num = None
+        self.sum_metric += float(s)
+        n = float(n)
+        # eager counts are ints (len(label)); keep that type when exact
+        self.num_inst += int(n) if n.is_integer() else n
+        if _tm.enabled():
+            _TM_SYNC.inc(metric=self.name)
+
+    def _eager_sync(self):
+        """Record one eager-path device->host sync (an update pair that
+        went through asnumpy) in the same family the fused drains use —
+        the fused-vs-eager sync count is the bench's pipeline story."""
+        if _tm.enabled():
+            _TM_SYNC.inc(metric=self.name)
+
+    def update_stamp(self):
+        """Cheap sync-free token that changes whenever this metric has
+        received updates (Speedometer's "values needed" guard): fused
+        enqueues bump ``_version``; eager updates move the host
+        accumulators directly."""
+
+        def _t(v):
+            return tuple(v) if isinstance(v, list) else v
+
+        return (self._version, _t(self.num_inst), _t(self.sum_metric))
+
     def update(self, labels, preds):
         raise NotImplementedError
 
@@ -71,6 +248,7 @@ class EvalMetric:
         return s / n if n else float("nan")
 
     def get(self):
+        self._drain()
         if self.num is None:
             return (self.name, self._value(self.sum_metric, self.num_inst))
         names = [f"{self.name}_{i}" for i in range(self.num)]
@@ -79,6 +257,7 @@ class EvalMetric:
         return (names, values)
 
     def get_global(self):
+        self._drain()
         if self.num is None:
             return (self.name, self._value(self._carried_sum + self.sum_metric,
                                            self._carried_num + self.num_inst))
@@ -112,6 +291,8 @@ class CompositeEvalMetric(EvalMetric):
         self.metrics.append(metric)
 
     def reset(self):
+        self._dev_sum = None
+        self._dev_num = None
         for m in getattr(self, "metrics", []):
             m.reset()
 
@@ -122,6 +303,9 @@ class CompositeEvalMetric(EvalMetric):
     def update(self, labels, preds):
         for m in self.metrics:
             m.update(labels, preds)
+
+    def update_stamp(self):
+        return tuple(m.update_stamp() for m in self.metrics)
 
     def get(self):
         names, values = [], []
@@ -151,9 +335,27 @@ class Accuracy(EvalMetric):
         super().__init__("accuracy")
         self.ignore_label = ignore_label
 
+    def _fused_delta(self, label, pred):
+        import jax.numpy as jnp
+
+        label = label.astype(jnp.int32)
+        if pred.ndim > 1 and pred.shape != label.shape:
+            pred = pred.argmax(axis=1)
+        pred = pred.astype(jnp.int32).reshape(-1)
+        label = label.reshape(-1)
+        if self.ignore_label is not None:
+            keep = label != self.ignore_label
+            return (((pred == label) & keep).sum().astype(jnp.float32),
+                    keep.sum().astype(jnp.float32))
+        return ((pred == label).sum().astype(jnp.float32),
+                jnp.float32(label.size))
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            if self._fused_accumulate(label, pred):
+                continue
+            self._eager_sync()
             pred_np = pred.asnumpy()
             label_np = label.asnumpy().astype(_np.int32)
             if pred_np.ndim > 1 and pred_np.shape != label_np.shape:
@@ -172,8 +374,19 @@ class TopKAccuracy(EvalMetric):
         super().__init__(f"top_k_accuracy_{top_k}")
         self.top_k = top_k
 
+    def _fused_delta(self, label, pred):
+        import jax.numpy as jnp
+
+        label = label.astype(jnp.int32).reshape(-1)
+        argsorted = jnp.argsort(-pred, axis=1)[:, : self.top_k]
+        hits = (argsorted == label[:, None]).any(axis=1).sum()
+        return hits.astype(jnp.float32), jnp.float32(label.size)
+
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
+            if self._fused_accumulate(label, pred):
+                continue
+            self._eager_sync()
             pred_np = pred.asnumpy()
             label_np = label.asnumpy().astype(_np.int32).reshape(-1)
             argsorted = _np.argsort(-pred_np, axis=1)[:, : self.top_k]
@@ -182,7 +395,8 @@ class TopKAccuracy(EvalMetric):
 
 
 class F1(EvalMetric):
-    """Binary F1 (parity: metric.py F1)."""
+    """Binary F1 (parity: metric.py F1).  Eager-only: the per-batch F1
+    readout is not a (sum, num) fold."""
 
     def __init__(self):
         super().__init__("f1")
@@ -208,8 +422,17 @@ class MAE(EvalMetric):
     def __init__(self):
         super().__init__("mae")
 
+    def _fused_delta(self, label, pred):
+        import jax.numpy as jnp
+
+        err = jnp.abs(label.reshape(pred.shape) - pred).mean()
+        return err.astype(jnp.float32), jnp.float32(1.0)
+
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
+            if self._fused_accumulate(label, pred):
+                continue
+            self._eager_sync()
             l, p = label.asnumpy(), pred.asnumpy()
             self.sum_metric += float(_np.abs(l.reshape(p.shape) - p).mean())
             self.num_inst += 1
@@ -219,8 +442,17 @@ class MSE(EvalMetric):
     def __init__(self):
         super().__init__("mse")
 
+    def _fused_delta(self, label, pred):
+        import jax.numpy as jnp
+
+        err = ((label.reshape(pred.shape) - pred) ** 2).mean()
+        return err.astype(jnp.float32), jnp.float32(1.0)
+
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
+            if self._fused_accumulate(label, pred):
+                continue
+            self._eager_sync()
             l, p = label.asnumpy(), pred.asnumpy()
             self.sum_metric += float(((l.reshape(p.shape) - p) ** 2).mean())
             self.num_inst += 1
@@ -230,8 +462,17 @@ class RMSE(EvalMetric):
     def __init__(self):
         super().__init__("rmse")
 
+    def _fused_delta(self, label, pred):
+        import jax.numpy as jnp
+
+        err = jnp.sqrt(((label.reshape(pred.shape) - pred) ** 2).mean())
+        return err.astype(jnp.float32), jnp.float32(1.0)
+
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
+            if self._fused_accumulate(label, pred):
+                continue
+            self._eager_sync()
             l, p = label.asnumpy(), pred.asnumpy()
             self.sum_metric += float(_np.sqrt(((l.reshape(p.shape) - p) ** 2).mean()))
             self.num_inst += 1
@@ -242,8 +483,19 @@ class CrossEntropy(EvalMetric):
         super().__init__("cross-entropy")
         self.eps = eps
 
+    def _fused_delta(self, label, pred):
+        import jax.numpy as jnp
+
+        label = label.astype(jnp.int32).reshape(-1)
+        prob = pred[jnp.arange(label.shape[0]), label]
+        return ((-jnp.log(prob + self.eps)).sum().astype(jnp.float32),
+                jnp.float32(label.shape[0]))
+
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
+            if self._fused_accumulate(label, pred):
+                continue
+            self._eager_sync()
             label_np = label.asnumpy().astype(_np.int32).reshape(-1)
             pred_np = pred.asnumpy()
             prob = pred_np[_np.arange(label_np.shape[0]), label_np]
@@ -261,9 +513,30 @@ class Perplexity(EvalMetric):
         self.ignore_label = ignore_label
         self.axis = axis
 
+    def _fused_delta(self, label, pred):
+        import jax.numpy as jnp
+
+        label = label.astype(jnp.int32).reshape(-1)
+        if self.axis not in (-1, pred.ndim - 1):
+            pred = jnp.moveaxis(pred, self.axis, -1)
+        pred = pred.reshape(label.shape[0], -1)
+        prob = pred[jnp.arange(label.shape[0]),
+                    jnp.clip(label, 0, pred.shape[1] - 1)]
+        nll = -jnp.log(jnp.maximum(prob, 1e-10))
+        if self.ignore_label is not None:
+            mask = label != self.ignore_label
+            return ((nll * mask).sum().astype(jnp.float32),
+                    mask.sum().astype(jnp.float32))
+        return nll.sum().astype(jnp.float32), jnp.float32(label.shape[0])
+
     def update(self, labels, preds):
+        fused_all = True
         loss, num = 0.0, 0
         for label, pred in zip(labels, preds):
+            if self._fused_accumulate(label, pred):
+                continue
+            self._eager_sync()
+            fused_all = False
             label_np = label.asnumpy().astype(_np.int32).reshape(-1)
             pred_np = pred.asnumpy()
             if self.axis not in (-1, pred_np.ndim - 1):
@@ -276,11 +549,35 @@ class Perplexity(EvalMetric):
                 mask = label_np != self.ignore_label
             loss += float(-_np.log(_np.maximum(prob[mask], 1e-10)).sum())
             num += int(mask.sum())
-        self.sum_metric += loss
-        self.num_inst += num
+        if not fused_all:
+            self.sum_metric += loss
+            self.num_inst += num
 
     def _value(self, s, n):
         return float(_np.exp(s / n)) if n else float("nan")
+
+
+class Loss(EvalMetric):
+    """Mean of the raw loss outputs (parity: mx.metric.Loss — "dummy"
+    metric for printing a MakeLoss/LinearRegressionOutput head).  Labels
+    are ignored."""
+
+    def __init__(self, name="loss"):
+        super().__init__(name)
+
+    def _fused_delta(self, label, pred):
+        import jax.numpy as jnp
+
+        return (pred.sum().astype(jnp.float32), jnp.float32(pred.size))
+
+    def update(self, labels, preds):
+        for pred in preds:
+            if self._fused_accumulate(None, pred):
+                continue
+            self._eager_sync()
+            pred_np = pred.asnumpy()
+            self.sum_metric += float(pred_np.sum())
+            self.num_inst += pred_np.size
 
 
 class Torch(EvalMetric):
@@ -346,6 +643,7 @@ _METRICS = {
     "ce": CrossEntropy,
     "cross-entropy": CrossEntropy,
     "torch": Torch,
+    "loss": Loss,
     "perplexity": Perplexity,
 }
 

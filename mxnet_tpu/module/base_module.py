@@ -12,6 +12,7 @@ from collections import namedtuple
 
 import numpy as np
 
+from .. import engine as _engine
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from .. import telemetry as _tm
@@ -47,6 +48,21 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _output_handles(self):
+        """Raw device arrays of the last step's outputs — what the
+        bounded async window blocks on.  Modules whose outputs are not
+        device arrays return [] (the window then never stalls on them)."""
+        try:
+            outs = self.get_outputs()
+        except Exception:  # noqa: BLE001 — e.g. PythonModule variants
+            return []
+        handles = []
+        for o in outs:
+            read = getattr(o, "_read", None)
+            if read is not None:
+                handles.append(read())
+        return handles
+
     def score(self, eval_data, eval_metric, num_batch=None, batch_end_callback=None,
               score_end_callback=None, reset=True, epoch=0):
         """Parity: base_module.py score — run eval_data through the net."""
@@ -57,16 +73,22 @@ class BaseModule:
             eval_data.reset()
         eval_metric.reset()
         nbatch = 0
+        # bounded in-flight window: with fused metrics nothing in this
+        # loop reads device values, so the window is what keeps the host
+        # from racing arbitrarily far ahead of the device
+        window = _engine.AsyncWindow()
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
             self.update_metric(eval_metric, eval_batch.label)
+            window.push(self._output_handles())
             if batch_end_callback is not None:
                 params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                        eval_metric=eval_metric, locals=locals())
                 for cb in _as_list(batch_end_callback):
                     cb(params)
+        window.drain()
         if score_end_callback is not None:
             params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                    eval_metric=eval_metric, locals=locals())
@@ -148,12 +170,19 @@ class BaseModule:
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
+            # bounded in-flight window (MXTPU_ASYNC_DEPTH, default 2):
+            # fused metrics make update_metric a pure enqueue, so the
+            # steady-state loop below performs no per-batch device sync —
+            # the host only blocks here when the window fills, and at the
+            # epoch boundary where values are genuinely needed
+            window = _engine.AsyncWindow()
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
                 self.update_metric(eval_metric, data_batch.label)
+                window.push(self._output_handles())
                 if _tm.enabled() and data_batch.data:
                     _TM_SAMPLES.inc(
                         data_batch.data[0].shape[0]
@@ -165,6 +194,9 @@ class BaseModule:
                                            eval_metric=eval_metric, locals=locals())
                     for cb in _as_list(batch_end_callback):
                         cb(params)
+            # epoch boundary: the checkpoint/eval callbacks below need the
+            # device caught up, and the epoch log reads the metric values
+            window.drain()
             # global view: correct even when a Speedometer(auto_reset=True)
             # batch callback reset the metric's local window mid-epoch
             for name, val in eval_metric.get_global_name_value():

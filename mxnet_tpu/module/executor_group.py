@@ -209,6 +209,13 @@ class DataParallelExecutorGroup:
         """Outputs are global (sharded) arrays — 'merge' is free."""
         return list(self.execs[0].outputs)
 
+    def get_output_handles(self):
+        """Raw jax arrays of the current step's outputs — the handles
+        the fit/score async window blocks on.  Reading them materializes
+        a pending lazy forward as a DISPATCH (no host sync): the arrays
+        stay futures until someone blocks on them."""
+        return [o._read() for o in self.execs[0].outputs]
+
     def get_input_grads(self, merge_multi_context=True):
         ex = self.execs[0]
         return [ex.grad_dict[n] for n in self.data_names if n in ex.grad_dict]

@@ -350,6 +350,9 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         return self._exec_group.get_outputs(merge_multi_context)
 
+    def _output_handles(self):
+        return self._exec_group.get_output_handles()
+
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and self.inputs_need_grad
         return self._exec_group.get_input_grads(merge_multi_context)

@@ -120,10 +120,13 @@ def get_prop(op_type: str, config=None) -> CustomOpProp:
     except KeyError:
         raise MXNetError(f"custom op type '{op_type}' is not registered "
                          "(use @mx.operator.register)") from None
-    # canonical text for sequence kwargs: the imperative jit cache
-    # round-trips attrs through frozen_attrs (list -> tuple), so both
-    # frontends must stringify to the same form
-    kwargs = {k: (str(list(v)) if isinstance(v, (list, tuple)) else str(v))
+    # canonical text for sequence kwargs is the TUPLE form ('(1, 2)') —
+    # what the reference frontend's str(v) emits for the tuple kwargs
+    # users write (kernel=(3, 3)).  frozen_attrs round-trips every
+    # sequence as a tuple through the imperative jit cache, so
+    # canonicalizing lists to tuples here makes both frontends (and both
+    # sides of the cache) stringify identically.
+    kwargs = {k: (str(tuple(v)) if isinstance(v, (list, tuple)) else str(v))
               for k, v in (config or {}).items()}
     return cls(**kwargs)
 

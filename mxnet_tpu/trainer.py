@@ -335,6 +335,13 @@ class FusedTrainer:
             # Per-step semantics (RNG fold by absolute step index, lr from
             # the host-computed schedule) are identical to train_step, so
             # step() and step_multi() are interchangeable mid-run.
+            # Inputs arrive either pre-stacked ``(k, B, ...)`` or as
+            # k-tuples of per-step ``(B, ...)`` arrays (the device-side
+            # feed from DevicePrefetchIter batches) — tuples are stacked
+            # HERE, inside the compiled program, so the caller never pays
+            # a separate host-dispatched stack for data already on device.
+            stacked = {k_: jnp.stack(v) if isinstance(v, tuple) else v
+                       for k_, v in stacked.items()}
             k = lrs.shape[0]
             idxs = step0 + 1 + jnp.arange(k, dtype=jnp.int32)
 
@@ -351,6 +358,12 @@ class FusedTrainer:
             return params, cparams, aux, opt_state, outs
 
         self._multi_fn = jax.jit(multi_step, donate_argnums=(0, 1, 2, 3))
+        # variant that ALSO donates the stacked batch (argnum 4): the
+        # scan consumes the batch exactly once, so when nobody else holds
+        # it XLA reuses its HBM instead of carrying a dead (k, B, ...)
+        # buffer across the whole k-step program
+        self._multi_fn_donate = jax.jit(multi_step,
+                                        donate_argnums=(0, 1, 2, 3, 4))
 
         def eval_step(params, cparams, aux, batch, key):
             if use_ccache:
@@ -415,24 +428,55 @@ class FusedTrainer:
             _TM_SAMPLES.inc(next(iter(sb.values())).shape[0], loop="fused")
         return outs
 
-    def step_multi(self, **stacked):
+    def step_multi(self, _donate=None, **stacked):
         """Run k fused train steps in ONE dispatch.
 
-        Every value carries a leading steps axis: ``(k, B, ...)`` where a
-        step() input would be ``(B, ...)``.  One compiled lax.scan
-        executes the k steps back to back on device, so the per-call
-        host/dispatch cost — the dominant term for small batches on
-        high-latency links (tools/probe_gap.py measured it at 82% of a
-        b32 ResNet-50 step over the bench tunnel) — is paid once per k
-        steps instead of once per step.  Interchangeable with step():
-        same per-step RNG folds, same lr schedule, same optimizer
-        updates.  Returns the per-step outputs stacked on axis 0."""
+        Every value carries a leading steps axis — either pre-stacked
+        ``(k, B, ...)`` where a step() input would be ``(B, ...)``, or a
+        k-list/tuple of per-step ``(B, ...)`` arrays (e.g. batches from
+        ``DevicePrefetchIter`` via ``io.step_multi_feeds``), which the
+        compiled program stacks ON DEVICE — no host re-stacking, no extra
+        dispatch.  One compiled lax.scan executes the k steps back to
+        back, so the per-call host/dispatch cost — the dominant term for
+        small batches on high-latency links (tools/probe_gap.py measured
+        it at 82% of a b32 ResNet-50 step over the bench tunnel) — is
+        paid once per k steps instead of once per step.  Interchangeable
+        with step(): same per-step RNG folds, same lr schedule, same
+        optimizer updates.
+
+        ``_donate`` controls batch-buffer donation: ``True`` hands the
+        input buffers to XLA (single-use feeds — the iterator pipeline;
+        the arrays are consumed), ``False`` preserves them (benchmarks
+        replaying one stack), ``None`` (default) donates exactly when
+        every input was a host array — the device buffer was created
+        here, so nobody else can hold it.
+
+        Returns the per-step outputs stacked on axis 0, still lazy
+        (async futures) — reading/blocking is the caller's sync point."""
         sb = {}
+        owned = True
         for k_, v in stacked.items():
+            if isinstance(v, (list, tuple)):
+                # per-step device feed: keep the tuple structure; the jit
+                # stacks in-trace
+                if any(isinstance(e, (NDArray, jax.Array)) for e in v):
+                    owned = False  # caller may still hold these buffers
+                sb[k_] = tuple(
+                    e._read() if isinstance(e, NDArray)
+                    else (e if isinstance(e, jax.Array)
+                          else jnp.asarray(np.asarray(e)))
+                    for e in v)
+                if self.mesh is not None:
+                    sh = NamedSharding(self.mesh, P(
+                        "data", *([None] * (sb[k_][0].ndim - 1))))
+                    sb[k_] = tuple(jax.device_put(e, sh) for e in sb[k_])
+                continue
             if isinstance(v, NDArray):
                 raw = v._read()
+                owned = False
             elif isinstance(v, jax.Array):
                 raw = v
+                owned = False
             else:
                 raw = jnp.asarray(np.asarray(v))
             if self.mesh is not None:
@@ -441,7 +485,8 @@ class FusedTrainer:
                     self.mesh, P(None, "data", *([None] * (raw.ndim - 2)))))
             else:
                 sb[k_] = raw
-        k = next(iter(sb.values())).shape[0]
+        first = next(iter(sb.values()))
+        k = len(first) if isinstance(first, tuple) else first.shape[0]
         if self._lr_scheduler is not None:
             lrs = np.asarray([self._lr_scheduler(self._step + 1 + i)
                               for i in range(k)], np.float32)
@@ -451,15 +496,27 @@ class FusedTrainer:
         self._step += k
         import time as _time
 
+        donate = owned if _donate is None else bool(_donate)
+        fn = self._multi_fn_donate if donate else self._multi_fn
         t0 = _time.perf_counter() if _tm.enabled() else None
-        (self.params, self._cparams, self.aux, self.opt_state,
-         outs) = self._multi_fn(
-            self.params, self._cparams, self.aux, self.opt_state,
-            sb, _random.current_key(), step0, lrs)
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            if donate:
+                # batch donation is best-effort: when no output aliases
+                # the batch (or the platform can't donate) jax warns per
+                # call — the fallback is exactly the non-donated behavior
+                _warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+            (self.params, self._cparams, self.aux, self.opt_state,
+             outs) = fn(
+                self.params, self._cparams, self.aux, self.opt_state,
+                sb, _random.current_key(), step0, lrs)
         if t0 is not None:
             _TM_STEP_SEC.observe(_time.perf_counter() - t0, loop="fused")
-            first = next(iter(sb.values()))
-            _TM_SAMPLES.inc(int(np.prod(first.shape[:2])), loop="fused")
+            per_step = (first[0].shape[0] if isinstance(first, tuple)
+                        else first.shape[1])
+            _TM_SAMPLES.inc(int(k * per_step), loop="fused")
         return outs
 
     def eval(self, **batch):
@@ -513,10 +570,16 @@ class FusedTrainer:
                             if eval_data is not None else [])
         eval_names = ([d[0] for d in eval_data.provide_data]
                       + eval_label_names if eval_data is not None else None)
+        from . import engine as _engine
+
         for epoch in range(num_epoch):
             tic = _time.time()
             eval_metric.reset()
             train_data.reset()
+            # bounded in-flight window (MXTPU_ASYNC_DEPTH): step() and the
+            # fused metric update are pure async dispatches, so this is
+            # the only place the steady-state loop blocks
+            window = _engine.AsyncWindow()
             for nbatch, batch in enumerate(train_data):
                 feed = dict(zip(train_names,
                                 list(batch.data) + list(batch.label)))
@@ -525,12 +588,14 @@ class FusedTrainer:
                                  for k, v in feed.items()})
                 outs = self.step(**feed)
                 eval_metric.update(batch.label, [NDArray(o) for o in outs])
+                window.push(list(outs))
                 if batch_end_callback is not None:
                     params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                            eval_metric=eval_metric,
                                            locals=None)
                     for cb in _as_list(batch_end_callback):
                         cb(params)
+            window.drain()
             for name, val in eval_metric.get_global_name_value():
                 log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             log.info("Epoch[%d] Time cost=%.3f", epoch,
@@ -543,6 +608,7 @@ class FusedTrainer:
                 vm = validation_metric
                 vm.reset()
                 eval_data.reset()
+                window = _engine.AsyncWindow()
                 for batch in eval_data:
                     feed = dict(zip(eval_names,
                                     list(batch.data)
@@ -550,6 +616,8 @@ class FusedTrainer:
                                        if eval_label_names else [])))
                     outs = self.eval(**feed)
                     vm.update(batch.label, [NDArray(o) for o in outs])
+                    window.push(list(outs))
+                window.drain()
                 for name, val in vm.get_global_name_value():
                     log.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
         return self
