@@ -409,6 +409,78 @@ def _pipeline_micro():
             tm.disable()
 
 
+def _health_micro():
+    """Health-layer micro-bench (round 9): the fused training hot loop
+    with MXTPU_SENTINEL off vs on (the in-program isfinite+norm
+    accumulator; <3% overhead target — the sentinel adds one tiny
+    reduction to an already-compiled step and ZERO host syncs), and the
+    flight recorder's per-record host cost (a bounded ring append).
+    """
+    import numpy as np
+
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu.telemetry import health
+    from mxnet_tpu.trainer import FusedTrainer
+    from mxnet_tpu import sym
+
+    was_enabled = tm.enabled()
+    tm.enable()
+    prev = os.environ.get("MXTPU_SENTINEL")
+    try:
+        net = sym.SoftmaxOutput(
+            sym.FullyConnected(sym.Variable("data"), num_hidden=64,
+                               name="health_fc"),
+            name="softmax")
+        rs = np.random.RandomState(9)
+        b = 64
+        x = rs.uniform(-1, 1, (b, 128)).astype(np.float32)
+        y = rs.randint(0, 64, b).astype(np.float32)
+
+        def run(sentinel):
+            os.environ["MXTPU_SENTINEL"] = "1" if sentinel else "0"
+            tr = FusedTrainer(net, optimizer="sgd",
+                              optimizer_params={"lr": 0.05,
+                                                "rescale_grad": 1.0 / b})
+            tr.init(data=(b, 128))
+            tr.step(data=x, softmax_label=y)  # compile
+            health.sentinel_check()
+            name = sorted(tr.params)[0]
+            float(np.asarray(tr.params[name]).ravel()[0])  # barrier
+            n = 60
+            tic = time.perf_counter()
+            for _ in range(n):
+                tr.step(data=x, softmax_label=y)
+            health.sentinel_check()
+            float(np.asarray(tr.params[name]).ravel()[0])
+            return (time.perf_counter() - tic) / n * 1e6
+
+        off_us = run(False)
+        on_us = run(True)
+
+        # flight-recorder record cost: the pure host-side ring append
+        # the fit loops pay per step
+        n = 20000
+        tic = time.perf_counter()
+        for i in range(n):
+            health.record_step(loop="bench", step=i, depth=2,
+                               dispatch_s=0.0)
+        rec_us = (time.perf_counter() - tic) / n * 1e6
+        return {
+            "health_sentinel_us_per_step": round(on_us, 1),
+            "health_sentinel_us_per_step_off": round(off_us, 1),
+            "health_sentinel_overhead_pct": round(
+                (on_us - off_us) / max(off_us, 1e-9) * 100.0, 2),
+            "flight_record_us": round(rec_us, 3),
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_SENTINEL", None)
+        else:
+            os.environ["MXTPU_SENTINEL"] = prev
+        if not was_enabled:
+            tm.disable()
+
+
 def _bench(dev, kind):
     import jax
     import jax.numpy as jnp
@@ -713,6 +785,15 @@ def _bench(dev, kind):
             # step_multi vs single dispatch (ISSUE 4)
             if os.environ.get("BENCH_PIPELINE", "1") == "1":
                 for k_, v_ in _pipeline_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # health layer: sentinel-on vs sentinel-off fused-loop
+            # overhead (<3% target) + flight-recorder per-record cost
+            # (ISSUE 5)
+            if os.environ.get("BENCH_HEALTH", "1") == "1":
+                for k_, v_ in _health_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

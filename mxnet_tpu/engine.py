@@ -28,17 +28,24 @@ import weakref
 from collections import deque
 
 import jax
+import numpy as np
 
 from . import telemetry as _tm
 from .base import get_env
 
 _live_arrays: "weakref.WeakValueDictionary[int, object]" = weakref.WeakValueDictionary()
 _counter = 0
+_live_bytes = 0.0
 
 # --- telemetry families (docs/telemetry.md) --------------------------------
 _TM_LIVE = _tm.gauge(
     "engine_live_arrays",
     "live device arrays currently tracked for wait_for_all")
+_TM_LIVE_BYTES = _tm.gauge(
+    "engine_live_bytes",
+    "total bytes of the live tracked device arrays (running total while "
+    "telemetry is enabled; the OOM report's live breakdown recomputes "
+    "exactly on demand)")
 _TM_NAIVE = _tm.gauge(
     "engine_naive_mode",
     "1 when MXNET_ENGINE_TYPE=NaiveEngine (every dispatch blocks)")
@@ -64,9 +71,23 @@ def _engine_is_naive() -> bool:
     return naive
 
 
+def _arr_nbytes(arr) -> int:
+    try:
+        return int(arr.size) * np.dtype(arr.dtype).itemsize
+    except Exception:  # noqa: BLE001 — non-array trackees count as 0
+        return 0
+
+
+def _on_array_freed(nbytes):
+    global _live_bytes
+    _live_bytes -= nbytes
+    if _tm.enabled():
+        _TM_LIVE_BYTES.set(max(_live_bytes, 0.0))
+
+
 def track(arr) -> int:
     """Register a live device array so wait_for_all can reach it."""
-    global _counter
+    global _counter, _live_bytes
     _counter += 1
     try:
         _live_arrays[_counter] = arr
@@ -74,7 +95,38 @@ def track(arr) -> int:
         pass
     if _tm.enabled():
         _TM_LIVE.set(len(_live_arrays))
+        nbytes = _arr_nbytes(arr)
+        if nbytes:
+            # size accounting rides the same weakref lifetime as the
+            # tracking dict: the finalizer gives the gauge its decrement
+            _live_bytes += nbytes
+            try:
+                weakref.finalize(arr, _on_array_freed, nbytes)
+            except TypeError:
+                _live_bytes -= nbytes
+                nbytes = 0
+        _TM_LIVE_BYTES.set(max(_live_bytes, 0.0))
     return _counter
+
+
+def live_memory(top: int = 10) -> dict:
+    """Exact live-array breakdown computed on demand (count, total
+    bytes, the ``top`` largest arrays) — the OOM report's live view,
+    independent of the telemetry switch."""
+    items = []
+    total = 0
+    for arr in list(_live_arrays.values()):
+        nbytes = _arr_nbytes(arr)
+        total += nbytes
+        try:
+            items.append((nbytes, str(np.dtype(arr.dtype)),
+                          str(tuple(arr.shape))))
+        except Exception:  # noqa: BLE001
+            pass
+    items.sort(reverse=True)
+    return {"arrays": len(items), "bytes": total,
+            "top": [{"bytes": b, "dtype": d, "shape": s}
+                    for b, d, s in items[:top]]}
 
 
 def on_push(result):
@@ -112,6 +164,9 @@ def wait_for_all():
         _host_engine.wait_all()
     if t0 is not None:
         _TM_WAIT_SEC.observe(time.perf_counter() - t0, call="wait_for_all")
+    # the device is drained: a reporting boundary — fold any parked
+    # sentinel state (no-op unless MXTPU_SENTINEL recorded something)
+    _tm.health.sentinel_check("boundary")
 
 
 def async_depth(default: int = 2) -> int:
@@ -181,6 +236,10 @@ class AsyncWindow:
     def drain(self, site: str = "boundary"):
         while self._dq:
             self._wait_one(site)
+        # epoch/checkpoint boundaries are the fused paths' reporting
+        # points: sync the numerics sentinel HERE (never per batch), so
+        # a NaN step surfaces at the same place fused metrics drain
+        _tm.health.sentinel_check("boundary")
 
 
 class _Variable:

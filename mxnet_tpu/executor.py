@@ -721,6 +721,41 @@ class Executor:
         self._monitor_callback = None
         self._monitor_fn = None   # lazily-compiled internals tap
         self._monitor_names = None
+        # device-memory accounting (telemetry/health.py): one
+        # attribution row per bound program, keyed by structure so
+        # rebinds refresh rather than multiply; shape math here, the
+        # compiled memory_analysis upgrade happens at first forward on
+        # non-CPU backends
+        self._program_label = self._record_bind_memory()
+        self._mem_analyzed = False
+
+    def _record_bind_memory(self):
+        try:
+            try:
+                sig = str(self._symbol.structural_signature())[:10]
+            except Exception:  # noqa: BLE001
+                sig = "%x" % (id(self._symbol) & 0xFFFFFF)
+            label = f"{self._symbol.name or 'graph'}[{sig}]"
+
+            def _nd_bytes(nd_arr):
+                return int(nd_arr.size) * np.dtype(nd_arr.dtype).itemsize
+
+            arg_b = sum(_nd_bytes(v) for v in self.arg_dict.values())
+            arg_b += sum(_nd_bytes(v) for v in self.aux_dict.values())
+            grad_b = sum(_nd_bytes(v) for v in self.grad_dict.values()
+                         if v is not None)
+            out_b = 0
+            try:
+                shapes = {k: v.shape for k, v in self.arg_dict.items()}
+                _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+                out_b = sum(int(np.prod(s)) * 4 for s in out_shapes or ())
+            except Exception:  # noqa: BLE001 — unknown outputs stay 0
+                pass
+            _tm.health.record_program(label, argument=arg_b + grad_b,
+                                      output=out_b, source="shape_math")
+            return label
+        except Exception:  # noqa: BLE001 — accounting must never break bind
+            return self._symbol.name or "graph"
 
     # ---------------------------------------------------------------- running
     @staticmethod
@@ -784,9 +819,21 @@ class Executor:
                             sync=lambda: jax.block_until_ready(
                                 self._outputs_cache[0]._read())
                             if self._outputs_cache else None):
-                outs, new_aux = self._jit_fwd(args, aux, key, False)
+                try:
+                    outs, new_aux = self._jit_fwd(args, aux, key, False)
+                except Exception as e:  # noqa: BLE001 — OOM gets a report
+                    _tm.health.reraise_if_oom(e, site="executor.forward")
+                    raise
                 self._pending = None
                 self._outputs_cache = [NDArray(o) for o in outs]
+                if not self._mem_analyzed:
+                    # accelerator backends: upgrade the shape-math row
+                    # with the compiled program's memory analysis (a
+                    # cache lookup there; skipped entirely on CPU)
+                    self._mem_analyzed = True
+                    _tm.health.attach_compiled_analysis(
+                        self._program_label, self._jit_fwd,
+                        args, aux, key, False)
             if t0 is not None:
                 _TM_FWD_SEC.observe(time.perf_counter() - t0)
             if self._monitor_callback is not None:
@@ -834,10 +881,14 @@ class Executor:
                     for h in head
                 ]
         grad_ins = {k: self.grad_dict[k]._read() for k in self._add_names}
-        outs, new_aux, grads = self._jit_fwdbwd(
-            args, aux, key, head, grad_ins,
-            gnames=self._gnames, add_names=self._add_names
-        )
+        try:
+            outs, new_aux, grads = self._jit_fwdbwd(
+                args, aux, key, head, grad_ins,
+                gnames=self._gnames, add_names=self._add_names
+            )
+        except Exception as e:  # noqa: BLE001 — OOM gets a report
+            _tm.health.reraise_if_oom(e, site="executor.backward")
+            raise
         self._outputs_cache = [NDArray(o) for o in outs]
         self._write_aux(new_aux)
         for k, g in grads.items():
@@ -863,7 +914,11 @@ class Executor:
                 raise MXNetError("no forward has been run")
             args, aux, key = self._pending
             t0 = time.perf_counter() if _tm.enabled() else None
-            outs, new_aux = self._jit_fwd(args, aux, key, True)
+            try:
+                outs, new_aux = self._jit_fwd(args, aux, key, True)
+            except Exception as e:  # noqa: BLE001 — OOM gets a report
+                _tm.health.reraise_if_oom(e, site="executor.outputs")
+                raise
             if t0 is not None:
                 _TM_FWD_SEC.observe(time.perf_counter() - t0)
             self._outputs_cache = [NDArray(o) for o in outs]

@@ -106,11 +106,17 @@ def _state_slots(state) -> Tuple[NDArray, ...]:
     return (state,)
 
 
-def _make_bucket_program(rule_name, opt_params, shapes, sizes, wds):
+def _make_bucket_program(rule_name, opt_params, shapes, sizes, wds,
+                         sentinel=False):
     """One jitted program for a bucket: flatten+concat each device's
     grads, ONE flat reduction across devices, then the per-key slices
     run the shared update rule — XLA fuses the whole chain.  ``lrs``
-    are traced scalars; shapes/sizes/wds/hyperparams are static."""
+    are traced scalars; shapes/sizes/wds/hyperparams are static.
+
+    With ``sentinel`` (MXTPU_SENTINEL) the program ALSO returns a
+    per-key isfinite mask and the bucket's gradient-norm scalar —
+    computed inside the already-jitted chain, returned as device
+    futures the health layer syncs only at reporting boundaries."""
     init_state, update = _RULES[rule_name](dict(opt_params))
     del init_state  # states come pre-created through the Updater
 
@@ -127,16 +133,27 @@ def _make_bucket_program(rule_name, opt_params, shapes, sizes, wds):
         for f in flats[1:]:
             merged = merged + f
         new_w, new_s = [], []
+        fins = []
         off = 0
         for i, shape in enumerate(shapes):
             g = merged[off:off + sizes[i]].reshape(shape)
             off += sizes[i]
+            if sentinel:
+                fins.append(jnp.isfinite(g).all())
             # lrs is ONE stacked traced vector (not n scalar leaves —
             # pytree flattening cost scales with leaf count on every
             # dispatch); lrs[i] is the key's traced scalar lr
             nw, ns = update(weights[i], g, states[i], lrs[i], wds[i])
             new_w.append(nw)
             new_s.append(tuple(ns))
+        if sentinel:
+            # per-key flags + the bucket's grad norm, packed into ONE
+            # extra output leaf (norm rides as the last entry)
+            fin_vec = jnp.stack(fins).astype(jnp.float32)
+            gnorm = jnp.sqrt(
+                jnp.sum(jnp.square(merged.astype(jnp.float32))))
+            return (tuple(new_w), tuple(new_s),
+                    jnp.concatenate([fin_vec, gnorm[None]]))
         return tuple(new_w), tuple(new_s)
 
     return jax.jit(_executor._count_traces(bucket_step, "kv_update"))
@@ -186,6 +203,7 @@ class FusedUpdateEngine:
         self._ndev = 0
         self._load: Dict = {}       # merge-device -> assigned bucket bytes
         self._local_programs: Dict = {}  # fallback when the LRU is off
+        self._push_count = 0        # the sentinel's step id for this store
 
     @property
     def num_buckets(self) -> int:
@@ -229,6 +247,15 @@ class FusedUpdateEngine:
             b.tset = b.target.device_set
             if _tm.enabled():
                 _TM_BUCKET_BYTES.observe(b.nbytes, store=self._kv.type)
+        for i, b in enumerate(buckets):
+            # memory attribution row per bucket program: ndev grad
+            # copies + weights in, weights (+ state, roughly weight-
+            # sized per slot) out — shape math, good enough to RANK
+            # programs in the OOM report
+            _tm.health.record_program(
+                f"kv_bucket{i}[{np.dtype(b.dtype).name}x{len(b.keys)}]",
+                argument=b.nbytes * (ndev + 2), output=b.nbytes * 2,
+                temp=b.nbytes, source="shape_math")
         self._buckets = buckets
         self._plan_keys = tuple(keys)
         self._key_index = idx
@@ -262,12 +289,26 @@ class FusedUpdateEngine:
         lrs = {k: float(opt.fused_lr(k)) for k in keys}
         wds = {k: float(opt._get_wd(k)) for k in keys}
         rule_name, opt_params = opt.fused_rule()
-        for b in self._buckets:
-            self._step_bucket(b, vlists, rule_name, opt_params, lrs, wds)
+        self._push_count += 1
+        try:
+            for bi, b in enumerate(self._buckets):
+                self._step_bucket(b, bi, vlists, rule_name, opt_params,
+                                  lrs, wds)
+        except Exception as e:  # noqa: BLE001 — OOM gets a report
+            _tm.health.reraise_if_oom(e, site="kvstore_fused.push")
+            raise
         if t0 is not None:
             _TM_FUSED_SEC.observe(time.perf_counter() - t0,
                                   store=kv.type)
         return True
+
+    def _key_name(self, k):
+        """Kvstore key -> the human name the sentinel reports (the
+        optimizer's param_idx2name mapping when keys are indices)."""
+        if isinstance(k, str):
+            return k
+        name = getattr(self._opt, "idx2name", {}).get(k)
+        return name if name else str(k)
 
     def _place(self, nd_arr, target, tset):
         """Device-resident guard: returns the raw array, migrating the
@@ -280,8 +321,9 @@ class FusedUpdateEngine:
             nd_arr._chunk.write(raw)
         return raw
 
-    def _step_bucket(self, b, vlists, rule_name, opt_params, lrs, wds):
+    def _step_bucket(self, b, bi, vlists, rule_name, opt_params, lrs, wds):
         kv, upd = self._kv, self._updater
+        sentinel = _tm.health.sentinel_mode() is not None
         weights = [kv._store[k] for k in b.keys]
         slot_lists = [
             _state_slots(upd.ensure_state(k, w))
@@ -312,9 +354,20 @@ class FusedUpdateEngine:
                 flats.append(flat)
             dev_inputs = tuple(flats)
         wd_tuple = tuple(wds[k] for k in b.keys)
-        fn = self._program(b, rule_name, opt_params, wd_tuple)
+        fn = self._program(b, rule_name, opt_params, wd_tuple, sentinel)
         lr_vec = np.asarray([lrs[k] for k in b.keys], np.float32)
-        new_w, new_s = fn(dev_inputs, tuple(w_raws), tuple(s_raws), lr_vec)
+        if sentinel:
+            new_w, new_s, sent_vec = fn(
+                dev_inputs, tuple(w_raws), tuple(s_raws), lr_vec)
+            # park the device future — NO sync here; sentinel_check
+            # reads it at the next reporting boundary
+            _tm.health.sentinel_record(
+                site=f"kv_bucket{bi}", step=self._push_count,
+                names=[self._key_name(k) for k in b.keys],
+                finite=sent_vec, packed_norm=True)
+        else:
+            new_w, new_s = fn(dev_inputs, tuple(w_raws), tuple(s_raws),
+                              lr_vec)
         for i, w in enumerate(weights):
             # outputs carry the bucket's placement by construction:
             # rebind the chunks directly (NDArray._set would device_put
@@ -328,16 +381,16 @@ class FusedUpdateEngine:
             _TM_PUSH.inc(len(b.keys), store=kv.type)
             _TM_PUSH_BYTES.inc(b.nbytes, store=kv.type)
 
-    def _program(self, b, rule_name, opt_params, wd_tuple):
+    def _program(self, b, rule_name, opt_params, wd_tuple, sentinel=False):
         key = ("kvfused", rule_name, tuple(sorted(opt_params.items())),
-               b.dtype.str, tuple(b.shapes), wd_tuple)
+               b.dtype.str, tuple(b.shapes), wd_tuple, sentinel)
         fn = _executor.program_cache_get(key)
         if fn is None:
             fn = self._local_programs.get(key)
             if fn is None:
                 fn = _make_bucket_program(rule_name, opt_params,
                                           tuple(b.shapes), tuple(b.sizes),
-                                          wd_tuple)
+                                          wd_tuple, sentinel)
                 _executor.program_cache_put(key, fn)
         self._local_programs[key] = fn
         return fn

@@ -167,6 +167,32 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        try:
+            self._fit_epochs(train_data, eval_data, eval_metric,
+                             validation_metric, begin_epoch, num_epoch,
+                             epoch_end_callback, batch_end_callback,
+                             eval_end_callback, eval_batch_end_callback,
+                             monitor)
+        except BaseException:
+            # black box first, then crash: dump the flight record (ring
+            # + registry + memory report) when MXTPU_FLIGHT_RECORD
+            # names a path, then let the exception propagate
+            _tm.health.auto_dump("exception")
+            raise
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, begin_epoch, num_epoch,
+                    epoch_end_callback, batch_end_callback,
+                    eval_end_callback, eval_batch_end_callback, monitor):
+        flight = _tm.health.flight_enabled()
+        program = None
+        if flight:
+            try:
+                program = getattr(self._exec_group.execs[0],
+                                  "_program_label", None)
+            except Exception:  # noqa: BLE001 — PythonModule variants
+                pass
+        step_id = 0
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -179,10 +205,18 @@ class BaseModule:
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
+                step_id += 1
+                t0 = time.perf_counter() if flight else 0.0
                 self.forward_backward(data_batch)
                 self.update()
                 self.update_metric(eval_metric, data_batch.label)
                 window.push(self._output_handles())
+                if flight:
+                    _tm.health.record_step(
+                        loop="module", step=step_id, epoch=epoch,
+                        nbatch=nbatch, depth=len(window),
+                        dispatch_s=time.perf_counter() - t0,
+                        program=program)
                 if _tm.enabled() and data_batch.data:
                     _TM_SAMPLES.inc(
                         data_batch.data[0].shape[0]

@@ -67,11 +67,14 @@ class Monitor:
         self.exes.append(exe)
 
     def tic(self):
-        """Open a recording window if this batch index is due."""
+        """Open a recording window if this batch index is due.
+
+        No device sync happens here: stat dispatch is async (stat_func
+        runs as lazy NDArray math) and the read already lands in
+        ``toc()``'s ``_render`` — a ``wait_to_read`` loop over every arg
+        array per interval would serialize the training loop's bounded
+        async window (``engine_pipeline_depth`` pinned to 0)."""
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
             self._records = []
             self.activated = True
         self.step += 1

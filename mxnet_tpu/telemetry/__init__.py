@@ -33,6 +33,12 @@ from .exporters import (  # noqa: F401
     generate_text, json_snapshot, dump_json, start_http_server,
     LoggingReporter,
 )
+from . import health  # noqa: F401
+from .health import (  # noqa: F401
+    NumericsError, DeviceOOMError, dump_flight_record, record_step,
+    flight_ring, sentinel_check, sentinel_record, memory_report,
+    format_memory_report,
+)
 
 _http_server = None
 _port = _os.environ.get("MXTPU_TELEMETRY_HTTP_PORT")

@@ -34,6 +34,10 @@ def _fmt_value(v: float) -> str:
     if v == float("-inf"):
         return "-Inf"
     f = float(v)
+    if f != f:
+        # the sentinel's grad-norm gauge goes NaN on a diverged run —
+        # the exposition must keep serving exactly then
+        return "NaN"
     return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
@@ -124,6 +128,18 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1",
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/metrics.json":
                 body = json.dumps(json_snapshot(reg)).encode("utf-8")
+                ctype = "application/json"
+            elif path == "/healthz":
+                # liveness probe, distinct from the scrape endpoint:
+                # answers "is the process serving" without the cost (or
+                # cardinality) of a full exposition render
+                from . import health as _health
+
+                body = json.dumps({
+                    "status": "ok",
+                    "families": len(reg.collect()),
+                    "flight_ring_len": len(_health.flight_ring()),
+                }).encode("utf-8")
                 ctype = "application/json"
             else:
                 self.send_error(404)

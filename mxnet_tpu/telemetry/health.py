@@ -1,0 +1,493 @@
+"""Training health layer: device-memory accounting, fused NaN/Inf
+sentinel, and a crash flight recorder.
+
+The async training stack (fused updates, fused metrics, the bounded
+in-flight window) moved the classic failure modes of a production TPU
+run — device OOM, silent NaN/Inf divergence, a hang inside the
+in-flight window — off the host thread where nothing observes them.
+The registry (registry.py) answers "how fast"; this module answers "how
+healthy" and "why did it die", the per-program memory/cost attribution
+that fused-execution stacks (arXiv:2004.13336 sharded updates, TVM
+arXiv:1802.04799) rely on to keep compiled execution debuggable.
+
+Three subsystems, one module:
+
+- **device-memory accounting** — every bind/plan/step-build records a
+  per-program memory attribution row (argument/output/temp/peak bytes;
+  the compiled program's ``memory_analysis()`` on real accelerators via
+  :func:`attach_compiled_analysis`, shape math as the CPU fallback).
+  Dispatch sites call :func:`reraise_if_oom` so a RESOURCE_EXHAUSTED
+  error surfaces a ranked memory report (top programs by peak bytes +
+  live-array breakdown) chained onto the original exception instead of
+  a bare allocator message.
+- **fused numerics sentinel** (``MXTPU_SENTINEL``, default off) — the
+  fused-update bucket programs and the FusedTrainer step compute an
+  isfinite-per-key mask and a gradient-norm scalar INSIDE the already-
+  jitted program; :func:`sentinel_record` parks the resulting device
+  scalars without reading them, and :func:`sentinel_check` (called at
+  the same reporting boundaries that drain fused metrics) performs the
+  only host sync — so a clean epoch keeps the zero-per-batch-sync
+  property.  A non-finite flag raises :class:`NumericsError` (or warns,
+  ``MXTPU_SENTINEL=warn``) naming the step id, site/bucket, and keys.
+- **flight recorder** (``MXTPU_FLIGHT_RECORD``, default on) — a bounded
+  ring of per-step records (step id, pipeline depth, dispatch latency,
+  program signature, sentinel backlog) that :func:`dump_flight_record`
+  writes together with the registry snapshot, the program-cache
+  contents, and the memory report as ONE JSON — the black box read
+  after a crash.  ``Module.fit``/``FusedTrainer.fit`` auto-dump on an
+  uncaught exception (when ``MXTPU_FLIGHT_RECORD`` names a path) and a
+  ``SIGUSR1`` dumps a live run without stopping it.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import warnings
+from collections import OrderedDict, deque
+
+from ..base import MXNetError
+from . import registry as _reg
+from .exporters import json_snapshot
+
+__all__ = [
+    "NumericsError", "DeviceOOMError",
+    "sentinel_mode", "sentinel_record", "sentinel_check", "sentinel_pending",
+    "record_program", "attach_compiled_analysis", "program_table",
+    "memory_report", "format_memory_report", "is_oom", "reraise_if_oom",
+    "donation_saved",
+    "flight_enabled", "record_step", "flight_ring", "dump_flight_record",
+    "auto_dump",
+]
+
+_logger = logging.getLogger("mxnet_tpu.telemetry")
+
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_PROG_MEM = _reg.gauge(
+    "program_memory_bytes",
+    "per-compiled-program memory attribution recorded at bind/plan time "
+    "(component=argument/output/temp/peak; source is memory_analysis on "
+    "accelerators, shape math on CPU)", labels=("program", "component"))
+_TM_OOM = _reg.counter(
+    "device_memory_oom_total",
+    "RESOURCE_EXHAUSTED errors intercepted at a dispatch site (each one "
+    "re-raised as DeviceOOMError carrying the ranked memory report)",
+    labels=("site",))
+_TM_DONATED = _reg.counter(
+    "device_memory_donated_bytes_total",
+    "bytes of buffers donated to XLA per dispatch (in-place reuse the "
+    "allocator never has to double-buffer)", labels=("site",))
+_TM_SENT_REC = _reg.counter(
+    "sentinel_records_total",
+    "sentinel accumulations enqueued device-side (no host sync)",
+    labels=("site",))
+_TM_SENT_SYNC = _reg.counter(
+    "sentinel_sync_total",
+    "host syncs of parked sentinel state (site=boundary: a reporting "
+    "boundary drained it; overflow: the pending window hit "
+    "MXTPU_SENTINEL_WINDOW; manual: an explicit sentinel_check)",
+    labels=("site",))
+_TM_SENT_BAD = _reg.counter(
+    "sentinel_nonfinite_total",
+    "non-finite (key, step) gradient flags the sentinel attributed",
+    labels=("site",))
+_TM_SENT_NORM = _reg.gauge(
+    "sentinel_grad_norm",
+    "last synced gradient norm from the sentinel's in-program "
+    "accumulator", labels=("site",))
+_TM_FLIGHT_REC = _reg.counter(
+    "flight_recorder_records_total",
+    "per-step records appended to the flight-recorder ring")
+_TM_FLIGHT_DUMP = _reg.counter(
+    "flight_recorder_dumps_total",
+    "flight-record JSON dumps written", labels=("trigger",))
+
+
+class NumericsError(MXNetError):
+    """Non-finite gradients detected by the fused sentinel."""
+
+
+class DeviceOOMError(MXNetError):
+    """Device RESOURCE_EXHAUSTED, re-raised with the memory report."""
+
+
+# ---------------------------------------------------------------------------
+# device-memory accounting
+# ---------------------------------------------------------------------------
+_PROG_CAP = 128
+_programs: "OrderedDict[str, dict]" = OrderedDict()
+_programs_lock = threading.Lock()
+
+
+def record_program(program: str, argument: int = 0, output: int = 0,
+                   temp: int = 0, alias: int = 0, peak=None,
+                   source: str = "shape_math"):
+    """Record (or refresh) one program's memory attribution row.
+
+    Called at bind time (executor), plan build (kvstore_fused), and
+    step build (trainer).  Rows are kept host-side regardless of the
+    telemetry switch so the OOM report works in any configuration; the
+    ``program_memory_bytes`` gauge mirrors them when recording is on.
+    """
+    if peak is None:
+        peak = max(int(argument) + int(output) + int(temp) - int(alias), 0)
+    entry = {"program": str(program), "argument_bytes": int(argument),
+             "output_bytes": int(output), "temp_bytes": int(temp),
+             "alias_bytes": int(alias), "peak_bytes": int(peak),
+             "source": source}
+    with _programs_lock:
+        _programs[entry["program"]] = entry
+        _programs.move_to_end(entry["program"])
+        while len(_programs) > _PROG_CAP:
+            _programs.popitem(last=False)
+    if _reg.enabled():
+        for comp in ("argument", "output", "temp", "peak"):
+            _TM_PROG_MEM.set(entry[f"{comp}_bytes"],
+                             program=entry["program"], component=comp)
+    return entry
+
+
+def attach_compiled_analysis(program: str, jitted, *args, **kwargs) -> bool:
+    """Refresh a program's row from the COMPILED executable's memory
+    analysis (XLA CompiledMemoryStats: argument/output/temp/alias bytes).
+
+    Only attempted off-CPU — on real accelerators ``lower().compile()``
+    shares the jit's compilation cache so this costs one lookup, while
+    XLA:CPU reports nothing useful (the bind-time shape math stands as
+    the documented CPU fallback).  Returns True when the row was
+    upgraded."""
+    import jax
+
+    try:
+        if jax.default_backend() == "cpu":
+            return False
+        mem = jitted.lower(*args, **kwargs).compile().memory_analysis()
+        record_program(
+            program,
+            argument=getattr(mem, "argument_size_in_bytes", 0),
+            output=getattr(mem, "output_size_in_bytes", 0),
+            temp=getattr(mem, "temp_size_in_bytes", 0),
+            alias=getattr(mem, "alias_size_in_bytes", 0),
+            source="memory_analysis")
+        return True
+    except Exception:  # noqa: BLE001 — attribution must never break a bind
+        return False
+
+
+def program_table():
+    """Current attribution rows, ranked by peak bytes (descending)."""
+    with _programs_lock:
+        rows = list(_programs.values())
+    return sorted(rows, key=lambda r: r["peak_bytes"], reverse=True)
+
+
+def donation_saved(nbytes: int, site: str):
+    """Count bytes donated to XLA at a dispatch site."""
+    if _reg.enabled() and nbytes > 0:
+        _TM_DONATED.inc(nbytes, site=site)
+
+
+def memory_report() -> dict:
+    """Ranked per-program memory table + live device-array breakdown."""
+    from .. import engine as _engine
+
+    return {"programs": program_table(), "live": _engine.live_memory()}
+
+
+def format_memory_report(report=None, top: int = 10) -> str:
+    """Human-readable rendering of :func:`memory_report` (the text that
+    rides on a DeviceOOMError)."""
+    report = report or memory_report()
+    lines = ["programs ranked by peak bytes:"]
+    rows = report["programs"][:top]
+    if not rows:
+        lines.append("  (no programs recorded)")
+    for r in rows:
+        lines.append(
+            "  %-48s peak=%d arg=%d out=%d temp=%d alias=%d (%s)" % (
+                r["program"][:48], r["peak_bytes"], r["argument_bytes"],
+                r["output_bytes"], r["temp_bytes"], r["alias_bytes"],
+                r["source"]))
+    live = report["live"]
+    lines.append("live device arrays: %d (%d bytes)"
+                 % (live["arrays"], live["bytes"]))
+    for t in live.get("top", []):
+        lines.append("  %12d bytes  %s %s"
+                     % (t["bytes"], t["dtype"], t["shape"]))
+    return "\n".join(lines)
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "resource_exhausted",
+                "Resource exhausted", "Out of memory", "out of memory")
+
+
+def is_oom(exc) -> bool:
+    """Does this exception look like a device allocator failure?"""
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def reraise_if_oom(exc, site: str):
+    """Dispatch-site guard: when ``exc`` is RESOURCE_EXHAUSTED-shaped,
+    log the ranked memory report and raise :class:`DeviceOOMError`
+    (report attached, original exception chained).  Any other exception
+    returns so the caller re-raises it unchanged."""
+    if not is_oom(exc):
+        return
+    _TM_OOM.inc(site=site)
+    try:
+        text = format_memory_report()
+    except Exception:  # noqa: BLE001 — the report must not mask the OOM
+        text = "(memory report unavailable)"
+    _logger.error("device OOM at %s\n%s", site, text)
+    raise DeviceOOMError(
+        f"device memory exhausted at {site}.\n{text}") from exc
+
+
+# ---------------------------------------------------------------------------
+# fused numerics sentinel
+# ---------------------------------------------------------------------------
+_pending: deque = deque()
+_pending_lock = threading.Lock()
+
+
+def sentinel_mode():
+    """MXTPU_SENTINEL: None (off, default) | 'raise' | 'warn'."""
+    raw = os.environ.get("MXTPU_SENTINEL", "0").strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    if raw == "warn":
+        return "warn"
+    return "raise"
+
+
+def sentinel_window() -> int:
+    """MXTPU_SENTINEL_WINDOW — parked records before a forced sync."""
+    try:
+        return max(int(os.environ.get("MXTPU_SENTINEL_WINDOW", "1024")), 8)
+    except ValueError:
+        return 1024
+
+
+def sentinel_pending() -> int:
+    return len(_pending)
+
+
+def sentinel_record(site: str, step: int, names, finite, norm=None,
+                    packed_norm=False):
+    """Park one program's sentinel outputs WITHOUT reading them.
+
+    ``finite`` is a device array of 0/1 flags — one per key (1-D), or
+    one per (step, key) for a multi-step dispatch (2-D, row i is step
+    ``step + i``).  ``norm`` is the program's gradient-norm scalar;
+    with ``packed_norm`` the norm rides as the LAST entry of ``finite``
+    instead (one output leaf per dispatch, the cheapest shape for the
+    hot loop).  The arrays stay device futures until
+    :func:`sentinel_check` syncs them at a reporting boundary,
+    preserving the hot loop's zero-per-batch-sync property."""
+    with _pending_lock:
+        _pending.append({"site": site, "step": int(step),
+                         "names": tuple(names), "finite": finite,
+                         "norm": norm, "packed": bool(packed_norm)})
+        overflow = len(_pending) > sentinel_window()
+    if _reg.enabled():
+        _TM_SENT_REC.inc(site=site)
+    if overflow:
+        sentinel_check(site="overflow")
+
+
+def sentinel_check(site: str = "boundary"):
+    """Sync every parked sentinel record (the fused path's ONLY
+    device→host sentinel sync) and attribute non-finite flags.
+
+    Returns the offender list ``[(step, site, key_name), ...]``; raises
+    :class:`NumericsError` naming them under ``MXTPU_SENTINEL=raise``
+    (warns under ``warn``).  No-op when nothing is parked."""
+    import numpy as np
+
+    with _pending_lock:
+        if not _pending:
+            return []
+        recs = list(_pending)
+        _pending.clear()
+    if _reg.enabled():
+        _TM_SENT_SYNC.inc(site=site)
+    offenders = []
+    for r in recs:
+        f = np.asarray(r["finite"])
+        if f.ndim == 0:
+            f = f.reshape(1)
+        rows = f.reshape(1, -1) if f.ndim == 1 else f
+        steps = ([r["step"]] if f.ndim == 1
+                 else [r["step"] + i for i in range(rows.shape[0])])
+        norm = r["norm"]
+        if r.get("packed"):
+            norm = rows[-1, -1]
+            rows = rows[:, :-1]
+        for row, step_id in zip(rows, steps):
+            for j, ok in enumerate(row):
+                if not ok:
+                    name = (r["names"][j] if j < len(r["names"])
+                            else f"#{j}")
+                    offenders.append((step_id, r["site"], name))
+        if norm is not None and _reg.enabled():
+            try:
+                _TM_SENT_NORM.set(float(np.asarray(norm)),
+                                  site=r["site"])
+            except Exception:  # noqa: BLE001
+                pass
+    if not offenders:
+        return []
+    if _reg.enabled():
+        for _, osite, _ in offenders:
+            _TM_SENT_BAD.inc(site=osite)
+    msg = ("non-finite gradient(s) detected by MXTPU_SENTINEL: "
+           + "; ".join(f"step {s} [{b}] key {n!r}"
+                       for s, b, n in offenders[:16])
+           + (f" (+{len(offenders) - 16} more)"
+              if len(offenders) > 16 else ""))
+    if sentinel_mode() == "raise":
+        raise NumericsError(msg)
+    warnings.warn(msg, RuntimeWarning, stacklevel=2)
+    return offenders
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+_OFF = ("0", "off", "false", "no")
+
+
+def _flight_raw() -> str:
+    return os.environ.get("MXTPU_FLIGHT_RECORD", "1").strip()
+
+
+def flight_enabled() -> bool:
+    """MXTPU_FLIGHT_RECORD gate (default on — a ring append per step)."""
+    return _flight_raw().lower() not in _OFF
+
+
+def _auto_dump_path():
+    """A pathy MXTPU_FLIGHT_RECORD value enables crash auto-dump there."""
+    raw = _flight_raw()
+    if raw.lower() in _OFF or raw in ("1", "on", "true", "yes"):
+        return None
+    return raw
+
+
+def flight_ring_size() -> int:
+    try:
+        return max(int(os.environ.get("MXTPU_FLIGHT_RING", "256")), 4)
+    except ValueError:
+        return 256
+
+
+_ring: deque = deque(maxlen=flight_ring_size())
+_ring_lock = threading.Lock()
+_step_seq = 0
+
+
+def record_step(**fields):
+    """Append one per-step record to the ring (host-only, no sync).
+
+    Callers pass whatever is cheap at the dispatch site — step/epoch
+    ids, pipeline depth, dispatch latency, program signature; a global
+    sequence number, wall-clock stamp, and the sentinel backlog are
+    added here."""
+    global _ring, _step_seq
+    if not flight_enabled():
+        return None
+    rec = dict(fields)
+    with _ring_lock:
+        _step_seq += 1
+        rec.setdefault("seq", _step_seq)
+        rec.setdefault("t", time.time())
+        rec.setdefault("sentinel_pending", len(_pending))
+        if _ring.maxlen != flight_ring_size():
+            _ring = deque(_ring, maxlen=flight_ring_size())
+        _ring.append(rec)
+    if _reg.enabled():
+        _TM_FLIGHT_REC.inc()
+    return rec
+
+
+def flight_ring():
+    """Snapshot of the ring, oldest first."""
+    with _ring_lock:
+        return list(_ring)
+
+
+def dump_flight_record(path=None, trigger: str = "manual") -> str:
+    """Write the flight record as ONE JSON: the step-record ring, the
+    registry snapshot, the compiled-program cache contents, the ranked
+    memory report, and the sentinel state.  Returns the path written."""
+    from .. import executor as _executor
+
+    if path is None:
+        path = _auto_dump_path() or f"mxtpu_flight_record_{os.getpid()}.json"
+    if os.path.isdir(path):
+        path = os.path.join(path, f"mxtpu_flight_record_{os.getpid()}.json")
+    with _executor._program_cache_lock:
+        cache_keys = [repr(k)[:200] for k in _executor._program_cache]
+    payload = {
+        "version": 1,
+        "time": time.time(),
+        "trigger": trigger,
+        "ring": flight_ring(),
+        "registry": json_snapshot(),
+        "program_cache": {
+            "capacity": _executor.program_cache_capacity(),
+            "size": len(cache_keys),
+            "entries": cache_keys,
+        },
+        "memory": memory_report(),
+        "sentinel": {"mode": sentinel_mode() or "off",
+                     "pending": len(_pending)},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, default=str)
+    _TM_FLIGHT_DUMP.inc(trigger=trigger)
+    return path
+
+
+def auto_dump(trigger: str):
+    """Best-effort dump for crash/signal paths.
+
+    ``exception`` dumps only when ``MXTPU_FLIGHT_RECORD`` names a path
+    (an uncaught exception must not litter the cwd by default);
+    ``signal`` always dumps (the operator asked).  Never raises."""
+    try:
+        if not flight_enabled():
+            return None
+        path = _auto_dump_path()
+        if path is None and trigger != "signal":
+            return None
+        return dump_flight_record(path, trigger=trigger)
+    except Exception:  # noqa: BLE001 — a dump failure must not mask the crash
+        _logger.exception("flight-record auto-dump failed")
+        return None
+
+
+def _install_sigusr1():
+    """SIGUSR1 -> dump the flight record of a live run (main thread
+    only; chains any previously-installed handler)."""
+    import signal
+
+    try:
+        prev = signal.getsignal(signal.SIGUSR1)
+
+        def _handler(signum, frame):
+            auto_dump("signal")
+            if callable(prev) and prev not in (signal.SIG_DFL,
+                                               signal.SIG_IGN):
+                prev(signum, frame)
+
+        signal.signal(signal.SIGUSR1, _handler)
+    except (ValueError, OSError, AttributeError):
+        pass  # non-main thread / platform without SIGUSR1
+
+
+if flight_enabled():
+    _install_sigusr1()

@@ -150,6 +150,11 @@ class FusedTrainer:
         self._cparams: Dict[str, jax.Array] = {}
         self._step_fn = None
         self._step = 0
+        # health-layer state (set for real by _build_step)
+        self._sentinel = False
+        self._sent_names: tuple = ()
+        self._mem_recorded = False
+        self._donated_bytes = None
 
     # ------------------------------------------------------------------ setup
     def init(self, **input_shapes):
@@ -262,6 +267,16 @@ class FusedTrainer:
 
         fixed = self._fixed
         use_ccache = self._use_ccache
+        # numerics sentinel (MXTPU_SENTINEL, sampled at build): the step
+        # ALSO returns a per-param isfinite mask + the global grad norm,
+        # computed inside the same compiled program — zero extra
+        # dispatches, synced only at reporting boundaries
+        sentinel = _tm.health.sentinel_mode() is not None
+        self._sentinel = sentinel
+        self._sent_names = tuple(k for k in self.params if k not in fixed)
+        sent_names = self._sent_names
+        self._mem_recorded = False
+        self._donated_bytes = None
 
         def train_step(params, cparams, aux, opt_state, batch, key, step, lr):
             # the per-step RNG fold happens INSIDE the compiled step (step
@@ -301,6 +316,17 @@ class FusedTrainer:
 
             f32_grads = {k: grads[k].astype(jnp.float32)
                          for k in params if k not in fixed}
+            if sentinel:
+                # raw (pre-clip) grads: a finite clip rescale cannot
+                # mask an inf/nan, and the norm is the divergence
+                # signal.  Flags + norm pack into ONE output leaf —
+                # the extra dispatch cost is one tiny array
+                fin_vec = jnp.stack([jnp.isfinite(f32_grads[k]).all()
+                                     for k in sent_names]).astype(
+                                         jnp.float32)
+                gnorm_s = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                       for g in f32_grads.values()))
+                sent_vec = jnp.concatenate([fin_vec, gnorm_s[None]])
             if self._clip_global_norm is not None:
                 gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
                                      for g in f32_grads.values()))
@@ -325,6 +351,9 @@ class FusedTrainer:
                     new_cparams[k] = (nw.astype(dtype)
                                       if nw.dtype == jnp.float32 else nw)
                 new_opt[k] = ns
+            if sentinel:
+                return (new_params, new_cparams, new_aux, new_opt, outs,
+                        sent_vec)
             return new_params, new_cparams, new_aux, new_opt, outs
 
         self._step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
@@ -348,14 +377,22 @@ class FusedTrainer:
             def body(carry, xs):
                 p, cp, a, o = carry
                 batch, idx, lr = xs
-                p, cp, a, o, outs = train_step(p, cp, a, o, batch, key,
-                                               idx, lr)
+                res = train_step(p, cp, a, o, batch, key, idx, lr)
+                if sentinel:
+                    p, cp, a, o, outs, sent = res
+                    return (p, cp, a, o), (outs, sent)
+                p, cp, a, o, outs = res
                 return (p, cp, a, o), outs
 
-            (params, cparams, aux, opt_state), outs = jax.lax.scan(
+            (params, cparams, aux, opt_state), ys = jax.lax.scan(
                 body, (params, cparams, aux, opt_state),
                 (stacked, idxs, lrs))
-            return params, cparams, aux, opt_state, outs
+            if sentinel:
+                outs, sents = ys
+                # sents is (k, n_params+1): row i flags step step0+1+i,
+                # last column is that step's grad norm
+                return params, cparams, aux, opt_state, outs, sents
+            return params, cparams, aux, opt_state, ys
 
         self._multi_fn = jax.jit(multi_step, donate_argnums=(0, 1, 2, 3))
         # variant that ALSO donates the stacked batch (argnum 4): the
@@ -418,15 +455,61 @@ class FusedTrainer:
         self._step += 1
         t0 = _time.perf_counter() if _tm.enabled() else None
         sb = self._shard_batch(batch)
-        (self.params, self._cparams, self.aux, self.opt_state,
-         outs) = self._step_fn(
-            self.params, self._cparams, self.aux, self.opt_state,
-            sb, _random.current_key(),
-            np.int32(self._step), lr)
+        self._record_step_memory(sb)
+        try:
+            res = self._step_fn(
+                self.params, self._cparams, self.aux, self.opt_state,
+                sb, _random.current_key(),
+                np.int32(self._step), lr)
+        except Exception as e:  # noqa: BLE001 — OOM gets a report
+            _tm.health.reraise_if_oom(e, site="trainer.step")
+            raise
+        if self._sentinel:
+            (self.params, self._cparams, self.aux, self.opt_state,
+             outs, sent) = res
+            _tm.health.sentinel_record(site="fused_step", step=self._step,
+                                       names=self._sent_names,
+                                       finite=sent, packed_norm=True)
+        else:
+            (self.params, self._cparams, self.aux, self.opt_state,
+             outs) = res
         if t0 is not None:
             _TM_STEP_SEC.observe(_time.perf_counter() - t0, loop="fused")
             _TM_SAMPLES.inc(next(iter(sb.values())).shape[0], loop="fused")
+            _tm.health.donation_saved(self._donated_bytes or 0,
+                                      site="trainer_step")
         return outs
+
+    def _tree_nbytes(self, *trees):
+        total = 0
+        for tree in trees:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                try:
+                    total += int(leaf.size) * np.dtype(leaf.dtype).itemsize
+                except Exception:  # noqa: BLE001
+                    pass
+        return total
+
+    def _record_step_memory(self, sb):
+        """First-dispatch memory attribution for the fused step: the
+        donated param/state trees alias their outputs (XLA reuses the
+        HBM), so peak ~ arguments + batch.  Shape math; accelerator
+        backends get the compiled memory_analysis upgrade through the
+        executor-bound programs."""
+        if self._mem_recorded:
+            return
+        self._mem_recorded = True
+        try:
+            donated = self._tree_nbytes(self.params, self._cparams,
+                                        self.aux, self.opt_state)
+            self._donated_bytes = donated
+            batch_b = self._tree_nbytes(sb)
+            label = f"fused_step[{self.symbol.name or 'graph'}]"
+            _tm.health.record_program(label, argument=donated + batch_b,
+                                      output=donated, alias=donated,
+                                      source="shape_math")
+        except Exception:  # noqa: BLE001 — accounting must never break step
+            pass
 
     def step_multi(self, _donate=None, **stacked):
         """Run k fused train steps in ONE dispatch.
@@ -501,6 +584,7 @@ class FusedTrainer:
         t0 = _time.perf_counter() if _tm.enabled() else None
         import warnings as _warnings
 
+        self._record_step_memory(sb)
         with _warnings.catch_warnings():
             if donate:
                 # batch donation is best-effort: when no output aliases
@@ -508,15 +592,33 @@ class FusedTrainer:
                 # call — the fallback is exactly the non-donated behavior
                 _warnings.filterwarnings(
                     "ignore", message="Some donated buffers were not usable")
+            try:
+                res = fn(
+                    self.params, self._cparams, self.aux, self.opt_state,
+                    sb, _random.current_key(), step0, lrs)
+            except Exception as e:  # noqa: BLE001 — OOM gets a report
+                _tm.health.reraise_if_oom(e, site="trainer.step_multi")
+                raise
+        if self._sentinel:
             (self.params, self._cparams, self.aux, self.opt_state,
-             outs) = fn(
-                self.params, self._cparams, self.aux, self.opt_state,
-                sb, _random.current_key(), step0, lrs)
+             outs, sents) = res
+            # sents rows map to steps step0+1 .. step0+k
+            _tm.health.sentinel_record(site="fused_step_multi",
+                                       step=int(step0) + 1,
+                                       names=self._sent_names,
+                                       finite=sents, packed_norm=True)
+        else:
+            (self.params, self._cparams, self.aux, self.opt_state,
+             outs) = res
         if t0 is not None:
             _TM_STEP_SEC.observe(_time.perf_counter() - t0, loop="fused")
             per_step = (first[0].shape[0] if isinstance(first, tuple)
                         else first.shape[1])
             _TM_SAMPLES.inc(int(k * per_step), loop="fused")
+            donated_b = self._donated_bytes or 0
+            if donate:
+                donated_b += self._tree_nbytes(sb)
+            _tm.health.donation_saved(donated_b, site="trainer_step_multi")
         return outs
 
     def eval(self, **batch):
@@ -572,6 +674,26 @@ class FusedTrainer:
                       + eval_label_names if eval_data is not None else None)
         from . import engine as _engine
 
+        try:
+            self._fit_impl(train_data, eval_data, eval_metric,
+                           validation_metric, num_epoch,
+                           batch_end_callback, epoch_end_callback, log,
+                           train_names, eval_names, eval_label_names,
+                           _engine, _time)
+        except BaseException:
+            # black box first, then crash: the ring + registry +
+            # memory report of the dying run (MXTPU_FLIGHT_RECORD path)
+            _tm.health.auto_dump("exception")
+            raise
+        return self
+
+    def _fit_impl(self, train_data, eval_data, eval_metric,
+                  validation_metric, num_epoch, batch_end_callback,
+                  epoch_end_callback, log, train_names, eval_names,
+                  eval_label_names, _engine, _time):
+        from .module.base_module import BatchEndParam, _as_list
+
+        flight = _tm.health.flight_enabled()
         for epoch in range(num_epoch):
             tic = _time.time()
             eval_metric.reset()
@@ -586,9 +708,16 @@ class FusedTrainer:
                 if not self.params:
                     self.init(**{k: tuple(v.shape)
                                  for k, v in feed.items()})
+                t0 = _time.perf_counter() if flight else 0.0
                 outs = self.step(**feed)
                 eval_metric.update(batch.label, [NDArray(o) for o in outs])
                 window.push(list(outs))
+                if flight:
+                    _tm.health.record_step(
+                        loop="fused", step=self._step, epoch=epoch,
+                        nbatch=nbatch, depth=len(window),
+                        dispatch_s=_time.perf_counter() - t0,
+                        program=f"fused_step[{self.symbol.name or 'graph'}]")
                 if batch_end_callback is not None:
                     params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                            eval_metric=eval_metric,

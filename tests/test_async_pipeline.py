@@ -241,6 +241,53 @@ def test_steady_state_fit_has_zero_per_batch_syncs(monkeypatch):
     assert large_eager > large
 
 
+def test_monitor_does_not_serialize_async_window(monkeypatch):
+    """ISSUE-5 satellite: Monitor.tic used to wait_to_read every arg
+    array each interval, pinning the in-flight window at 0.  Stat
+    dispatch is async (the sync lives in toc's _render), so an
+    installed Monitor must keep engine_pipeline_depth > 0."""
+    from mxnet_tpu import telemetry as tm
+
+    monkeypatch.setenv("MXTPU_ASYNC_DEPTH", "2")
+    # tic's old blocking loop is only observable as wait_to_read calls:
+    # count them (the deque-length gauge alone stays full either way)
+    waits = {"n": 0}
+    orig_wait = nd.NDArray.wait_to_read
+
+    def counted_wait(self):
+        waits["n"] += 1
+        return orig_wait(self)
+
+    monkeypatch.setattr(nd.NDArray, "wait_to_read", counted_wait)
+    tm.reset()
+    tm.enable()
+    try:
+        (xtr, ytr), _ = get_synthetic_mnist(64 * 8, 16)
+        train = mx.io.NDArrayIter(xtr, ytr, batch_size=64, shuffle=False)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mon = mx.Monitor(interval=1, pattern=".*fc1.*")
+        depth = tm.get_registry().get("engine_pipeline_depth")
+        seen = []
+
+        def watch(_param):
+            seen.append(depth.value())
+
+        mod.fit(train, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.5),), num_epoch=1,
+                arg_params=_fixed_params(), monitor=mon,
+                batch_end_callback=watch)
+        # the monitor still produced stats (toc_print consumed them)...
+        assert mon.step > 0
+        # ...without the per-interval wait_to_read sweep over every arg
+        # array (8 batches x 8 arrays would be >= 64 calls)
+        assert waits["n"] == 0, waits
+        # ...and the window stayed pipelined under it
+        assert max(seen) > 0, seen
+    finally:
+        tm.reset()
+        tm.disable()
+
+
 def test_fused_metrics_with_data_parallel_module():
     """Sharded outputs (4-device data-parallel group) accumulate device-
     side too: replicated scalars + replicated host labels."""

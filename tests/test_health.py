@@ -1,0 +1,412 @@
+"""Training health layer (round 9): device-memory accounting, the fused
+NaN/Inf sentinel, and the crash flight recorder.
+
+Covers the ISSUE-5 acceptance criteria: a NaN gradient step raises (or
+warns) naming the offending key and step id while a clean fused epoch
+keeps the zero-per-batch-host-sync property; RESOURCE_EXHAUSTED at a
+dispatch site re-raises with the ranked memory report chained; and
+``dump_flight_record`` (manual, crash auto-dump, /healthz) produces the
+one-JSON black box.
+"""
+import json
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.telemetry import health
+
+
+@pytest.fixture(autouse=True)
+def _health_isolation():
+    """Telemetry on, zeroed registry, empty sentinel/ring state."""
+    tm.reset()
+    tm.enable()
+    health._pending.clear()
+    health._ring.clear()
+    with health._programs_lock:
+        health._programs.clear()
+    yield
+    health._pending.clear()
+    health._ring.clear()
+    tm.reset()
+    tm.disable()
+
+
+def _mlp():
+    net = sym.FullyConnected(sym.Variable("data"), name="hfc1",
+                             num_hidden=8)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="hfc2", num_hidden=4)
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+# ---------------------------------------------------------------------------
+# device-memory accounting
+# ---------------------------------------------------------------------------
+def test_engine_live_bytes_tracks_sizes():
+    reg = tm.get_registry()
+    before = reg.get("engine_live_bytes").value()
+    keep = nd.ones((1024,))  # 4096 bytes
+    keep.wait_to_read()
+    assert reg.get("engine_live_bytes").value() >= before + 4096
+    stats = mx.engine.live_memory(top=3)
+    assert stats["arrays"] >= 1
+    assert stats["bytes"] >= 4096
+    assert stats["top"] and stats["top"][0]["bytes"] > 0
+    del keep
+
+
+def test_bind_records_program_memory():
+    ex = _mlp().simple_bind(mx.cpu(), data=(4, 16))
+    rows = {r["program"]: r for r in health.program_table()}
+    assert ex._program_label in rows
+    row = rows[ex._program_label]
+    # args include params+grads; outputs inferred from the symbol
+    assert row["argument_bytes"] > 0
+    assert row["output_bytes"] > 0
+    assert row["peak_bytes"] >= row["argument_bytes"]
+    # mirrored into the registry gauge
+    g = tm.get_registry().get("program_memory_bytes")
+    assert g.value(program=ex._program_label, component="peak") \
+        == row["peak_bytes"]
+
+
+def test_memory_report_ranks_by_peak():
+    _mlp().simple_bind(mx.cpu(), data=(4, 16))
+    report = health.memory_report()
+    peaks = [r["peak_bytes"] for r in report["programs"]]
+    assert peaks == sorted(peaks, reverse=True)
+    text = health.format_memory_report(report)
+    assert "ranked by peak" in text
+    assert "live device arrays" in text
+
+
+def test_oom_at_dispatch_reraises_with_ranked_report(monkeypatch):
+    """ISSUE-5 satellite: a RESOURCE_EXHAUSTED-shaped dispatch error
+    surfaces the ranked memory report with the original chained."""
+    ex = _mlp().simple_bind(mx.cpu(), data=(4, 16))
+    orig = RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "9876543210 bytes")
+
+    def boom(*a, **k):
+        raise orig
+
+    monkeypatch.setattr(ex, "_jit_fwd", boom)
+    with pytest.raises(tm.DeviceOOMError) as ei:
+        ex.forward(is_train=False)
+    assert ei.value.__cause__ is orig
+    msg = str(ei.value)
+    assert "ranked by peak" in msg
+    assert ex._program_label in msg
+    assert tm.get_registry().get("device_memory_oom_total").value(
+        site="executor.forward") == 1
+
+
+def test_non_oom_errors_pass_through_unwrapped(monkeypatch):
+    ex = _mlp().simple_bind(mx.cpu(), data=(4, 16))
+
+    def boom(*a, **k):
+        raise ValueError("shapes do not line up")
+
+    monkeypatch.setattr(ex, "_jit_fwd", boom)
+    with pytest.raises(ValueError, match="shapes do not line up"):
+        ex.forward(is_train=False)
+    assert tm.get_registry().get("device_memory_oom_total").total() == 0
+
+
+def test_oom_in_fused_kv_push(monkeypatch):
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    kv.init([0], [nd.ones((4,))])
+    assert kv._fused is not None
+
+    def boom(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    monkeypatch.setattr(kv._fused, "_step_bucket", boom)
+    with pytest.raises(tm.DeviceOOMError):
+        kv.push([0], [[nd.ones((4,))]])
+    assert tm.get_registry().get("device_memory_oom_total").value(
+        site="kvstore_fused.push") == 1
+
+
+# ---------------------------------------------------------------------------
+# fused numerics sentinel
+# ---------------------------------------------------------------------------
+def _kv_with_nan(monkeypatch, mode="1"):
+    monkeypatch.setenv("MXTPU_SENTINEL", mode)
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create(
+        "sgd", learning_rate=0.1,
+        param_idx2name={0: "clean_w", 1: "bad_w"}))
+    kv.init([0, 1], [nd.ones((4,)), nd.ones((4,))])
+    bad = nd.array(np.array([1.0, np.nan, 1.0, 1.0], np.float32))
+    kv.push([0, 1], [[nd.ones((4,))], [bad]])
+    return kv
+
+
+def test_sentinel_raises_with_key_and_step(monkeypatch):
+    """ISSUE-5 acceptance: a NaN gradient raises naming the offending
+    key and step id — at the boundary sync, not per batch."""
+    _kv_with_nan(monkeypatch)
+    assert health.sentinel_pending() > 0
+    with pytest.raises(tm.NumericsError) as ei:
+        health.sentinel_check()
+    msg = str(ei.value)
+    assert "bad_w" in msg
+    assert "clean_w" not in msg
+    assert "step 1" in msg
+    reg = tm.get_registry()
+    assert reg.get("sentinel_nonfinite_total").total() == 1
+    assert reg.get("sentinel_records_total").total() >= 1
+
+
+def test_sentinel_warn_mode(monkeypatch):
+    _kv_with_nan(monkeypatch, mode="warn")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        offenders = health.sentinel_check()
+    assert [(s, n) for s, _, n in offenders] == [(1, "bad_w")]
+    assert any("bad_w" in str(x.message) for x in w)
+
+
+def test_sentinel_check_via_window_drain(monkeypatch):
+    """The async window's drain IS the reporting boundary: a fit-shaped
+    loop needs no explicit sentinel_check call."""
+    from mxnet_tpu import engine
+
+    _kv_with_nan(monkeypatch)
+    window = engine.AsyncWindow()
+    with pytest.raises(tm.NumericsError, match="bad_w"):
+        window.drain()
+
+
+def test_sentinel_clean_push_is_silent(monkeypatch):
+    monkeypatch.setenv("MXTPU_SENTINEL", "1")
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    kv.init([0], [nd.ones((4,))])
+    kv.push([0], [[nd.ones((4,))]])
+    assert health.sentinel_check() == []
+    # the norm accumulator synced into the gauge
+    assert tm.get_registry().get("sentinel_grad_norm").value(
+        site="kv_bucket0") == pytest.approx(2.0)
+
+
+def test_sentinel_fused_trainer_step_and_multi(monkeypatch):
+    from mxnet_tpu.trainer import FusedTrainer
+
+    monkeypatch.setenv("MXTPU_SENTINEL", "1")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="sfc"),
+        name="softmax")
+    tr = FusedTrainer(net, optimizer="sgd")
+    tr.init(data=(2, 8), softmax_label=(2,))
+    x = np.zeros((2, 8), np.float32)
+    tr.step(data=x, softmax_label=np.zeros((2,), np.float32))
+    assert health.sentinel_check() == []  # clean step
+    x[0, 0] = np.nan
+    tr.step(data=x, softmax_label=np.zeros((2,), np.float32))
+    with pytest.raises(tm.NumericsError) as ei:
+        health.sentinel_check()
+    assert "sfc_weight" in str(ei.value)
+    assert "step 2" in str(ei.value)
+    # step_multi: per-step rows attribute the right absolute step ids
+    # (fresh trainer — the NaN update above already poisoned tr's params)
+    import jax.numpy as jnp
+
+    tr2 = FusedTrainer(net, optimizer="sgd")
+    tr2.init(data=(2, 8), softmax_label=(2,))
+    xs = jnp.stack([jnp.zeros((2, 8)), jnp.asarray(x), jnp.zeros((2, 8))])
+    ys = jnp.zeros((3, 2), jnp.float32)
+    tr2.step_multi(data=xs, softmax_label=ys)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        monkeypatch.setenv("MXTPU_SENTINEL", "warn")
+        offenders = health.sentinel_check()
+    steps = {s for s, _, _ in offenders}
+    # step 1 (clean data, clean params) is NOT flagged; step 2 (the NaN
+    # batch) is; step 3 may flag too — the NaN update poisoned the params
+    assert 2 in steps and 1 not in steps
+    assert "sfc_weight" in {n for _, _, n in offenders}
+
+
+def test_sentinel_zero_per_batch_syncs(monkeypatch):
+    """ISSUE-5 acceptance: sentinel on, a clean fused-metrics epoch
+    still performs ZERO per-batch host syncs — metric_host_sync_total
+    and sentinel_sync_total grow per epoch, not per batch."""
+    monkeypatch.setenv("MXTPU_SENTINEL", "1")
+    reg = tm.get_registry()
+
+    def run(nbatch):
+        rs = np.random.RandomState(0)
+        x = rs.rand(16 * nbatch, 8).astype(np.float32)
+        y = (rs.rand(16 * nbatch) > 0.5).astype(np.float32)
+        it = mx.io.NDArrayIter(x, y, batch_size=16)
+        mod = mx.mod.Module(
+            sym.SoftmaxOutput(sym.FullyConnected(
+                sym.Variable("data"), num_hidden=2), name="softmax"),
+            context=mx.cpu())
+        m0 = reg.get("metric_host_sync_total").total()
+        s0 = reg.get("sentinel_sync_total").total()
+        mod.fit(it, num_epoch=1, kvstore=mx.kv.create("local"),
+                optimizer_params=(("learning_rate", 0.1),))
+        return (reg.get("metric_host_sync_total").total() - m0,
+                reg.get("sentinel_sync_total").total() - s0)
+
+    m_small, s_small = run(4)
+    m_large, s_large = run(16)
+    assert m_large == m_small, (m_small, m_large)
+    assert s_large == s_small, (s_small, s_large)
+    assert s_small >= 1  # the boundary drain really did sync the sentinel
+    # and the sentinel really watched every batch (one record per push)
+    assert reg.get("sentinel_records_total").total() >= 20
+
+
+def test_sentinel_overflow_bounds_pending(monkeypatch):
+    monkeypatch.setenv("MXTPU_SENTINEL", "warn")
+    monkeypatch.setenv("MXTPU_SENTINEL_WINDOW", "8")
+    import jax.numpy as jnp
+
+    fin = jnp.ones((3,), jnp.float32)
+    for i in range(20):
+        health.sentinel_record(site="t", step=i, names=("a", "b", "c"),
+                               finite=fin)
+    assert health.sentinel_pending() <= 9
+    assert tm.get_registry().get("sentinel_sync_total").value(
+        site="overflow") >= 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+def test_flight_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_RING", "16")
+    for i in range(64):
+        tm.record_step(loop="t", step=i)
+    ring = tm.flight_ring()
+    assert len(ring) == 16
+    assert ring[-1]["step"] == 63  # newest kept
+    assert tm.get_registry().get(
+        "flight_recorder_records_total").value() == 64
+
+
+def test_flight_record_disabled(monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_RECORD", "0")
+    assert tm.record_step(loop="t", step=1) is None
+    assert tm.flight_ring() == []
+
+
+def test_dump_flight_record_one_json(tmp_path):
+    """ISSUE-5 acceptance: the dump holds the last N step records, the
+    registry snapshot, and the per-program memory table."""
+    _mlp().simple_bind(mx.cpu(), data=(4, 16))
+    tm.counter("t_flight_total", "help").inc(3)
+    for i in range(5):
+        tm.record_step(loop="t", step=i, depth=2, dispatch_s=0.001)
+    path = tm.dump_flight_record(str(tmp_path / "flight.json"))
+    with open(path) as f:
+        d = json.load(f)
+    assert len(d["ring"]) >= 5
+    assert d["ring"][-1]["step"] == 4
+    assert d["registry"]["metrics"]["t_flight_total"]["samples"]
+    progs = [r["program"] for r in d["memory"]["programs"]]
+    assert any(p.startswith("softmax[") for p in progs)
+    assert "entries" in d["program_cache"]
+    assert d["sentinel"]["mode"] == "off"
+    assert tm.get_registry().get("flight_recorder_dumps_total").value(
+        trigger="manual") == 1
+
+
+def test_module_fit_auto_dumps_on_exception(tmp_path, monkeypatch):
+    """ISSUE-5 acceptance: an uncaught exception inside Module.fit
+    writes the flight record to the MXTPU_FLIGHT_RECORD path."""
+    target = tmp_path / "crash.json"
+    monkeypatch.setenv("MXTPU_FLIGHT_RECORD", str(target))
+    rs = np.random.RandomState(0)
+    it = mx.io.NDArrayIter(rs.rand(64, 8).astype(np.float32),
+                           (rs.rand(64) > 0.5).astype(np.float32),
+                           batch_size=16)
+    mod = mx.mod.Module(
+        sym.SoftmaxOutput(sym.FullyConnected(
+            sym.Variable("data"), num_hidden=2), name="softmax"),
+        context=mx.cpu())
+
+    def exploding_callback(param):
+        if param.nbatch >= 2:
+            raise RuntimeError("boom mid-epoch")
+
+    with pytest.raises(RuntimeError, match="boom mid-epoch"):
+        mod.fit(it, num_epoch=1, batch_end_callback=exploding_callback)
+    with open(target) as f:
+        d = json.load(f)
+    assert d["trigger"] == "exception"
+    # the ring captured the steps that ran before the crash
+    module_steps = [r for r in d["ring"] if r.get("loop") == "module"]
+    assert len(module_steps) >= 2
+    assert {"step", "depth", "dispatch_s"} <= set(module_steps[0])
+
+
+def test_fused_trainer_fit_auto_dumps_on_exception(tmp_path, monkeypatch):
+    from mxnet_tpu.trainer import FusedTrainer
+
+    target = tmp_path / "crash_fused.json"
+    monkeypatch.setenv("MXTPU_FLIGHT_RECORD", str(target))
+    rs = np.random.RandomState(0)
+    it = mx.io.NDArrayIter(rs.rand(64, 8).astype(np.float32),
+                           (rs.rand(64) > 0.5).astype(np.float32),
+                           batch_size=16)
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=2), name="softmax")
+    tr = FusedTrainer(net, optimizer="sgd")
+
+    def exploding_callback(param):
+        if param.nbatch >= 1:
+            raise RuntimeError("boom fused")
+
+    with pytest.raises(RuntimeError, match="boom fused"):
+        tr.fit(it, num_epoch=1, batch_end_callback=exploding_callback)
+    with open(target) as f:
+        d = json.load(f)
+    assert any(r.get("loop") == "fused" for r in d["ring"])
+
+
+def test_healthz_endpoint():
+    """ISSUE-5 satellite: /healthz liveness probe distinct from
+    /metrics."""
+    tm.record_step(loop="t", step=1)
+    srv = tm.start_http_server(0)
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read()
+        d = json.loads(body)
+        assert d["status"] == "ok"
+        assert d["families"] > 0
+        assert d["flight_ring_len"] >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_donation_savings_counter():
+    from mxnet_tpu.trainer import FusedTrainer
+
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=4), name="softmax")
+    tr = FusedTrainer(net, optimizer="sgd")
+    tr.init(data=(2, 8), softmax_label=(2,))
+    tr.step(data=np.zeros((2, 8), np.float32),
+            softmax_label=np.zeros((2,), np.float32))
+    tr.step(data=np.zeros((2, 8), np.float32),
+            softmax_label=np.zeros((2,), np.float32))
+    v = tm.get_registry().get("device_memory_donated_bytes_total").value(
+        site="trainer_step")
+    # params + bf16 cache + aux + opt state donated on both steps (the
+    # first dispatch records the tree size, so only the second counts)
+    assert v > 0

@@ -170,6 +170,17 @@ def test_generate_text_is_valid_exposition():
     assert '\\"' in text and "\\n" in text  # label escapes applied
 
 
+def test_generate_text_serves_nonfinite_gauges():
+    """A diverged run parks NaN in sentinel_grad_norm — the exposition
+    must keep serving exactly then, not die on int(NaN)."""
+    tm.gauge("t_exp_nan_gauge", "goes NaN on divergence").set(float("nan"))
+    tm.gauge("t_exp_inf_gauge", "overflowed").set(float("inf"))
+    text = tm.generate_text()
+    _assert_valid_exposition(text)
+    assert "t_exp_nan_gauge NaN" in text
+    assert "t_exp_inf_gauge +Inf" in text
+
+
 def test_json_snapshot_and_dump(tmp_path):
     c = tm.counter("t_json_total", "help", labels=("kind",))
     c.inc(2, kind="a")
@@ -366,6 +377,66 @@ def test_train_loop_disabled_records_nothing():
     _short_train_loop(epochs=1)
     for fam in tm.get_registry().collect():
         assert not fam.samples(), f"{fam.name} recorded while disabled"
+
+
+# ---------------------------------------------------------------------------
+# docs drift
+# ---------------------------------------------------------------------------
+def test_metric_catalog_matches_registered_families():
+    """ISSUE-5 satellite: docs/telemetry.md's catalog and the families
+    the instrumented modules register at import must agree BOTH ways —
+    a new metric without a docs row fails, and a catalog row for a
+    removed metric fails.  Families are enumerated in a fresh
+    subprocess so dynamically-created test families (spans, t_*) don't
+    pollute the set."""
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ.pop('MXTPU_TELEMETRY_HTTP_PORT', None)\n"
+        "import mxnet_tpu\n"
+        "import mxnet_tpu.trainer\n"
+        "import mxnet_tpu.kvstore_fused\n"
+        "import mxnet_tpu.mp_io\n"
+        "import mxnet_tpu.module.base_module\n"
+        "for f in mxnet_tpu.telemetry.get_registry().collect():\n"
+        "    print(f.name)\n")
+    res = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert res.returncode == 0, res.stderr[-2000:]
+    registered = {l.strip() for l in res.stdout.splitlines() if l.strip()}
+    assert "executor_compile_total" in registered  # enumeration sanity
+    assert len(registered) > 20
+
+    doc = pathlib.Path(__file__).resolve().parent.parent.joinpath(
+        "docs", "telemetry.md").read_text()
+    undocumented = sorted(n for n in registered if f"`{n}`" not in doc)
+    assert not undocumented, (
+        f"registered metric families missing from docs/telemetry.md: "
+        f"{undocumented}")
+
+    # vice versa: every family named in a catalog table's first column
+    # must still be registered by the instrumented modules
+    catalog = doc.split("## Metric catalog", 1)[1]
+    in_catalog = set()
+    for line in catalog.splitlines():
+        if not line.startswith("|") or "---" in line:
+            continue
+        first_cell = line.split("|")[1]
+        for name in re.findall(r"`([a-zA-Z_][a-zA-Z0-9_]*)`", first_cell):
+            if "_" in name:
+                in_catalog.add(name)
+    assert len(in_catalog) > 20
+    stale = sorted(n for n in in_catalog if n not in registered)
+    assert not stale, (
+        f"docs/telemetry.md catalogs families no module registers: "
+        f"{stale}")
 
 
 # ---------------------------------------------------------------------------
