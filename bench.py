@@ -76,11 +76,15 @@ def _fail(msg, metric="resnet50_train_imgs_per_sec_per_chip"):
             # artifacts carry their own capture date; never guess from
             # file mtime (that's the checkout time on a fresh clone).
             # nested under "error" context so automated extra-key
-            # scanners can't mistake the stale artifact for live numbers
+            # scanners can't mistake the stale artifact for live numbers.
+            # fallback_reason makes the artifact substitution EXPLICIT in
+            # the emitted json — rounds r03–r05 fell back silently and
+            # their reports read stale numbers as live ones
             stamp = measured.get("captured_utc", "date unrecorded")
             payload["last_measured"] = {
                 "note": "NOT a live capture; committed artifact embedded "
                         "because this run errored",
+                "fallback_reason": msg,
                 "source": "%s (captured %s)" % (rel, stamp),
                 "data": measured,
             }
@@ -98,41 +102,72 @@ def _peak_flops(device_kind):
     return None
 
 
-def _init_backend(timeout_s):
-    """Initialize the jax backend under a watchdog; returns the device list.
+def _init_backend(timeout_s, retry_timeout_s, notes):
+    """Initialize the jax backend under a two-window watchdog; returns
+    the device list.
 
-    The accelerator plugin's init can hang with ~0 CPU forever (observed in
-    round 1: BENCH_r01 rc=1 / probe >500s).  jax backend init is not
-    interruptible from Python, so the watchdog hard-exits the process after
-    emitting the diagnostic JSON line the driver can parse.
+    The accelerator plugin's init can hang with ~0 CPU forever (observed
+    in round 1: BENCH_r01 rc=1 / probe >500s), and rounds r03–r05 showed
+    a SECOND failure mode: init that completes just past the first
+    timeout.  jax backend init is not interruptible from Python, so the
+    watchdog cannot re-run it — instead it retries by EXTENDING the
+    deadline once (``BENCH_INIT_RETRY_TIMEOUT_S``, default 2x the first
+    window) before hard-exiting with the diagnostic JSON line the driver
+    can parse.  An init that *raises* is genuinely retried once.  Every
+    attempt lands in ``notes`` (emitted as ``init_notes`` in the bench
+    JSON), so a slow-but-successful init is visible instead of silent.
     """
     state = {"done": False}
+    deadline = {"at": time.monotonic() + timeout_s, "extended": False}
 
     def watchdog():
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if state["done"]:
-                return
+        while not state["done"]:
+            now = time.monotonic()
+            if now >= deadline["at"]:
+                if not deadline["extended"]:
+                    deadline["extended"] = True
+                    deadline["at"] = now + retry_timeout_s
+                    notes.append(
+                        "backend init exceeded the %ds window; watchdog "
+                        "extended once for a %ds retry window"
+                        % (timeout_s, retry_timeout_s))
+                else:
+                    _fail("backend init timed out after retry "
+                          "(%ds + %ds windows): %s"
+                          % (timeout_s, retry_timeout_s, "; ".join(notes)))
+                    os._exit(2)
             time.sleep(1.0)
-        if not state["done"]:
-            _fail("backend init timed out after %ds" % timeout_s)
-            os._exit(2)
 
     threading.Thread(target=watchdog, daemon=True).start()
+    tic = time.monotonic()
     try:
         import jax
 
-        return jax.devices()
+        try:
+            devices = jax.devices()
+        except Exception as exc:  # noqa: BLE001 — plugin flake: retry once
+            notes.append("first init attempt raised %r; retrying once"
+                         % (exc,))
+            time.sleep(2.0)
+            devices = jax.devices()
+        init_s = time.monotonic() - tic
+        if init_s > min(timeout_s, 60):
+            notes.append("backend init took %.1fs" % init_s)
+        return devices
     finally:
         state["done"] = True  # disarm even when init raises
 
 
 def main():
     timeout_s = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "240"))
+    retry_s = int(os.environ.get("BENCH_INIT_RETRY_TIMEOUT_S",
+                                 str(2 * timeout_s)))
+    init_notes = []
     try:
-        devices = _init_backend(timeout_s)
+        devices = _init_backend(timeout_s, retry_s, init_notes)
     except Exception as exc:  # noqa: BLE001 — diagnostic JSON is the contract
-        _fail("backend init failed: %r" % (exc,))
+        _fail("backend init failed after retry: %r (%s)"
+              % (exc, "; ".join(init_notes) or "first attempt"))
         return 2
     if not devices:
         _fail("backend initialized but exposed no devices")
@@ -143,11 +178,12 @@ def main():
     if os.environ.get("BENCH_DEVICE_CHECK"):
         _emit({"metric": "device_check", "value": 1, "unit": "devices",
                "vs_baseline": 0.0, "platform": dev.platform,
-               "device_kind": kind, "n_devices": len(devices)})
+               "device_kind": kind, "n_devices": len(devices),
+               **({"init_notes": init_notes} if init_notes else {})})
         return 0
 
     try:
-        return _bench(dev, kind)
+        return _bench(dev, kind, init_notes)
     except Exception as exc:  # noqa: BLE001
         _fail("bench failed on %s: %r" % (kind, exc))
         return 2
@@ -481,7 +517,83 @@ def _health_micro():
             tm.disable()
 
 
-def _bench(dev, kind):
+def _serve_micro():
+    """Serving micro-bench (round 10): the continuous-batching decode
+    scheduler (mxnet_tpu/serving/) under a synthetic Poisson arrival
+    load — served tokens/s, p50/p99 time-to-first-token, and mean slot
+    occupancy.  Drives the SlotScheduler directly (the HTTP layer adds
+    ~connection overhead, not decode behavior); prompts span several
+    prefill buckets so admission exercises the bucketed-length programs
+    the way mixed traffic would.
+    """
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models, telemetry as tm
+    from mxnet_tpu.models.decode import KVDecoder
+    from mxnet_tpu.serving import SlotScheduler
+
+    was_enabled = tm.enabled()
+    tm.enable()
+    sched = None
+    try:
+        L_, H_, D_, T_, V_ = 2, 4, 128, 128, 512
+        net = models.transformer.transformer_lm(
+            num_layers=L_, num_heads=H_, d_model=D_, seq_len=T_,
+            vocab_size=V_)
+        ex = net.simple_bind(ctx=mx.cpu(), grad_req="null",
+                             data=(1, T_), softmax_label=(1, T_))
+        rs = np.random.RandomState(11)
+        params = {}
+        for name, arr in ex.arg_dict.items():
+            if name in ("data", "softmax_label"):
+                continue
+            arr[:] = rs.normal(0, 0.08, arr.shape).astype(np.float32)
+            params[name] = arr
+        dec = KVDecoder(params, num_layers=L_, num_heads=H_, max_len=T_)
+        sched = SlotScheduler(dec, num_slots=4, queue_size=64,
+                              default_deadline_ms=120000)
+        # warm every program mixed traffic will hit: one request per
+        # prefill bucket + the shared step/adopt programs
+        for plen in (5, 12, 30):
+            sched.generate(rs.randint(0, V_, plen), max_new_tokens=2,
+                           timeout=120)
+        n_req, max_new = 24, 12
+        reqs = []
+        tic = time.perf_counter()
+        ticks0 = sched.stats["ticks"]
+        slot_ticks0 = sched.stats["slot_ticks"]
+        for _ in range(n_req):
+            time.sleep(float(rs.exponential(0.01)))  # Poisson arrivals
+            reqs.append(sched.submit(
+                rs.randint(0, V_, int(rs.randint(4, 32))),
+                max_new_tokens=max_new))
+        for r in reqs:
+            r.wait(300)
+        dt = time.perf_counter() - tic
+        toks = sum(len(r.tokens) for r in reqs)
+        ttfts = sorted(r.ttft for r in reqs if r.ttft is not None)
+        ticks = sched.stats["ticks"] - ticks0
+        slot_ticks = sched.stats["slot_ticks"] - slot_ticks0
+        pct = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)]
+        return {
+            "serve_tokens_per_sec": round(toks / dt, 1),
+            "serve_ttft_p50_ms": round(pct(0.50) * 1e3, 1),
+            "serve_ttft_p99_ms": round(pct(0.99) * 1e3, 1),
+            "serve_slot_occupancy_mean": round(
+                slot_ticks / max(ticks, 1), 2),
+            "serve_outcomes_ok": sum(1 for r in reqs
+                                     if r.outcome == "ok"),
+            "serve_requests": n_req,
+        }
+    finally:
+        if sched is not None:
+            sched.close()
+        if not was_enabled:
+            tm.disable()
+
+
+def _bench(dev, kind, init_notes=()):
     import jax
     import jax.numpy as jnp
 
@@ -571,6 +683,9 @@ def _bench(dev, kind):
         "model_tflops_per_sec": round(img_s * TRAIN_FLOPS_PER_IMG / 1e12, 2),
         "steps_per_call": spc,
     }
+    if init_notes:
+        # a slow/retried backend init is a datapoint, not a silent event
+        payload["init_notes"] = list(init_notes)
 
     if os.environ.get("BENCH_EXTRAS", "1") == "1":
         # secondary datapoint (inference b32; P100 baseline 713.17 img/s)
@@ -794,6 +909,15 @@ def _bench(dev, kind):
             # (ISSUE 5)
             if os.environ.get("BENCH_HEALTH", "1") == "1":
                 for k_, v_ in _health_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # serving hot path: continuous-batching scheduler under a
+            # Poisson arrival load — served tok/s, TTFT tail, slot
+            # occupancy (ISSUE 6)
+            if os.environ.get("BENCH_SERVE", "1") == "1":
+                for k_, v_ in _serve_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

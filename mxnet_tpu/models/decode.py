@@ -11,6 +11,17 @@ forward functionally so each step is one XLA program with
 `lax.dynamic_update_slice` into a (L, B, H, max_len, dh) cache.
 `tests/test_decode.py` pins step-by-step equivalence against the
 symbol graph's full forward.
+
+Beyond the shared-position API (`prefill`/`step`, every row at the same
+``pos``), the decoder also exposes a **slot-pool API** for the serving
+subsystem (`mxnet_tpu/serving/`): each batch row is an independent
+*slot* with its own host-tracked ``(start, cursor)`` cache window, so
+requests of different prompt lengths decode in ONE jitted step and
+finished rows can be replaced mid-flight without touching the others —
+see :meth:`KVDecoder.prefill_padded`, :meth:`KVDecoder.step_slots`, and
+:meth:`KVDecoder.adopt_row`.  ``quantize="int8"`` stores the weights as
+int8 + per-channel scales and dequantizes inside the compiled programs
+(`serving/quantize.py`).
 """
 from functools import partial
 
@@ -20,6 +31,40 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def _count_compiles(fn, kind):
+    """Wrap a to-be-jitted callable so each trace (= each XLA compile)
+    lands in ``executor_compile_total{kind=decode_*}`` — the serving
+    tests assert this stays flat after warmup (zero per-tick recompiles).
+    """
+    import functools
+
+    from .. import telemetry as _tm
+
+    ctr = _tm.counter(
+        "executor_compile_total",
+        "graph traces handed to XLA: one per jit cache miss, including "
+        "per-shape recompiles", labels=("kind",))
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        ctr.inc(kind=kind)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+class _DequantView(dict):
+    """Param dict whose int8 entries dequantize on read.  Inside a jit
+    trace the int8 array is the captured constant and the
+    ``astype * scale`` fuses into the consumer (matmul/gather), so the
+    device holds int8 storage while compute runs in the compute dtype."""
+
+    def __getitem__(self, key):
+        v = dict.__getitem__(self, key)
+        deq = getattr(v, "dequantize", None)
+        return deq() if deq is not None else v
 
 
 def _logsumexp(x):
@@ -46,7 +91,8 @@ class KVDecoder:
     """
 
     def __init__(self, arg_params, num_layers, num_heads, max_len,
-                 dtype=jnp.float32, mesh=None, model_axis="model"):
+                 dtype=jnp.float32, mesh=None, model_axis="model",
+                 quantize=None):
         """``mesh``: shard serving over devices, Megatron-style — q/k/v
         and ffn_in weights column-parallel, proj and ffn_out
         row-parallel, the K/V cache split on its HEAD axis — so each
@@ -78,21 +124,42 @@ class KVDecoder:
                           if "lm_head" not in r.pattern
                           and "tok_embed" not in r.pattern)
             p = shard_params(mesh, p, rules)
-        self.p = p
         self.L, self.H = num_layers, num_heads
         self.max_len = max_len
         self.d_model = p["tok_embed_weight"].shape[1]
         self.dh = self.d_model // num_heads
         self.vocab = p["lm_head_weight"].shape[0]
+        self._cache_dtype = p["tok_embed_weight"].dtype
         if p["pos_embed"].shape[1] < max_len:
             raise ValueError(
                 f"checkpoint pos table {p['pos_embed'].shape[1]} < "
                 f"max_len {max_len}")
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r} "
+                             "(supported: 'int8')")
+        if quantize == "int8":
+            if mesh is not None:
+                raise ValueError(
+                    "quantize='int8' is not supported together with a "
+                    "tensor-parallel mesh (shard the fp weights instead)")
+            from ..serving.quantize import quantize_params
+
+            p = _DequantView(quantize_params(p, dtype=dtype))
+        self.quantize = quantize
+        self.p = p
         self._step_jit = jax.jit(partial(self._forward_positions, n=1))
         self._reorder_jit = jax.jit(
             lambda kc, vc, idx: (kc[:, idx], vc[:, idx]))
         self._prefill_cache = {}
         self._scan_cache = {}
+        self._padded_prefill_cache = {}
+        self._slot_step_jit = jax.jit(
+            _count_compiles(self._forward_slots, "decode_step"))
+        self._adopt_jit = jax.jit(_count_compiles(
+            lambda kc, vc, kr, vr, slot: (
+                jax.lax.dynamic_update_slice(kc, kr, (0, slot, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(vc, vr, (0, slot, 0, 0, 0))),
+            "decode_adopt"))
 
     def _cache_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -160,7 +227,7 @@ class KVDecoder:
     def init_state(self, batch):
         """state = (k_cache, v_cache, pos) — pos is a HOST int."""
         shape = (self.L, batch, self.H, self.max_len, self.dh)
-        dtype = self.p["tok_embed_weight"].dtype
+        dtype = self._cache_dtype
         if self.mesh is not None:
             # allocate SHARDED: each device holds 1/tp of the cache from
             # the start (a dense zeros + reshard would transiently put
@@ -198,6 +265,181 @@ class KVDecoder:
         (kc, vc), logits = self._step_jit(
             kc, vc, pos, jnp.asarray(token).reshape(-1, 1))
         return (kc, vc, pos + 1), logits[:, 0]
+
+    # ------------------------------------------------- slot-pool API
+    # (continuous batching, mxnet_tpu/serving/): each batch row is an
+    # independent request slot whose cache window [start, cursor] the
+    # CALLER tracks as host int arrays — no step reads device state, so
+    # the scheduler's bookkeeping costs zero syncs, exactly like the
+    # shared-pos API's host counter.
+
+    def _forward_slots(self, kc, vc, tokens, start, cursor):
+        """One decode position for EVERY slot at once, each row at its
+        own cache position.  ``tokens``/``start``/``cursor`` are (B,)
+        int32: row ``b`` writes its new K/V at cache position
+        ``cursor[b]`` and attends over ``[start[b], cursor[b]]`` with
+        position embedding ``cursor[b] - start[b]``.  Rows whose slot is
+        free still ride along (fixed batch keeps this ONE compiled
+        program); their outputs are garbage the caller ignores and their
+        writes land at position ``cursor[b]`` of a row :meth:`adopt_row`
+        fully overwrites on the next admission."""
+        p = self.p
+        B = tokens.shape[0]
+        H, dh, D = self.H, self.dh, self.d_model
+
+        tok = jnp.take(p["tok_embed_weight"], tokens.astype(jnp.int32),
+                       axis=0)                               # (B, D)
+        pos_ids = jnp.clip(cursor - start, 0, self.max_len - 1)
+        posv = jnp.take(p["pos_embed"][0], pos_ids, axis=0)  # (B, D)
+        h = (tok + posv)[:, None]                            # (B, 1, D)
+        s_idx = jnp.arange(self.max_len)
+        valid = (s_idx[None, :] >= start[:, None]) & \
+            (s_idx[None, :] <= cursor[:, None])              # (B, S)
+        rows = jnp.arange(B)
+        for i in range(self.L):
+            name = f"layer{i}"
+            h2 = _ln(h, p[f"{name}_ln1_gamma"], p[f"{name}_ln1_beta"])
+            q, k, v = self._block_qkv(i, h2)
+            sh = lambda a: a.reshape(B, 1, H, dh).transpose(0, 2, 1, 3)
+            qh, kh, vh = sh(q), sh(k), sh(v)                 # (B, H, 1, dh)
+            kc = kc.at[i, rows, :, cursor].set(kh[:, :, 0])
+            vc = vc.at[i, rows, :, cursor].set(vh[:, :, 0])
+            scores = jnp.einsum("bhnd,bhsd->bhns", qh, kc[i]) \
+                / jnp.sqrt(jnp.asarray(dh, h.dtype))
+            scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+            att = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bhns,bhsd->bhnd", att, vc[i])
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(B, 1, D)
+            proj = _fc(ctx, p[f"{name}_proj_weight"],
+                       p[f"{name}_proj_bias"])
+            h = h + proj
+            h2 = _ln(h, p[f"{name}_ln2_gamma"], p[f"{name}_ln2_beta"])
+            f = _fc(h2, p[f"{name}_ffn_in_weight"],
+                    p[f"{name}_ffn_in_bias"])
+            f = jax.nn.gelu(f)
+            f = _fc(f, p[f"{name}_ffn_out_weight"],
+                    p[f"{name}_ffn_out_bias"])
+            h = h + f
+        h = _ln(h, p["final_ln_gamma"], p["final_ln_beta"])
+        logits = _fc(h, p["lm_head_weight"], p["lm_head_bias"])
+        return (kc, vc), logits[:, 0]                        # (B, V)
+
+    def _forward_padded(self, kc, vc, tokens, start):
+        """Left-padded prefill: ``tokens`` (B, T) with row ``b``'s real
+        prompt right-aligned in the last ``T - start[b]`` positions.
+        Real tokens write K/V at their padded index and attend over
+        ``[start[b], n]``; pad queries (n < start) attend to themselves
+        only — finite garbage that every real query's window excludes.
+        Left-padding makes ``logits[:, -1]`` the next-token logits of
+        EVERY row regardless of its prompt length."""
+        p = self.p
+        B, T = tokens.shape
+        H, dh, D = self.H, self.dh, self.d_model
+
+        tok = jnp.take(p["tok_embed_weight"], tokens.astype(jnp.int32),
+                       axis=0)                               # (B, T, D)
+        pos_ids = jnp.clip(jnp.arange(T)[None, :] - start[:, None],
+                           0, self.max_len - 1)              # (B, T)
+        posv = jnp.take(p["pos_embed"][0], pos_ids, axis=0)  # (B, T, D)
+        h = tok + posv
+        n_idx = jnp.arange(T)
+        s_idx = jnp.arange(self.max_len)
+        lo = jnp.minimum(start[:, None], n_idx[None, :])     # (B, T)
+        valid = (s_idx[None, None, :] <= n_idx[None, :, None]) & \
+            (s_idx[None, None, :] >= lo[:, :, None])         # (B, T, S)
+        for i in range(self.L):
+            name = f"layer{i}"
+            h2 = _ln(h, p[f"{name}_ln1_gamma"], p[f"{name}_ln1_beta"])
+            q, k, v = self._block_qkv(i, h2)
+            sh = lambda a: a.reshape(B, T, H, dh).transpose(0, 2, 1, 3)
+            qh, kh, vh = sh(q), sh(k), sh(v)                 # (B, H, T, dh)
+            kc = jax.lax.dynamic_update_slice(kc, kh[None], (i, 0, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, vh[None], (i, 0, 0, 0, 0))
+            scores = jnp.einsum("bhnd,bhsd->bhns", qh, kc[i]) \
+                / jnp.sqrt(jnp.asarray(dh, h.dtype))
+            scores = jnp.where(valid[:, None], scores, NEG_INF)
+            att = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bhns,bhsd->bhnd", att, vc[i])
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, D)
+            proj = _fc(ctx, p[f"{name}_proj_weight"],
+                       p[f"{name}_proj_bias"])
+            h = h + proj
+            h2 = _ln(h, p[f"{name}_ln2_gamma"], p[f"{name}_ln2_beta"])
+            f = _fc(h2, p[f"{name}_ffn_in_weight"],
+                    p[f"{name}_ffn_in_bias"])
+            f = jax.nn.gelu(f)
+            f = _fc(f, p[f"{name}_ffn_out_weight"],
+                    p[f"{name}_ffn_out_bias"])
+            h = h + f
+        h = _ln(h, p["final_ln_gamma"], p["final_ln_beta"])
+        logits = _fc(h, p["lm_head_weight"], p["lm_head_bias"])
+        return (kc, vc), logits                              # (B, T, V)
+
+    def init_slot_state(self, num_slots):
+        """Empty slot-pool cache ``(k_cache, v_cache)`` for ``num_slots``
+        slots; the per-slot ``start``/``cursor`` windows live with the
+        caller (host int arrays)."""
+        kc, vc, _ = self.init_state(num_slots)
+        return kc, vc
+
+    def prefill_padded(self, tokens, lengths):
+        """Variable-length co-batched prefill.  ``tokens`` (B, T)
+        LEFT-padded, ``lengths`` (B,) real prompt lengths (0 < len <= T).
+        Returns ``((kc, vc), logits)`` with logits (B, T, V);
+        ``logits[:, -1]`` is every row's next-token distribution.  The
+        caller's slot windows are ``start = T - lengths``, ``cursor = T``.
+        One compile per distinct padded length T (bucket prompt lengths
+        to bound the program count)."""
+        tokens = jnp.asarray(tokens)
+        B, T = tokens.shape
+        lengths = np.asarray(lengths, np.int64)
+        if T > self.max_len:
+            raise ValueError(f"padded prompt {T} > max_len {self.max_len}")
+        if lengths.shape != (B,) or (lengths <= 0).any() \
+                or (lengths > T).any():
+            raise ValueError(
+                f"lengths must be (B,) in [1, {T}], got {lengths!r}")
+        if T not in self._padded_prefill_cache:
+            self._padded_prefill_cache[T] = jax.jit(
+                _count_compiles(self._forward_padded, "decode_prefill"))
+        kc, vc, _ = self.init_state(B)
+        start = (T - lengths).astype(np.int32)
+        (kc, vc), logits = self._padded_prefill_cache[T](
+            kc, vc, tokens, jnp.asarray(start))
+        return (kc, vc), logits
+
+    def step_slots(self, cache, tokens, start, cursor):
+        """One decode tick over the whole slot pool: (B,) next tokens in,
+        ``((kc, vc), logits (B, V))`` out.  ``start``/``cursor`` are the
+        host-tracked per-slot cache windows; the caller advances
+        ``cursor[b] += 1`` for every row it actually consumed and MUST
+        keep ``cursor < max_len`` (finish the request when its window is
+        full).  ONE fused XLA program regardless of which slots are
+        live."""
+        kc, vc = cache
+        cursor = np.asarray(cursor)
+        if (cursor >= self.max_len).any():
+            raise ValueError(
+                f"slot cursor at max_len {self.max_len}: finish or evict "
+                "the request before ticking it")
+        (kc, vc), logits = self._slot_step_jit(
+            kc, vc, jnp.asarray(np.asarray(tokens), jnp.int32),
+            jnp.asarray(np.asarray(start), jnp.int32),
+            jnp.asarray(cursor, jnp.int32))
+        return (kc, vc), logits
+
+    def adopt_row(self, cache, row_cache, slot):
+        """Copy a freshly prefilled batch-1 cache (from
+        :meth:`prefill_padded` at B=1) into slot ``slot`` of the pool —
+        the admission write of the continuous-batching scheduler.  The
+        slot index rides as a traced scalar, so every admission reuses
+        ONE compiled program."""
+        kc, vc = cache
+        kr, vr = row_cache
+        if kr.shape[1] != 1:
+            raise ValueError(f"row cache must be batch-1, got {kr.shape}")
+        kc, vc = self._adopt_jit(kc, vc, kr, vr, jnp.int32(slot))
+        return kc, vc
 
     def _check_generation_budget(self, prompt, n_tokens):
         """Shared generate()/generate_scan() prologue: normalized prompt

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from ..base import mxu_precision
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from .mesh import shard_map
 
 
 def _stream_block(q, k, v, m, l, o, scale, mask=None):

@@ -36,12 +36,21 @@ class Predictor:
     the checkpoint's own precision; the ``MXTPU_PREDICT_DTYPE`` env var
     sets it for non-Python clients of the C ABI (src/c_predict.cc),
     which construct this class without kwargs.
+
+    ``quantize="int8"``: post-training weight quantization
+    (serving/quantize.py) — fp 2-D matmul and 4-D conv ``*weight``
+    params are stored as int8 + per-channel symmetric scales and
+    dequantized *inside* the compiled program, so the
+    ``astype * scale`` fuses into each weight's consumer.  4x smaller
+    weight residency than fp32 (composable with ``dtype="bfloat16"``:
+    int8 storage, bf16 compute).  ``MXTPU_PREDICT_INT8=1`` sets it for
+    kwarg-less C-ABI clients, like ``MXTPU_PREDICT_DTYPE``.
     """
 
     def __init__(self, symbol_json_str=None, param_bytes=None,
                  input_shapes=None, dev_type="cpu", dev_id=0,
                  symbol=None, arg_params=None, aux_params=None,
-                 output_index=None, dtype=None):
+                 output_index=None, dtype=None, quantize=None):
         from . import context as ctx_mod
         from .executor import simple_bind
 
@@ -126,7 +135,14 @@ class Predictor:
 
         if dtype is None:
             dtype = os.environ.get("MXTPU_PREDICT_DTYPE") or None
+        if quantize is None and os.environ.get(
+                "MXTPU_PREDICT_INT8", "0").lower() not in ("", "0", "false"):
+            quantize = "int8"
+        if quantize not in (None, "int8"):
+            raise MXNetError(f"unknown quantize mode {quantize!r} "
+                             "(supported: 'int8')")
         self._dtype = dtype  # normalized to a jnp dtype in _build_fast_forward
+        self._quantize = quantize
         self._wire_dtype = None  # host-side upload dtype (set below)
         self._build_fast_forward()
         self._fast_outs = None
@@ -153,13 +169,14 @@ class Predictor:
 
         if getattr(self._exec, "_placed", False):
             self._infer_jit = None  # ctx-group graphs: outer must stay unjitted
-            if self._dtype not in (None, "float32"):
+            if self._dtype not in (None, "float32") or self._quantize:
                 import warnings
 
                 warnings.warn(
-                    "Predictor dtype=%r is not applied on ctx-group (placed) "
-                    "graphs — the executor fallback computes in the "
-                    "checkpoint's own precision" % (self._dtype,),
+                    "Predictor dtype=%r / quantize=%r is not applied on "
+                    "ctx-group (placed) graphs — the executor fallback "
+                    "computes in the checkpoint's own precision"
+                    % (self._dtype, self._quantize),
                     stacklevel=3)
             return
         graph_fn = self._exec._graph_fn
@@ -172,6 +189,24 @@ class Predictor:
             if k not in self._input_names}
         self._aux_snapshot = {
             k: v._read() for k, v in self._exec.aux_dict.items()}
+        # int8 weight quantization (serving/quantize.py): move the
+        # filtered weights out of the fp snapshot into an int8+scale
+        # tree; _infer dequantizes them INSIDE the program, directly in
+        # the compute dtype, so storage is int8 and the multiply fuses
+        # into each weight's consumer
+        self._qparams = {}
+        if self._quantize == "int8":
+            from .serving.quantize import (default_weight_filter,
+                                           quantize_per_channel)
+
+            for k in list(self._param_snapshot):
+                v = self._param_snapshot[k]
+                if not default_weight_filter(k, v):
+                    continue
+                q, scale = quantize_per_channel(np.asarray(v), axis=0)
+                self._qparams[k] = (jax.device_put(q),
+                                    jax.device_put(scale))
+                del self._param_snapshot[k]
         # upload inputs over the wire ALREADY in the compute dtype: the
         # in-graph cast would throw the upper half of every fp32 mantissa
         # away on arrival anyway, so casting on the host first halves the
@@ -180,9 +215,12 @@ class Predictor:
         if cast is not None and cast != jnp.float32:
             self._wire_dtype = cast
 
-        def _infer(params, aux, inputs, step, base_key):
+        def _infer(params, qparams, aux, inputs, step, base_key):
             key = jax.random.fold_in(base_key, step)
             merged = dict(params)
+            dq = cast if cast is not None else jnp.float32
+            for k, (q, scale) in qparams.items():
+                merged[k] = q.astype(dq) * scale.astype(dq)
             merged.update(inputs)
             if cast is not None and cast != jnp.float32:
                 merged = {k: v.astype(cast) if v.dtype == jnp.float32 else v
@@ -257,8 +295,8 @@ class Predictor:
         # the key is a traced argument (not a closure constant) so a
         # later mx.random.seed() is honored, matching Executor.forward
         outs = self._infer_jit(
-            self._param_snapshot, self._aux_snapshot, feeds,
-            np.uint32(self._step), _random.current_key())
+            self._param_snapshot, self._qparams, self._aux_snapshot,
+            feeds, np.uint32(self._step), _random.current_key())
         self._step += 1
         self._dirty = False
         return outs
@@ -390,7 +428,7 @@ class Predictor:
         new = Predictor(symbol=self.symbol, arg_params=arg_params,
                         aux_params=aux_params, input_shapes=input_shapes,
                         dev_type=self._exec._ctx,  # keep the original device
-                        dtype=self._dtype)
+                        dtype=self._dtype, quantize=self._quantize)
         self.__dict__.update(new.__dict__)
 
 
@@ -407,7 +445,7 @@ def _load_param_bytes(param_bytes):
 
 
 def create(prefix, epoch, input_shapes, dev_type="cpu", dev_id=0,
-           dtype=None):
+           dtype=None, quantize=None):
     """Load a save_checkpoint()-style checkpoint into a Predictor
     (parity: the common MXPredCreate usage in c_predict_api examples)."""
     from .model import load_checkpoint
@@ -415,4 +453,5 @@ def create(prefix, epoch, input_shapes, dev_type="cpu", dev_id=0,
     symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
     return Predictor(symbol=symbol, arg_params=arg_params,
                      aux_params=aux_params, input_shapes=input_shapes,
-                     dev_type=dev_type, dev_id=dev_id, dtype=dtype)
+                     dev_type=dev_type, dev_id=dev_id, dtype=dtype,
+                     quantize=quantize)

@@ -1,0 +1,31 @@
+"""Inference serving subsystem: continuous batching + int8 predict.
+
+The first subsystem that SERVES traffic instead of training it — the
+"millions of users, heavy traffic" workload of the ROADMAP north star,
+exercising the predict-API surface of the source paper (the C-predict
+ABI / `predict.py`) as a long-running server process.
+
+Three pieces:
+
+- :mod:`.scheduler` — slot-pool continuous batching over a
+  `KVDecoder`: one jitted decode step per tick across all occupied
+  slots, mid-flight slot reuse, bounded admission queue, deadlines.
+- :mod:`.server` — stdlib HTTP front-end (``POST /generate`` with 429
+  backpressure, plus the ops ``/metrics`` and ``/healthz``); see
+  ``tools/serve.py`` for the process entrypoint.
+- :mod:`.quantize` — post-training int8 weight quantization
+  (per-channel symmetric, int8 storage, dequantize-in-compute) for
+  `Predictor` and `KVDecoder` — the TVM-style (arXiv:1802.04799)
+  quantized-inference lowering, done through XLA fusion.
+
+Env knobs (docs/how_to/env_var.md round 10): ``MXTPU_SERVE_SLOTS``,
+``MXTPU_SERVE_QUEUE``, ``MXTPU_SERVE_DEADLINE_MS``,
+``MXTPU_PREDICT_INT8``.  Metric families: docs/telemetry.md (serving
+section).
+"""
+from . import quantize  # noqa: F401
+from .quantize import QuantizedTensor, quantize_params  # noqa: F401
+from .scheduler import (  # noqa: F401
+    AdmissionQueueFull, Request, SlotScheduler,
+)
+from .server import serve_decoder, start_server  # noqa: F401

@@ -1,0 +1,388 @@
+"""Slot-pool continuous-batching scheduler.
+
+The serving core: a fixed number of decode *slots* sharing ONE
+`KVDecoder` batch.  Every engine tick runs one jitted decode step over
+all slots (`KVDecoder.step_slots` — a single XLA program regardless of
+which slots are live); a request that finishes (eos / token budget /
+cache capacity / deadline) frees its slot **mid-flight**, and queued
+requests are admitted into free slots at the next iteration without
+recompiling anything: admission is a bucketed-length prefill
+(`prefill_padded`, one program per bucket, warmed after the first
+request of each bucket) plus one traced-slot-index cache write
+(`adopt_row`).  The decode jits live in the same process as the PR-2
+program cache, so a warm server performs ZERO traces per tick —
+asserted via `executor_compile_total{kind=decode_*}` by
+tests/test_serving.py.
+
+Host/device split follows the training hot loop's rule: per-slot
+``start``/``cursor`` windows, queued requests, and sampling live on the
+HOST (numpy); no tick reads device state except the one (B, V) logits
+fetch that sampling needs anyway.  Per-request sampling params
+(temperature / top_k / seed) are host-side, so heterogeneous requests
+co-batch freely.
+
+Backpressure is explicit: the admission queue is bounded
+(``MXTPU_SERVE_QUEUE``); a full queue raises
+:class:`AdmissionQueueFull`, which the HTTP layer maps to 429.
+Deadlines (``MXTPU_SERVE_DEADLINE_MS`` default, per-request override)
+are enforced both while queued and mid-generation.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .. import telemetry as _tm
+from ..base import MXNetError
+
+__all__ = ["Request", "SlotScheduler", "AdmissionQueueFull"]
+
+# --- serving metric families (docs/telemetry.md, serving section) ----------
+_TM_REQS = _tm.counter(
+    "serve_requests_total",
+    "requests by terminal outcome: ok (completed), rejected (admission "
+    "queue full), timeout (deadline while queued or generating), error, "
+    "shutdown", labels=("outcome",))
+_TM_TOKENS = _tm.counter(
+    "serve_tokens_total", "tokens generated and delivered to requests")
+_TM_QUEUE = _tm.gauge(
+    "serve_queue_depth", "requests waiting in the bounded admission queue")
+_TM_OCCUPANCY = _tm.gauge(
+    "serve_slot_occupancy", "decode slots currently running a request")
+_TM_TTFT = _tm.histogram(
+    "serve_ttft_seconds",
+    "time-to-first-token: request arrival to its first sampled token "
+    "(queue wait + prefill)")
+_TM_REQ_SEC = _tm.histogram(
+    "serve_request_seconds", "request latency: arrival to terminal outcome")
+_TM_REUSE = _tm.counter(
+    "serve_slot_reuse_total",
+    "admissions into a slot that already served an earlier request — "
+    "continuous batching in action (0 means every request got a cold slot)")
+_TM_TICK = _tm.histogram(
+    "serve_tick_seconds",
+    "one engine tick: a fused decode step over all slots + host sampling")
+
+
+class AdmissionQueueFull(MXNetError):
+    """The bounded admission queue is full — shed load (HTTP 429)."""
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return default if not v else int(v)
+
+
+class Request:
+    """One generation request and its (thread-safe) result slot.
+
+    ``wait(timeout)`` blocks until a terminal outcome; ``tokens`` then
+    holds everything generated (possibly partial on ``timeout``).
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens=16, temperature=0.0,
+                 top_k=None, eos_id=None, deadline_ms=None, seed=0):
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise MXNetError(
+                f"prompt must be a non-empty 1-D token-id sequence, got "
+                f"shape {prompt.shape}")
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        self.id = next(Request._ids)
+        self.prompt = prompt.astype(np.int64)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.arrival = time.monotonic()
+        self.deadline = (self.arrival + deadline_ms / 1000.0
+                         if deadline_ms else None)
+        self.tokens = []
+        self.outcome = None   # ok | timeout | error | shutdown
+        self.error = None
+        self.ttft = None
+        self._rng = np.random.RandomState(seed)
+        self._event = threading.Event()
+
+    def wait(self, timeout=None):
+        """Block until the request reaches a terminal outcome (or the
+        wait times out — ``outcome`` is then still None)."""
+        self._event.wait(timeout)
+        return self
+
+    @property
+    def done(self):
+        return self._event.is_set()
+
+
+class SlotScheduler:
+    """Continuous batching over one :class:`~mxnet_tpu.models.decode.
+    KVDecoder`.
+
+    ``prefill_buckets``: padded prompt lengths the admission prefill
+    compiles for (default: powers of two from 8 up to the decoder's
+    ``max_len``).  A request's prompt is left-padded to the smallest
+    bucket that fits, so the number of prefill programs is
+    O(log max_len) and a warm server admits without tracing.
+    """
+
+    def __init__(self, decoder, num_slots=None, queue_size=None,
+                 default_deadline_ms=None, prefill_buckets=None,
+                 idle_wait=0.05):
+        self.decoder = decoder
+        self.num_slots = num_slots or _env_int("MXTPU_SERVE_SLOTS", 4)
+        self.queue_size = queue_size or _env_int("MXTPU_SERVE_QUEUE", 16)
+        self.default_deadline_ms = (
+            default_deadline_ms
+            if default_deadline_ms is not None
+            else _env_int("MXTPU_SERVE_DEADLINE_MS", 30000))
+        if self.num_slots < 1:
+            raise MXNetError("need at least one decode slot")
+        if prefill_buckets is None:
+            prefill_buckets, b = [], 8
+            while b < decoder.max_len:
+                prefill_buckets.append(b)
+                b *= 2
+            prefill_buckets.append(decoder.max_len)
+        self.prefill_buckets = tuple(sorted(set(prefill_buckets)))
+        if self.prefill_buckets[-1] > decoder.max_len:
+            raise MXNetError(
+                f"prefill bucket {self.prefill_buckets[-1]} exceeds the "
+                f"decoder's max_len {decoder.max_len}")
+
+        self.cache = decoder.init_slot_state(self.num_slots)
+        self.start = np.zeros(self.num_slots, np.int32)
+        self.cursor = np.zeros(self.num_slots, np.int32)
+        self.slots = [None] * self.num_slots
+        self._next_tok = np.zeros(self.num_slots, np.int64)
+        self._slot_used = [False] * self.num_slots
+        self._queue = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._idle_wait = float(idle_wait)
+        # rolled-up engine stats (bench + /healthz): mean slot occupancy
+        # = slot_ticks / ticks
+        self.stats = {"ticks": 0, "slot_ticks": 0, "admitted": 0,
+                      "completed": 0}
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="mxtpu-serve-engine-%d" % id(self))
+        self._thread.start()
+
+    # ------------------------------------------------------------ client API
+    def submit(self, prompt, **kwargs):
+        """Enqueue a generation request; returns the :class:`Request`.
+        Raises :class:`AdmissionQueueFull` when the bounded queue is full
+        and :class:`MXNetError` for requests that can never be served
+        (prompt longer than the largest prefill bucket)."""
+        kwargs.setdefault("deadline_ms", self.default_deadline_ms or None)
+        req = Request(prompt, **kwargs)
+        if req.prompt.size > self.prefill_buckets[-1]:
+            _TM_REQS.inc(outcome="rejected")
+            raise MXNetError(
+                f"prompt length {req.prompt.size} exceeds the largest "
+                f"prefill bucket {self.prefill_buckets[-1]}")
+        with self._cond:
+            if self._stop:
+                raise MXNetError("scheduler is shut down")
+            if len(self._queue) >= self.queue_size:
+                _TM_REQS.inc(outcome="rejected")
+                raise AdmissionQueueFull(
+                    f"admission queue full ({self.queue_size} waiting)")
+            self._queue.append(req)
+            _TM_QUEUE.set(len(self._queue))
+            self._cond.notify()
+        return req
+
+    def generate(self, prompt, timeout=None, **kwargs):
+        """submit() + wait(): returns the finished :class:`Request`."""
+        req = self.submit(prompt, **kwargs)
+        limit = timeout
+        if limit is None and req.deadline is not None:
+            limit = max(req.deadline - time.monotonic(), 0.0) + 5.0
+        return req.wait(limit)
+
+    @property
+    def occupied(self):
+        return sum(1 for r in self.slots if r is not None)
+
+    @property
+    def queue_depth(self):
+        with self._cond:
+            return len(self._queue)
+
+    def close(self, timeout=10.0):
+        """Stop the engine thread; queued and in-flight requests finish
+        with outcome ``shutdown``."""
+        with self._cond:
+            if self._stop:
+                return
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        for req in list(self._queue) + [r for r in self.slots
+                                        if r is not None]:
+            self._terminal(req, "shutdown")
+        self._queue.clear()
+        _TM_QUEUE.set(0)
+        self.slots = [None] * self.num_slots
+        _TM_OCCUPANCY.set(0)
+
+    # ---------------------------------------------------------- engine loop
+    def _run(self):
+        while True:
+            with self._cond:
+                while (not self._stop and not self._queue
+                       and all(r is None for r in self.slots)):
+                    self._cond.wait(self._idle_wait)
+                if self._stop:
+                    return
+            now = time.monotonic()
+            self._expire_queued(now)
+            self._admit(now)
+            if any(r is not None for r in self.slots):
+                try:
+                    self._tick()
+                except Exception as exc:  # noqa: BLE001 — requests must
+                    #                       terminate, not hang their clients
+                    for i, req in enumerate(self.slots):
+                        if req is not None:
+                            req.error = exc
+                            self._finish_slot(i, "error")
+
+    def _expire_queued(self, now):
+        with self._cond:
+            keep = deque()
+            for req in self._queue:
+                if req.deadline is not None and now > req.deadline:
+                    self._terminal(req, "timeout")
+                else:
+                    keep.append(req)
+            if len(keep) != len(self._queue):
+                self._queue = keep
+                _TM_QUEUE.set(len(keep))
+
+    def _admit(self, now):
+        """Move queued requests into free slots: bucketed prefill + one
+        traced-slot cache write each; the first token is sampled straight
+        from the prefill logits (that fetch IS the TTFT)."""
+        while True:
+            free = next((i for i, r in enumerate(self.slots) if r is None),
+                        None)
+            if free is None:
+                return
+            with self._cond:
+                if not self._queue:
+                    return
+                req = self._queue.popleft()
+                _TM_QUEUE.set(len(self._queue))
+            plen = int(req.prompt.size)
+            bucket = next(b for b in self.prefill_buckets if b >= plen)
+            padded = np.zeros((1, bucket), np.int64)
+            padded[0, bucket - plen:] = req.prompt
+            try:
+                row, logits = self.decoder.prefill_padded(padded, [plen])
+            except Exception as exc:  # noqa: BLE001
+                req.error = exc
+                self._terminal(req, "error")
+                continue
+            first = self._sample(req, np.asarray(logits[0, -1], np.float32))
+            self.cache = self.decoder.adopt_row(self.cache, row, free)
+            self.start[free] = bucket - plen
+            self.cursor[free] = bucket
+            self._next_tok[free] = first
+            if self._slot_used[free]:
+                _TM_REUSE.inc()
+            self._slot_used[free] = True
+            self.slots[free] = req
+            req.tokens.append(first)
+            req.ttft = time.monotonic() - req.arrival
+            _TM_TTFT.observe(req.ttft)
+            _TM_TOKENS.inc()
+            self.stats["admitted"] += 1
+            _TM_OCCUPANCY.set(self.occupied)
+            self._maybe_finish(free, time.monotonic())
+
+    def _tick(self):
+        """ONE jitted decode step over the whole pool + host sampling."""
+        t0 = time.perf_counter()
+        occupied = [i for i, r in enumerate(self.slots) if r is not None]
+        tokens = self._next_tok.copy()
+        start = self.start.copy()
+        cursor = self.cursor.copy()
+        for i in range(self.num_slots):
+            if self.slots[i] is None:
+                # free rows ride along; pin their write to position 0 —
+                # adopt_row overwrites the whole row on admission
+                tokens[i] = 0
+                start[i] = 0
+                cursor[i] = 0
+        self.cache, logits = self.decoder.step_slots(
+            self.cache, tokens, start, cursor)
+        logits = np.asarray(logits, np.float32)   # the ONE host sync/tick
+        now = time.monotonic()
+        for i in occupied:
+            req = self.slots[i]
+            self.cursor[i] += 1
+            nxt = self._sample(req, logits[i])
+            req.tokens.append(nxt)
+            self._next_tok[i] = nxt
+            _TM_TOKENS.inc()
+            self._maybe_finish(i, now)
+        self.stats["ticks"] += 1
+        self.stats["slot_ticks"] += len(occupied)
+        _TM_TICK.observe(time.perf_counter() - t0)
+
+    def _maybe_finish(self, slot, now):
+        req = self.slots[slot]
+        if req.deadline is not None and now > req.deadline:
+            self._finish_slot(slot, "timeout")
+        elif (req.eos_id is not None and req.tokens
+              and req.tokens[-1] == req.eos_id):
+            self._finish_slot(slot, "ok")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._finish_slot(slot, "ok")
+        elif self.cursor[slot] >= self.decoder.max_len:
+            # cache window exhausted: the checkpoint's positional table
+            # ends here — deliver what fits (documented truncation)
+            self._finish_slot(slot, "ok")
+
+    def _finish_slot(self, slot, outcome):
+        req = self.slots[slot]
+        self.slots[slot] = None
+        self.start[slot] = 0
+        self.cursor[slot] = 0
+        self._next_tok[slot] = 0
+        self.stats["completed"] += 1
+        _TM_OCCUPANCY.set(self.occupied)
+        self._terminal(req, outcome)
+
+    def _terminal(self, req, outcome):
+        req.outcome = outcome
+        _TM_REQS.inc(outcome=outcome)
+        _TM_REQ_SEC.observe(time.monotonic() - req.arrival)
+        req._event.set()
+
+    @staticmethod
+    def _sample(req, logits):
+        """Host-side per-request sampling — same math as
+        KVDecoder.generate, but with each request's own params/rng so
+        heterogeneous requests co-batch."""
+        if req.temperature <= 0:
+            return int(logits.argmax())
+        lg = logits / req.temperature
+        if req.top_k:
+            kth = np.partition(lg, -req.top_k)[-req.top_k]
+            lg = np.where(lg < kth, -np.inf, lg)
+        z = lg - lg.max()
+        prob = np.exp(z)
+        prob /= prob.sum()
+        return int(req._rng.choice(lg.shape[-1], p=prob))

@@ -1,0 +1,187 @@
+"""HTTP serving front-end for the slot-pool scheduler.
+
+Extends the telemetry HTTP skeleton (`telemetry/exporters.py`) into a
+request-serving process: stdlib ``ThreadingHTTPServer`` (one thread per
+connection — each handler thread just blocks on its request's event
+while the single engine thread batches everyone's decode), no
+dependencies, same ops endpoints the training stack already exposes.
+
+Endpoints:
+
+``POST /generate``
+    body: ``{"prompt": [token ids], "max_tokens": 16, "temperature": 0,
+    "top_k": null, "eos_id": null, "deadline_ms": null, "seed": 0}``.
+    200: ``{"tokens": [...], "outcome": "ok", "ttft_ms": ..,
+    "latency_ms": ..}``.  429 when the bounded admission queue is full
+    (body carries ``Retry-After`` guidance), 504 when the deadline
+    expires (partial ``tokens`` included), 400 on malformed input,
+    500 on an engine error.
+``GET /metrics`` / ``/metrics.json``
+    Prometheus text / JSON snapshot of the process registry — the
+    serving families (docs/telemetry.md) plus everything else the
+    process emits.
+``GET /healthz``
+    ``{"status", "slots", "occupied", "queue_depth", "ticks"}`` —
+    liveness + the two saturation signals an orchestrator scales on.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from .. import telemetry as _tm
+from ..base import MXNetError
+from .scheduler import AdmissionQueueFull, SlotScheduler
+
+__all__ = ["start_server", "serve_decoder"]
+
+_GENERATE_FIELDS = {"prompt", "max_tokens", "temperature", "top_k",
+                    "eos_id", "deadline_ms", "seed"}
+
+
+def _parse_generate(body):
+    """Validate a /generate JSON body into Request kwargs (raises
+    MXNetError with a client-facing message)."""
+    if not isinstance(body, dict):
+        raise MXNetError("body must be a JSON object")
+    unknown = set(body) - _GENERATE_FIELDS
+    if unknown:
+        raise MXNetError(f"unknown fields {sorted(unknown)}; "
+                         f"accepted: {sorted(_GENERATE_FIELDS)}")
+    prompt = body.get("prompt")
+    if (not isinstance(prompt, list) or not prompt
+            or not all(isinstance(t, int) and t >= 0 for t in prompt)):
+        raise MXNetError("prompt must be a non-empty list of token ids")
+    kwargs = {"max_new_tokens": body.get("max_tokens", 16)}
+    for src, dst in (("temperature", "temperature"), ("top_k", "top_k"),
+                     ("eos_id", "eos_id"), ("deadline_ms", "deadline_ms"),
+                     ("seed", "seed")):
+        if body.get(src) is not None:
+            kwargs[dst] = body[src]
+    if not isinstance(kwargs["max_new_tokens"], int) \
+            or kwargs["max_new_tokens"] < 1:
+        raise MXNetError("max_tokens must be a positive integer")
+    return prompt, kwargs
+
+
+def _request_json(req):
+    return {
+        "id": req.id,
+        "tokens": [int(t) for t in req.tokens],
+        "n_tokens": len(req.tokens),
+        "outcome": req.outcome,
+        "ttft_ms": round(req.ttft * 1000.0, 3) if req.ttft is not None
+        else None,
+    }
+
+
+def start_server(scheduler: SlotScheduler, port: int = 0,
+                 addr: str = "127.0.0.1", registry=None):
+    """Serve the scheduler over HTTP on a daemon thread.  ``port=0``
+    binds an ephemeral port — read it back from
+    ``server.server_address``.  ``server.shutdown()`` stops serving
+    (the scheduler is closed separately: ``scheduler.close()``)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry or _tm.get_registry()
+
+    class _Handler(BaseHTTPRequestHandler):
+        def _reply(self, code, payload, ctype="application/json",
+                   headers=()):
+            body = payload if isinstance(payload, bytes) \
+                else json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path in ("/", "/metrics"):
+                self._reply(200, _tm.generate_text(reg).encode("utf-8"),
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/metrics.json":
+                self._reply(200, _tm.json_snapshot(reg))
+            elif path == "/healthz":
+                self._reply(200, {
+                    "status": "ok",
+                    "slots": scheduler.num_slots,
+                    "occupied": scheduler.occupied,
+                    "queue_depth": scheduler.queue_depth,
+                    "ticks": scheduler.stats["ticks"],
+                })
+            else:
+                self._reply(404, {"error": f"no such path {path!r}"})
+
+        def do_POST(self):
+            path = self.path.split("?", 1)[0]
+            if path != "/generate":
+                self._reply(404, {"error": f"no such path {path!r}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prompt, kwargs = _parse_generate(body)
+            except MXNetError as exc:
+                self._reply(400, {"error": str(exc)})
+                return
+            except (ValueError, UnicodeDecodeError) as exc:
+                self._reply(400, {"error": f"malformed JSON: {exc}"})
+                return
+            try:
+                req = scheduler.submit(prompt, **kwargs)
+            except AdmissionQueueFull as exc:
+                self._reply(429, {"error": str(exc)},
+                            headers=(("Retry-After", "1"),))
+                return
+            except MXNetError as exc:
+                self._reply(400, {"error": str(exc)})
+                return
+            # block this connection thread on the terminal outcome; the
+            # engine enforces the deadline, the +5s slack only guards
+            # against a wedged engine
+            limit = None
+            if req.deadline is not None:
+                import time as _time
+
+                limit = max(req.deadline - _time.monotonic(), 0.0) + 5.0
+            req.wait(limit)
+            payload = _request_json(req)
+            if req.outcome == "ok":
+                self._reply(200, payload)
+            elif req.outcome == "timeout":
+                self._reply(504, payload)
+            elif req.outcome is None:
+                payload["error"] = "engine did not reach a terminal state"
+                self._reply(500, payload)
+            else:
+                payload["error"] = repr(req.error) if req.error else \
+                    req.outcome
+                self._reply(500, payload)
+
+        def log_message(self, *args):  # health probes are chatty
+            pass
+
+    class _Server(ThreadingHTTPServer):
+        daemon_threads = True
+        # the stdlib default backlog of 5 resets bursty concurrent
+        # connects long before the bounded admission queue (the real
+        # backpressure signal, HTTP 429) ever gets to answer them
+        request_queue_size = 128
+
+    srv = _Server((addr, port), _Handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True,
+                              name="mxtpu-serve-http")
+    thread.start()
+    return srv
+
+
+def serve_decoder(decoder, port=0, addr="127.0.0.1", **scheduler_kwargs):
+    """Convenience bring-up: scheduler + HTTP server around a bound
+    KVDecoder.  Returns ``(server, scheduler)``."""
+    scheduler = SlotScheduler(decoder, **scheduler_kwargs)
+    server = start_server(scheduler, port=port, addr=addr)
+    return server, scheduler

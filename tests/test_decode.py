@@ -205,3 +205,117 @@ def test_generate_scan_eos_early_exit():
         cut = (hits[0] + 1) if len(hits) else n
         np.testing.assert_array_equal(s_got[r, :cut], s_free[r, :cut])
         assert (s_got[r, cut:] == s_eos).all()
+
+
+def test_slot_pool_variable_length_parity():
+    """ISSUE 6 satellite: variable-length prompts co-batched in ONE
+    slot-pool batch (left-padded prefill + per-slot [start, cursor]
+    windows) must reproduce per-request single-batch decode exactly —
+    prefill next-token logits AND every subsequent step_slots tick."""
+    _, params, rs = _bound_model()
+    dec = KVDecoder(params, num_layers=L, num_heads=H, max_len=T)
+    lengths = [3, 7, 5]
+    B, P = len(lengths), 8
+    prompts = [rs.randint(0, V, ln) for ln in lengths]
+    padded = np.zeros((B, P), np.int64)
+    for b, p in enumerate(prompts):
+        padded[b, P - len(p):] = p
+    cache, logits = dec.prefill_padded(padded, lengths)
+    logits = np.asarray(logits)
+    start = (P - np.asarray(lengths)).astype(np.int32)
+    cursor = np.full(B, P, np.int32)
+
+    # reference: each request prefilled alone at its own length
+    refs = [dec.prefill(p[None]) for p in prompts]
+    for b in range(B):
+        np.testing.assert_allclose(
+            logits[b, -1], np.asarray(refs[b][1])[0, -1], atol=2e-5)
+
+    # co-batched greedy steps, every row at a DIFFERENT cache position
+    ref_states = [r[0] for r in refs]
+    toks = np.array([np.asarray(r[1])[0, -1].argmax() for r in refs])
+    for _ in range(4):
+        cache, lg = dec.step_slots(cache, toks, start, cursor)
+        cursor += 1
+        lg = np.asarray(lg)
+        nxt = []
+        for b in range(B):
+            ref_states[b], rlg = dec.step(ref_states[b], toks[b:b + 1])
+            rlg = np.asarray(rlg)[0]
+            np.testing.assert_allclose(lg[b], rlg, atol=2e-5)
+            nxt.append(rlg.argmax())
+        toks = np.array(nxt)
+
+
+def test_slot_pool_adopt_row_mid_flight():
+    """adopt_row replaces ONE slot's cache without perturbing the other
+    slots: a row admitted mid-flight decodes exactly like a fresh
+    single-request decode while its neighbor's stream continues
+    unchanged."""
+    _, params, rs = _bound_model()
+    dec = KVDecoder(params, num_layers=L, num_heads=H, max_len=T)
+    P = 8
+    stay, newcomer = rs.randint(0, V, 6), rs.randint(0, V, 4)
+
+    # slot 0: 'stay', slot 1: garbage that a finished request left behind
+    padded = np.zeros((2, P), np.int64)
+    padded[0, P - 6:] = stay
+    padded[1, :] = rs.randint(0, V, P)
+    cache, logits = dec.prefill_padded(padded, [6, P])
+    start = np.array([P - 6, 0], np.int32)
+    cursor = np.array([P, P], np.int32)
+    tok_stay = int(np.asarray(logits)[0, -1].argmax())
+
+    # admit 'newcomer' into slot 1 via the scheduler's admission path
+    row, row_logits = dec.prefill_padded(
+        np.concatenate([np.zeros(P - 4, np.int64), newcomer])[None], [4])
+    cache = dec.adopt_row(cache, row, 1)
+    start[1], cursor[1] = P - 4, P
+    tok_new = int(np.asarray(row_logits)[0, -1].argmax())
+
+    # references decoded alone
+    st_stay, lg = dec.prefill(stay[None])
+    assert int(np.asarray(lg)[0, -1].argmax()) == tok_stay
+    st_new, lg = dec.prefill(newcomer[None])
+    assert int(np.asarray(lg)[0, -1].argmax()) == tok_new
+
+    toks = np.array([tok_stay, tok_new])
+    for _ in range(3):
+        cache, lg = dec.step_slots(cache, toks, start, cursor)
+        cursor += 1
+        lg = np.asarray(lg)
+        st_stay, r0 = dec.step(st_stay, toks[0:1])
+        st_new, r1 = dec.step(st_new, toks[1:2])
+        np.testing.assert_allclose(lg[0], np.asarray(r0)[0], atol=2e-5)
+        np.testing.assert_allclose(lg[1], np.asarray(r1)[0], atol=2e-5)
+        toks = np.array([np.asarray(r0)[0].argmax(),
+                         np.asarray(r1)[0].argmax()])
+
+
+def test_slot_pool_validation():
+    _, params, _ = _bound_model()
+    dec = KVDecoder(params, num_layers=L, num_heads=H, max_len=T)
+    padded = np.zeros((1, 8), np.int64)
+    for bad in ([0], [9], [4, 4]):
+        try:
+            dec.prefill_padded(padded, bad)
+            assert False, f"lengths {bad} should have been rejected"
+        except ValueError:
+            pass
+    try:
+        dec.prefill_padded(np.zeros((1, T + 1), np.int64), [1])
+        assert False, "padded width beyond max_len should be rejected"
+    except ValueError:
+        pass
+    cache = dec.init_slot_state(2)
+    try:
+        dec.step_slots(cache, np.zeros(2, np.int64),
+                       np.zeros(2, np.int32), np.array([0, T], np.int32))
+        assert False, "cursor at max_len should be rejected"
+    except ValueError:
+        pass
+    try:
+        dec.adopt_row(cache, dec.init_slot_state(2), 0)
+        assert False, "non-batch-1 row cache should be rejected"
+    except ValueError:
+        pass

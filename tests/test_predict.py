@@ -206,3 +206,34 @@ def test_predictor_discard_and_inflight_bound(tmp_path):
     with pytest.raises(mx.MXNetError):
         p.get_async(tickets[0])    # evicted
     assert p.get_async(tickets[-1]) is not None  # newest survives
+
+
+def test_predictor_int8_quantize_parity(tmp_path, monkeypatch):
+    """quantize='int8' (ISSUE 6): fp matmul weights stored int8 +
+    per-channel scales, dequantized inside the compiled program —
+    outputs stay within quantization tolerance of the fp32 predictor,
+    and MXTPU_PREDICT_INT8=1 enables it for kwarg-less (C-ABI) clients."""
+    prefix, x = _trained_checkpoint(tmp_path)
+    p32 = pred_create(prefix, 1, {"data": (16, 8)})
+    p8 = pred_create(prefix, 1, {"data": (16, 8)}, quantize="int8")
+    # int8 storage is real: both fc weights left the fp snapshot
+    assert sorted(p8._qparams) == ["fc1_weight", "fc2_weight"]
+    assert all(np.dtype(q.dtype) == np.int8
+               for q, _ in p8._qparams.values())
+    assert not any(k.endswith("weight") for k in p8._param_snapshot)
+    p32.forward(data=x[:16])
+    p8.forward(data=x[:16])
+    o32, o8 = p32.get_output(0), p8.get_output(0)
+    assert o8.dtype == np.float32
+    assert np.allclose(o8.sum(axis=1), 1.0, atol=1e-3)  # still a softmax
+    assert np.allclose(o8, o32, atol=0.02)
+
+    # env-var path for clients that construct without kwargs
+    monkeypatch.setenv("MXTPU_PREDICT_INT8", "1")
+    penv = pred_create(prefix, 1, {"data": (16, 8)})
+    assert penv._quantize == "int8"
+    penv.forward(data=x[:16])
+    assert np.allclose(penv.get_output(0), o8, atol=1e-6)
+
+    with pytest.raises(mx.MXNetError):
+        pred_create(prefix, 1, {"data": (16, 8)}, quantize="int4")

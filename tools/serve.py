@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Decode-serving process: continuous batching over a transformer-LM
+checkpoint (mxnet_tpu/serving/).
+
+The deployment entrypoint the C-predict ABI story was missing: one
+process owns the bound KVDecoder, admits concurrent request streams
+over HTTP, and batches their decode steps into one jitted program per
+tick.  Ops surface: ``/metrics`` (Prometheus), ``/healthz``,
+``POST /generate`` — see docs/serving.md for the runbook.
+
+    # serve a save_checkpoint()-style transformer_lm checkpoint
+    python tools/serve.py --prefix ckpt/lm --epoch 10 \
+        --num-layers 4 --num-heads 8 --max-len 512 --port 9200
+
+    # smoke/demo: a randomly initialized tiny LM (no checkpoint needed)
+    python tools/serve.py --demo --port 9200
+
+    curl -s localhost:9200/generate -d \
+        '{"prompt": [1, 2, 3], "max_tokens": 16}'
+
+Knobs (flags override env): MXTPU_SERVE_SLOTS, MXTPU_SERVE_QUEUE,
+MXTPU_SERVE_DEADLINE_MS, MXTPU_PREDICT_INT8 (docs/how_to/env_var.md
+round 10).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="continuous-batching decode server")
+    ap.add_argument("--prefix", help="checkpoint prefix (save_checkpoint)")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--demo", action="store_true",
+                    help="serve a randomly initialized tiny LM (smoke)")
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-heads", type=int, default=2)
+    ap.add_argument("--d-model", type=int, default=64,
+                    help="demo model width (checkpoints carry their own)")
+    ap.add_argument("--vocab-size", type=int, default=256,
+                    help="demo vocab (checkpoints carry their own)")
+    ap.add_argument("--max-len", type=int, default=128,
+                    help="KV-cache length = prompt + generation budget")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--int8", action="store_true",
+                    help="post-training int8 weight quantization "
+                         "(or MXTPU_PREDICT_INT8=1)")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="decode slots (MXTPU_SERVE_SLOTS, default 4)")
+    ap.add_argument("--queue", type=int, default=None,
+                    help="admission queue bound (MXTPU_SERVE_QUEUE, 16)")
+    ap.add_argument("--deadline-ms", type=int, default=None,
+                    help="default per-request deadline "
+                         "(MXTPU_SERVE_DEADLINE_MS, 30000)")
+    ap.add_argument("--port", type=int, default=9200)
+    ap.add_argument("--addr", default="127.0.0.1")
+    return ap.parse_args(argv)
+
+
+def build_decoder(args):
+    """KVDecoder from a checkpoint (or random demo params)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.decode import KVDecoder
+
+    quantize = "int8" if (args.int8 or os.environ.get(
+        "MXTPU_PREDICT_INT8", "0").lower() not in ("", "0", "false")) \
+        else None
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.demo:
+        from mxnet_tpu import models
+
+        net = models.transformer.transformer_lm(
+            num_layers=args.num_layers, num_heads=args.num_heads,
+            d_model=args.d_model, seq_len=args.max_len,
+            vocab_size=args.vocab_size)
+        ex = net.simple_bind(ctx=mx.cpu(), grad_req="null",
+                             data=(1, args.max_len),
+                             softmax_label=(1, args.max_len))
+        rs = np.random.RandomState(0)
+        params = {}
+        for name, arr in ex.arg_dict.items():
+            if name in ("data", "softmax_label"):
+                continue
+            arr[:] = rs.normal(0, 0.08, arr.shape).astype(np.float32)
+            params[name] = arr
+    else:
+        if not args.prefix:
+            raise SystemExit("need --prefix (or --demo)")
+        _, params, _ = mx.model.load_checkpoint(args.prefix, args.epoch)
+    return KVDecoder(params, num_layers=args.num_layers,
+                     num_heads=args.num_heads, max_len=args.max_len,
+                     dtype=dtype, quantize=quantize)
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import serve_decoder
+
+    telemetry.enable()  # a server without metrics is not operable
+    decoder = build_decoder(args)
+    server, scheduler = serve_decoder(
+        decoder, port=args.port, addr=args.addr, num_slots=args.slots,
+        queue_size=args.queue, default_deadline_ms=args.deadline_ms)
+    host, port = server.server_address[:2]
+    print(f"serving on http://{host}:{port}  "
+          f"(slots={scheduler.num_slots} queue={scheduler.queue_size} "
+          f"deadline_ms={scheduler.default_deadline_ms} "
+          f"int8={decoder.quantize == 'int8'})", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("shutting down", flush=True)
+    finally:
+        server.shutdown()
+        scheduler.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
