@@ -96,10 +96,27 @@ class Request:
                 f"shape {prompt.shape}")
         if max_new_tokens < 1:
             raise MXNetError("max_new_tokens must be >= 1")
+        temperature = float(temperature)
+        if not np.isfinite(temperature) or temperature < 0:
+            raise MXNetError(
+                f"temperature must be a finite number >= 0, got "
+                f"{temperature!r}")
+        if top_k is not None:
+            top_k = int(top_k)
+            if top_k < 1:
+                raise MXNetError(f"top_k must be >= 1, got {top_k}")
+        if deadline_ms is not None and not (
+                np.isfinite(deadline_ms) and deadline_ms >= 0):
+            raise MXNetError(
+                f"deadline_ms must be a finite number >= 0, got "
+                f"{deadline_ms!r}")
+        seed = int(seed)
+        if not 0 <= seed < 2 ** 32:
+            raise MXNetError(f"seed must be in [0, 2**32), got {seed}")
         self.id = next(Request._ids)
         self.prompt = prompt.astype(np.int64)
         self.max_new_tokens = int(max_new_tokens)
-        self.temperature = float(temperature)
+        self.temperature = temperature
         self.top_k = top_k
         self.eos_id = eos_id
         self.arrival = time.monotonic()
@@ -138,14 +155,23 @@ class SlotScheduler:
                  default_deadline_ms=None, prefill_buckets=None,
                  idle_wait=0.05):
         self.decoder = decoder
-        self.num_slots = num_slots or _env_int("MXTPU_SERVE_SLOTS", 4)
-        self.queue_size = queue_size or _env_int("MXTPU_SERVE_QUEUE", 16)
+        # `is not None` (not truthiness): an explicit 0 must reach the
+        # guards below, not silently become the env/default value
+        self.num_slots = int(
+            num_slots if num_slots is not None
+            else _env_int("MXTPU_SERVE_SLOTS", 4))
+        self.queue_size = int(
+            queue_size if queue_size is not None
+            else _env_int("MXTPU_SERVE_QUEUE", 16))
         self.default_deadline_ms = (
             default_deadline_ms
             if default_deadline_ms is not None
             else _env_int("MXTPU_SERVE_DEADLINE_MS", 30000))
         if self.num_slots < 1:
             raise MXNetError("need at least one decode slot")
+        if self.queue_size < 0:
+            raise MXNetError("queue_size must be >= 0 (0 disables "
+                             "queueing: every submit sheds load)")
         if prefill_buckets is None:
             prefill_buckets, b = [], 8
             while b < decoder.max_len:
@@ -185,6 +211,11 @@ class SlotScheduler:
         (prompt longer than the largest prefill bucket)."""
         kwargs.setdefault("deadline_ms", self.default_deadline_ms or None)
         req = Request(prompt, **kwargs)
+        vocab = getattr(self.decoder, "vocab", None)
+        if req.top_k is not None and vocab and req.top_k > vocab:
+            _TM_REQS.inc(outcome="rejected")
+            raise MXNetError(
+                f"top_k {req.top_k} exceeds the vocab size {vocab}")
         if req.prompt.size > self.prefill_buckets[-1]:
             _TM_REQS.inc(outcome="rejected")
             raise MXNetError(
@@ -228,11 +259,23 @@ class SlotScheduler:
             self._stop = True
             self._cond.notify_all()
         self._thread.join(timeout)
-        for req in list(self._queue) + [r for r in self.slots
-                                        if r is not None]:
-            self._terminal(req, "shutdown")
-        self._queue.clear()
+        with self._cond:
+            queued = list(self._queue)
+            self._queue.clear()
         _TM_QUEUE.set(0)
+        # the engine never touches the queue after _stop, so queued
+        # requests are safe to terminate here either way
+        for req in queued:
+            self._terminal(req, "shutdown")
+        if self._thread.is_alive():
+            # engine wedged past the join timeout (e.g. blocked inside a
+            # jitted call): leave the slots to it — it may still finish
+            # them, and _terminal is idempotent if it does so later; the
+            # in-flight clients' own wait() deadlines bound their hang
+            return
+        for req in self.slots:
+            if req is not None:
+                self._terminal(req, "shutdown")
         self.slots = [None] * self.num_slots
         _TM_OCCUPANCY.set(0)
 
@@ -245,18 +288,22 @@ class SlotScheduler:
                     self._cond.wait(self._idle_wait)
                 if self._stop:
                     return
-            now = time.monotonic()
-            self._expire_queued(now)
-            self._admit(now)
-            if any(r is not None for r in self.slots):
-                try:
+            # the engine thread must OUTLIVE any single bad request: an
+            # exception anywhere in an iteration terminates the affected
+            # requests with outcome `error` and the loop keeps serving —
+            # a dead engine would hang every in-flight and future client
+            try:
+                now = time.monotonic()
+                self._expire_queued(now)
+                self._admit(now)
+                if any(r is not None for r in self.slots):
                     self._tick()
-                except Exception as exc:  # noqa: BLE001 — requests must
-                    #                       terminate, not hang their clients
-                    for i, req in enumerate(self.slots):
-                        if req is not None:
-                            req.error = exc
-                            self._finish_slot(i, "error")
+            except Exception as exc:  # noqa: BLE001 — requests must
+                #                       terminate, not hang their clients
+                for i, req in enumerate(self.slots):
+                    if req is not None:
+                        req.error = exc
+                        self._finish_slot(i, "error")
 
     def _expire_queued(self, now):
         with self._cond:
@@ -289,13 +336,17 @@ class SlotScheduler:
             padded = np.zeros((1, bucket), np.int64)
             padded[0, bucket - plen:] = req.prompt
             try:
+                # the whole admission for THIS request — prefill, first
+                # sample, cache adoption — fails only this request; the
+                # slot stays free and the engine moves on
                 row, logits = self.decoder.prefill_padded(padded, [plen])
+                first = self._sample(
+                    req, np.asarray(logits[0, -1], np.float32))
+                self.cache = self.decoder.adopt_row(self.cache, row, free)
             except Exception as exc:  # noqa: BLE001
                 req.error = exc
                 self._terminal(req, "error")
                 continue
-            first = self._sample(req, np.asarray(logits[0, -1], np.float32))
-            self.cache = self.decoder.adopt_row(self.cache, row, free)
             self.start[free] = bucket - plen
             self.cursor[free] = bucket
             self._next_tok[free] = first
@@ -366,6 +417,8 @@ class SlotScheduler:
         self._terminal(req, outcome)
 
     def _terminal(self, req, outcome):
+        if req.outcome is not None:   # idempotent: first outcome wins
+            return
         req.outcome = outcome
         _TM_REQS.inc(outcome=outcome)
         _TM_REQ_SEC.observe(time.monotonic() - req.arrival)
@@ -380,7 +433,11 @@ class SlotScheduler:
             return int(logits.argmax())
         lg = logits / req.temperature
         if req.top_k:
-            kth = np.partition(lg, -req.top_k)[-req.top_k]
+            # clamp to the vocab: submit() validates against the
+            # decoder's vocab when known, this keeps np.partition safe
+            # for decoders that don't expose one
+            k = min(req.top_k, lg.shape[-1])
+            kth = np.partition(lg, -k)[-k]
             lg = np.where(lg < kth, -np.inf, lg)
         z = lg - lg.max()
         prob = np.exp(z)

@@ -27,6 +27,7 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import math
 import threading
 
 from .. import telemetry as _tm
@@ -37,6 +38,27 @@ __all__ = ["start_server", "serve_decoder"]
 
 _GENERATE_FIELDS = {"prompt", "max_tokens", "temperature", "top_k",
                     "eos_id", "deadline_ms", "seed"}
+
+
+def _number(body, name, integral=False, lo=None, hi=None):
+    """Pull an optional numeric field out of a /generate body, rejecting
+    wrong types (bools included), non-finite values (json.loads happily
+    parses NaN/Infinity), and out-of-range values — malformed sampling
+    params must die here with a 400, not inside the engine thread."""
+    v = body.get(name)
+    if v is None:
+        return None
+    ok = int if integral else (int, float)
+    if isinstance(v, bool) or not isinstance(v, ok):
+        kind = "an integer" if integral else "a number"
+        raise MXNetError(f"{name} must be {kind}, got {v!r}")
+    if not math.isfinite(v):
+        raise MXNetError(f"{name} must be finite, got {v!r}")
+    if lo is not None and v < lo:
+        raise MXNetError(f"{name} must be >= {lo}, got {v!r}")
+    if hi is not None and v > hi:
+        raise MXNetError(f"{name} must be <= {hi}, got {v!r}")
+    return v
 
 
 def _parse_generate(body):
@@ -50,17 +72,21 @@ def _parse_generate(body):
                          f"accepted: {sorted(_GENERATE_FIELDS)}")
     prompt = body.get("prompt")
     if (not isinstance(prompt, list) or not prompt
-            or not all(isinstance(t, int) and t >= 0 for t in prompt)):
+            or not all(isinstance(t, int) and not isinstance(t, bool)
+                       and t >= 0 for t in prompt)):
         raise MXNetError("prompt must be a non-empty list of token ids")
-    kwargs = {"max_new_tokens": body.get("max_tokens", 16)}
-    for src, dst in (("temperature", "temperature"), ("top_k", "top_k"),
-                     ("eos_id", "eos_id"), ("deadline_ms", "deadline_ms"),
-                     ("seed", "seed")):
-        if body.get(src) is not None:
-            kwargs[dst] = body[src]
-    if not isinstance(kwargs["max_new_tokens"], int) \
-            or kwargs["max_new_tokens"] < 1:
-        raise MXNetError("max_tokens must be a positive integer")
+    kwargs = {}
+    for name, dst, integral, lo, hi in (
+            ("max_tokens", "max_new_tokens", True, 1, None),
+            ("temperature", "temperature", False, 0, None),
+            ("top_k", "top_k", True, 1, None),
+            ("eos_id", "eos_id", True, 0, None),
+            ("deadline_ms", "deadline_ms", True, 0, None),
+            ("seed", "seed", True, 0, 2 ** 32 - 1)):
+        v = _number(body, name, integral=integral, lo=lo, hi=hi)
+        if v is not None:
+            kwargs[dst] = v
+    kwargs.setdefault("max_new_tokens", 16)
     return prompt, kwargs
 
 
@@ -137,7 +163,10 @@ def start_server(scheduler: SlotScheduler, port: int = 0,
                 self._reply(429, {"error": str(exc)},
                             headers=(("Retry-After", "1"),))
                 return
-            except MXNetError as exc:
+            except (MXNetError, TypeError, ValueError) as exc:
+                # backstop for values _parse_generate let through that
+                # Request.__init__ still rejects — a 400, not a dropped
+                # connection from an unwound handler thread
                 self._reply(400, {"error": str(exc)})
                 return
             # block this connection thread on the terminal outcome; the

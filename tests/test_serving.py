@@ -145,6 +145,70 @@ def test_scheduler_backpressure_and_validation(decoder, metrics):
         sched.close()
 
 
+def test_scheduler_rejects_bad_sampling_params(decoder):
+    """Malformed sampling params die at submit() with MXNetError — they
+    must never reach the engine thread (one NaN temperature or
+    oversized top_k used to kill it permanently)."""
+    sched = SlotScheduler(decoder, num_slots=1, queue_size=4)
+    try:
+        prompt = np.array([1, 2, 3])
+        for bad in ({"temperature": float("nan")},
+                    {"temperature": -0.5},
+                    {"top_k": 0},
+                    {"top_k": V + 1},         # > vocab -> np.partition
+                    {"seed": -1},
+                    {"deadline_ms": float("inf")}):
+            with pytest.raises(mx.MXNetError):
+                sched.submit(prompt, max_new_tokens=2, **bad)
+        # the engine is still alive and serving
+        ok = sched.generate(prompt, max_new_tokens=2, timeout=120)
+        assert ok.outcome == "ok"
+    finally:
+        sched.close()
+
+
+def test_scheduler_explicit_zero_config(decoder):
+    """Explicit zeros are validated/honored, not silently replaced by
+    the env/default values."""
+    with pytest.raises(mx.MXNetError):
+        SlotScheduler(decoder, num_slots=0)
+    with pytest.raises(mx.MXNetError):
+        SlotScheduler(decoder, num_slots=1, queue_size=-1)
+    sched = SlotScheduler(decoder, num_slots=1, queue_size=0)
+    try:
+        assert sched.queue_size == 0   # not the default 16
+        with pytest.raises(AdmissionQueueFull):
+            sched.submit(np.array([1]), max_new_tokens=1)
+    finally:
+        sched.close()
+
+
+def test_engine_survives_admission_error(decoder, monkeypatch):
+    """A request whose admission blows up inside the engine (injected
+    prefill failure) terminates with outcome `error`; the engine thread
+    survives and keeps serving."""
+    sched = SlotScheduler(decoder, num_slots=1, queue_size=4)
+    try:
+        calls = {"n": 0}
+        orig = decoder.prefill_padded
+
+        def boom(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected prefill failure")
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(decoder, "prefill_padded", boom)
+        bad = sched.submit(np.array([1, 2]), max_new_tokens=2)
+        assert bad.wait(120).outcome == "error"
+        assert isinstance(bad.error, RuntimeError)
+        good = sched.generate(np.array([1, 2]), max_new_tokens=2,
+                              timeout=120)
+        assert good.outcome == "ok"
+    finally:
+        sched.close()
+
+
 def test_scheduler_deadline_times_out_queued_request(decoder):
     sched = SlotScheduler(decoder, num_slots=1, queue_size=4)
     try:
@@ -257,10 +321,27 @@ def test_server_generate_parity_and_validation(decoder):
 
         for bad in ({"prompt": []}, {"prompt": "hi"}, {"max_tokens": 3},
                     {"prompt": [1], "max_tokens": 0},
-                    {"prompt": [1], "bogus": True}):
+                    {"prompt": [1], "bogus": True},
+                    # sampling params: wrong types, non-finite values
+                    # (json.loads accepts NaN), and out-of-range values
+                    # all get a 400 — never a dropped connection, never
+                    # a dead engine thread
+                    {"prompt": [1], "temperature": "hot"},
+                    {"prompt": [1], "temperature": float("nan")},
+                    {"prompt": [1], "temperature": -1},
+                    {"prompt": [1], "top_k": 0},
+                    {"prompt": [1], "top_k": 10 ** 9},
+                    {"prompt": [1], "max_tokens": True},
+                    {"prompt": [1], "seed": -1},
+                    {"prompt": [1], "seed": 2 ** 40},
+                    {"prompt": [1], "deadline_ms": -5},
+                    {"prompt": [1], "eos_id": 1.5}):
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _post(port, bad)
-            assert ei.value.code == 400
+            assert ei.value.code == 400, f"no 400 for {bad}"
+        # after all that abuse the engine still serves
+        status, out = _post(port, {"prompt": [1, 2], "max_tokens": 2})
+        assert status == 200 and out["outcome"] == "ok"
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
                                    timeout=30)
