@@ -159,6 +159,12 @@ def _init_backend(timeout_s, retry_timeout_s, notes):
 
 
 def main():
+    if "--shard-micro" in sys.argv:
+        # subprocess mode for _shard_micro on single-device hosts: the
+        # parent owns the accelerator, this process runs the virtual
+        # CPU mesh and prints ONE json line
+        _emit(_shard_micro_body())
+        return 0
     timeout_s = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "240"))
     retry_s = int(os.environ.get("BENCH_INIT_RETRY_TIMEOUT_S",
                                  str(2 * timeout_s)))
@@ -515,6 +521,124 @@ def _health_micro():
             os.environ["MXTPU_SENTINEL"] = prev
         if not was_enabled:
             tm.disable()
+
+
+def _shard_micro_body():
+    """Sharded-update micro-bench (round 11): the fused kvstore bucket
+    step with the cross-replica sharded update (MXTPU_SHARD_UPDATE=1,
+    arXiv:2004.13336) vs the replicated per-key bucket programs, on the
+    process mesh.  Reports the per-step dispatch cost of each, the
+    optimizer-state bytes per replica (the 1/N residency win), and the
+    logical collective payload per sharded step."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, telemetry as tm
+    from mxnet_tpu.parallel.mesh import global_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    was_enabled = tm.enabled()
+    tm.enable()
+    prev = os.environ.get("MXTPU_SHARD_UPDATE")
+    prev_cap = os.environ.get("MXTPU_KV_BUCKET_MB")
+    try:
+        mesh = global_mesh()
+        repl = NamedSharding(mesh, P())
+        rng = np.random.RandomState(11)
+        # deliberately small keys + a tiny bucket cap: the section
+        # measures DISPATCH/RESIDENCY structure (sharded vs replicated,
+        # bytes per replica, collective payload), and virtual-CPU rigs
+        # serialize every mesh collective through the host cores —
+        # MB-scale buckets there turn one step into seconds of
+        # rendezvous without changing any reported ratio
+        os.environ.setdefault("MXTPU_KV_BUCKET_MB", "0.05")
+        shapes = [(64, 37), (37,), (128, 16), (19,)] * 6
+        weights = [rng.uniform(-1, 1, s).astype(np.float32) for s in shapes]
+        grads = [rng.uniform(-1, 1, s).astype(np.float32) for s in shapes]
+        keys = list(range(len(shapes)))
+
+        def run(shard):
+            os.environ["MXTPU_SHARD_UPDATE"] = "1" if shard else "0"
+            kv = mx.kv.create("local")
+            kv.set_optimizer(mx.optimizer.create(
+                "adam", learning_rate=1e-3, rescale_grad=1.0 / 64))
+            kv.init(keys, [nd.array(w) for w in weights])
+            gnds = [[nd.NDArray(jax.device_put(g, repl))] for g in grads]
+            outs = [nd.zeros(s) for s in shapes]
+
+            def step():
+                kv.push(keys, gnds)
+                kv.pull(keys, outs)
+
+            for _ in range(3):  # warmup: plan build + bucket compiles
+                step()
+            jax.block_until_ready([o._read() for o in outs])
+            coll = tm.get_registry().get("executor_collective_bytes_total")
+            c0 = coll.total() if coll is not None else 0
+            n = 20
+            tic = time.perf_counter()
+            for _ in range(n):
+                step()
+            jax.block_until_ready([o._read() for o in outs])
+            dt = (time.perf_counter() - tic) / n
+            cps = ((coll.total() - c0) / n) if coll is not None else 0
+            return dt, kv._fused.state_memory(), cps
+
+        repl_dt, repl_mem, _ = run(False)
+        shard_dt, shard_mem, coll_per_step = run(True)
+        return {
+            "shard_update_us_per_step": round(shard_dt * 1e6, 1),
+            "shard_update_us_per_step_replicated": round(repl_dt * 1e6, 1),
+            "optimizer_state_bytes_per_replica": int(
+                shard_mem["per_replica_bytes"]),
+            "optimizer_state_bytes_per_replica_replicated": int(
+                repl_mem["per_replica_bytes"]),
+            "collective_bytes_per_step": int(coll_per_step),
+            "shard_replicas": int(shard_mem["replicas"]),
+            "shard_buckets": int(shard_mem["sharded_buckets"]),
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_SHARD_UPDATE", None)
+        else:
+            os.environ["MXTPU_SHARD_UPDATE"] = prev
+        if prev_cap is None:
+            os.environ.pop("MXTPU_KV_BUCKET_MB", None)
+        else:
+            os.environ["MXTPU_KV_BUCKET_MB"] = prev_cap
+        if not was_enabled:
+            tm.disable()
+
+
+def _shard_micro():
+    """Run the sharded-update micro on this process's mesh when it has
+    >= 2 devices (the MULTICHIP path), else in a fresh subprocess on an
+    8-virtual-CPU mesh (the backend is already owned by this process,
+    so a single-chip host cannot re-init it for a second mesh)."""
+    import jax
+
+    if len(jax.devices()) >= 2:
+        return _shard_micro_body()
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXTPU_PLATFORM="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"))
+    r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                        "--shard-micro"],
+                       capture_output=True, text=True, timeout=600, env=env)
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            payload = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        payload["shard_mesh"] = "8-virtual-cpu-subprocess"
+        return payload
+    return {"shard_error": "subprocess rc=%d: %s"
+            % (r.returncode, (r.stderr or r.stdout)[-300:])}
 
 
 def _serve_micro():
@@ -909,6 +1033,15 @@ def _bench(dev, kind, init_notes=()):
             # (ISSUE 5)
             if os.environ.get("BENCH_HEALTH", "1") == "1":
                 for k_, v_ in _health_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # mesh-sharded update path: sharded vs replicated bucket
+            # step, optimizer-state bytes per replica, collective
+            # payload — the MULTICHIP runs' primary section (ISSUE 7)
+            if os.environ.get("BENCH_SHARD", "1") == "1":
+                for k_, v_ in _shard_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

@@ -98,6 +98,34 @@ def num_devices(device_type: str = "tpu") -> int:
     return len(_device_list(device_type))
 
 
+def process_mesh():
+    """The process-level ("batch", "model") device mesh (parallel.mesh.
+    global_mesh over the accelerator devices; MXTPU_MESH_SHAPE picks the
+    factorization, default pure data parallel).  This is what group2ctx
+    PartitionSpec annotations and mesh-spanning executor groups resolve
+    against — the named-axis replacement for raw device-id lists."""
+    from .parallel.mesh import global_mesh
+
+    return global_mesh(_device_list("tpu"))
+
+
+def mesh_sharding(spec=None):
+    """NamedSharding on the process mesh for a PartitionSpec (or a plain
+    tuple of axis names / None spelled the PartitionSpec way).  ``None``
+    means replicated.  The group2ctx value
+    ``{"tp": mx.context.mesh_sharding(("model",))}`` places that group's
+    parameters sharded over the mesh's model axis instead of pinning
+    them to one device id."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if spec is None:
+        spec = PartitionSpec()
+    elif not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec) if isinstance(spec, (tuple, list)) \
+            else PartitionSpec(spec)
+    return NamedSharding(process_mesh(), spec)
+
+
 def current_context() -> Context:
     if Context._default_ctx is None:
         Context._default_ctx = Context("cpu", 0)

@@ -69,6 +69,11 @@ _TM_FWD_SEC = _tm.histogram(
     "the profiler's sync mode)")
 _TM_BWD_SEC = _tm.histogram(
     "executor_backward_seconds", "Executor.backward wall time (dispatch)")
+_TM_COLLECTIVE = _tm.counter(
+    "executor_collective_bytes_total",
+    "logical payload bytes of mesh collectives the sharded paths "
+    "request per dispatch (grad all-reduce, sharded-update param "
+    "all-gather; estimate at dispatch, not wire bytes)", labels=("op",))
 
 
 def _count_traces(fn, kind):
@@ -166,20 +171,27 @@ def program_cache_put(key, entry):
             _program_cache.popitem(last=False)
 
 
-def _compiled_programs(symbol: Symbol, platform: Optional[str]):
+def _compiled_programs(symbol: Symbol, platform: Optional[str],
+                       shard_sig=None):
     """(graph_fn, jit_fwd, jit_fwdbwd) for a symbol, through the cache.
 
     Cache-key discipline: everything that changes the traced computation
     and is not already a jit cache axis must be in the key — the layout
     pass (channels_last) and the lowering platform are; grad reqs are not
     (they are static jit arguments of the fwdbwd program), and input
-    avals are not (jax.jit keys on them per call).
+    avals are not (jax.jit keys on them per call).  ``shard_sig`` is the
+    bind's mesh-sharding signature (executor `shardings` / group2ctx
+    PartitionSpec placements): the traced Python is sharding-agnostic,
+    but keying on it keeps a mesh-annotated bind's entry distinct from a
+    single-device bind of the same structure, so cache hits always
+    return programs whose jit-level sharding history matches the bind.
     """
     channels_last = channels_last_default()
     capacity = program_cache_capacity()
     key = None
     if capacity > 0:
-        key = (symbol.structural_signature(), platform, channels_last)
+        key = (symbol.structural_signature(), platform, channels_last,
+               shard_sig)
         with _program_cache_lock:
             entry = _program_cache.get(key)
             if entry is not None:
@@ -415,8 +427,10 @@ def placement_plan(symbol: Symbol, group2ctx, default_ctx):
     variable lives with its first consumer, mirroring PlaceDevice's
     device propagation), and n_distinct counts distinct concrete devices
     in the plan.  group2ctx entries not matching any annotation are
-    ignored, as in the reference.
+    ignored, as in the reference (bind warns once per unknown group).
     """
+    group2ctx = {g: c for g, c in group2ctx.items()
+                 if isinstance(c, Context)}
     topo = _topo_order([n for n, _ in symbol._outputs])
     node_ctx, var_ctx = {}, {}
     # a variable's OWN annotation wins (reference PlaceDevice honors the
@@ -440,6 +454,96 @@ def placement_plan(symbol: Symbol, group2ctx, default_ctx):
     distinct = {c.jax_device for c in node_ctx.values()} | {
         c.jax_device for c in var_ctx.values()}
     return node_ctx, var_ctx, len(distinct)
+
+
+# ---------------------------------------------------------------------------
+# group2ctx -> mesh placement (the GSPMD half of PlaceDevice).
+#
+# A group2ctx value may be a jax.sharding.PartitionSpec (or a Sharding)
+# instead of a Context: the group's variables are then placed as
+# NamedSharding annotations on the process mesh
+# (context.process_mesh(); MXTPU_MESH_SHAPE) and the whole graph stays
+# ONE compiled SPMD program — XLA GSPMD inserts the collectives the
+# reference's _CrossDeviceCopy edges would have been.  Contexts keep the
+# segmented per-device plan for true disjoint-device model parallelism.
+# ---------------------------------------------------------------------------
+_warned_unknown_groups = set()
+
+
+def _resolve_group_sharding(value):
+    """group2ctx value -> NamedSharding on the process mesh, or None
+    when the value is a Context (the segmented-placement path)."""
+    from jax.sharding import PartitionSpec, Sharding
+
+    if isinstance(value, Sharding):
+        return value
+    if isinstance(value, PartitionSpec):
+        from .context import mesh_sharding
+
+        return mesh_sharding(value)
+    return None
+
+
+def sharding_plan(symbol: Symbol, group2ctx):
+    """{variable name: Sharding} for PartitionSpec-valued group2ctx
+    entries, following placement_plan's propagation (a variable's own
+    ctx_group wins; otherwise first consumer's group)."""
+    spec_groups = {}
+    for g, v in (group2ctx or {}).items():
+        sh = _resolve_group_sharding(v)
+        if sh is not None:
+            spec_groups[g] = sh
+    if not spec_groups:
+        return {}
+    topo = _topo_order([n for n, _ in symbol._outputs])
+    var_sh = {}
+    for node in topo:
+        if node.is_variable:
+            grp = node.extra_attrs.get("ctx_group")
+            if grp in spec_groups:
+                var_sh[node.name] = spec_groups[grp]
+    for node in topo:
+        if node.is_variable:
+            continue
+        grp = node.extra_attrs.get("ctx_group")
+        sh = spec_groups.get(grp) if grp else None
+        if sh is None:
+            continue
+        for src, _ in node.inputs:
+            if src.is_variable and src.name not in var_sh:
+                var_sh[src.name] = sh
+    return var_sh
+
+
+def _fit_sharding_rank(sh, ndim):
+    """Adapt a NamedSharding to an array's rank: a group-level spec like
+    P("model", None) also covers the group's rank-1 biases (Megatron
+    convention: the bias shards with its weight's output dim) by
+    truncating trailing spec entries the array has no dims for."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not isinstance(sh, NamedSharding) or len(sh.spec) <= ndim:
+        return sh
+    return NamedSharding(sh.mesh, PartitionSpec(*sh.spec[:ndim]))
+
+
+def _warn_unmatched_groups(symbol: Symbol, group2ctx):
+    """A group2ctx entry naming a group no node is annotated with used
+    to be silently ignored — a typo'd group name trained fully on the
+    default device with nothing to say about it.  Warn once per name."""
+    if not group2ctx:
+        return
+    annotated = {n.extra_attrs.get("ctx_group")
+                 for n in symbol.nodes if n.extra_attrs.get("ctx_group")}
+    for g in group2ctx:
+        if g not in annotated and g not in _warned_unknown_groups:
+            _warned_unknown_groups.add(g)
+            import warnings
+
+            warnings.warn(
+                f"group2ctx group {g!r} matches no ctx_group annotation "
+                f"in the symbol (annotated groups: {sorted(annotated)}); "
+                "the entry is ignored", stacklevel=3)
 
 
 class _Segment:
@@ -620,10 +724,21 @@ class Executor:
 
     def __init__(self, symbol: Symbol, ctx: Optional[Context], args, args_grad,
                  grad_req="write", aux_states=None, group2ctx=None,
-                 shared_exec: "Executor" = None):
+                 shared_exec: "Executor" = None, shardings=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
         self._group2ctx = group2ctx or {}
+        _warn_unmatched_groups(symbol, self._group2ctx)
+        # mesh-sharding annotations: explicit `shardings` ({var name ->
+        # jax Sharding}, e.g. from DataParallelExecutorGroup) merged
+        # over group2ctx PartitionSpec placements.  These place the
+        # bound arrays; the jitted programs see the placements through
+        # their committed inputs (GSPMD spans the mesh from them), and
+        # the signature below keys the program cache.
+        self._shardings = dict(sharding_plan(symbol, self._group2ctx))
+        self._shardings.update(shardings or {})
+        self._shard_sig = tuple(sorted(
+            (name, str(sh)) for name, sh in self._shardings.items())) or None
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
 
@@ -674,6 +789,37 @@ class Executor:
             raise MXNetError(f"bind: missing aux states {missing_aux}")
         self.aux_arrays = [self.aux_dict[k] for k in aux_names]
 
+        # place annotated arrays on their mesh shardings (one batched
+        # transfer; arrays already carrying the target sharding pass).
+        # Any mesh annotation commits the WHOLE bind to that mesh:
+        # unannotated arrays default to replicated, or the jit would see
+        # mixed single-device/mesh operands and refuse to compile.
+        if self._shardings:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            meshes = [sh.mesh for sh in self._shardings.values()
+                      if isinstance(sh, NamedSharding) and sh.mesh.size > 1]
+            if meshes:
+                repl = NamedSharding(meshes[0], PartitionSpec())
+                for name in list(arg_names) + list(aux_names):
+                    self._shardings.setdefault(name, repl)
+            todo, targets = {}, {}
+            for name, sh in self._shardings.items():
+                for store in (self.arg_dict, self.aux_dict, self.grad_dict):
+                    arr = store.get(name)
+                    if arr is None:
+                        continue
+                    raw = arr._read()
+                    tgt = _fit_sharding_rank(sh, raw.ndim)
+                    if getattr(raw, "sharding", None) != tgt:
+                        todo[id(arr)] = raw
+                        targets[id(arr)] = (arr, tgt)
+            if todo:
+                moved = jax.device_put(
+                    todo, {k: targets[k][1] for k in todo})
+                for k, raw in moved.items():
+                    targets[k][0]._chunk.write(raw)
+
         # ctx_group placement (parity: PlaceDevice, graph_executor.cc:225-314):
         # only a plan spanning >1 device changes execution; a single-device
         # plan keeps the whole-graph jit fast path.
@@ -710,7 +856,8 @@ class Executor:
             _TM_GRAPH_CACHE.inc(result="hit")
         else:
             self._graph_fn, self._jit_fwd, self._jit_fwdbwd = \
-                _compiled_programs(symbol, self._platform())
+                _compiled_programs(symbol, self._platform(),
+                                   shard_sig=self._shard_sig)
         self._step = 0
         self._pending = None  # (args_raw, aux_raw, key) of last train forward
         self._outputs_cache: Optional[List] = None
@@ -869,14 +1016,27 @@ class Executor:
                 out_grads = [out_grads]
             head = [g._read() if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads]
             # pin head grads to the executor's device (caller may have
-            # created them on the default device)
-            from jax.sharding import SingleDeviceSharding
+            # created them on the default device); a mesh-sharded bind
+            # replicates them over its mesh — a single-device committed
+            # seed would otherwise refuse to enter the SPMD program
+            from jax.sharding import NamedSharding, PartitionSpec, \
+                SingleDeviceSharding
 
             ref = next(iter(args.values()), None)
-            if ref is not None and isinstance(getattr(ref, "sharding", None), SingleDeviceSharding):
+            ref_sh = getattr(ref, "sharding", None)
+            if isinstance(ref_sh, SingleDeviceSharding):
                 head = [
-                    jax.device_put(h, ref.sharding)
-                    if getattr(h, "sharding", None) != ref.sharding
+                    jax.device_put(h, ref_sh)
+                    if getattr(h, "sharding", None) != ref_sh
+                    else h
+                    for h in head
+                ]
+            elif isinstance(ref_sh, NamedSharding) and ref_sh.mesh.size > 1:
+                repl = NamedSharding(ref_sh.mesh, PartitionSpec())
+                head = [
+                    jax.device_put(h, repl)
+                    if getattr(h, "sharding", None) is None
+                    or h.sharding.device_set != ref_sh.device_set
                     else h
                     for h in head
                 ]
@@ -981,7 +1141,8 @@ class Executor:
         types.update({k: v.dtype for k, v in self.aux_dict.items()})
         return simple_bind(self._symbol, self._ctx, grad_req=self.grad_req,
                            type_dict=types, group2ctx=self._group2ctx or None,
-                           shared_exec=self, **shapes)
+                           shared_exec=self, shardings=self._shardings or None,
+                           **shapes)
 
     @property
     def symbol(self):
@@ -989,7 +1150,8 @@ class Executor:
 
 
 def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
-                group2ctx=None, shared_exec=None, **kwargs) -> Executor:
+                group2ctx=None, shared_exec=None, shardings=None,
+                **kwargs) -> Executor:
     """Parity: Symbol.simple_bind (python/mxnet/symbol.py:726): infer
     shapes, allocate arrays (+grads per grad_req), bind.
 
@@ -997,6 +1159,10 @@ def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
     reference's simple_bind type inference); a ``Variable(dtype=...)``
     annotation is the per-symbol default, and anything undeclared
     allocates float32.  Grad arrays always match their arg's dtype.
+    ``shardings`` ({var name -> jax Sharding}) places the named arrays
+    on the process mesh at bind — the named-axis path a
+    DataParallelExecutorGroup or a group2ctx PartitionSpec annotation
+    resolves to.
     """
     ctx = ctx or current_context()
     arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
@@ -1041,4 +1207,4 @@ def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
         if req.get(k, "null") != "null"
     }
     return Executor(symbol, ctx, args, grads, req, aux, group2ctx=group2ctx,
-                    shared_exec=shared_exec)
+                    shared_exec=shared_exec, shardings=shardings)

@@ -148,6 +148,11 @@ class KVStore:
         if (self._fused is not None and not single
                 and self._fused.handle_push(keys, values)):
             return
+        if self._fused is not None:
+            # about to run the eager per-key loop: any sharded
+            # optimizer state must land back in the per-key NDArrays
+            # the Updater reads (no-op when nothing is sharded)
+            self._fused.ensure_host_state()
         for k, v in zip(keys, values):
             t0 = time.perf_counter() if _tm.enabled() else None
             if isinstance(v, (list, tuple)):
@@ -240,6 +245,10 @@ class KVStore:
         self._maybe_init_fused()
 
     def _maybe_init_fused(self):
+        if self._fused is not None:
+            # the outgoing engine may hold sharded optimizer state only
+            # it can map back to per-key NDArrays
+            self._fused.ensure_host_state()
         self._fused = None
         if "dist" in self.type or self._optimizer is None:
             return  # dist stores keep the per-key RPC/priority contract
@@ -254,6 +263,8 @@ class KVStore:
 
     def _set_updater(self, updater):
         # a custom Python updater has no fused rule — eager per-key path
+        if self._fused is not None:
+            self._fused.ensure_host_state()
         self._updater = updater
         self._optimizer = None
         self._fused = None
@@ -275,6 +286,10 @@ class KVStore:
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("no updater/optimizer set")
+        if self._fused is not None:
+            # sharded flat state materializes into the per-key NDArrays
+            # the pickled state dict is built from
+            self._fused.ensure_host_state()
         with open(fname, "wb") as f:
             f.write(self._updater.get_states())
 

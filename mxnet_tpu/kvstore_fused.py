@@ -49,11 +49,12 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import executor as _executor
 from . import telemetry as _tm
 from .ndarray import NDArray
-from .optim_rules import _RULES
+from .optim_rules import _RULES, flat_rule
 
 # --- telemetry families (docs/telemetry.md) --------------------------------
 _TM_FUSED_SEC = _tm.histogram(
@@ -71,6 +72,12 @@ _TM_BUCKET_BYTES = _tm.histogram(
     buckets=(1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20,
              1 << 22, 1 << 23, 1 << 24, 1 << 26))
 
+_TM_SHARD_GATHER = _tm.histogram(
+    "kvstore_shard_gather_seconds",
+    "host time materializing sharded optimizer-state vectors back into "
+    "per-key NDArrays (sync_shard_state: save/load, eager interleave, "
+    "plan rebuild — never the per-step hot path)", labels=("store",))
+
 _DEFAULT_BUCKET_MB = 4.0
 
 
@@ -79,6 +86,24 @@ def fused_update_enabled() -> bool:
     from .base import parse_bool
 
     return parse_bool(os.environ.get("MXTPU_FUSED_UPDATE", "1"))
+
+
+def shard_update_enabled() -> bool:
+    """MXTPU_SHARD_UPDATE gate (default on).
+
+    When a bucket's gradients arrive as ONE mesh-global array over a
+    >1-device mesh, the bucket program shards the weight update across
+    the mesh per arXiv:2004.13336: reduce-scatter the flat gradient,
+    run the optimizer rule on each replica's 1/N slice against
+    device-resident SHARDED flat optimizer state, and all-gather the
+    fresh parameters in-trace — ~1/N update FLOPs and ~1/N
+    optimizer-state bytes per replica.  ``0`` keeps the replicated
+    per-key bucket programs (bit-identical to rounds 7-10).  Sampled at
+    plan build: flipping it mid-run takes effect at the next key-set
+    change (or a fresh engine)."""
+    from .base import parse_bool
+
+    return parse_bool(os.environ.get("MXTPU_SHARD_UPDATE", "1"))
 
 
 def bucket_cap_bytes() -> int:
@@ -159,6 +184,64 @@ def _make_bucket_program(rule_name, opt_params, shapes, sizes, wds,
     return jax.jit(_executor._count_traces(bucket_step, "kv_update"))
 
 
+def _make_sharded_bucket_program(rule_name, opt_params, shapes, sizes, wds,
+                                 wdtype, mesh, sentinel=False):
+    """One jitted program for a CROSS-REPLICA SHARDED bucket
+    (arXiv:2004.13336): the flat gradient/weight/state vectors are
+    constrained to ``P(mesh.axis_names)`` so GSPMD gives each replica a
+    1/N slice (for an already-reduced replicated gradient this is the
+    reduce-scatter fused into the producing program's all-reduce), the
+    flat-vector optimizer rule (optim_rules.flat_rule — bit-compatible
+    elementwise math, lr/wd as per-element vectors) updates the slice
+    against SHARDED flat state that never leaves the program sharded,
+    and the fresh parameters are all-gathered in-trace by a replicated
+    constraint before slicing back to per-key shapes.  Everything static
+    (shapes, wd, mesh) keys the program in the executor LRU; lr stays a
+    traced vector so schedules never retrace."""
+    nslots, update = flat_rule(rule_name, opt_params)
+    total = int(sum(sizes))
+    n = mesh.size
+    padded = -(-total // n) * n
+    shard = NamedSharding(mesh, P(mesh.axis_names))
+    repl = NamedSharding(mesh, P())
+    sizes_np = np.asarray(sizes, np.int64)
+    # per-element wd, cast to the weight dtype exactly as the weak-typed
+    # Python float in the per-key kernel would be; pad region is 0
+    wd_el = np.zeros(padded, np.dtype(wdtype))
+    wd_el[:total] = np.repeat(np.asarray(wds, np.float64), sizes_np)
+    csc = jax.lax.with_sharding_constraint
+
+    def bucket_step(parts, w_raws, shard_state, lrs):
+        gflat = jnp.ravel(parts[0]) if len(parts) == 1 else \
+            jnp.concatenate([jnp.ravel(p) for p in parts])
+        gflat = jnp.pad(gflat, (0, padded - total))
+        g = csc(gflat, shard)
+        wflat = jnp.ravel(w_raws[0]) if len(w_raws) == 1 else \
+            jnp.concatenate([jnp.ravel(w) for w in w_raws])
+        wflat = csc(jnp.pad(wflat, (0, padded - total)), shard)
+        lr_el = jnp.pad(jnp.repeat(lrs, sizes_np,
+                                   total_repeat_length=total),
+                        (0, padded - total))
+        lr_el = csc(lr_el, shard)
+        new_w, new_s = update(wflat, g, shard_state, lr_el,
+                              jnp.asarray(wd_el))
+        new_s = tuple(csc(s, shard) for s in new_s)
+        full = csc(new_w, repl)  # the in-trace param all-gather
+        outs, off = [], 0
+        for shape, size in zip(shapes, sizes):
+            outs.append(full[off:off + size].reshape(shape))
+            off += size
+        if sentinel:
+            fins = jnp.stack([jnp.isfinite(p).all() for p in parts])
+            gnorm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            return (tuple(outs), new_s,
+                    jnp.concatenate([fins.astype(jnp.float32),
+                                     gnorm[None]]))
+        return tuple(outs), new_s
+
+    return jax.jit(_executor._count_traces(bucket_step, "kv_update"))
+
+
 _concat_flat = None
 
 
@@ -174,7 +257,10 @@ def _concat(parts):
 
 class _Bucket:
     __slots__ = ("dtype", "keys", "shapes", "sizes", "nbytes",
-                 "target", "tset")
+                 "target", "tset",
+                 # cross-replica sharded update (arXiv:2004.13336)
+                 "shard_n", "shard_mesh", "shard_sharding", "padded",
+                 "offsets", "nslots", "wdtype", "shard_state", "shard_src")
 
     def __init__(self, dtype):
         self.dtype = dtype
@@ -184,6 +270,15 @@ class _Bucket:
         self.nbytes = 0
         self.target = None   # jax Sharding the bucket executes under
         self.tset = None     # its device_set (cheap placement guard)
+        self.shard_n = 1          # >1: this bucket runs the sharded program
+        self.shard_mesh = None
+        self.shard_sharding = None
+        self.padded = 0           # flat length padded to a shard_n multiple
+        self.offsets: List[int] = []
+        self.nslots = 0           # optimizer state slots (uniform per rule)
+        self.wdtype = None        # the bucket's (uniform) weight dtype
+        self.shard_state = None   # tuple of SHARDED flat state vectors
+        self.shard_src = None     # per-key state fingerprints at ingest
 
 
 class FusedUpdateEngine:
@@ -245,23 +340,60 @@ class FusedUpdateEngine:
                 self._load[dev] = self._load.get(dev, 0) + b.nbytes
                 b.target = jax.sharding.SingleDeviceSharding(dev)
             b.tset = b.target.device_set
+            self._maybe_shard_bucket(b, raws[0] if ndev == 1 else None)
             if _tm.enabled():
                 _TM_BUCKET_BYTES.observe(b.nbytes, store=self._kv.type)
         for i, b in enumerate(buckets):
             # memory attribution row per bucket program: ndev grad
             # copies + weights in, weights (+ state, roughly weight-
             # sized per slot) out — shape math, good enough to RANK
-            # programs in the OOM report
+            # programs in the OOM report.  A sharded bucket's state
+            # (and its update temp) is resident at 1/N per replica —
+            # the row is where the arXiv:2004.13336 memory saving shows
+            # up in the health layer's accounting
+            state_b = b.nbytes * max(b.nslots, 1) // b.shard_n
             _tm.health.record_program(
-                f"kv_bucket{i}[{np.dtype(b.dtype).name}x{len(b.keys)}]",
-                argument=b.nbytes * (ndev + 2), output=b.nbytes * 2,
-                temp=b.nbytes, source="shape_math")
+                f"kv_bucket{i}[{np.dtype(b.dtype).name}x{len(b.keys)}"
+                + (f"/shard{b.shard_n}" if b.shard_n > 1 else "") + "]",
+                argument=b.nbytes * (ndev + 1) + state_b,
+                output=b.nbytes + state_b,
+                temp=b.nbytes // b.shard_n, source="shape_math")
         self._buckets = buckets
         self._plan_keys = tuple(keys)
         self._key_index = idx
         self._ndev = ndev
         if _tm.enabled():
             _TM_BUCKET_COUNT.set(len(buckets), store=self._kv.type)
+
+    def _maybe_shard_bucket(self, b, raw0):
+        """Mark a bucket for the cross-replica sharded update when its
+        (single, mesh-global) gradient is replicated over a >1-device
+        mesh, the optimizer rule has a flat-vector form, and the
+        bucket's weights share one dtype.  Per-device grad-copy lists
+        (ndev > 1) and TP-sharded gradients keep the replicated
+        per-key program."""
+        b.offsets = [int(o) for o in np.cumsum([0] + b.sizes)[:-1]]
+        if raw0 is None or not shard_update_enabled():
+            return
+        sh = raw0.sharding
+        if not isinstance(sh, NamedSharding) or sh.mesh.size <= 1 \
+                or not sh.is_fully_replicated:
+            return
+        rule = self._opt.fused_rule()
+        flat = flat_rule(*rule) if rule is not None else None
+        if flat is None:
+            return
+        wdts = {np.dtype(self._kv._store[k].dtype) for k in b.keys
+                if k in self._kv._store}
+        if len(wdts) != 1:
+            return
+        b.wdtype = wdts.pop()
+        b.nslots = flat[0]
+        b.shard_n = int(sh.mesh.size)
+        b.shard_mesh = sh.mesh
+        b.shard_sharding = NamedSharding(sh.mesh, P(sh.mesh.axis_names))
+        total = int(sum(b.sizes))
+        b.padded = -(-total // b.shard_n) * b.shard_n
 
     # ----------------------------------------------------------------- push
     def handle_push(self, keys, values) -> bool:
@@ -280,6 +412,9 @@ class FusedUpdateEngine:
                 return False
         t0 = time.perf_counter() if _tm.enabled() else None
         if self._plan_keys != tuple(keys) or self._ndev != ndev:
+            # a plan rebuild drops the old buckets: any sharded state
+            # they hold must land back in the per-key NDArrays first
+            self.sync_shard_state()
             self._build_plan(keys, vlists, ndev)
         opt = self._opt
         # host bookkeeping first (eager order: every key of the step sees
@@ -325,6 +460,10 @@ class FusedUpdateEngine:
         kv, upd = self._kv, self._updater
         sentinel = _tm.health.sentinel_mode() is not None
         weights = [kv._store[k] for k in b.keys]
+        if b.shard_n > 1:
+            return self._step_bucket_sharded(b, bi, vlists, rule_name,
+                                             opt_params, lrs, wds,
+                                             weights, sentinel)
         slot_lists = [
             _state_slots(upd.ensure_state(k, w))
             for k, w in zip(b.keys, weights)
@@ -380,6 +519,179 @@ class FusedUpdateEngine:
 
             _TM_PUSH.inc(len(b.keys), store=kv.type)
             _TM_PUSH_BYTES.inc(b.nbytes, store=kv.type)
+
+    # ------------------------------------------- cross-replica sharded step
+    def _step_bucket_sharded(self, b, bi, vlists, rule_name, opt_params,
+                             lrs, wds, weights, sentinel):
+        """One sharded bucket step (arXiv:2004.13336): grads/weights
+        enter per-key (replicated), the jitted program reduce-scatters
+        the flat gradient, updates each replica's 1/N slice against the
+        bucket's device-resident SHARDED flat state, and all-gathers
+        fresh per-key weights — one compiled program, no host sync, no
+        per-key state dispatches."""
+        kv = self._kv
+        self._ensure_shard_state(b)
+        idx = self._key_index
+        parts = []
+        for k in b.keys:
+            g = vlists[idx[k]][0]._read()
+            if g.sharding.device_set != b.tset:
+                g = jax.device_put(g, b.target)
+            parts.append(g)
+        w_raws = [self._place(w, b.target, b.tset) for w in weights]
+        wd_tuple = tuple(wds[k] for k in b.keys)
+        fn = self._shard_program(b, rule_name, opt_params, wd_tuple,
+                                 sentinel)
+        lr_vec = np.asarray([lrs[k] for k in b.keys], np.float32)
+        if sentinel:
+            new_w, new_s, sent_vec = fn(tuple(parts), tuple(w_raws),
+                                        b.shard_state, lr_vec)
+            _tm.health.sentinel_record(
+                site=f"kv_bucket{bi}", step=self._push_count,
+                names=[self._key_name(k) for k in b.keys],
+                finite=sent_vec, packed_norm=True)
+        else:
+            new_w, new_s = fn(tuple(parts), tuple(w_raws),
+                              b.shard_state, lr_vec)
+        b.shard_state = tuple(new_s)
+        for i, w in enumerate(weights):
+            w._chunk.write(new_w[i])
+        if _tm.enabled():
+            from .kvstore import _TM_PUSH, _TM_PUSH_BYTES
+
+            _TM_PUSH.inc(len(b.keys), store=kv.type)
+            _TM_PUSH_BYTES.inc(b.nbytes, store=kv.type)
+            itemsize = np.dtype(b.wdtype).itemsize
+            _executor._TM_COLLECTIVE.inc(b.padded * itemsize,
+                                         op="kv_param_allgather")
+            _executor._TM_COLLECTIVE.inc(
+                b.padded * np.dtype(b.dtype).itemsize // b.shard_n,
+                op="kv_grad_shard")
+
+    def _state_fingerprints(self, b):
+        """{key: ((chunk, version), ...)} of the per-key state NDArrays
+        the Updater currently holds for this bucket's keys — the change
+        detector for eager interleaves / load_optimizer_states."""
+        cur = {}
+        for k in b.keys:
+            st = self._updater.states.get(k)
+            if st is None:
+                continue
+            slots = _state_slots(st)
+            cur[k] = tuple((s._chunk, s._chunk.version) for s in slots)
+        return cur
+
+    def _ensure_shard_state(self, b):
+        """(Re)build the bucket's sharded flat state vectors.
+
+        Fresh training never materializes full per-key state: absent
+        Updater entries ingest as zeros directly into the sharded
+        layout (the 1/N-bytes-per-replica property).  Keys that DO have
+        per-key state (an eager interlude, load_optimizer_states, a
+        checkpoint restore) are folded in, and their (chunk, version)
+        fingerprints recorded so any outside write triggers a
+        re-ingest on the next sharded step."""
+        cur = self._state_fingerprints(b)
+        if b.shard_state is not None and cur == b.shard_src:
+            return
+        dt = np.dtype(b.wdtype)
+        flats = []
+        for s in range(b.nslots):
+            segs = []
+            for i, k in enumerate(b.keys):
+                st = self._updater.states.get(k)
+                if st is None:
+                    segs.append(jnp.zeros(b.sizes[i], dtype=dt))
+                else:
+                    segs.append(jnp.ravel(
+                        _state_slots(st)[s]._read()).astype(dt))
+            flat = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+            flat = jnp.pad(flat, (0, b.padded - int(sum(b.sizes))))
+            flats.append(jax.device_put(flat, b.shard_sharding))
+        b.shard_state = tuple(flats)
+        b.shard_src = cur
+
+    def sync_shard_state(self):
+        """Materialize every sharded flat state vector back into the
+        per-key NDArrays the eager ``Updater`` owns (the ONLY
+        device→host path of the sharded engine — called at plan
+        rebuilds, save/load_optimizer_states, and before any eager
+        per-key update, never per step)."""
+        buckets = [b for b in (self._buckets or ())
+                   if b.shard_state is not None]
+        if not buckets:
+            return
+        t0 = time.perf_counter() if _tm.enabled() else None
+        for b in buckets:
+            fulls = [np.asarray(f) for f in b.shard_state]
+            for i, k in enumerate(b.keys):
+                w = self._kv._store.get(k)
+                if w is None:
+                    continue
+                slots = _state_slots(self._updater.ensure_state(k, w))
+                for s, s_nd in enumerate(slots):
+                    seg = fulls[s][b.offsets[i]:b.offsets[i] + b.sizes[i]]
+                    s_nd._chunk.write(
+                        jnp.asarray(seg.reshape(b.shapes[i])).astype(
+                            s_nd.dtype))
+            b.shard_src = self._state_fingerprints(b)
+        if t0 is not None:
+            _TM_SHARD_GATHER.observe(time.perf_counter() - t0,
+                                     store=self._kv.type)
+
+    # public alias the kvstore's eager paths call before touching the
+    # per-key state store (a no-op flag check when nothing is sharded)
+    ensure_host_state = sync_shard_state
+
+    @property
+    def shard_replicas(self) -> int:
+        """Replica count of the sharded plan (1 = replicated)."""
+        return max([b.shard_n for b in self._buckets or ()] or [1])
+
+    def state_memory(self) -> dict:
+        """Optimizer-state residency of the current plan: global bytes
+        vs bytes per replica (the arXiv:2004.13336 saving, asserted by
+        tests and emitted by bench.py's shard section)."""
+        per_replica = 0
+        global_b = 0
+        sharded = 0
+        for b in self._buckets or ():
+            if b.shard_state is not None:
+                bytes_ = sum(int(f.size) * np.dtype(f.dtype).itemsize
+                             for f in b.shard_state)
+                global_b += bytes_
+                per_replica += bytes_ // b.shard_n
+                sharded += 1
+            else:
+                bytes_ = 0
+                for k in b.keys:
+                    for s_nd in _state_slots(self._updater.states.get(k)):
+                        bytes_ += int(s_nd.size) * \
+                            np.dtype(s_nd.dtype).itemsize
+                global_b += bytes_
+                per_replica += bytes_  # replicated: every replica holds all
+        return {"global_bytes": global_b, "per_replica_bytes": per_replica,
+                "sharded_buckets": sharded,
+                "replicas": self.shard_replicas}
+
+    def _shard_program(self, b, rule_name, opt_params, wd_tuple,
+                       sentinel=False):
+        mesh = b.shard_mesh
+        mesh_sig = (mesh.axis_names, mesh.devices.shape,
+                    tuple(d.id for d in mesh.devices.flat))
+        key = ("kvshard", rule_name, tuple(sorted(opt_params.items())),
+               b.dtype.str, np.dtype(b.wdtype).str, tuple(b.shapes),
+               wd_tuple, mesh_sig, sentinel)
+        fn = _executor.program_cache_get(key)
+        if fn is None:
+            fn = self._local_programs.get(key)
+            if fn is None:
+                fn = _make_sharded_bucket_program(
+                    rule_name, opt_params, tuple(b.shapes),
+                    tuple(b.sizes), wd_tuple, b.wdtype, mesh, sentinel)
+                _executor.program_cache_put(key, fn)
+        self._local_programs[key] = fn
+        return fn
 
     def _program(self, b, rule_name, opt_params, wd_tuple, sentinel=False):
         key = ("kvfused", rule_name, tuple(sorted(opt_params.items())),

@@ -6,11 +6,16 @@ per device, scatters inputs (_load_data:41) and gathers outputs
 (_merge_multi_context:50); gradients meet in the kvstore.
 
 TPU-native redesign (SURVEY.md §7 'Data parallelism' row): ONE executor,
-ONE compiled SPMD program.  The contexts become a 1-D jax mesh with axis
-``data``; input batches are device_put with a batch-sharded NamedSharding,
-params/grads are replicated.  XLA GSPMD inserts the gradient all-reduce
-over ICI — the engine-scheduled P2P copy + ElementwiseSum machinery of
-CommDevice (src/kvstore/comm.h:200-360) becomes a single fused collective.
+ONE compiled SPMD program.  The contexts resolve onto the process-level
+named 2-D mesh ``("batch", "model")`` (parallel.mesh.global_mesh,
+MXTPU_MESH_SHAPE; a context subset gets a batch-only sub-mesh): input
+batches are bound with a ``NamedSharding(P("batch"))`` annotation
+threaded through ``simple_bind(shardings=...)``, params/grads are
+replicated (group2ctx PartitionSpec annotations may shard them over
+"model").  XLA GSPMD inserts the gradient all-reduce over ICI — the
+engine-scheduled P2P copy + ElementwiseSum machinery of CommDevice
+(src/kvstore/comm.h:200-360) becomes a single fused collective, counted
+per step in ``executor_collective_bytes_total{op=grad_allreduce}``.
 The slice/merge API surface is preserved so Module code is unchanged.
 """
 from __future__ import annotations
@@ -22,9 +27,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import ndarray as nd
+from .. import telemetry as _tm
 from ..base import MXNetError
-from ..executor import simple_bind
+from ..executor import _TM_COLLECTIVE, simple_bind
 from ..ndarray import NDArray
+from ..parallel.mesh import GLOBAL_AXES, create_mesh, global_mesh
 
 
 def _split_input_slice(batch_size, work_load_list):
@@ -58,18 +65,29 @@ class DataParallelExecutorGroup:
         self.label_shapes = label_shapes
         self.batch_size = data_shapes[0][1][0]
 
-        # ----- mesh over the data axis (the TPU-native executor "group") ----
+        # ----- named 2-D mesh (the TPU-native executor "group") -------------
+        # contexts spanning every process device use the process-level
+        # ("batch", "model") mesh — MXTPU_MESH_SHAPE decides how many
+        # replicas vs model shards; a context subset keeps a batch-only
+        # sub-mesh over exactly those devices (reference parity: the
+        # group computes on the contexts it was given, PlaceDevice-style)
         devices = [c.jax_device for c in contexts]
         unique = []
         for d in devices:
             if d not in unique:
                 unique.append(d)
-        if self.batch_size % len(unique) != 0:
+        if len(unique) == len(jax.devices()):
+            mesh = global_mesh(unique)
+        else:
+            mesh = create_mesh((len(unique), 1), GLOBAL_AXES,
+                               devices=unique)
+        n_batch, n_model = mesh.devices.shape
+        if self.batch_size % n_batch != 0:
             # GSPMD shards the batch evenly, so an uneven request uses the
-            # LARGEST device count dividing the batch — and says so (the
+            # LARGEST replica count dividing the batch — and says so (the
             # reference's _split_input_slice gave devices uneven slices;
             # silently dropping to one device is not acceptable either way)
-            n = len(unique)
+            n = n_batch
             while self.batch_size % n:
                 n -= 1
             import logging
@@ -78,10 +96,11 @@ class DataParallelExecutorGroup:
                 "batch size %d not divisible by %d devices; data-parallel "
                 "group uses %d device(s) — pad the batch or adjust "
                 "batch_size for full utilization",
-                self.batch_size, len(unique), n)
-            unique = unique[:n]
-        self.mesh = Mesh(np.array(unique), ("data",))
-        self._data_sharding = NamedSharding(self.mesh, P("data"))
+                self.batch_size, n_batch, n)
+            unique = unique[:n * n_model]
+            mesh = create_mesh((n, n_model), GLOBAL_AXES, devices=unique)
+        self.mesh = mesh
+        self._data_sharding = NamedSharding(self.mesh, P("batch"))
         self._repl_sharding = NamedSharding(self.mesh, P())
 
         arg_names = symbol.list_arguments()
@@ -104,8 +123,25 @@ class DataParallelExecutorGroup:
         # program cache (structural signature), so switch_bucket never
         # recompiles a structure it has seen
         shared_exec = shared_group.execs[0] if shared_group is not None else None
+        # the bind carries the mesh annotations: inputs batch-sharded,
+        # everything else replicated (a group2ctx PartitionSpec via the
+        # executor may override single params onto the "model" axis) —
+        # ONE compiled SPMD program spans the mesh, and the sharding
+        # spec joins the program-cache key alongside the structure hash
+        shardings = None
+        if self.mesh.size > 1:
+            shardings = {}
+            for name in self.data_names + self.label_names:
+                if name in arg_names or name in input_shapes:
+                    shardings[name] = self._data_sharding
+            for name in arg_names:
+                if name not in shardings:
+                    shardings[name] = self._repl_sharding
+            for name in self.aux_names:
+                shardings.setdefault(name, self._repl_sharding)
         exec_ = simple_bind(symbol, contexts[0], grad_req=req,
-                            shared_exec=shared_exec, **input_shapes)
+                            shared_exec=shared_exec, shardings=shardings,
+                            **input_shapes)
         same_mesh = (shared_group is not None
                      and list(shared_group.mesh.devices.flat)
                      == list(self.mesh.devices.flat))
@@ -152,6 +188,15 @@ class DataParallelExecutorGroup:
                 arr._chunk.write(jax.device_put(arr._read(), self._repl_sharding))
         self.execs = [exec_]
         self.slices = _split_input_slice(self.batch_size, self.workload)
+        # logical payload of the per-step gradient all-reduce GSPMD
+        # inserts for replicated params over a >1-replica mesh (counted
+        # at backward dispatch into executor_collective_bytes_total)
+        self._grad_allreduce_bytes = 0
+        if self.mesh.devices.shape[0] > 1:
+            self._grad_allreduce_bytes = sum(
+                int(g.size) * np.dtype(g.dtype).itemsize
+                for n, g in exec_.grad_dict.items()
+                if g is not None and n not in self.data_names)
 
     # ---------------------------------------------------------------- params
     def set_params(self, arg_params, aux_params):
@@ -204,6 +249,9 @@ class DataParallelExecutorGroup:
 
     def backward(self, out_grads=None):
         self.execs[0].backward(out_grads)
+        if self._grad_allreduce_bytes and _tm.enabled():
+            _TM_COLLECTIVE.inc(self._grad_allreduce_bytes,
+                               op="grad_allreduce")
 
     def get_outputs(self, merge_multi_context=True):
         """Outputs are global (sharded) arrays — 'merge' is free."""

@@ -95,3 +95,98 @@ def _rmsprop_rule(opt_params):
 
 
 _RULES = {"sgd": _sgd_rule, "adam": _adam_rule, "rmsprop": _rmsprop_rule}
+
+
+# ---------------------------------------------------------------------------
+# Flat-vector rule variants (the cross-replica sharded update path,
+# arXiv:2004.13336).
+#
+# Every rule above is elementwise, so a whole flat bucket can run as ONE
+# vector computation with per-ELEMENT lr/wd vectors instead of one
+# per-key program slice — which is what lets the kvstore's sharded
+# update split the bucket across mesh replicas with a plain
+# with_sharding_constraint: each replica computes its 1/N slice, the
+# optimizer state stays resident as the sharded flat vector, and the
+# fresh parameters all-gather in-trace.  The math mirrors
+# ops/optimizer_ops.py operation-for-operation (same multiply/add order,
+# scalar hyperparams stay weakly-typed Python floats) so the sharded
+# path is bit-compatible with the per-key bucket programs.
+# ---------------------------------------------------------------------------
+def _flat_prep(g, w, wd_el, opt_params):
+    """_prep_grad over a flat vector: wd arrives per element (already
+    base_wd * wd_mult, cast to the bucket dtype the way the weak-typed
+    Python float in the per-key kernel would be)."""
+    rescale = float(opt_params.get("rescale_grad", 1.0))
+    clip = opt_params.get("clip_gradient", None)
+    g = g * rescale
+    if clip is not None and float(clip) > 0:
+        g = jnp.clip(g, -float(clip), float(clip))
+    return g + wd_el * w
+
+
+def _sgd_flat(opt_params):
+    momentum = opt_params.get("momentum", 0.0)
+
+    def nslots():
+        return 1 if momentum else 0
+
+    def update(w, g, state, lr_el, wd_el):
+        g = _flat_prep(g, w, wd_el, opt_params)
+        if momentum:
+            new_m = momentum * state[0] - lr_el * g
+            return w + new_m, (new_m,)
+        return w - lr_el * g, ()
+
+    return nslots(), update
+
+
+def _adam_flat(opt_params):
+    beta1 = float(opt_params.get("beta1", 0.9))
+    beta2 = float(opt_params.get("beta2", 0.999))
+    eps = float(opt_params.get("epsilon", 1e-8))
+
+    def update(w, g, state, lr_el, wd_el):
+        m, v = state
+        g = _flat_prep(g, w, wd_el, opt_params)
+        new_m = beta1 * m + (1 - beta1) * g
+        new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+        new_w = w - lr_el * new_m / (jnp.sqrt(new_v) + eps)
+        return new_w, (new_m, new_v)
+
+    return 2, update
+
+
+def _rmsprop_flat(opt_params):
+    if parse_bool(opt_params.get("centered", False)):
+        raise ValueError("the fused rmsprop rule is the plain "
+                         "(Tieleman-Hinton) variant; use Module for "
+                         "centered RMSProp")
+    gamma1 = float(opt_params.get("gamma1", 0.95))
+    eps = float(opt_params.get("epsilon", 1e-8))
+    clip_weights = opt_params.get("clip_weights", None)
+
+    def update(w, g, state, lr_el, wd_el):
+        n = state[0]
+        g = _flat_prep(g, w, wd_el, opt_params)
+        new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+        new_w = w - lr_el * g / jnp.sqrt(new_n + eps)
+        if clip_weights is not None and float(clip_weights) > 0:
+            cw = float(clip_weights)
+            new_w = jnp.clip(new_w, -cw, cw)
+        return new_w, (new_n,)
+
+    return 1, update
+
+
+_FLAT_RULES = {"sgd": _sgd_flat, "adam": _adam_flat, "rmsprop": _rmsprop_flat}
+
+
+def flat_rule(rule_name, opt_params):
+    """(n_state_slots, update) — the flat-vector variant of
+    ``_RULES[rule_name]`` for the sharded bucket program, or ``None``
+    when the rule has no flat form (the caller keeps the per-key
+    replicated program)."""
+    builder = _FLAT_RULES.get(rule_name)
+    if builder is None:
+        return None
+    return builder(dict(opt_params))

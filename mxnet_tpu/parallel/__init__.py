@@ -5,4 +5,6 @@ TPU-native replacement for the reference's distribution machinery
 parameter-server push/pull, this package composes jax.sharding meshes and
 XLA collectives over ICI/DCN.
 """
-from .mesh import create_mesh, data_sharding, replicated, shard_params, ShardingRule
+from .mesh import (create_mesh, data_sharding, global_mesh,
+                   mesh_shape_from_env, param_shardings, replicated,
+                   shard_params, ShardingRule)

@@ -425,11 +425,13 @@ class Symbol:
                         group2ctx=group2ctx, shared_exec=shared_exec)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    group2ctx=None, shared_exec=None, **kwargs):
+                    group2ctx=None, shared_exec=None, shardings=None,
+                    **kwargs):
         from .executor import simple_bind as _simple_bind
 
         return _simple_bind(self, ctx, grad_req=grad_req, type_dict=type_dict,
-                            group2ctx=group2ctx, shared_exec=shared_exec, **kwargs)
+                            group2ctx=group2ctx, shared_exec=shared_exec,
+                            shardings=shardings, **kwargs)
 
     # convenience evaluation (imperative-style) used by tests
     def eval(self, ctx=None, **kwargs):
