@@ -43,6 +43,7 @@ from . import symbol as sym
 from .symbol import Symbol, Variable, Group
 from . import executor
 from .executor import Executor
+from . import passes
 from . import initializer
 from . import initializer as init
 from .initializer import Initializer, Uniform, Normal, Xavier, Orthogonal, MSRAPrelu, Mixed, Load

@@ -185,7 +185,18 @@ def _compiled_programs(symbol: Symbol, platform: Optional[str],
     but keying on it keeps a mesh-annotated bind's entry distinct from a
     single-device bind of the same structure, so cache hits always
     return programs whose jit-level sharding history matches the bind.
+
+    The graph-rewrite pipeline (mxnet_tpu.passes; MXTPU_GRAPH_PASSES)
+    runs FIRST, so the key is the POST-pass signature: differently-
+    written but equivalent graphs — duplicated subexpressions, dead
+    no-op nodes, unfused elementwise chains — rewrite to one canonical
+    structure and converge on a single compiled entry.  Different pass
+    selections need no extra key axis for the same reason: the
+    rewritten structure IS the selection's fingerprint.
     """
+    from . import passes as _passes
+
+    symbol = _passes.apply_graph_passes(symbol)
     channels_last = channels_last_default()
     capacity = program_cache_capacity()
     key = None
@@ -245,6 +256,9 @@ _CL_UNARY = {
     "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
     "_div_scalar", "_rdiv_scalar", "_power_scalar", "_rpower_scalar",
     "_maximum_scalar", "_minimum_scalar", "_hypot_scalar",
+    # a pre-fused elementwise chain (passes/prefuse.py) is itself a pure
+    # elementwise map, so it passes NHWC through like its parts would
+    "_fused_elemwise", "Cast",
 }
 _CL_MULTI = {
     # same-shape multi-tensor elementwise (incl. residual adds)

@@ -1,0 +1,184 @@
+"""Graph-rewrite pass pipeline over ``Symbol`` graphs.
+
+The source paper's one-line identity includes "a graph optimization
+layer on top" — nnvm passes over the symbolic graph before execution.
+This package is that layer for the TPU-native stack: semantics-
+preserving rewrites applied at bind time, BEFORE the executor's
+``_build_graph_fn`` traces the graph, in the spirit of Relay
+(arXiv:1810.00952) and nGraph (arXiv:1801.08058).
+
+Pipeline (registration order = run order; docs/graph_passes.md):
+
+- ``constant_fold``  evaluate constant subgraphs once, bake literals
+- ``cse``            merge structurally identical nodes
+- ``dce``            drop identity/no-op nodes, prune dead ones
+- ``prefuse``        collapse elementwise chains into one fused node
+- ``convbn_fold``    inference-only Conv+BN weight folding (needs the
+                     parameter values; Predictor/serving path only)
+
+Selection: ``MXTPU_GRAPH_PASSES`` — default/empty/``on`` runs the whole
+pipeline, ``0``/``off`` disables everything, a comma list
+(``cse,dce``) runs exactly the named passes in pipeline order.
+
+Cache interaction: the executor keys its process-wide program cache on
+the POST-pass ``structural_signature``, so differently-written but
+equivalent graphs (a duplicated subexpression vs a shared one, a
+dead-reshape variant, alpha-renamed op nodes) converge on ONE compiled
+entry.
+
+Training safety: a pass declaring ``training_safe=True`` is applied to
+every whole-graph bind — forward AND the fused fwd+bwd program trace
+the rewritten graph, and jax.vjp differentiates straight through the
+rewrites (which is exact: each rewrite forwards the same pure
+function).  ``training_safe=False`` passes never run there.  ctx-group
+*placed* (multi-device segmented) graphs skip the pipeline entirely:
+their execution plan is keyed by node identity.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from collections import OrderedDict
+from typing import Callable
+
+from .. import telemetry as _tm
+from ..base import MXNetError
+
+# --- telemetry families (docs/telemetry.md "Graph passes") -----------------
+_TM_PASS_SEC = _tm.histogram(
+    "graph_pass_seconds",
+    "wall time of one graph-rewrite pass application at bind",
+    labels=("pass",))
+_TM_PASS_REMOVED = _tm.counter(
+    "graph_pass_nodes_removed_total",
+    "op nodes removed from bound graphs, per rewrite pass",
+    labels=("pass",))
+_TM_CONVBN = _tm.counter(
+    "graph_pass_convbn_folded_total",
+    "Conv+BatchNorm pairs folded into conv weights on inference binds")
+
+
+@dataclass
+class PassDef:
+    """One registered graph pass.
+
+    ``training_safe`` is a REQUIRED declaration: True means the rewrite
+    preserves fwd outputs and bwd gradients and may run on training
+    binds; False restricts it to inference-only call sites.  The pass
+    lint in tests/test_passes.py enforces that every registered pass
+    declares it and has a named parity test.
+    """
+
+    name: str
+    fn: Callable
+    training_safe: bool
+    needs_params: bool = False
+    doc: str = ""
+
+
+PASSES: "OrderedDict[str, PassDef]" = OrderedDict()
+
+
+def register_pass(name, *, training_safe, needs_params=False):
+    """Register a pass; registration order defines pipeline order."""
+
+    def deco(fn):
+        PASSES[name] = PassDef(name=name, fn=fn,
+                               training_safe=bool(training_safe),
+                               needs_params=needs_params,
+                               doc=fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+def enabled_passes():
+    """Pass names selected by MXTPU_GRAPH_PASSES, in pipeline order."""
+    raw = os.environ.get("MXTPU_GRAPH_PASSES", "").strip().lower()
+    if raw in ("0", "off", "false", "no", "none", "disable", "disabled"):
+        return []
+    if raw in ("", "1", "on", "true", "yes", "default", "all"):
+        return list(PASSES)
+    names = {p.strip() for p in raw.split(",") if p.strip()}
+    unknown = sorted(names - set(PASSES))
+    if unknown:
+        raise MXNetError(
+            f"MXTPU_GRAPH_PASSES names unknown passes {unknown}; "
+            f"registered: {list(PASSES)}")
+    return [n for n in PASSES if n in names]
+
+
+def convbn_fold_enabled() -> bool:
+    return "convbn_fold" in enabled_passes()
+
+
+def apply_graph_passes(symbol):
+    """Run every enabled training-safe graph pass over ``symbol``.
+
+    This is the executor's bind-time hook: pure graph-in/graph-out
+    passes only (``needs_params`` passes like convbn_fold have their
+    own inference-path entry point).  Returns the input symbol
+    unchanged when the pipeline is disabled.
+    """
+    names = enabled_passes()
+    if not names:
+        return symbol
+    from .common import op_node_count
+
+    for name in names:
+        p = PASSES[name]
+        if p.needs_params or not p.training_safe:
+            continue
+        before = op_node_count(symbol)
+        t0 = time.perf_counter()
+        symbol = p.fn(symbol)
+        _TM_PASS_SEC.observe(time.perf_counter() - t0, **{"pass": name})
+        removed = before - op_node_count(symbol)
+        if removed > 0:
+            _TM_PASS_REMOVED.inc(removed, **{"pass": name})
+    return symbol
+
+
+def apply_convbn_fold(symbol, arg_params, aux_params):
+    """Telemetry-counted Conv+BN fold (the inference-bind entry point
+    used by Predictor / serving).  Honors MXTPU_GRAPH_PASSES selection;
+    returns ``(symbol, arg_params, aux_params, n_folded)``."""
+    if not convbn_fold_enabled():
+        return symbol, dict(arg_params or {}), dict(aux_params or {}), 0
+    t0 = time.perf_counter()
+    symbol, arg_params, aux_params, n = fold_conv_bn(
+        symbol, arg_params, aux_params)
+    _TM_PASS_SEC.observe(time.perf_counter() - t0,
+                         **{"pass": "convbn_fold"})
+    if n > 0:
+        _TM_CONVBN.inc(n)
+        _TM_PASS_REMOVED.inc(n, **{"pass": "convbn_fold"})
+    return symbol, arg_params, aux_params, n
+
+
+def pipeline_report(symbol):
+    """Per-pass node counts for the enabled graph passes (bench.py's
+    ``_passes_micro``): [{'pass', 'nodes_before', 'nodes_after'}, ...]."""
+    from .common import op_node_count
+
+    rows = []
+    for name in enabled_passes():
+        p = PASSES[name]
+        if p.needs_params or not p.training_safe:
+            continue
+        before = op_node_count(symbol)
+        symbol = p.fn(symbol)
+        rows.append({"pass": name, "nodes_before": before,
+                     "nodes_after": op_node_count(symbol)})
+    return rows
+
+
+# pass modules register themselves in PIPELINE ORDER
+from . import constant_fold  # noqa: E402,F401
+from . import cse  # noqa: E402,F401
+from . import dce  # noqa: E402,F401
+from . import prefuse  # noqa: E402,F401
+from . import convbn  # noqa: E402,F401
+from .convbn import fold_conv_bn  # noqa: E402,F401
+from .common import op_node_count  # noqa: E402,F401

@@ -1,0 +1,113 @@
+"""Constant folding: evaluate constant subgraphs once at bind time.
+
+Parity target: nnvm's constant folding / Relay FoldConstant
+(arXiv:1810.00952) and nGraph's constant propagation
+(arXiv:1801.08058).  A subgraph is constant when every leaf is a
+no-input creation op (``_zeros``/``_ones``/``_full``/``_arange``/...)
+and every interior op is deterministic (no PRNG, no aux state, no
+Custom/native escape hatch).  The frontier of each maximal constant
+region — the constant node some non-constant consumer (or an output
+head) reads — is evaluated ONCE here, eagerly, and baked into the
+graph as a ``_literal`` node carrying the raw bytes; everything feeding
+it stops being traced, dispatched, or re-evaluated per forward.
+
+Leaves themselves are not worth folding (one creation op either way,
+and a materialized literal would bloat the structural signature), so a
+node is only folded when it has at least one input — i.e. an actual
+computation collapses.  Results larger than ``FOLD_MAX_BYTES`` stay
+unfolded: baking megabytes into attrs would make every signature hash
+scan them.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from ..symbol import _Node
+from . import register_pass
+from .common import clone_rewrite
+
+# ops that must never fold even when their inputs are constant: PRNG
+# draws differ per step, Custom/native ops may touch external state
+_BLOCKLIST = {"Custom", "_Native", "_NDArray"}
+
+FOLD_MAX_BYTES = 1 << 16
+
+
+@ops.register("_literal", arg_names=())
+def _literal(ctx, **attrs):
+    """A folded constant: raw bytes + dtype + shape baked into attrs.
+
+    Evaluated under jit the array is a captured constant — XLA embeds
+    it into the executable exactly like the reference embeds folded
+    nnvm constants into the cached op sequence.  The bytes live in
+    attrs (not a side table) so ``structural_signature`` keys on the
+    VALUE: two graphs folding to different constants never share a
+    compiled program.
+    """
+    import jax.numpy as jnp
+
+    arr = np.frombuffer(attrs["data"], dtype=np.dtype(attrs["dtype"]))
+    return jnp.asarray(arr.reshape(tuple(attrs["shape"])))
+
+
+def _is_const(node, const):
+    if node.is_variable:
+        return False
+    od = ops.get(node.op)
+    if od.needs_rng or od.aux_names or node.op in _BLOCKLIST:
+        return False
+    return all(const.get(id(src), False) for src, _ in node.inputs)
+
+
+def _eval_const(node, values):
+    """Eagerly evaluate one constant node (memoized); returns the tuple
+    of output arrays.  Runs the registered op fns directly — jnp ops
+    execute eagerly here, once, at pass time."""
+    got = values.get(id(node))
+    if got is not None:
+        return got
+    ins = [_eval_const(src, values)[oidx] for src, oidx in node.inputs]
+    od = ops.get(node.op)
+    res = od.fn(ops.OpCtx(is_train=False), *ins, **node.attrs)
+    if not isinstance(res, tuple):
+        res = (res,)
+    values[id(node)] = res
+    return res
+
+
+@register_pass("constant_fold", training_safe=True)
+def constant_fold(symbol):
+    """Fold the frontier of every maximal constant subgraph into
+    ``_literal`` nodes.  Training-safe: a constant has no gradient path
+    (no variable ancestors), so fwd+bwd binds fold identically."""
+    const: dict = {}
+    for node in symbol.nodes:
+        if not node.is_variable:
+            const[id(node)] = _is_const(node, const)
+
+    values: dict = {}
+
+    def rewrite(node, new_inputs):
+        if not const.get(id(node)) or not node.inputs:
+            return None
+        if node.op == "_literal":
+            return None  # already folded (idempotent re-runs)
+        try:
+            outs = _eval_const(node, values)
+        except Exception:  # noqa: BLE001 — an op that refuses eager
+            return None    # evaluation simply stays in the graph
+        host = [np.asarray(o) for o in outs]
+        if sum(h.nbytes for h in host) > FOLD_MAX_BYTES:
+            return None
+        entries = []
+        for k, h in enumerate(host):
+            lit = _Node("_literal",
+                        node.name if len(host) == 1 else f"{node.name}_{k}",
+                        attrs={"data": h.tobytes(), "dtype": h.dtype.name,
+                               "shape": tuple(int(s) for s in h.shape)},
+                        extra_attrs=node.extra_attrs)
+            entries.append((lit, 0))
+        return entries
+
+    return clone_rewrite(symbol, rewrite)

@@ -1,0 +1,114 @@
+"""Elementwise-chain pre-fusion.
+
+XLA already fuses elementwise chains into one kernel at compile time —
+what it cannot remove is the *Python* cost of each node: a registry
+lookup, an ``_eval_node`` frame, and a jaxpr equation per op at every
+trace, plus a dispatch leaf in graphs that fall back to eager.  This
+pass collapses maximal single-consumer chains of ``ops/elemwise.py``
+primitives (unary math, scalar binaries, clip, smooth_l1, Cast) into
+ONE ``_fused_elemwise`` node whose attrs carry the op program, so a
+chain of k ops traces as one node.  The reference gets the same effect
+statically from mshadow expression templates; Relay calls the shape
+FuseOps (arXiv:1810.00952).
+
+Fusion safety: every primitive in the fusible set is a pure
+elementwise map (no PRNG, no aux, shape-preserving up to dtype), so
+the fused node commutes with layout transposes exactly like its parts —
+the executor's NHWC pass treats ``_fused_elemwise`` as a layout-
+transparent unary op.  Gradients come from jax.vjp straight through
+the replayed chain: identical math to the unfused graph.
+"""
+from __future__ import annotations
+
+from .. import ops
+from ..base import frozen_attrs
+from ..ops import elemwise as _ew
+from ..symbol import Symbol, _Node
+from . import register_pass
+from .common import consumer_counts
+
+# fusible primitives: single-input, single-output, elementwise, pure.
+# BlockGrad is included — lax.stop_gradient is per-element and jax.vjp
+# handles it inside the replayed chain exactly as it does standalone.
+FUSIBLE = (frozenset(_ew._UNARY) | frozenset(_ew._SCALAR)
+           | {"_copy", "identity", "BlockGrad", "stop_gradient",
+              "Cast", "cast", "clip", "smooth_l1"})
+
+MIN_CHAIN = 2
+
+
+@ops.register("_fused_elemwise", arg_names=("data",))
+def _fused_elemwise(ctx, data, **attrs):
+    """Replay a pre-fused elementwise chain (attrs['ops'] = tuple of
+    (opname, frozen_attrs) in application order)."""
+    out = data
+    for opname, fattrs in attrs["ops"]:
+        od = ops.get(opname)
+        out = od.fn(ctx, out, **dict(fattrs))
+    return out
+
+
+def _fusible(node):
+    return (not node.is_variable and node.op in FUSIBLE
+            and len(node.inputs) == 1 and node.num_outputs() == 1
+            and "ctx_group" not in node.extra_attrs)
+
+
+@register_pass("prefuse", training_safe=True)
+def prefuse(symbol):
+    """Collapse maximal fusible chains into single ``_fused_elemwise``
+    nodes.  A chain link requires the producer to be consumed ONLY by
+    the next op in the chain and by no output head — interior values
+    must not be observable."""
+    counts = consumer_counts(symbol)
+
+    # chain[id(tail)] = (list of chain nodes head..tail, feed entry)
+    chains: dict = {}
+    chain_member: set = set()
+    for node in reversed(symbol.nodes):  # tails appear after their heads
+        if id(node) in chain_member or not _fusible(node):
+            continue
+        run = [node]
+        cur = node
+        while True:
+            src, oidx = cur.inputs[0]
+            if (_fusible(src) and oidx == 0
+                    and counts.get((id(src), 0), 0) == 1):
+                run.append(src)
+                cur = src
+            else:
+                break
+        if len(run) >= MIN_CHAIN:
+            run.reverse()  # head..tail
+            chains[id(node)] = (run, run[0].inputs[0])
+            chain_member.update(id(n) for n in run)
+
+    if not chains:
+        return symbol
+
+    memo: dict = {}
+    for node in symbol.nodes:
+        if node.is_variable:
+            memo[id(node)] = ((node, 0),)
+            continue
+        chain = chains.get(id(node))
+        if chain is not None:
+            run, (feed_node, feed_idx) = chain
+            program = tuple((n.op, frozen_attrs(n.attrs)) for n in run)
+            fused = _Node("_fused_elemwise", node.name,
+                          attrs={"ops": program},
+                          inputs=[memo[id(feed_node)][feed_idx]],
+                          extra_attrs=node.extra_attrs)
+            memo[id(node)] = ((fused, 0),)
+            continue
+        new_inputs = [memo[id(src)][oidx] for src, oidx in node.inputs]
+        if all(e[0] is src and e[1] == oidx
+               for e, (src, oidx) in zip(new_inputs, node.inputs)):
+            memo[id(node)] = tuple(
+                (node, k) for k in range(node.num_outputs()))
+        else:
+            clone = _Node(node.op, node.name, attrs=node.attrs,
+                          inputs=new_inputs, extra_attrs=node.extra_attrs)
+            memo[id(node)] = tuple(
+                (clone, k) for k in range(clone.num_outputs()))
+    return Symbol([memo[id(n)][i] for n, i in symbol._outputs])

@@ -37,6 +37,14 @@ class Predictor:
     sets it for non-Python clients of the C ABI (src/c_predict.cc),
     which construct this class without kwargs.
 
+    Graph passes: the bind below runs the training-safe rewrite
+    pipeline (mxnet_tpu.passes) like every executor bind, and the
+    constructor additionally applies inference-only Conv+BN folding —
+    frozen BatchNorm moving stats and affine params are folded into the
+    producing conv's weights/bias, removing a normalization per conv
+    from every forward.  ``MXTPU_GRAPH_PASSES=0`` restores the
+    unrewritten graph bit-identically.
+
     ``quantize="int8"``: post-training weight quantization
     (serving/quantize.py) — fp 2-D matmul and 4-D conv ``*weight``
     params are stored as int8 + per-channel symmetric scales and
@@ -90,6 +98,17 @@ class Predictor:
                     raise MXNetError(
                         f"output_index entries must be int or str, got {sel!r}")
             symbol = picked[0] if len(picked) == 1 else sym_mod.Group(picked)
+
+        # inference-mode Conv+BN folding (passes/convbn.py): the predict
+        # path never trains, so every frozen BatchNorm behind a conv is
+        # folded into the conv's weights/bias BEFORE binding — and,
+        # critically, before int8 quantization below computes per-channel
+        # scales, so the scales see the folded dynamic range.  Runs on
+        # the cut (output_index) symbol; MXTPU_GRAPH_PASSES gates it.
+        from .passes import apply_convbn_fold
+
+        symbol, arg_params, aux_params, self._n_bn_folded = \
+            apply_convbn_fold(symbol, arg_params, aux_params)
 
         self.symbol = symbol
         self._input_names = [n for n in symbol.list_arguments()

@@ -29,7 +29,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["QuantizedTensor", "quantize_per_channel", "quantize_params",
-           "default_weight_filter"]
+           "default_weight_filter", "prepare_inference_params"]
 
 
 class QuantizedTensor:
@@ -118,3 +118,34 @@ def quantize_params(params, dtype=np.float32, weight_filter=None,
             scale = jax.device_put(scale)
         out[name] = QuantizedTensor(q, scale, dtype=jnp.dtype(dtype))
     return out
+
+
+def prepare_inference_params(symbol, arg_params, aux_params, quantize="int8",
+                             dtype=np.float32, weight_filter=None,
+                             device_put=True):
+    """Rewrite (symbol, params) for serving: Conv+BN fold, THEN int8.
+
+    Ordering is the whole point: inference-mode Conv+BN folding
+    (passes/convbn.py) multiplies each conv's weight rows by the BN
+    scale ``gamma/sqrt(var+eps)`` — the per-channel symmetric scales
+    below must be computed from the FOLDED weights, or the int8 grid
+    would be sized to a dynamic range the deployed weights no longer
+    have (channels with large BN scale would clip, channels with small
+    BN scale would waste grid).  ``Predictor`` reproduces this ordering
+    internally; this helper is the explicit form for serving code that
+    manages its own executors.
+
+    Returns ``(symbol, params, aux_params, n_folded)`` where ``params``
+    maps each quantized weight to a :class:`QuantizedTensor` (and
+    passes everything else through); ``quantize=None`` skips the int8
+    step and returns the folded fp params.
+    """
+    from ..passes import apply_convbn_fold
+
+    symbol, arg_params, aux_params, n_folded = apply_convbn_fold(
+        symbol, arg_params, aux_params)
+    if quantize == "int8":
+        arg_params = quantize_params(arg_params, dtype=dtype,
+                                     weight_filter=weight_filter,
+                                     device_put=device_put)
+    return symbol, arg_params, aux_params, n_folded

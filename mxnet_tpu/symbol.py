@@ -146,9 +146,16 @@ class Symbol:
         entries.  Node identity is deliberately NOT part of the key — a
         graph rebuilt from scratch (fresh ``simple_bind`` in tests or
         serving, a re-generated bucket symbol) hashes equal and reuses
-        the already-jitted executables.  Runtime input shapes/dtypes stay
-        out of the key: ``jax.jit`` already caches per-aval under one
-        compiled callable, which is exactly the reuse this enables.
+        the already-jitted executables.  Names are in the key ONLY for
+        variable nodes: they are the bind interface (arg/aux dicts key
+        on them), while internal op-node names are presentation-only —
+        ``_build_graph_fn`` never reads them.  Dropping them means
+        alpha-renamed but identical graphs (fresh gensym suffixes from
+        the NameManager counter across processes or re-generated bucket
+        symbols) hit the program cache instead of recompiling.  Runtime
+        input shapes/dtypes stay out of the key: ``jax.jit`` already
+        caches per-aval under one compiled callable, which is exactly
+        the reuse this enables.
         """
         nodes = self.nodes
         index = {id(n): i for i, n in enumerate(nodes)}
@@ -156,7 +163,7 @@ class Symbol:
         for n in nodes:
             parts.append((
                 n.op or "null",
-                n.name,
+                n.name if n.is_variable else "",
                 n.is_aux,
                 tuple(sorted((k, repr(v)) for k, v in n.attrs.items())),
                 tuple(sorted((k, repr(v)) for k, v in n.extra_attrs.items())),

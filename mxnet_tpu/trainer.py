@@ -133,7 +133,17 @@ class FusedTrainer:
             except Exception:  # noqa: BLE001
                 platform = None
         self._platform = platform
-        self._graph_fn = _build_graph_fn(symbol, platform=platform)
+        # graph-rewrite pipeline (mxnet_tpu.passes; MXTPU_GRAPH_PASSES):
+        # the EXECUTED graph is the rewritten one — fewer traced nodes
+        # per step compile — while self.symbol stays the user-facing
+        # interface (list_arguments/infer_shape/attr_dict all read the
+        # original; passes never rename variables, so the name spaces
+        # agree)
+        from . import passes as _passes
+
+        self._exec_symbol = _passes.apply_graph_passes(symbol)
+        self._graph_fn = _build_graph_fn(self._exec_symbol,
+                                         platform=platform)
         # conv weights stored physically HWIO (filled by init(); see
         # _discover_hwio_params) — logical OIHW at every API boundary
         self._hwio: frozenset = frozenset()
@@ -184,7 +194,8 @@ class FusedTrainer:
             arg_names, arg_shapes, aux_names, aux_shapes)
         if self._hwio:
             self._graph_fn = _build_graph_fn(
-                self.symbol, platform=self._platform, hwio_params=self._hwio)
+                self._exec_symbol, platform=self._platform,
+                hwio_params=self._hwio)
             for name in self._hwio:
                 v = jnp.transpose(self.params[name], (2, 3, 1, 0))
                 if self.mesh is not None:
@@ -225,7 +236,9 @@ class FusedTrainer:
                 or not channels_last_default()):
             return frozenset()
         report = {"conv_w": set(), "other": set()}
-        probe = _build_graph_fn(self.symbol, layout_report=report)
+        # probe the REWRITTEN graph — HWIO safety is about how the
+        # executed graph consumes each weight, not how the user wrote it
+        probe = _build_graph_fn(self._exec_symbol, layout_report=report)
         args = {n: jax.ShapeDtypeStruct(tuple(s), jnp.float32)
                 for n, s in zip(arg_names, arg_shapes)}
         aux = {n: jax.ShapeDtypeStruct(tuple(s), jnp.float32)

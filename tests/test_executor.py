@@ -222,6 +222,48 @@ def test_program_cache_rebind_zero_retraces(_telemetry):
     assert reg.get("executor_compile_total").total() == compiles
 
 
+def test_program_cache_alpha_renamed_graphs_share_entry(_telemetry):
+    """ISSUE-8 satellite: internal op-node names are NOT part of
+    structural_signature — two gensym-renamed copies of the same net
+    (fresh NameManager counters, as across processes or re-generated
+    bucket symbols) share ONE program-cache entry.  Variable names stay
+    in the key: they are the bind interface."""
+    reg = _telemetry
+
+    def build():
+        data = sym.Variable("data")
+        w, b = sym.Variable("ar_weight"), sym.Variable("ar_bias")
+        fc = sym.FullyConnected(data, weight=w, bias=b, num_hidden=4)
+        return sym.SoftmaxOutput(fc, label=sym.Variable("ar_label"),
+                                 name="ar_softmax")
+
+    s1, s2 = build(), build()
+    fc1 = next(n.name for n in s1.nodes if n.op == "FullyConnected")
+    fc2 = next(n.name for n in s2.nodes if n.op == "FullyConnected")
+    assert fc1 != fc2  # genuinely alpha-renamed op nodes...
+    assert s1.structural_signature() == s2.structural_signature()
+
+    ex1 = s1.simple_bind(mx.cpu(), data=(4, 6))
+    ex1.forward(is_train=True)
+    ex1.backward()
+    compiles = reg.get("executor_compile_total").total()
+    hits = reg.get("executor_graph_cache_total").value(result="hit")
+    ex2 = s2.simple_bind(mx.cpu(), data=(4, 6))
+    assert ex2._jit_fwd is ex1._jit_fwd  # ...one cache entry
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert reg.get("executor_graph_cache_total").value(result="hit") == hits + 1
+    assert reg.get("executor_compile_total").total() == compiles
+
+    # variable renames still miss: the bind interface is the key
+    data = sym.Variable("data")
+    s3 = sym.SoftmaxOutput(
+        sym.FullyConnected(data, weight=sym.Variable("other_weight"),
+                           bias=sym.Variable("ar_bias"), num_hidden=4),
+        label=sym.Variable("ar_label"), name="ar_softmax")
+    assert s3.structural_signature() != s1.structural_signature()
+
+
 def test_program_cache_disable_knob(monkeypatch):
     from mxnet_tpu.executor import program_cache_clear
 

@@ -1,0 +1,514 @@
+"""Graph-rewrite pass pipeline (ISSUE 8).
+
+Parity contract: every pass is semantics-preserving — with the pass on,
+forward outputs (and, for training-safe passes, backward gradients)
+match the pass-off graph on real model-zoo symbols.  Plus the pass-
+safety lint: a pass cannot be registered without declaring
+``training_safe`` and appearing by name in this file's parity tests.
+"""
+import pathlib
+import re
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import passes, sym
+from mxnet_tpu import executor as ex_mod
+from mxnet_tpu.base import MXNetError
+
+ALL_GRAPH_PASSES = ["constant_fold", "cse", "dce", "prefuse"]
+
+
+@pytest.fixture
+def _telemetry():
+    from mxnet_tpu import telemetry as tm
+
+    tm.reset()
+    tm.enable()
+    yield tm.get_registry()
+    tm.reset()
+    tm.disable()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    ex_mod.program_cache_clear()
+    yield
+    ex_mod.program_cache_clear()
+
+
+# ---------------------------------------------------------------------------
+# graph builders
+# ---------------------------------------------------------------------------
+def _mixed_net():
+    """A net exercising every graph pass at once: conv stack (layout
+    pass composition), duplicated subexpression (cse), no-op
+    reshape/transpose-pair/identity (dce), elementwise chain (prefuse),
+    and a constant subgraph (constant_fold)."""
+    d = sym.Variable("data")
+    c1 = sym.Convolution(d, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                         name="px_c1")
+    a1 = sym.Activation(c1, act_type="relu", name="px_r1")
+    # transpose pair that cancels + an identity copy
+    t = sym.transpose(a1, axes=(0, 2, 3, 1))
+    t = sym.transpose(t, axes=(0, 3, 1, 2))
+    t = sym.identity(t)
+    # duplicated subexpression for cse
+    dup = t * t + t * t
+    # elementwise chain for prefuse
+    chain = sym.exp(sym.tanh(dup * 0.5 + 1.0))
+    # constant subgraph folded at bind
+    const = sym.ones((2, 4, 8, 8)) * 0.25 + sym.zeros((2, 4, 8, 8))
+    f = sym.Flatten(chain + const, name="px_fl")
+    fc = sym.FullyConnected(f, num_hidden=3, name="px_fc")
+    return sym.SoftmaxOutput(fc, label=sym.Variable("softmax_label"),
+                             name="softmax"), {"data": (2, 3, 8, 8),
+                                               "softmax_label": (2,)}
+
+
+def _model_zoo(name):
+    from mxnet_tpu import models
+
+    if name == "resnet":
+        net = models.get_symbol("resnet-18", num_classes=10,
+                                image_shape=(3, 32, 32))
+        return net, {"data": (1, 3, 32, 32), "softmax_label": (1,)}
+    if name == "inception_bn":
+        net = models.get_symbol("inception-bn", num_classes=10,
+                                image_shape=(3, 32, 32))
+        return net, {"data": (1, 3, 32, 32), "softmax_label": (1,)}
+    if name == "lstm":
+        from mxnet_tpu.models.lstm import lstm_unroll
+
+        net = lstm_unroll(1, 4, 30, 8, 8, 30, dropout=0.0)
+        return net, {"data": (2, 4), "softmax_label": (2, 4),
+                     "l0_init_c": (2, 8), "l0_init_h": (2, 8)}
+    raise AssertionError(name)
+
+
+def _fill(ex, shapes, seed=7):
+    """Deterministic by-name fill so pass-on and pass-off binds see the
+    same values."""
+    rng = np.random.RandomState(seed)
+    for k in sorted(ex.arg_dict):
+        v = ex.arg_dict[k]
+        if k == "data" and len(v.shape) == 2:  # token ids (lstm)
+            v[:] = rng.randint(0, 30, v.shape).astype(np.float32)
+        elif k == "softmax_label":
+            v[:] = rng.randint(0, 3, v.shape).astype(np.float32)
+        else:
+            v[:] = rng.uniform(-0.5, 0.5, v.shape).astype(np.float32)
+    for k in sorted(ex.aux_dict):
+        v = ex.aux_dict[k]
+        if "var" in k:
+            v[:] = rng.uniform(0.5, 1.5, v.shape).astype(np.float32)
+        else:
+            v[:] = rng.uniform(-0.2, 0.2, v.shape).astype(np.float32)
+
+
+def _run(net, shapes, passes_env, monkeypatch, train=True, seed=7):
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", passes_env)
+    ex_mod.program_cache_clear()
+    ex = net.simple_bind(mx.cpu(), grad_req="write" if train else "null",
+                         **shapes)
+    _fill(ex, shapes, seed)
+    out = ex.forward(is_train=train)
+    if not train:
+        return [o.asnumpy() for o in out], {}
+    ex.backward()
+    outs = [o.asnumpy() for o in ex.outputs]
+    grads = {k: g.asnumpy() for k, g in ex.grad_dict.items()
+             if g is not None and k not in ("data", "softmax_label")}
+    return outs, grads
+
+
+def _assert_parity(ref, got, atol=2e-4):
+    ro, rg = ref
+    go, gg = got
+    assert len(ro) == len(go)
+    for a, b in zip(ro, go):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=atol)
+    assert sorted(rg) == sorted(gg)
+    for k in rg:
+        np.testing.assert_allclose(rg[k], gg[k], rtol=1e-3, atol=atol,
+                                   err_msg=f"grad {k}")
+
+
+# ---------------------------------------------------------------------------
+# per-pass parity (fwd AND bwd — all four graph passes are training-safe)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("pass_name", ALL_GRAPH_PASSES)
+def test_single_pass_parity_fwd_bwd(pass_name, monkeypatch):
+    net, shapes = _mixed_net()
+    ref = _run(net, shapes, "off", monkeypatch)
+    got = _run(net, shapes, pass_name, monkeypatch)
+    _assert_parity(ref, got)
+
+
+@pytest.mark.parametrize("model", ["resnet", "inception_bn", "lstm"])
+def test_full_pipeline_parity_model_zoo(model, monkeypatch):
+    """Whole default pipeline vs pass-off on model-zoo symbols: forward
+    outputs and parameter gradients agree."""
+    net, shapes = _model_zoo(model)
+    ref = _run(net, shapes, "off", monkeypatch)
+    got = _run(net, shapes, "default", monkeypatch)
+    _assert_parity(ref, got, atol=5e-4)
+
+
+def test_passes_off_bit_identical(monkeypatch):
+    """MXTPU_GRAPH_PASSES=0 restores pass-off numerics bit-identically:
+    two pass-off binds agree bitwise (the rewrite layer is fully out of
+    the path, not merely approximately disabled)."""
+    net, shapes = _mixed_net()
+    a = _run(net, shapes, "0", monkeypatch)
+    b = _run(net, shapes, "0", monkeypatch)
+    for x, y in zip(a[0], b[0]):
+        np.testing.assert_array_equal(x, y)
+    for k in a[1]:
+        np.testing.assert_array_equal(a[1][k], b[1][k])
+
+
+# ---------------------------------------------------------------------------
+# structural effects
+# ---------------------------------------------------------------------------
+def test_constant_fold_bakes_literal(monkeypatch):
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "constant_fold")
+    net, _ = _mixed_net()
+    before = passes.op_node_count(net)
+    out = passes.apply_graph_passes(net)
+    ops_after = [n.op for n in out.nodes if not n.is_variable]
+    assert "_literal" in ops_after
+    assert "_zeros" not in ops_after and "_ones" not in ops_after
+    assert passes.op_node_count(out) < before
+
+
+def test_prefuse_collapses_chain(monkeypatch):
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "prefuse")
+    d = sym.Variable("data")
+    chain = sym.exp(sym.tanh(sym.sqrt(d * 2.0) + 1.0))
+    out = passes.apply_graph_passes(chain)
+    ops_after = [n.op for n in out.nodes if not n.is_variable]
+    assert ops_after == ["_fused_elemwise"]
+
+
+def test_dce_cancels_transpose_pair_and_identity(monkeypatch):
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "dce")
+    d = sym.Variable("data")
+    t = sym.transpose(sym.transpose(d, axes=(0, 2, 3, 1)),
+                      axes=(0, 3, 1, 2))
+    out = passes.apply_graph_passes(sym.identity(t) + d)
+    ops_after = [n.op for n in out.nodes if not n.is_variable]
+    assert "transpose" not in ops_after and "_copy" not in ops_after
+
+
+def test_cse_merges_duplicate_subexpression(monkeypatch):
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "cse")
+    a, b = sym.Variable("a"), sym.Variable("b")
+    out = passes.apply_graph_passes(a * b + a * b)
+    muls = [n for n in out.nodes if n.op == "elemwise_mul"]
+    assert len(muls) == 1
+
+
+def test_cse_never_merges_rng_ops(monkeypatch):
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "cse")
+    d = sym.Variable("data")
+    net = sym.Dropout(d, p=0.5) + sym.Dropout(d, p=0.5)
+    out = passes.apply_graph_passes(net)
+    drops = [n for n in out.nodes if n.op == "Dropout"]
+    assert len(drops) == 2  # two independent masks must stay independent
+
+
+def test_env_selection_and_unknown_name(monkeypatch):
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "cse,dce")
+    assert passes.enabled_passes() == ["cse", "dce"]
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "0")
+    assert passes.enabled_passes() == []
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "nope")
+    with pytest.raises(MXNetError):
+        passes.enabled_passes()
+
+
+# ---------------------------------------------------------------------------
+# program-cache interaction (cache keys on the POST-pass signature)
+# ---------------------------------------------------------------------------
+def test_equivalent_graphs_share_one_cache_entry(_telemetry, monkeypatch):
+    """Differently-written but equivalent graphs converge: a duplicated
+    subexpression (CSE-able) and its shared-subexpression form rewrite
+    to the same structure, so the second bind is a cache hit with zero
+    fresh traces."""
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "default")
+    reg = _telemetry
+    a, b = sym.Variable("a"), sym.Variable("b")
+    g1 = sym.identity(a * b) + (a * b)   # duplicated + a no-op identity
+    m = a * b
+    g2 = m + m                           # shared subexpression
+    ex1 = g1.simple_bind(mx.cpu(), grad_req="null", a=(2, 3), b=(2, 3))
+    ex1.forward(is_train=False)
+    compiles = reg.get("executor_compile_total").total()
+    hits = reg.get("executor_graph_cache_total").value(result="hit")
+    ex2 = g2.simple_bind(mx.cpu(), grad_req="null", a=(2, 3), b=(2, 3))
+    assert ex2._jit_fwd is ex1._jit_fwd
+    ex2.forward(is_train=False)
+    assert reg.get("executor_graph_cache_total").value(result="hit") == hits + 1
+    assert reg.get("executor_compile_total").total() == compiles
+
+
+def test_zero_recompiles_after_warmup_with_passes(_telemetry, monkeypatch):
+    """Equal-structure rebinds of pass-rewritten graphs still do zero
+    retraces (ISSUE 8 acceptance): warm bind+forward, rebind a fresh
+    equal-structure symbol, compile counter stays flat."""
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "default")
+    reg = _telemetry
+    net1, shapes = _mixed_net()
+    ex1 = net1.simple_bind(mx.cpu(), grad_req="write", **shapes)
+    _fill(ex1, shapes)
+    ex1.forward(is_train=True)
+    ex1.backward()
+    compiles = reg.get("executor_compile_total").total()
+    net2, _ = _mixed_net()  # fresh gensym names, equal structure
+    ex2 = net2.simple_bind(mx.cpu(), grad_req="write", **shapes)
+    _fill(ex2, shapes)
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert reg.get("executor_compile_total").total() == compiles
+
+
+# ---------------------------------------------------------------------------
+# inference-mode Conv+BN folding ("convbn_fold")
+# ---------------------------------------------------------------------------
+def _convbn_net():
+    d = sym.Variable("data")
+    c1 = sym.Convolution(d, num_filter=6, kernel=(3, 3), pad=(1, 1),
+                         name="q_c1")
+    b1 = sym.BatchNorm(c1, fix_gamma=False, eps=2e-5, name="q_b1")
+    a1 = sym.Activation(b1, act_type="relu", name="q_r1")
+    c2 = sym.Convolution(a1, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                         no_bias=True, name="q_c2")
+    b2 = sym.BatchNorm(c2, name="q_b2")  # fix_gamma default True
+    f = sym.Flatten(b2, name="q_fl")
+    fc = sym.FullyConnected(f, num_hidden=3, name="q_fc")
+    return sym.SoftmaxOutput(fc, label=sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _convbn_params(net, seed=3):
+    rng = np.random.RandomState(seed)
+    ex = net.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 8, 8))
+    args, auxs = {}, {}
+    for k, v in ex.arg_dict.items():
+        if k in ("data", "softmax_label"):
+            continue
+        args[k] = mx.nd.array(
+            rng.uniform(-0.5, 0.5, v.shape).astype(np.float32))
+    for k, v in ex.aux_dict.items():
+        if "var" in k:
+            auxs[k] = mx.nd.array(
+                rng.uniform(0.5, 1.5, v.shape).astype(np.float32))
+        else:
+            auxs[k] = mx.nd.array(
+                rng.uniform(-0.2, 0.2, v.shape).astype(np.float32))
+    return args, auxs
+
+
+def test_convbn_fold_predictor_parity(_telemetry, monkeypatch):
+    """convbn_fold parity: the folded Predictor matches the unfolded
+    (MXTPU_GRAPH_PASSES=0) float path, both BatchNorms leave the graph
+    (a no_bias conv gains a bias), and the telemetry counter records
+    the folds."""
+    from mxnet_tpu.predict import Predictor
+
+    net = _convbn_net()
+    args, auxs = _convbn_params(net)
+    x = np.random.RandomState(11).uniform(
+        -1, 1, (2, 3, 8, 8)).astype(np.float32)
+
+    reg = _telemetry
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "default")
+    p_fold = Predictor(symbol=net, arg_params=dict(args),
+                       aux_params=dict(auxs),
+                       input_shapes={"data": (2, 3, 8, 8)})
+    assert p_fold._n_bn_folded == 2
+    assert reg.get("graph_pass_convbn_folded_total").total() == 2
+    folded_ops = [n.op for n in p_fold.symbol.nodes if not n.is_variable]
+    assert "BatchNorm" not in folded_ops
+    assert "q_c2_bias" in p_fold.symbol.list_arguments()
+    p_fold.forward(data=x)
+    out_fold = p_fold.get_output(0)
+
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "0")
+    ex_mod.program_cache_clear()
+    p_raw = Predictor(symbol=net, arg_params=dict(args),
+                      aux_params=dict(auxs),
+                      input_shapes={"data": (2, 3, 8, 8)})
+    assert p_raw._n_bn_folded == 0
+    p_raw.forward(data=x)
+    out_raw = p_raw.get_output(0)
+    np.testing.assert_allclose(out_fold, out_raw, rtol=1e-4, atol=1e-4)
+
+
+def test_convbn_fold_skips_shared_activations():
+    """A conv whose output feeds MORE than the BN must not fold — the
+    other consumer observes pre-BN activations."""
+    d = sym.Variable("data")
+    c = sym.Convolution(d, num_filter=4, kernel=(1, 1), name="s_c")
+    b = sym.BatchNorm(c, name="s_b")
+    net = sym.Group([b, sym.Activation(c, act_type="relu")])
+    ex = net.simple_bind(mx.cpu(), grad_req="null", data=(1, 3, 4, 4))
+    args = {k: mx.nd.array(np.ones(v.shape, np.float32))
+            for k, v in ex.arg_dict.items() if k != "data"}
+    auxs = {k: mx.nd.array(np.ones(v.shape, np.float32))
+            for k, v in ex.aux_dict.items()}
+    out, new_args, new_auxs, n = passes.fold_conv_bn(net, args, auxs)
+    assert n == 0
+    assert sorted(new_args) == sorted(args)
+
+
+def test_convbn_fold_runs_before_int8_scales(monkeypatch):
+    """serving e2e ordering: prepare_inference_params quantizes the
+    FOLDED weights — the dequantized conv kernel reconstructs W*scale
+    (not the raw checkpoint W), and the per-channel scales differ from
+    scales of the unfolded weight wherever BN rescales a channel."""
+    from mxnet_tpu.serving.quantize import (QuantizedTensor,
+                                            prepare_inference_params,
+                                            quantize_per_channel)
+
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "default")
+    net = _convbn_net()
+    args, auxs = _convbn_params(net)
+    fsym, fargs, faux, n = passes.fold_conv_bn(net, args, auxs)
+    assert n == 2
+    qsym, qparams, qaux, qn = prepare_inference_params(
+        net, args, auxs, quantize="int8", device_put=False)
+    assert qn == 2
+    qt = qparams["q_c1_weight"]
+    assert isinstance(qt, QuantizedTensor)
+    folded_w = fargs["q_c1_weight"].asnumpy()
+    deq = np.asarray(qt.q, np.float32) * np.asarray(qt.scale, np.float32)
+    np.testing.assert_allclose(deq, folded_w,
+                               atol=np.abs(folded_w).max() / 127 + 1e-7)
+    _, raw_scale = quantize_per_channel(args["q_c1_weight"].asnumpy())
+    assert not np.allclose(np.asarray(qt.scale), raw_scale)
+
+
+def test_int8_of_folded_net_matches_unfolded_float(monkeypatch):
+    """serving e2e: int8 quantization of a BN-folded net stays within
+    the established int8 tolerance (test_predict uses 0.02) of the
+    UNFOLDED float path."""
+    from mxnet_tpu.predict import Predictor
+
+    net = _convbn_net()
+    args, auxs = _convbn_params(net)
+    x = np.random.RandomState(5).uniform(
+        -1, 1, (2, 3, 8, 8)).astype(np.float32)
+
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "0")
+    p_float = Predictor(symbol=net, arg_params=dict(args),
+                        aux_params=dict(auxs),
+                        input_shapes={"data": (2, 3, 8, 8)})
+    p_float.forward(data=x)
+    out_float = p_float.get_output(0)
+
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "default")
+    ex_mod.program_cache_clear()
+    p8 = Predictor(symbol=net, arg_params=dict(args), aux_params=dict(auxs),
+                   input_shapes={"data": (2, 3, 8, 8)}, quantize="int8")
+    assert p8._n_bn_folded == 2
+    assert any(k.endswith("weight") for k in p8._qparams)
+    p8.forward(data=x)
+    out8 = p8.get_output(0)
+    np.testing.assert_allclose(out8.sum(axis=1), 1.0, atol=1e-3)
+    np.testing.assert_allclose(out8, out_float, atol=0.02)
+
+
+def test_convbn_fold_model_zoo_counts(monkeypatch):
+    """Acceptance: on ResNet-50 / inception_bn inference binds the fold
+    actually fires (counter > 0), and the folded inception predictor
+    matches the unfolded float path."""
+    from mxnet_tpu import models, telemetry as tm
+    from mxnet_tpu.predict import Predictor
+
+    tm.reset()
+    tm.enable()
+    try:
+        # resnet-50: pre-activation units still contain interior
+        # conv->bn pairs (bn2(conv1), bn3(conv2)); fold without a
+        # forward (structure + values only)
+        rnet = models.get_symbol("resnet-50", num_classes=10,
+                                 image_shape=(3, 32, 32))
+        rex = rnet.simple_bind(mx.cpu(), grad_req="null",
+                               data=(1, 3, 32, 32))
+        rng = np.random.RandomState(1)
+        rargs = {k: mx.nd.array(rng.uniform(-0.1, 0.1, v.shape)
+                                .astype(np.float32))
+                 for k, v in rex.arg_dict.items()
+                 if k not in ("data", "softmax_label")}
+        rauxs = {k: mx.nd.array(
+                    (rng.uniform(0.5, 1.5, v.shape) if "var" in k
+                     else rng.uniform(-0.1, 0.1, v.shape))
+                    .astype(np.float32))
+                 for k, v in rex.aux_dict.items()}
+        _, _, _, n_res = passes.fold_conv_bn(rnet, rargs, rauxs)
+        assert n_res > 0
+
+        inet = models.get_symbol("inception-bn", num_classes=10,
+                                 image_shape=(3, 32, 32))
+        iex = inet.simple_bind(mx.cpu(), grad_req="null",
+                               data=(1, 3, 32, 32))
+        iargs = {k: mx.nd.array(rng.uniform(-0.1, 0.1, v.shape)
+                                .astype(np.float32))
+                 for k, v in iex.arg_dict.items()
+                 if k not in ("data", "softmax_label")}
+        iauxs = {k: mx.nd.array(
+                    (rng.uniform(0.5, 1.5, v.shape) if "var" in k
+                     else rng.uniform(-0.1, 0.1, v.shape))
+                    .astype(np.float32))
+                 for k, v in iex.aux_dict.items()}
+        x = rng.uniform(-1, 1, (1, 3, 32, 32)).astype(np.float32)
+
+        monkeypatch.setenv("MXTPU_GRAPH_PASSES", "default")
+        ex_mod.program_cache_clear()
+        reg = tm.get_registry()
+        before = reg.get("graph_pass_convbn_folded_total").total()
+        p_fold = Predictor(symbol=inet, arg_params=dict(iargs),
+                           aux_params=dict(iauxs),
+                           input_shapes={"data": (1, 3, 32, 32)})
+        assert p_fold._n_bn_folded > 0
+        assert reg.get("graph_pass_convbn_folded_total").total() > before
+        p_fold.forward(data=x)
+        out_fold = p_fold.get_output(0)
+
+        monkeypatch.setenv("MXTPU_GRAPH_PASSES", "0")
+        ex_mod.program_cache_clear()
+        p_raw = Predictor(symbol=inet, arg_params=dict(iargs),
+                          aux_params=dict(iauxs),
+                          input_shapes={"data": (1, 3, 32, 32)})
+        p_raw.forward(data=x)
+        np.testing.assert_allclose(out_fold, p_raw.get_output(0),
+                                   rtol=1e-3, atol=2e-4)
+    finally:
+        tm.reset()
+        tm.disable()
+
+
+# ---------------------------------------------------------------------------
+# pass-safety lint (ISSUE 8 satellite): no pass lands unverified
+# ---------------------------------------------------------------------------
+def test_pass_safety_lint():
+    """Every registered pass declares training_safe as a real bool and
+    is referenced by name in this parity suite, so a future pass
+    cannot land without a parity test."""
+    src = pathlib.Path(__file__).read_text()
+    assert passes.PASSES, "pass registry is empty"
+    for name, p in passes.PASSES.items():
+        assert isinstance(p.training_safe, bool), (
+            f"pass {name!r} must declare training_safe as a bool")
+        refs = re.findall(rf'"{re.escape(name)}"', src)
+        assert refs, (
+            f"pass {name!r} has no parity test referencing it by name "
+            f"in tests/test_passes.py")
+    # the pipeline entry point skips inference-only passes on training
+    # binds: convbn_fold is registered training-unsafe
+    assert passes.PASSES["convbn_fold"].training_safe is False
+    for name in ALL_GRAPH_PASSES:
+        assert passes.PASSES[name].training_safe is True
