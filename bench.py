@@ -724,6 +724,75 @@ def _serve_micro():
             tm.disable()
 
 
+def _sparse_micro():
+    """Row-sparse embedding-update micro-bench (round 13): the fused
+    sparse bucket (touched-rows-only jitted update, kvstore_fused +
+    sparse.py) vs the dense-gradient path on a table whose row count
+    dwarfs one batch's lookups — the regime where the dense scatter
+    plus full-table optimizer sweep is the step bottleneck.
+
+    Both sides run the same Module-path kvstore step (one batched push)
+    with the same Adam state; the dense side is fed ``todense()`` of
+    the identical row-sparse gradient, so the arithmetic being timed is
+    equivalent.  Emits the ISSUE-9 acceptance ratio
+    (``sparse_update_speedup`` >= 3 on this table), the touched-row
+    fraction, and sustained touched-rows-per-second through the sparse
+    path."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sparse
+
+    rows = int(os.environ.get("BENCH_SPARSE_ROWS", "300000"))
+    dim = int(os.environ.get("BENCH_SPARSE_DIM", "64"))
+    lookups = int(os.environ.get("BENCH_SPARSE_LOOKUPS", "4096"))
+    rng = np.random.RandomState(11)
+    table = rng.uniform(-1, 1, (rows, dim)).astype(np.float32)
+    idx_steps = [rng.randint(0, rows, lookups).astype(np.int32)
+                 for _ in range(8)]
+    val_steps = [rng.uniform(-1, 1, (lookups, dim)).astype(np.float32)
+                 for _ in range(8)]
+    uniq = np.mean([np.unique(i).size for i in idx_steps])
+
+    def run(sparse_grads):
+        kv = mx.kv.create("local")
+        kv.set_optimizer(mx.optimizer.create(
+            "adam", learning_rate=0.05, rescale_grad=1.0 / lookups))
+        init = sparse.full_row_sparse(nd.array(table)) if sparse_grads \
+            else nd.array(table)
+        kv.init(0, init)
+        grads = []
+        for i, v in zip(idx_steps, val_steps):
+            g = sparse.RowSparseNDArray(nd.NDArray(i), nd.NDArray(v),
+                                        (rows, dim))
+            grads.append([g] if sparse_grads else [g.todense()])
+
+        def step(n):
+            kv.push([0], grads[n % len(grads)])
+
+        for w in range(3):
+            step(w)
+        jax.block_until_ready(kv._store[0]._read())
+        n = 20
+        tic = time.perf_counter()
+        for s in range(n):
+            step(s)
+        jax.block_until_ready(kv._store[0]._read())
+        return (time.perf_counter() - tic) / n
+
+    dense_dt = run(False)
+    sparse_dt = run(True)
+    return {
+        "sparse_update_us_per_step": round(sparse_dt * 1e6, 1),
+        "sparse_update_us_per_step_dense": round(dense_dt * 1e6, 1),
+        "sparse_update_speedup": round(dense_dt / max(sparse_dt, 1e-9), 1),
+        "sparse_touched_row_fraction": round(float(uniq) / rows, 5),
+        "embedding_rows_per_sec": round(uniq / max(sparse_dt, 1e-9)),
+        "sparse_table_rows": rows,
+    }
+
+
 def _passes_micro():
     """Graph-rewrite pipeline micro-bench (round 12): bind/trace cost
     and node count with MXTPU_GRAPH_PASSES off vs on, per-pass node
@@ -1185,6 +1254,14 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
             # (ISSUE 8)
             if os.environ.get("BENCH_PASSES", "1") == "1":
                 for k_, v_ in _passes_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # row-sparse embedding update: touched-rows-only fused
+            # bucket vs the dense-gradient scatter path (ISSUE 9)
+            if os.environ.get("BENCH_SPARSE", "1") == "1":
+                for k_, v_ in _sparse_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

@@ -38,6 +38,9 @@ from . import ops
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import sparse
+from .sparse import RowSparseNDArray
+ndarray.sparse = sparse  # reference surface: mx.nd.sparse.row_sparse_array
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol, Variable, Group

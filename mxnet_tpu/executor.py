@@ -215,7 +215,7 @@ def _compiled_programs(symbol: Symbol, platform: Optional[str],
     jit_fwd = jax.jit(_count_traces(graph_fn, "fwd"), static_argnums=(3,))
     jit_fwdbwd = jax.jit(
         _count_traces(_make_fwdbwd(graph_fn, placed=False), "fwdbwd"),
-        static_argnames=("gnames", "add_names"))
+        static_argnames=("gnames", "add_names", "rs_specs"))
     entry = (graph_fn, jit_fwd, jit_fwdbwd)
     if key is not None:
         with _program_cache_lock:
@@ -405,6 +405,17 @@ def _build_graph_fn(symbol: Symbol, channels_last: Optional[bool] = None,
         channels_last = channels_last_default()
     out_entries = list(symbol._outputs)
     topo = _topo_order([n for n, _ in out_entries])
+    # row-sparse-gradient Embedding nodes (sparse.rs_plan): evaluated
+    # inline so (a) an optional zero "probe" rides on the gathered rows
+    # — its vjp cotangent IS the per-row gradient, no dense scatter into
+    # the table — and (b) the looked-up ids surface through new_aux for
+    # the fwdbwd wrapper's in-trace unique-row segment-sum.  Probe-less
+    # calls compute exactly what the Embedding op computes (clip + take),
+    # so fwd-only paths and MXTPU_SPARSE_UPDATE=0 are bit-identical.
+    from . import sparse as _sparse
+
+    rs_nodes = {id(node): wname
+                for wname, node in _sparse.rs_plan(symbol).items()}
 
     def fn(arg_vals: Dict, aux_vals: Dict, key, is_train: bool):
         env = {}
@@ -416,6 +427,25 @@ def _build_graph_fn(symbol: Symbol, channels_last: Optional[bool] = None,
                     env[id(node)] = (aux_vals[node.name],)
                 else:
                     env[id(node)] = (arg_vals[node.name],)
+                continue
+            rsw = rs_nodes.get(id(node))
+            if rsw is not None:
+                data = env[id(node.inputs[0][0])][node.inputs[0][1]]
+                w = env[id(node.inputs[1][0])][node.inputs[1][1]]
+                if lay is not None:
+                    if lay.get((id(node.inputs[0][0]), node.inputs[0][1])):
+                        data = _to_nchw(data)
+                    if lay.get((id(node.inputs[1][0]), node.inputs[1][1])):
+                        w = _to_nchw(w)
+                idx = jnp.clip(data.astype(jnp.int32), 0, w.shape[0] - 1)
+                out = jnp.take(w, idx, axis=0)
+                probe = arg_vals.get("__rs_probe__:" + rsw)
+                if probe is not None:
+                    out = out + probe.reshape(out.shape).astype(out.dtype)
+                env[id(node)] = (out,)
+                if lay is not None:
+                    lay[(id(node), 0)] = False
+                new_aux["__rs_idx__:" + rsw] = idx.reshape(-1)
                 continue
             new_aux.update(_eval_node(node, i, env, key, is_train, lay,
                                       platform, hwio_params, layout_report))
@@ -671,6 +701,16 @@ def _build_placed_fn(symbol: Symbol, node_ctx, var_ctx, default_ctx):
     return fn
 
 
+def _zero_cotangent(x):
+    """Zero cotangent for an aux leaf: floats get zeros_like; integer/
+    bool leaves (the row-sparse path's looked-up ids riding in new_aux)
+    take jax's float0 convention — an int-dtyped zero would be rejected
+    by the vjp."""
+    if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+        return jnp.zeros_like(x)
+    return np.zeros(np.shape(x), jax.dtypes.float0)
+
+
 def _make_fwdbwd(graph_fn, placed: bool):
     """Build the fused fwd+bwd evaluator over ``graph_fn``.
 
@@ -684,10 +724,18 @@ def _make_fwdbwd(graph_fn, placed: bool):
     the cotangents are built in-trace from the forward outputs — a
     loss-graph backward() therefore costs no per-call jax.eval_shape and
     no extra host dispatches for the seed arrays.
+
+    ``rs_specs`` (static) lists the row-sparse-gradient embedding
+    weights as ``(name, n_ids, row_dim, dtype)``: each gets an in-trace
+    zero probe differentiated INSTEAD of the table itself, and its
+    cotangent — the per-lookup gradient rows — is coalesced by the
+    in-trace unique-row segment-sum into the ``(indices, values)`` pair
+    returned as that weight's gradient.  The dense scatter into the
+    full table never happens.
     """
 
     def fwdbwd(arg_vals, aux_vals, key, head_grads, grad_ins,
-               gnames: tuple, add_names: tuple):
+               gnames: tuple, add_names: tuple, rs_specs: tuple = ()):
         def fwd_for_grad(grad_args):
             merged = dict(arg_vals)
             merged.update(grad_args)
@@ -695,6 +743,12 @@ def _make_fwdbwd(graph_fn, placed: bool):
             return outs, new_aux
 
         grad_args = {k: arg_vals[k] for k in gnames}
+        for wname, n_ids, row_dim, dt in rs_specs:
+            # zero probe built in-trace (XLA folds it): the graph fn
+            # adds it onto the gathered rows, so d out/d probe is the
+            # row gradient — shape-stable at n_ids slots
+            grad_args["__rs_probe__:" + wname] = jnp.zeros(
+                (n_ids, row_dim), jnp.dtype(dt))
         (outs, new_aux), vjp_fn = jax.vjp(
             lambda ga: fwd_for_grad(ga), grad_args, has_aux=False
         )
@@ -712,9 +766,18 @@ def _make_fwdbwd(graph_fn, placed: bool):
                 jax.device_put(h, next(iter(o.devices())))
                 for h, o in zip(head_grads, outs)
             ]
-        # cotangent: (outputs_cot, aux_cot=zeros)
-        aux_cot = jax.tree_util.tree_map(jnp.zeros_like, new_aux)
+        # cotangent: (outputs_cot, aux_cot=zeros; float0 for int leaves)
+        aux_cot = jax.tree_util.tree_map(_zero_cotangent, new_aux)
         (grads,) = vjp_fn((list(head_grads), aux_cot))
+        if rs_specs:
+            from . import sparse as _sparse
+
+            grads = dict(grads)
+            for wname, n_ids, row_dim, dt in rs_specs:
+                vals = grads.pop("__rs_probe__:" + wname)
+                ids = new_aux["__rs_idx__:" + wname]
+                sid, gvals, _first = _sparse.coalesce_rows(ids, vals)
+                grads[wname] = (sid, gvals)
         if add_names:
             grads = dict(grads)
             for k in add_names:
@@ -821,7 +884,11 @@ class Executor:
             for name, sh in self._shardings.items():
                 for store in (self.arg_dict, self.aux_dict, self.grad_dict):
                     arr = store.get(name)
-                    if arr is None:
+                    if arr is None or getattr(arr, "stype",
+                                              "default") != "default":
+                        # a row-sparse grad holder has no dense buffer
+                        # to place; its (indices, values) land sharded
+                        # by the backward program itself
                         continue
                     raw = arr._read()
                     tgt = _fit_sharding_rank(sh, raw.ndim)
@@ -846,12 +913,23 @@ class Executor:
             if self._placed:
                 self._plan = (node_dev, var_dev)
         self._grad_names = [k for k in arg_names if self.grad_req.get(k) != "null"]
+        # row-sparse gradient emission: args whose grad buffer is a
+        # RowSparseNDArray holder (simple_bind allocates them for
+        # grad_stype="row_sparse" variables when MXTPU_SPARSE_UPDATE is
+        # on) leave the vjp'd name set and get probe specs instead
+        rs_holders = sorted(
+            k for k, g in self.grad_dict.items()
+            if getattr(g, "stype", "default") == "row_sparse")
+        self._rs_specs = self._build_rs_specs(symbol, rs_holders) \
+            if rs_holders else ()
+        rs_set = {s[0] for s in self._rs_specs}
         # static arguments of the fused fwd+bwd program: which args need
         # grads, and which of those accumulate (grad_req="add") INSIDE the
         # compiled program — fixed at bind time, so precomputed once
-        self._gnames = tuple(self._grad_names)
+        self._gnames = tuple(k for k in self._grad_names if k not in rs_set)
         self._add_names = tuple(
-            k for k in self._grad_names if self.grad_req.get(k) == "add")
+            k for k in self._grad_names
+            if self.grad_req.get(k) == "add" and k not in rs_set)
         if self._placed:
             self._graph_fn = _build_placed_fn(symbol, node_dev, var_dev, self._ctx)
             # segments carry their own jits; the outer pipeline must stay
@@ -889,6 +967,45 @@ class Executor:
         # non-CPU backends
         self._program_label = self._record_bind_memory()
         self._mem_analyzed = False
+
+    def _build_rs_specs(self, symbol, rs_holders):
+        """Static ``(name, n_ids, row_dim, dtype)`` probe specs for the
+        fused fwd+bwd program, one per row-sparse grad holder.  The id
+        count comes from the Embedding node's data-input shape under the
+        bound arg shapes, so the spec (and the compiled program) is
+        fixed per bind like every other shape."""
+        from . import sparse as _sparse
+
+        if self._placed:
+            raise MXNetError(
+                "row_sparse gradients are not supported with ctx_group "
+                "Context placement; use mesh PartitionSpec placement or "
+                "dense gradients")
+        plan = _sparse.rs_plan(symbol)
+        known = {k: v.shape for k, v in self.arg_dict.items()}
+        shapes, _ = symbol._infer(known, {}, partial=True)
+        specs = []
+        for wname in rs_holders:
+            node = plan.get(wname)
+            w_arr = self.arg_dict.get(wname)
+            if node is None or w_arr is None \
+                    or self.grad_req.get(wname) != "write":
+                raise MXNetError(
+                    f"bind: arg {wname!r} has a row_sparse gradient "
+                    "buffer but is not the sole weight of one Embedding "
+                    "op with grad_req='write'; bind a dense gradient "
+                    "instead")
+            src, oidx = node.inputs[0]
+            dshape = shapes.get((src.name, "var")) if src.is_variable \
+                else shapes.get((id(src), oidx))
+            if dshape is None or len(w_arr.shape) != 2:
+                raise MXNetError(
+                    f"bind: cannot infer the lookup shape feeding "
+                    f"Embedding weight {wname!r}")
+            specs.append((wname, int(np.prod(dshape)),
+                          int(w_arr.shape[1]),
+                          np.dtype(w_arr.dtype).name))
+        return tuple(specs)
 
     def _record_bind_memory(self):
         try:
@@ -1058,7 +1175,8 @@ class Executor:
         try:
             outs, new_aux, grads = self._jit_fwdbwd(
                 args, aux, key, head, grad_ins,
-                gnames=self._gnames, add_names=self._add_names
+                gnames=self._gnames, add_names=self._add_names,
+                rs_specs=self._rs_specs
             )
         except Exception as e:  # noqa: BLE001 — OOM gets a report
             _tm.health.reraise_if_oom(e, site="executor.backward")
@@ -1069,6 +1187,11 @@ class Executor:
             req = self.grad_req.get(k, "null")
             tgt = self.grad_dict.get(k)
             if tgt is None or req == "null":
+                continue
+            if isinstance(g, tuple):
+                # row-sparse emission: the coalesced (indices, values)
+                # pair rebinds the holder's storage — no dense buffer
+                tgt._set_rows(*g)
                 continue
             # grad_req="add" was already accumulated inside the compiled
             # program (grad_ins); every req lands with a plain write
@@ -1213,12 +1336,28 @@ def simple_bind(symbol: Symbol, ctx=None, grad_req="write", type_dict=None,
         req = dict(zip(arg_names, grad_req))
     else:
         req = {k: grad_req.get(k, "null") for k in arg_names}
-    grads = {
-        k: NDArray(jnp.zeros(dict(zip(arg_names, arg_shapes))[k],
-                             dtype=_dtype(k)),
-                   ctx=var_ctx.get(k, ctx))
-        for k in arg_names
-        if req.get(k, "null") != "null"
-    }
+    # grad_stype="row_sparse" variables (threaded through the symbol's
+    # __grad_stype__ annotation) get a RowSparseNDArray holder instead
+    # of a table-sized dense buffer — the backward rebinds it with the
+    # coalesced (indices, values) pair each step.  MXTPU_SPARSE_UPDATE=0
+    # keeps dense buffers (and thereby the dense scatter) bit-identically.
+    from . import sparse as _sparse
+
+    rs_grad_names = set()
+    if _sparse.sparse_update_enabled() and _sparse.annotated_rs_names(symbol):
+        rs_grad_names = {name for name in _sparse.rs_plan(symbol)
+                         if req.get(name) == "write"}
+    shape_of = dict(zip(arg_names, arg_shapes))
+    grads = {}
+    for k in arg_names:
+        if req.get(k, "null") == "null":
+            continue
+        if k in rs_grad_names:
+            grads[k] = _sparse.zeros("row_sparse", shape_of[k],
+                                     ctx=var_ctx.get(k, ctx),
+                                     dtype=_dtype(k))
+        else:
+            grads[k] = NDArray(jnp.zeros(shape_of[k], dtype=_dtype(k)),
+                               ctx=var_ctx.get(k, ctx))
     return Executor(symbol, ctx, args, grads, req, aux, group2ctx=group2ctx,
                     shared_exec=shared_exec, shardings=shardings)

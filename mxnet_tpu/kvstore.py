@@ -92,6 +92,10 @@ class KVStore:
     def __init__(self, kv_type="local"):
         self.type = kv_type
         self._store: Dict = {}
+        # per-key storage type fixed at init ("default"/"row_sparse"):
+        # a push whose value stype disagrees raises instead of silently
+        # training the wrong math (docs/sparse.md)
+        self._stypes: Dict = {}
         self._updater: Optional[Callable] = None
         self._optimizer = None
         # 'device'-class stores reduce on-device with per-key merge
@@ -126,14 +130,21 @@ class KVStore:
 
     # ------------------------------------------------------------------ basic
     def init(self, key, value):
-        """Parity: KVStore::Init — must be called once per key."""
+        """Parity: KVStore::Init — must be called once per key.  A
+        ``RowSparseNDArray`` value marks the key row-sparse: pushes must
+        then be row-sparse (touched-rows-only updates); the stored table
+        itself stays a dense device array (every row exists — sparsity
+        here is a *gradient* property, SURVEY §KVStore)."""
         keys, _ = _key_list(key)
         values = value if isinstance(value, (list, tuple)) else [value]
         _check_pairs(keys, values, "init")
         for k, v in zip(keys, values):
             if k in self._store:
                 raise MXNetError(f"duplicate init of key {k}")
-            self._store[k] = v.copy()
+            stype = getattr(v, "stype", "default")
+            self._stypes[k] = stype
+            self._store[k] = v.todense() if stype == "row_sparse" \
+                else v.copy()
 
     def push(self, key, value, priority=0):
         """Parity: KVStore::Push.  value may be one NDArray or a list of
@@ -145,6 +156,7 @@ class KVStore:
         else:
             values = value
             _check_pairs(keys, values, "push")
+        self._check_push_stypes(keys, values)
         if (self._fused is not None and not single
                 and self._fused.handle_push(keys, values)):
             return
@@ -154,6 +166,10 @@ class KVStore:
             # the Updater reads (no-op when nothing is sharded)
             self._fused.ensure_host_state()
         for k, v in zip(keys, values):
+            vlist = v if isinstance(v, (list, tuple)) else [v]
+            if getattr(vlist[0], "stype", "default") == "row_sparse":
+                self._push_row_sparse(k, vlist)
+                continue
             t0 = time.perf_counter() if _tm.enabled() else None
             if isinstance(v, (list, tuple)):
                 if self._device_mode:
@@ -190,9 +206,120 @@ class KVStore:
                 _TM_PUSH_SEC.observe(time.perf_counter() - t0,
                                      store=self.type)
 
+    def _check_push_stypes(self, keys, values):
+        """Reject stype-mismatched pushes (ISSUE-9 satellite): a
+        row-sparse gradient landing on a dense-initialized key (or a
+        dense gradient on a row-sparse key) is never what the caller
+        meant — the dense path would scatter garbage, the sparse path
+        would decay rows it should not touch."""
+        for k, v in zip(keys, values):
+            v0 = v[0] if isinstance(v, (list, tuple)) and v else v
+            vstype = getattr(v0, "stype", "default")
+            if isinstance(v, (list, tuple)):
+                for other in v[1:]:
+                    if getattr(other, "stype", "default") != vstype:
+                        raise MXNetError(
+                            f"KVStore.push: key {k!r} received mixed "
+                            "storage types across device copies")
+            kstype = self._stypes.get(k)
+            if kstype is not None and vstype != kstype:
+                raise MXNetError(
+                    f"KVStore.push: key {k!r} was initialized "
+                    f"{kstype!r} but received a {vstype!r} value; "
+                    "init the key with the matching storage type "
+                    "(mx.nd.sparse / dense NDArray)")
+
+    def _push_row_sparse(self, k, vlist):
+        """Eager per-key row-sparse push: concat the per-device pairs
+        (the segment-sum inside the row program does the cross-device
+        reduce) and run the lazy touched-rows-only update through the
+        Updater.  The fused engine's sparse buckets are the batched
+        form of exactly this."""
+        from . import sparse as _sparse
+
+        t0 = time.perf_counter() if _tm.enabled() else None
+        merged = _sparse.concat_rows(vlist)
+        if self._updater is not None:
+            self._updater(k if isinstance(k, int) else k, merged,
+                          self._store[k])
+        else:
+            # aggregation-only mode: the merged (uncoalesced) gradient
+            # replaces the stored value; pull hands it back row-sparse
+            self._store[k] = merged.copy()
+        if t0 is not None:
+            _TM_PUSH.inc(store=self.type)
+            _TM_PUSH_BYTES.inc(_nbytes(merged.data) + _nbytes(merged.indices),
+                               store=self.type)
+            _TM_PUSH_SEC.observe(time.perf_counter() - t0, store=self.type)
+            _sparse._TM_SPARSE_ROWS.inc(int(merged.indices.shape[0]),
+                                        store=self.type)
+            _sparse._TM_SPARSE_DENSITY.observe(
+                merged.indices.shape[0] / max(merged.shape[0], 1),
+                store=self.type)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Parity: KVStore.row_sparse_pull — fetch ONLY the requested
+        rows of a row-sparse key as a ``RowSparseNDArray`` (the pull
+        half of the sparse contract: a worker holding a shard of the
+        batch never materializes the full table).  ``row_ids`` is an
+        NDArray / array-like of row indices (duplicates allowed, order
+        preserved)."""
+        from . import sparse as _sparse
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, single = _key_list(key)
+        outs = [out] if out is None or isinstance(
+            out, NDArray) else list(out)
+        ids_list = [row_ids] if not isinstance(
+            row_ids, (list, tuple)) else list(row_ids)
+        if len(ids_list) != len(keys):
+            raise MXNetError(
+                f"row_sparse_pull: got {len(keys)} keys but "
+                f"{len(ids_list)} row_ids")
+        results = []
+        for k, o, ids in zip(keys, outs, ids_list):
+            if self._stypes.get(k) != "row_sparse":
+                raise MXNetError(
+                    f"row_sparse_pull: key {k!r} was initialized "
+                    f"{self._stypes.get(k, 'default')!r}, not "
+                    "'row_sparse'")
+            t0 = time.perf_counter() if _tm.enabled() else None
+            stored = self._store[k]
+            if getattr(stored, "stype", "default") == "row_sparse":
+                stored = stored.todense()  # aggregation-mode grads
+            raw = stored._read()
+            import jax.numpy as jnp
+
+            idx = jnp.asarray(
+                ids.asnumpy() if isinstance(ids, NDArray)
+                else np.asarray(ids), dtype=jnp.int32).reshape(-1)
+            rows = jnp.take(raw, idx, axis=0)
+            if o is None:
+                o = _sparse.RowSparseNDArray(NDArray(idx), NDArray(rows),
+                                             tuple(raw.shape))
+            else:
+                if getattr(o, "stype", "default") != "row_sparse":
+                    raise MXNetError(
+                        "row_sparse_pull: out must be a "
+                        "RowSparseNDArray")
+                o._set_rows(idx, rows)
+            results.append(o)
+            if t0 is not None:
+                self._record_pull(k, 1)
+                _TM_PULL_SEC.observe(time.perf_counter() - t0,
+                                     store=self.type)
+        return results[0] if single else results
+
     def pull(self, key, out=None, priority=0):
         """Parity: KVStore::Pull — copy current value into every out array
-        (Comm::Broadcast, comm.h:256-274)."""
+        (Comm::Broadcast, comm.h:256-274).
+
+        Storage-type rules (docs/sparse.md): a row-sparse out array on a
+        dense key raises (use ``row_sparse_pull`` on a row-sparse key
+        for row subsets); a DENSE out on a row-sparse key densifies —
+        the stored table is a dense device array, so this is the
+        whole-table broadcast the Module weight pull performs."""
         keys, single = _key_list(key)
         outs = [out] if isinstance(out, NDArray) else out
         if single and isinstance(out, (list, tuple)):
@@ -201,6 +328,7 @@ class KVStore:
             # histogram, leaving kvstore_pull_seconds under-counted)
             t0 = time.perf_counter() if _tm.enabled() else None
             for o in out:
+                self._check_pull_out(keys[0], o)
                 self._store[keys[0]].copyto(o)
             if t0 is not None:
                 self._record_pull(keys[0], len(out))
@@ -216,15 +344,30 @@ class KVStore:
             t0 = time.perf_counter() if _tm.enabled() else None
             if isinstance(o, (list, tuple)):
                 for oo in o:
+                    self._check_pull_out(k, oo)
                     self._store[k].copyto(oo)
                 ncopies = len(o)
             else:
+                self._check_pull_out(k, o)
                 self._store[k].copyto(o)
                 ncopies = 1
             if t0 is not None:
                 self._record_pull(k, ncopies)
                 _TM_PULL_SEC.observe(time.perf_counter() - t0,
                                      store=self.type)
+
+    def _check_pull_out(self, k, oo):
+        """A row-sparse out array can only receive a row-sparse stored
+        value; silently densifying INTO a sparse holder (or scattering
+        a dense value across one) is the wrong-answer class the stype
+        checks exist to stop."""
+        if getattr(oo, "stype", "default") == "row_sparse" \
+                and getattr(self._store[k], "stype",
+                            "default") == "default":
+            raise MXNetError(
+                f"KVStore.pull: key {k!r} holds a 'default' (dense) "
+                "value but the out array is 'row_sparse'; use "
+                "row_sparse_pull(key, row_ids=...) for row subsets")
 
     def _record_pull(self, k, ncopies):
         if _tm.enabled():
@@ -608,6 +751,16 @@ class KVStoreDist(KVStore):
         values = [value] if single else value
         if not single:
             _check_pairs(keys, values, "push")
+        for v in values:
+            v0 = v[0] if isinstance(v, (list, tuple)) and v else v
+            if getattr(v0, "stype", "default") == "row_sparse":
+                # a dist push would densify through asnumpy AND run the
+                # server's dense update (momentum/wd on every row) —
+                # silently different math from the local lazy path
+                raise MXNetError(
+                    "row_sparse push is not supported on dist stores "
+                    "yet; densify explicitly with .todense() to accept "
+                    "dense (non-lazy) update semantics")
         for k, v in zip(keys, values):
             if isinstance(v, (list, tuple)):
                 merged = v[0].copy()

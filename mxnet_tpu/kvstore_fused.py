@@ -281,6 +281,43 @@ class _Bucket:
         self.shard_src = None     # per-key state fingerprints at ingest
 
 
+class _SparseBucket:
+    """One row-sparse key's touched-rows-only update unit (ISSUE-9
+    tentpole).  Embedding tables are the big keys, so a sparse bucket
+    is per-key: ONE jitted program gathers the pushed rows' weight and
+    optimizer-state slices, applies the shared rule, and scatter-adds
+    the masked delta — cost scales with rows *touched*, not table
+    rows.  A mesh-sharded table (NamedSharding over >1 devices, e.g.
+    P("model") from group2ctx) keeps its sharding: the program
+    constrains its outputs back to the table's layout and GSPMD routes
+    each row's gather/scatter to the shard owning it."""
+
+    __slots__ = ("key", "shape", "gdtype", "target", "tset", "repl",
+                 "out_sharding", "mesh_sig", "nparts", "nslots")
+
+    def __init__(self, key, w_raw, nparts):
+        self.key = key
+        self.shape = tuple(w_raw.shape)
+        self.gdtype = np.dtype(w_raw.dtype)
+        self.target = w_raw.sharding
+        self.tset = self.target.device_set
+        self.repl = None
+        self.out_sharding = None
+        self.mesh_sig = None
+        self.nparts = nparts
+        self.nslots = 0
+        if isinstance(self.target, NamedSharding) \
+                and self.target.mesh.size > 1:
+            mesh = self.target.mesh
+            # pushed (idx, vals) pairs enter replicated; the table and
+            # state keep their own (possibly "model"-sharded) layout
+            self.repl = NamedSharding(mesh, P())
+            self.out_sharding = self.target
+            self.mesh_sig = (mesh.axis_names, mesh.devices.shape,
+                             tuple(d.id for d in mesh.devices.flat),
+                             str(self.target.spec))
+
+
 class FusedUpdateEngine:
     """Drives the bucketed fused update for one KVStore instance.
 
@@ -293,6 +330,8 @@ class FusedUpdateEngine:
         self._opt = optimizer
         self._updater = updater
         self._buckets: Optional[List[_Bucket]] = None
+        self._sparse_buckets: List[_SparseBucket] = []
+        self._plan_stypes: Optional[Tuple] = None
         self._plan_keys: Optional[Tuple] = None
         self._key_index: Dict = {}
         self._ndev = 0
@@ -308,8 +347,18 @@ class FusedUpdateEngine:
     def _build_plan(self, keys, vlists, ndev):
         cap = bucket_cap_bytes()
         buckets: List[_Bucket] = []
+        sparse_buckets: List[_SparseBucket] = []
         cur = None
         for i, _k in enumerate(keys):
+            if getattr(vlists[i][0], "stype", "default") == "row_sparse":
+                # row-sparse keys get their own per-key touched-rows
+                # bucket, executing where the stored table lives (its
+                # sharding included — a "model"-sharded table stays
+                # sharded through the update)
+                w_raw = self._kv._store[keys[i]]._read()
+                sparse_buckets.append(
+                    _SparseBucket(keys[i], w_raw, ndev))
+                continue
             g0 = vlists[i][0]._read()
             dt = np.dtype(g0.dtype)
             size = int(g0.size)
@@ -322,6 +371,13 @@ class FusedUpdateEngine:
             cur.shapes.append(tuple(g0.shape))
             cur.sizes.append(size)
             cur.nbytes += nbytes
+        self._sparse_buckets = sparse_buckets
+        for si, sb in enumerate(sparse_buckets):
+            state_b = int(np.prod(sb.shape)) * sb.gdtype.itemsize \
+                * max(sb.nslots, 1)
+            _tm.health.record_program(
+                f"kv_sparse[{sb.key}:{'x'.join(map(str, sb.shape))}]",
+                argument=state_b, output=state_b, source="shape_math")
         idx = {k: i for i, k in enumerate(keys)}
         for b in buckets:
             raws = [vlists[idx[b.keys[0]]][d]._read() for d in range(ndev)]
@@ -411,11 +467,15 @@ class FusedUpdateEngine:
             if k not in kv._store or len(vl) != ndev:
                 return False
         t0 = time.perf_counter() if _tm.enabled() else None
-        if self._plan_keys != tuple(keys) or self._ndev != ndev:
+        stypes = tuple(getattr(vl[0], "stype", "default")
+                       for vl in vlists)
+        if self._plan_keys != tuple(keys) or self._ndev != ndev \
+                or self._plan_stypes != stypes:
             # a plan rebuild drops the old buckets: any sharded state
             # they hold must land back in the per-key NDArrays first
             self.sync_shard_state()
             self._build_plan(keys, vlists, ndev)
+            self._plan_stypes = stypes
         opt = self._opt
         # host bookkeeping first (eager order: every key of the step sees
         # the same num_update), then the per-key traced lr / static wd
@@ -429,6 +489,16 @@ class FusedUpdateEngine:
             for bi, b in enumerate(self._buckets):
                 self._step_bucket(b, bi, vlists, rule_name, opt_params,
                                   lrs, wds)
+            if self._sparse_buckets:
+                ts = time.perf_counter() if t0 is not None else None
+                for si, sb in enumerate(self._sparse_buckets):
+                    self._step_sparse_bucket(sb, si, vlists, rule_name,
+                                             opt_params, lrs, wds)
+                if ts is not None:
+                    from .sparse import _TM_SPARSE_SEC
+
+                    _TM_SPARSE_SEC.observe(time.perf_counter() - ts,
+                                           store=kv.type)
         except Exception as e:  # noqa: BLE001 — OOM gets a report
             _tm.health.reraise_if_oom(e, site="kvstore_fused.push")
             raise
@@ -519,6 +589,82 @@ class FusedUpdateEngine:
 
             _TM_PUSH.inc(len(b.keys), store=kv.type)
             _TM_PUSH_BYTES.inc(b.nbytes, store=kv.type)
+
+    # --------------------------------------------------- sparse bucket step
+    def _step_sparse_bucket(self, sb, si, vlists, rule_name, opt_params,
+                            lrs, wds):
+        """One touched-rows-only update: per-device (idx, vals) pairs in,
+        ONE jitted program (concat → in-trace segment-sum coalesce →
+        gather touched weight/state rows → shared rule → scatter-add
+        masked delta) out.  No host syncs: the row count is host-known
+        (it is the pushed slot count), lr is the traced scalar."""
+        from . import sparse as _sparse
+
+        kv, upd = self._kv, self._updater
+        sentinel = _tm.health.sentinel_mode() is not None
+        w = kv._store[sb.key]
+        slots = _state_slots(upd.ensure_state(sb.key, w))
+        sb.nslots = len(slots)
+        w_raw = self._place(w, sb.target, sb.tset)
+        s_raws = tuple(self._place(s, sb.target, sb.tset) for s in slots)
+        idx_parts, val_parts = [], []
+        nrows = 0
+        for v in vlists[self._key_index[sb.key]]:
+            ir = v.indices._read()
+            vr = v.data._read()
+            nrows += int(ir.shape[0])
+            if ir.sharding.device_set != sb.tset:
+                place = sb.repl if sb.repl is not None else sb.target
+                ir = jax.device_put(ir, place)
+                vr = jax.device_put(vr, place)
+            idx_parts.append(ir)
+            val_parts.append(vr)
+        fn = self._sparse_program(sb, rule_name, opt_params,
+                                  wds[sb.key], sentinel)
+        lr = np.float32(lrs[sb.key])
+        if sentinel:
+            new_w, new_s, sent_vec = fn(tuple(idx_parts),
+                                        tuple(val_parts), w_raw,
+                                        s_raws, lr)
+            _tm.health.sentinel_record(
+                site=f"kv_sparse{si}", step=self._push_count,
+                names=[self._key_name(sb.key)], finite=sent_vec,
+                packed_norm=True)
+        else:
+            new_w, new_s = fn(tuple(idx_parts), tuple(val_parts),
+                              w_raw, s_raws, lr)
+        w._chunk.write(new_w)
+        for s_nd, s_raw in zip(slots, new_s):
+            s_nd._chunk.write(s_raw)
+        if _tm.enabled():
+            from .kvstore import _TM_PUSH, _TM_PUSH_BYTES
+
+            _TM_PUSH.inc(store=kv.type)
+            row_b = nrows * (int(np.prod(sb.shape[1:])) + 1) \
+                * sb.gdtype.itemsize
+            _TM_PUSH_BYTES.inc(row_b, store=kv.type)
+            _sparse._TM_SPARSE_ROWS.inc(nrows, store=kv.type)
+            _sparse._TM_SPARSE_DENSITY.observe(
+                nrows / max(sb.shape[0], 1), store=kv.type)
+
+    def _sparse_program(self, sb, rule_name, opt_params, wd_mult,
+                        sentinel=False):
+        key = ("kvsparse", rule_name, tuple(sorted(opt_params.items())),
+               float(wd_mult), sb.gdtype.str, len(sb.shape), sb.nparts,
+               sb.mesh_sig, sentinel)
+        fn = _executor.program_cache_get(key)
+        if fn is None:
+            fn = self._local_programs.get(key)
+            if fn is None:
+                from . import sparse as _sparse
+
+                fn = _sparse.make_row_program(
+                    rule_name, tuple(sorted(opt_params.items())),
+                    float(wd_mult), sb.nparts, sentinel=sentinel,
+                    out_sharding=sb.out_sharding)
+                _executor.program_cache_put(key, fn)
+        self._local_programs[key] = fn
+        return fn
 
     # ------------------------------------------- cross-replica sharded step
     def _step_bucket_sharded(self, b, bi, vlists, rule_name, opt_params,
@@ -670,6 +816,12 @@ class FusedUpdateEngine:
                             np.dtype(s_nd.dtype).itemsize
                 global_b += bytes_
                 per_replica += bytes_  # replicated: every replica holds all
+        for sb in self._sparse_buckets:
+            bytes_ = 0
+            for s_nd in _state_slots(self._updater.states.get(sb.key)):
+                bytes_ += int(s_nd.size) * np.dtype(s_nd.dtype).itemsize
+            global_b += bytes_
+            per_replica += bytes_
         return {"global_bytes": global_b, "per_replica_bytes": per_replica,
                 "sharded_buckets": sharded,
                 "replicas": self.shard_replicas}
@@ -717,6 +869,10 @@ class FusedUpdateEngine:
         kv = self._kv
         if any(k not in kv._store for k in keys):
             return False
+        for o in outs:
+            for oo in (o if isinstance(o, (list, tuple)) else [o]):
+                if getattr(oo, "stype", "default") != "default":
+                    return False  # sparse outs: the eager path decides
         t0 = time.perf_counter() if _tm.enabled() else None
         ncopies = 0
         nbytes = 0

@@ -193,10 +193,14 @@ class DataParallelExecutorGroup:
         # at backward dispatch into executor_collective_bytes_total)
         self._grad_allreduce_bytes = 0
         if self.mesh.devices.shape[0] > 1:
+            # row-sparse grads are not dense-all-reduced (their rows
+            # segment-sum inside the sparse bucket program) — counting
+            # the full table here would overstate the collective payload
             self._grad_allreduce_bytes = sum(
                 int(g.size) * np.dtype(g.dtype).itemsize
                 for n, g in exec_.grad_dict.items()
-                if g is not None and n not in self.data_names)
+                if g is not None and n not in self.data_names
+                and getattr(g, "stype", "default") == "default")
 
     # ---------------------------------------------------------------- params
     def set_params(self, arg_params, aux_params):

@@ -267,7 +267,16 @@ class Module(BaseModule):
             pull_back = update_on_kvstore and kvstore_inst.num_workers > 1
             for idx, name in enumerate(self._param_names):
                 if name in self._arg_params:
-                    kvstore_inst.init(idx, self._arg_params[name])
+                    init_val = self._arg_params[name]
+                    grad = ex.grad_dict.get(name)
+                    if getattr(grad, "stype", "default") == "row_sparse":
+                        # the param's gradient arrives row-sparse, so
+                        # its key must be initialized row-sparse or the
+                        # stype check would (rightly) reject the push
+                        from .. import sparse as _sparse
+
+                        init_val = _sparse.full_row_sparse(init_val)
+                    kvstore_inst.init(idx, init_val)
                     if pull_back:
                         kvstore_inst.pull(idx, ex.arg_dict[name],
                                           priority=-idx)

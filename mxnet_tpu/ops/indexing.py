@@ -26,8 +26,11 @@ def _embedding_params(attrs, *in_shapes):
 )
 def _embedding(ctx, data, weight, **attrs):
     """Parity: Embedding (indexing_op.h).  data holds float indices (MXNet
-    convention); output shape = data.shape + (output_dim,)."""
-    idx = data.astype(jnp.int32)
+    convention); output shape = data.shape + (output_dim,).  Out-of-range
+    ids clip to the table bounds like ``take`` (and the reference's
+    kernel) — unclipped they flowed straight into the XLA gather, whose
+    out-of-bounds behavior is implementation-defined."""
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
     return jnp.take(weight, idx, axis=0)
 
 
@@ -47,12 +50,17 @@ def _batch_take(ctx, a, indices, **attrs):
 
 @register("one_hot", aliases=("_onehot_encode",))
 def _one_hot(ctx, data, **attrs):
-    """Parity: _onehot_encode NDArray function (src/ndarray/ndarray.cc:752)."""
+    """Parity: _onehot_encode NDArray function (src/ndarray/ndarray.cc:752).
+    ``dtype`` is honored (it used to be hard-coded float32 regardless of
+    the requested type)."""
     depth = int(parse_attr(attrs["depth"]))
     on = float(parse_attr(attrs.get("on_value", 1.0)))
     off = float(parse_attr(attrs.get("off_value", 0.0)))
-    oh = jax.nn.one_hot(data.astype(jnp.int32), depth, dtype=jnp.float32)
-    return oh * (on - off) + off
+    dtype = jnp.dtype(str(attrs.get("dtype", "float32")))
+    oh = jax.nn.one_hot(data.astype(jnp.int32), depth, dtype=dtype)
+    if on == 1.0 and off == 0.0:
+        return oh
+    return (oh * (on - off) + off).astype(dtype)
 
 
 @register("choose_element_0index", arg_names=("lhs", "rhs"))

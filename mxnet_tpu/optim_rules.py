@@ -181,6 +181,41 @@ def _rmsprop_flat(opt_params):
 _FLAT_RULES = {"sgd": _sgd_flat, "adam": _adam_flat, "rmsprop": _rmsprop_flat}
 
 
+# ---------------------------------------------------------------------------
+# Sparse (row-wise lazy) rule variants — the touched-rows-only update of
+# the kvstore's sparse buckets (sparse.make_row_program).
+#
+# Every per-key rule above is elementwise over its tensor, so applying
+# it to the GATHERED touched rows of an embedding table is the exact
+# per-row math of the dense kernel — what changes is the *domain*: only
+# rows a batch looked up are gathered, updated, and scattered back.
+# That is the reference's lazy_update semantics: momentum/Adam state of
+# an untouched row is not decayed, its weight sees no wd, and both stay
+# byte-identical until the row is next touched.  (The dense path decays
+# every row every step — the two paths agree exactly only for plain SGD
+# with wd=0; the lazy difference is intentional and documented in
+# docs/sparse.md.)
+# ---------------------------------------------------------------------------
+_SPARSE_NSLOTS = {"adam": 2, "rmsprop": 1}
+
+
+def sparse_rule(rule_name, opt_params):
+    """(n_state_slots, row_update) — the row-wise lazy variant of
+    ``_RULES[rule_name]`` for sparse bucket programs, or ``None`` when
+    the rule has no sparse form.  ``row_update`` IS the per-key rule's
+    update closure (same fused kernels, same operand order), applied to
+    gathered ``(rows, ...)`` stacks instead of whole tensors."""
+    builder = _RULES.get(rule_name)
+    if builder is None:
+        return None
+    _init, update = builder(dict(opt_params))
+    if rule_name == "sgd":
+        nslots = 1 if opt_params.get("momentum") else 0
+    else:
+        nslots = _SPARSE_NSLOTS[rule_name]
+    return nslots, update
+
+
 def flat_rule(rule_name, opt_params):
     """(n_state_slots, update) — the flat-vector variant of
     ``_RULES[rule_name]`` for the sharded bucket program, or ``None``

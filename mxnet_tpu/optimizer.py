@@ -424,6 +424,15 @@ class Updater:
         return self.states[index]
 
     def __call__(self, index, grad, weight):
+        if getattr(grad, "stype", "default") == "row_sparse":
+            # touched-rows-only lazy update (sparse.py): same jitted
+            # row program as the fused sparse bucket, so eager and
+            # fused interleave bit-identically
+            from . import sparse as _sparse
+
+            _sparse.eager_update(self.optimizer, self, index, weight,
+                                 grad)
+            return
         self.optimizer.update(index, weight, grad,
                               self.ensure_state(index, weight))
 

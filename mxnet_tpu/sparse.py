@@ -1,0 +1,495 @@
+"""Row-sparse gradient subsystem.
+
+Parity: python/mxnet/ndarray/sparse.py (``RowSparseNDArray``) + the
+row_sparse storage type of src/ndarray/ndarray.cc — the storage format
+the source paper's KVStore exists to serve: huge embedding tables whose
+per-batch gradient touches only the rows the batch looked up
+(SURVEY.md; ROADMAP item 5).
+
+TPU-native shape discipline: the reference materializes a
+variable-length ``(indices, values)`` pair per backward (unique row
+count changes every batch), which would retrace a jitted program per
+batch.  Here everything is **shape-stable**: a row-sparse gradient
+carries exactly one slot per looked-up id (``K = prod(idx.shape)``,
+static), coalesced in-trace by sort + segment-sum — duplicate ids keep
+their slot with a zero row, the first occurrence holds the sum.  Dense
+conversion is therefore defined as *scatter-add* (equal to the
+reference's row-set when indices are unique).
+
+Three consumers share ONE row-update program builder so their math is
+bit-identical:
+
+- the executor's Embedding backward (``__grad_stype__="row_sparse"``
+  variables) emits the coalesced ``(indices, values)`` pair in-trace,
+- ``kvstore_fused``'s sparse buckets run :func:`make_row_program` —
+  gather touched rows, apply the shared optim_rules kernel, scatter-add
+  the masked delta (lazy-state semantics: untouched rows' weight AND
+  optimizer state are left byte-identical),
+- the eager per-key fallback (:func:`eager_update`) runs the SAME
+  jitted program at nparts=1, so fused-vs-eager interleave stays
+  consistent.
+
+``MXTPU_SPARSE_UPDATE=0`` disables the row-sparse grad emission at bind
+(grads come back dense) and thereby the whole sparse path,
+bit-identically restoring the dense behavior.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ndarray as nd
+from . import telemetry as _tm
+from .base import MXNetError
+from .ndarray import NDArray
+
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_SPARSE_ROWS = _tm.counter(
+    "kvstore_sparse_rows_total",
+    "gradient row slots pushed through the sparse update path (one per "
+    "looked-up id, duplicates included — host-known, never a device "
+    "sync)", labels=("store",))
+_TM_SPARSE_DENSITY = _tm.histogram(
+    "kvstore_sparse_density",
+    "pushed row slots / table rows per sparse push (the touched "
+    "fraction upper bound; <1 means the dense scatter was wasteful)",
+    labels=("store",),
+    buckets=(1e-5, 1e-4, 1e-3, 1e-2, 0.05, 0.1, 0.25, 0.5, 1.0))
+_TM_SPARSE_SEC = _tm.histogram(
+    "kvstore_sparse_update_seconds",
+    "wall time of one batched sparse-bucket update (touched-rows-only "
+    "jitted programs; dispatch, not device completion)",
+    labels=("store",))
+
+
+def sparse_update_enabled() -> bool:
+    """MXTPU_SPARSE_UPDATE gate (default on).
+
+    ``0`` makes ``simple_bind`` allocate dense gradient buffers for
+    ``grad_stype="row_sparse"`` variables, so Embedding backward falls
+    back to the dense scatter and every downstream consumer (kvstore,
+    optimizer) sees the pre-sparse behavior bit-identically.  Sampled
+    at bind time."""
+    from .base import parse_bool
+
+    return parse_bool(os.environ.get("MXTPU_SPARSE_UPDATE", "1"))
+
+
+# ---------------------------------------------------------------------------
+# RowSparseNDArray
+# ---------------------------------------------------------------------------
+class RowSparseNDArray(NDArray):
+    """A ``(indices, values)`` pair standing for a tensor whose rows
+    outside ``indices`` are zero (parity: mx.nd.sparse.RowSparseNDArray).
+
+    ``indices`` is int32 ``(K,)`` sorted ascending; ``values`` is
+    ``(K,) + shape[1:]``.  Duplicate indices are allowed (the in-trace
+    coalesce keeps one slot per looked-up id) and SUM on dense
+    conversion, so ``todense()`` is exact for both unique-row user
+    arrays and coalesced gradient emissions (duplicate slots carry zero
+    rows).  Dense reads (``_read``) raise — silent densification of a
+    table-sized sparse array is the bug this type exists to prevent;
+    use ``.todense()`` / ``.data`` / ``.indices`` explicitly."""
+
+    __slots__ = ("_indices", "_values", "_full_shape")
+
+    stype = "row_sparse"
+
+    def __init__(self, indices, values, shape):
+        ind = indices if isinstance(indices, NDArray) else NDArray(
+            jnp.asarray(np.asarray(indices), dtype=jnp.int32))
+        val = values if isinstance(values, NDArray) else NDArray(
+            jnp.asarray(values))
+        shape = tuple(int(s) for s in shape)
+        if len(ind.shape) != 1:
+            raise MXNetError(
+                f"row_sparse indices must be 1-D, got {ind.shape}")
+        if tuple(val.shape) != (ind.shape[0],) + shape[1:]:
+            raise MXNetError(
+                f"row_sparse values shape {val.shape} does not match "
+                f"{(ind.shape[0],) + shape[1:]} (indices {ind.shape}, "
+                f"shape {shape})")
+        self._indices = ind
+        self._values = val
+        self._full_shape = shape
+        # NDArray plumbing: the chunk aliases the values storage so
+        # generic context/dtype/engine accounting keep working
+        self._chunk = val._chunk
+        self._index = None
+        self._shape = None
+
+    # -------------------------------------------------------------- structure
+    @property
+    def indices(self) -> NDArray:
+        return self._indices
+
+    @property
+    def data(self) -> NDArray:
+        """The value rows (parity: RowSparseNDArray.data)."""
+        return self._values
+
+    values = data
+
+    @property
+    def shape(self):
+        return self._full_shape
+
+    @property
+    def size(self):
+        return int(np.prod(self._full_shape)) if self._full_shape else 1
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def context(self):
+        return self._values.context
+
+    ctx = context
+
+    def __len__(self):
+        return self._full_shape[0]
+
+    def __repr__(self):
+        return (f"<RowSparseNDArray {'x'.join(map(str, self.shape))} "
+                f"rows={self._indices.shape[0]} @{self.context}>")
+
+    # ------------------------------------------------------------------ reads
+    def _read(self):
+        raise MXNetError(
+            "row_sparse NDArray cannot be read as a dense array; use "
+            ".todense() / .tostype('default') (explicit) or .indices/"
+            ".data for the sparse parts")
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def wait_to_read(self):
+        self._indices.wait_to_read()
+        self._values.wait_to_read()
+
+    def todense(self) -> NDArray:
+        """Materialize the dense tensor (scatter-add of the value rows)."""
+        idx = self._indices._read()
+        vals = self._values._read()
+        dense = jnp.zeros(self._full_shape, dtype=vals.dtype)
+        return NDArray(dense.at[idx].add(vals))
+
+    def tostype(self, stype: str):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError(f"unknown storage type {stype!r}")
+
+    def copy(self) -> "RowSparseNDArray":
+        return RowSparseNDArray(self._indices.copy(), self._values.copy(),
+                                self._full_shape)
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            if other._full_shape != self._full_shape:
+                raise MXNetError(
+                    f"copyto: shape mismatch {self._full_shape} vs "
+                    f"{other._full_shape}")
+            other._set_rows(self._indices._read(), self._values._read())
+            return other
+        if isinstance(other, NDArray):
+            other._set(self.todense()._read())
+            return other
+        return super().copyto(other)
+
+    # ----------------------------------------------------------------- writes
+    def _set_rows(self, indices_raw, values_raw):
+        """Rebind the (indices, values) pair in place — the executor's
+        backward write and kvstore row pulls land here.  Shapes may
+        change between steps (a rebind with a new batch size); only the
+        row width and full shape are pinned."""
+        if not isinstance(indices_raw, jax.Array):
+            indices_raw = jnp.asarray(np.asarray(indices_raw),
+                                      dtype=jnp.int32)
+        if not isinstance(values_raw, jax.Array):
+            values_raw = jnp.asarray(values_raw)
+        if tuple(values_raw.shape[1:]) != self._full_shape[1:] or \
+                values_raw.shape[0] != indices_raw.shape[0]:
+            raise MXNetError(
+                f"row_sparse write: values {values_raw.shape} does not "
+                f"match indices {indices_raw.shape} + row shape "
+                f"{self._full_shape[1:]}")
+        self._indices._chunk.write(indices_raw)
+        self._values._chunk.write(values_raw)
+        self._chunk = self._values._chunk
+        return self
+
+    def _set(self, new_data, _from_engine=False):
+        raise MXNetError(
+            "row_sparse NDArray does not support dense writes; use "
+            "_set_rows(indices, values)")
+
+
+def _as_stype(arr) -> str:
+    return getattr(arr, "stype", "default")
+
+
+# ---------------------------------------------------------------------------
+# constructors (parity: mx.nd.sparse.row_sparse_array / mx.nd.sparse.zeros)
+# ---------------------------------------------------------------------------
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    """Parity: mx.nd.sparse.row_sparse_array.
+
+    ``arg`` is either ``(data, indices)`` (rows + their row ids) or a
+    dense array-like to compress (non-zero rows kept)."""
+    if isinstance(arg, tuple) and len(arg) == 2:
+        data, indices = arg
+        data = np.asarray(data.asnumpy() if isinstance(data, NDArray)
+                          else data, dtype=dtype)
+        indices = np.asarray(
+            indices.asnumpy() if isinstance(indices, NDArray) else indices,
+            dtype=np.int32)
+        order = np.argsort(indices, kind="stable")
+        indices, data = indices[order], data[order]
+        if shape is None:
+            top = int(indices[-1]) + 1 if indices.size else 0
+            shape = (top,) + data.shape[1:]
+        if indices.size and (int(indices[0]) < 0
+                             or int(indices[-1]) >= shape[0]):
+            raise MXNetError(
+                f"row_sparse_array: row id out of bounds for shape "
+                f"{tuple(shape)}")
+        return RowSparseNDArray(
+            NDArray(jnp.asarray(indices), ctx=ctx),
+            NDArray(jnp.asarray(data), ctx=ctx), tuple(shape))
+    if isinstance(arg, RowSparseNDArray):
+        return arg.copy()
+    dense = np.asarray(arg.asnumpy() if isinstance(arg, NDArray) else arg,
+                       dtype=dtype)
+    if shape is None:
+        shape = dense.shape
+    nz = np.flatnonzero(dense.reshape(dense.shape[0], -1).any(axis=1))
+    return RowSparseNDArray(
+        NDArray(jnp.asarray(nz.astype(np.int32)), ctx=ctx),
+        NDArray(jnp.asarray(dense[nz]), ctx=ctx), tuple(shape))
+
+
+def zeros(stype, shape, ctx=None, dtype=np.float32):
+    """Parity: mx.nd.sparse.zeros — an all-zero array of the given
+    storage type (a row_sparse zero holds no rows)."""
+    if stype == "default":
+        return nd.zeros(shape, ctx=ctx, dtype=dtype)
+    if stype != "row_sparse":
+        raise MXNetError(f"unknown storage type {stype!r}")
+    shape = tuple(shape)
+    return RowSparseNDArray(
+        NDArray(jnp.zeros((0,), dtype=jnp.int32), ctx=ctx),
+        NDArray(jnp.zeros((0,) + shape[1:], dtype=jnp.dtype(dtype)),
+                ctx=ctx), shape)
+
+
+def full_row_sparse(arr: NDArray) -> RowSparseNDArray:
+    """A row_sparse view-copy holding EVERY row (indices = arange) —
+    how a dense embedding table enters ``KVStore.init`` for a key that
+    will receive row-sparse pushes."""
+    raw = arr._read()
+    return RowSparseNDArray(
+        NDArray(jnp.arange(raw.shape[0], dtype=jnp.int32)),
+        NDArray(raw), tuple(raw.shape))
+
+
+# ---------------------------------------------------------------------------
+# graph analysis: which variables are row-sparse-gradient eligible
+# ---------------------------------------------------------------------------
+def annotated_rs_names(symbol) -> List[str]:
+    """Variable names carrying ``__grad_stype__="row_sparse"``."""
+    return [n.name for n in symbol.nodes
+            if n.is_variable
+            and n.extra_attrs.get("__grad_stype__") == "row_sparse"]
+
+
+def rs_plan(symbol) -> Dict[str, object]:
+    """{weight name: its Embedding node} for every annotated variable
+    whose ONLY consumer is one Embedding op reading it as the weight —
+    the structural condition under which the executor may emit the
+    row-sparse gradient instead of the dense scatter.  A weight with
+    any other consumer (tied decoder, regularizer term) falls back to
+    dense silently: the dense grad is always correct."""
+    rs_names = set(annotated_rs_names(symbol))
+    if not rs_names:
+        return {}
+    consumers: Dict[str, List] = {w: [] for w in rs_names}
+    for node in symbol.nodes:
+        if node.is_variable:
+            continue
+        for pos, (src, _oidx) in enumerate(node.inputs):
+            if src.is_variable and src.name in rs_names:
+                consumers[src.name].append((node, pos))
+    plan = {}
+    for wname, cons in consumers.items():
+        if len(cons) == 1 and cons[0][0].op == "Embedding" \
+                and cons[0][1] == 1:
+            plan[wname] = cons[0][0]
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# in-trace row math (shared by executor backward + kvstore programs)
+# ---------------------------------------------------------------------------
+def coalesce_rows(idx, vals):
+    """Sort ids and sum duplicate rows into the first occurrence —
+    shape-stable (K slots in, K slots out; later duplicates keep their
+    id with a zero row).  Returns ``(sorted_ids, summed_vals,
+    first_mask)``."""
+    order = jnp.argsort(idx)
+    sid = idx[order]
+    sval = vals[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    seg = jnp.cumsum(first) - 1
+    summed = jax.ops.segment_sum(sval, seg, num_segments=idx.shape[0])
+    mask = first.reshape((-1,) + (1,) * (vals.ndim - 1))
+    return sid, jnp.where(mask, summed[seg], 0), first
+
+
+def make_row_program(rule_name: str, opt_params: tuple, wd_mult: float,
+                     nparts: int, sentinel: bool = False,
+                     out_sharding=None, donate: bool = True):
+    """Build the ONE jitted touched-rows-only update program for a
+    sparse bucket: concat the per-device ``(idx, vals)`` parts,
+    coalesce by sort + segment-sum, gather the touched weight/state
+    rows, run the shared optim_rules kernel on them, and scatter-add
+    the masked delta back — untouched rows (and duplicate slots) are
+    exact no-ops, which IS the lazy-update semantics.  ``lr`` is a
+    traced scalar; everything else is static and keys the program in
+    the executor LRU.  With ``out_sharding`` (a mesh-sharded table) the
+    fresh table and state are constrained back to the table's
+    sharding, so GSPMD keeps the shards in place and routes rows
+    per-shard.  The eager fallback runs this same builder at
+    ``nparts=1`` — fused vs eager is the same compiled math.
+
+    The table and state ARE donated (``donate``): XLA aliases the
+    outputs onto the inputs, so a step costs O(touched rows), not a
+    full-table copy — the whole point of the sparse path.  Donation is
+    safe because every caller immediately rebinds the owning chunks to
+    the outputs; the one observable consequence is that an NDArray
+    which adopted the table buffer via a zero-copy pull raises
+    "deleted/donated" if read after the NEXT push but before its pull
+    (push/pull are adjacent in every Module step) — see docs/sparse.md.
+    """
+    from . import executor as _executor
+    from .optim_rules import sparse_rule
+
+    nslots, update = sparse_rule(rule_name, dict(opt_params))
+    del nslots
+
+    def step(idx_parts, val_parts, w, slots, lr):
+        idx = idx_parts[0] if len(idx_parts) == 1 \
+            else jnp.concatenate(idx_parts)
+        vals = val_parts[0] if len(val_parts) == 1 \
+            else jnp.concatenate(val_parts)
+        sid, gvals, first = coalesce_rows(idx, vals)
+        w_rows = jnp.take(w, sid, axis=0)
+        s_rows = tuple(jnp.take(s, sid, axis=0) for s in slots)
+        new_rows, new_s_rows = update(w_rows, gvals, s_rows, lr, wd_mult)
+        mask = first.reshape((-1,) + (1,) * (vals.ndim - 1))
+        new_w = w.at[sid].add(
+            jnp.where(mask, (new_rows - w_rows).astype(w.dtype), 0))
+        new_slots = tuple(
+            s.at[sid].add(jnp.where(mask, (ns - sr).astype(s.dtype), 0))
+            for s, ns, sr in zip(slots, new_s_rows, s_rows))
+        if out_sharding is not None:
+            csc = jax.lax.with_sharding_constraint
+            new_w = csc(new_w, out_sharding)
+            new_slots = tuple(csc(s, out_sharding) for s in new_slots)
+        if sentinel:
+            fin = jnp.isfinite(vals).all()[None].astype(jnp.float32)
+            gnorm = jnp.sqrt(jnp.sum(
+                jnp.square(gvals.astype(jnp.float32))))
+            return new_w, new_slots, jnp.concatenate([fin, gnorm[None]])
+        return new_w, new_slots
+
+    if not donate:
+        return jax.jit(_executor._count_traces(step, "kv_sparse"))
+    inner = jax.jit(_executor._count_traces(step, "kv_sparse"),
+                    donate_argnums=(2, 3))
+
+    def counted(idx_parts, val_parts, w, slots, lr):
+        if _tm.enabled():
+            nbytes = int(w.size) * np.dtype(w.dtype).itemsize \
+                + sum(int(s.size) * np.dtype(s.dtype).itemsize
+                      for s in slots)
+            _tm.health.donation_saved(nbytes, site="kv_sparse")
+        return inner(idx_parts, val_parts, w, slots, lr)
+
+    return counted
+
+
+def _state_slots(state) -> Tuple[NDArray, ...]:
+    if state is None:
+        return ()
+    if isinstance(state, (tuple, list)):
+        return tuple(state)
+    return (state,)
+
+
+def concat_rows(values) -> RowSparseNDArray:
+    """Merge a per-device list of row-sparse gradients into ONE
+    uncoalesced pair (plain concatenation; the row-update program's
+    in-trace segment-sum does the cross-device summing — the sparse
+    analogue of Comm::Reduce)."""
+    values = list(values)
+    if len(values) == 1:
+        return values[0]
+    shape = values[0].shape
+    for v in values[1:]:
+        if v.shape != shape:
+            raise MXNetError(
+                f"row_sparse reduce: mismatched shapes {shape} vs "
+                f"{v.shape}")
+    idx = jnp.concatenate([v.indices._read() for v in values])
+    vals = jnp.concatenate([v.data._read() for v in values])
+    return RowSparseNDArray(NDArray(idx), NDArray(vals), shape)
+
+
+# eager-path program cache: the eager fallback must NOT depend on the
+# executor LRU being enabled (and must survive program_cache_clear in
+# tests without changing math) — a small module-level dict suffices
+_EAGER_PROGRAMS: Dict[tuple, object] = {}
+
+
+def eager_update(optimizer, updater, index, weight: NDArray,
+                 rs_grad: RowSparseNDArray):
+    """Per-key row-sparse update for the eager paths (kvstore fallback
+    loops, the Module-local Updater): same host bookkeeping as the
+    dense eager update (update count, traced lr with bias correction,
+    per-key wd), then the SAME jitted row program the fused sparse
+    bucket runs — lazy-state semantics, bit-identical either way."""
+    rule = optimizer.fused_rule() if optimizer is not None else None
+    if rule is None:
+        name = type(optimizer).__name__ if optimizer is not None \
+            else "a custom updater"
+        raise MXNetError(
+            f"row_sparse gradients need an optimizer with a fused rule "
+            f"(SGD/ccSGD/Adam/RMSProp); {name} must densify explicitly "
+            f"via .todense()")
+    rule_name, opt_params = rule
+    optimizer._update_count(index)
+    lr = float(optimizer.fused_lr(index))
+    wd_mult = float(optimizer._get_wd(index))
+    slots = _state_slots(updater.ensure_state(index, weight))
+    key = (rule_name, tuple(sorted(opt_params.items())), wd_mult)
+    fn = _EAGER_PROGRAMS.get(key)
+    if fn is None:
+        fn = make_row_program(rule_name, tuple(sorted(opt_params.items())),
+                              wd_mult, nparts=1)
+        _EAGER_PROGRAMS[key] = fn
+    new_w, new_slots = fn(
+        (rs_grad.indices._read(),), (rs_grad.data._read(),),
+        weight._read(), tuple(s._read() for s in slots),
+        np.float32(lr))
+    weight._chunk.write(new_w)
+    for s_nd, s_raw in zip(slots, new_slots):
+        s_nd._chunk.write(s_raw)

@@ -473,8 +473,17 @@ def _abstract_eval(od, attrs, in_avals):
 # composition
 # ---------------------------------------------------------------------------
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
-             dtype=None, init=None, **kwargs) -> Symbol:
-    """Parity: mx.sym.Variable (symbol.py in reference)."""
+             dtype=None, init=None, stype=None, grad_stype=None,
+             **kwargs) -> Symbol:
+    """Parity: mx.sym.Variable (symbol.py in reference).
+
+    ``grad_stype="row_sparse"`` marks an Embedding weight for row-sparse
+    gradient emission (docs/sparse.md): the executor's backward returns
+    the coalesced ``(indices, values)`` pair of touched rows instead of
+    a table-sized dense scatter.  ``stype`` is accepted for reference
+    API parity and recorded as an annotation (storage here is dense
+    device arrays; the sparse *gradient* path is what the TPU port
+    optimizes)."""
     scope = current_attr_scope()
     extra = scope.get(attr) if scope else dict(attr or {})
     if shape is not None:
@@ -487,6 +496,14 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         extra["__dtype__"] = np.dtype(dtype).name
     if init is not None:
         extra["__init__"] = init if isinstance(init, str) else init.dumps()
+    for key, val in (("__storage_type__", stype),
+                     ("__grad_stype__", grad_stype)):
+        if val is not None:
+            if val not in ("default", "row_sparse"):
+                raise MXNetError(
+                    f"Variable {name!r}: unknown storage type {val!r} "
+                    "(expected 'default' or 'row_sparse')")
+            extra[key] = val
     node = _Node(None, name, extra_attrs=extra)
     return Symbol([(node, 0)])
 
