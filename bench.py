@@ -793,6 +793,177 @@ def _sparse_micro():
     }
 
 
+def _amp_micro():
+    """AMP micro-bench (round 14, docs/amp.md): ResNet-50 training
+    through the Module/Executor/KVStore path with MXTPU_AMP=bf16 +
+    dynamic loss scaling vs plain fp32 — img/s per chip and MFU both
+    ways (the ROADMAP >= 0.35 target's measurement), the loss-scale
+    ladder's final state, and the fused residual-epilogue kernel's
+    per-block time vs XLA's unfused elementwise chain.
+
+    On the CPU fallback rig the model drops to the cifar-style
+    resnet-8 at a small batch (recorded in ``amp_model``): the section
+    then measures dispatch/machinery structure, not chip throughput.
+    On a >=2-device host the Module binds across the process mesh, so
+    the fused update runs the SHARDED bucket programs and the reported
+    ``amp_master_bytes_per_replica`` is the 1/N master residency."""
+    import jax
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, models, nd
+    from mxnet_tpu import executor as ex_mod
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.module import Module
+
+    devs = jax.devices()
+    on_cpu = devs[0].platform == "cpu"
+    if on_cpu:
+        layers, img, batch, iters = 8, 32, 8, 8
+    else:
+        layers, img = 50, 224
+        batch = int(os.environ.get("BENCH_AMP_BATCH", "256"))
+        iters = int(os.environ.get("BENCH_AMP_ITERS", "12"))
+    nclass = 100 if on_cpu else 1000
+    net = models.get_symbol(f"resnet-{layers}", num_classes=nclass,
+                            image_shape=(3, img, img))
+    rng = np.random.RandomState(11)
+    data = rng.uniform(0, 1, (batch, 3, img, img)).astype(np.float32)
+    labels = rng.randint(0, nclass, batch).astype(np.float32)
+    mk_ctx = mx.cpu if on_cpu else mx.tpu
+    contexts = [mk_ctx(i) for i in range(len(devs))] if len(devs) > 1 \
+        else [mk_ctx(0)]
+
+    def run(amp_on):
+        for k, v in (("MXTPU_AMP", "bf16"),
+                     ("MXTPU_LOSS_SCALE", "dynamic")):
+            if amp_on:
+                os.environ[k] = v
+            else:
+                os.environ.pop(k, None)
+        amp.reset_scaler()
+        ex_mod.program_cache_clear()
+        mod = Module(net, context=contexts)
+        mod.bind(data_shapes=[("data", data.shape)],
+                 label_shapes=[("softmax_label", labels.shape)])
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(kvstore="local", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9})
+        batch_nd = DataBatch(data=[nd.array(data)],
+                             label=[nd.array(labels)])
+
+        def step():
+            mod.forward(batch_nd, is_train=True)
+            mod.backward()
+            mod.update()
+
+        for _ in range(2):  # compile + settle
+            step()
+        ex = mod._exec_group.execs[0]
+        pname = sorted(ex.arg_dict)[-1]
+        jax.block_until_ready(ex.arg_dict[pname]._read())
+        tic = time.perf_counter()
+        for _ in range(iters):
+            step()
+        jax.block_until_ready(ex.arg_dict[pname]._read())
+        dt = time.perf_counter() - tic
+        mem = mod._kvstore._fused.state_memory() \
+            if mod._kvstore is not None and mod._kvstore._fused else {}
+        rep = amp.global_scaler().report() if amp_on else {}
+        return batch * iters / dt, mem, rep
+
+    fp32_rate, _, _ = run(False)
+    amp_rate, mem, rep = run(True)
+
+    # sharded fp32 masters (the MULTICHIP payload): bf16-STORED params
+    # through the fused kvstore on the process mesh — masters ride the
+    # sharded flat state at 1/N bytes per replica.  (The Module run
+    # above keeps params f32 — there the params ARE the masters.)
+    if len(devs) > 1:
+        try:
+            import jax as _jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from mxnet_tpu.parallel.mesh import global_mesh
+
+            os.environ["MXTPU_AMP"] = "bf16"
+            repl = NamedSharding(global_mesh(), P())
+            kvm = mx.kv.create("local")
+            kvm.set_optimizer(mx.optimizer.create(
+                "sgd", learning_rate=0.05, momentum=0.9))
+            mshapes = [(256, 64), (64,), (128, 32)]
+            kvm.init(list(range(len(mshapes))),
+                     [nd.array(rng.uniform(-1, 1, s).astype(
+                         np.float32)).astype(jnp.bfloat16)
+                      for s in mshapes])
+            mgrads = [[nd.NDArray(_jax.device_put(rng.uniform(
+                -0.1, 0.1, s).astype(np.float32), repl))]
+                for s in mshapes]
+            for _ in range(3):
+                kvm.push(list(range(len(mshapes))), mgrads)
+            mem = kvm._fused.state_memory()
+        except Exception:  # noqa: BLE001 — payload stays Module-only
+            pass
+    os.environ.pop("MXTPU_AMP", None)
+    os.environ.pop("MXTPU_LOSS_SCALE", None)
+    amp.reset_scaler()
+
+    out = {
+        "amp_model": f"resnet-{layers}_b{batch}_{img}px"
+                     + ("_cpu" if on_cpu else ""),
+        "amp_imgs_per_sec": round(amp_rate, 1),
+        "amp_imgs_per_sec_fp32": round(fp32_rate, 1),
+        "amp_speedup": round(amp_rate / max(fp32_rate, 1e-9), 3),
+        "amp_loss_scale_final": rep.get("scale"),
+        "amp_overflows": rep.get("overflow_total"),
+        "amp_skipped_steps": rep.get("skipped_steps_total"),
+        "amp_master_bytes_per_replica": mem.get(
+            "master_bytes_per_replica", 0),
+        "amp_shard_replicas": mem.get("replicas", 1),
+    }
+    if not on_cpu:
+        peak = _peak_flops(devs[0].device_kind)
+        if peak and layers == 50:
+            per_chip = amp_rate / len(devs)
+            out["amp_mfu"] = round(
+                per_chip * TRAIN_FLOPS_PER_IMG / peak, 4)
+            out["amp_mfu_fp32"] = round(
+                (fp32_rate / len(devs)) * TRAIN_FLOPS_PER_IMG / peak, 4)
+
+    # --- fused residual-epilogue kernel vs XLA's unfused chain --------
+    from mxnet_tpu.ops import residual_epilogue as re_mod
+
+    n, h, w, c = (8, 14, 14, 256) if on_cpu else (64, 56, 56, 256)
+    x = jnp.asarray(rng.uniform(-1, 1, (n, h, w, c)).astype(np.float32))
+    s = jnp.asarray(rng.uniform(-1, 1, (n, h, w, c)).astype(np.float32))
+    sc = jnp.asarray(rng.uniform(0.5, 1.5, (c,)).astype(np.float32))
+    b = jnp.asarray(rng.uniform(-0.5, 0.5, (c,)).astype(np.float32))
+    impl = "auto" if not on_cpu else "lax"
+
+    fused = jax.jit(lambda x_, s_: re_mod.residual_epilogue(
+        x_, s_, sc, b, channel_axis=-1, impl=impl,
+        platform=devs[0].platform))
+    unfused = jax.jit(lambda x_, s_: jnp.maximum(
+        (x_ + s_) * sc.reshape(1, 1, 1, -1) + b.reshape(1, 1, 1, -1),
+        0.0))
+
+    def time_fn(fn):
+        jax.block_until_ready(fn(x, s))
+        reps = 30
+        tic = time.perf_counter()
+        for _ in range(reps):
+            out_ = fn(x, s)
+        jax.block_until_ready(out_)
+        return (time.perf_counter() - tic) / reps * 1e6
+
+    out["epilogue_us_per_block"] = round(time_fn(fused), 1)
+    out["epilogue_us_per_block_xla"] = round(time_fn(unfused), 1)
+    out["epilogue_block"] = f"{n}x{h}x{w}x{c}"
+    return out
+
+
 def _passes_micro():
     """Graph-rewrite pipeline micro-bench (round 12): bind/trace cost
     and node count with MXTPU_GRAPH_PASSES off vs on, per-pass node
@@ -1262,6 +1433,17 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
             # bucket vs the dense-gradient scatter path (ISSUE 9)
             if os.environ.get("BENCH_SPARSE", "1") == "1":
                 for k_, v_ in _sparse_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # first-class AMP: bf16 Module training vs fp32 (MFU toward
+            # the ROADMAP >= 0.35 target), loss-scale ladder state, and
+            # the fused residual-epilogue kernel vs XLA's chain; on a
+            # multi-device host the Module spans the mesh, so masters
+            # run SHARDED (1/N bytes per replica) — ISSUE 10
+            if os.environ.get("BENCH_AMP", "1") == "1":
+                for k_, v_ in _amp_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

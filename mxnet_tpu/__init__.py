@@ -46,6 +46,7 @@ from . import symbol as sym
 from .symbol import Symbol, Variable, Group
 from . import executor
 from .executor import Executor
+from . import amp
 from . import passes
 from . import initializer
 from . import initializer as init

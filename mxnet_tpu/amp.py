@@ -1,0 +1,334 @@
+"""Automatic mixed precision (AMP) — policy, master weights, and
+in-trace dynamic loss scaling for the Module/Executor/KVStore path.
+
+ROADMAP item 4's precision half: the FusedTrainer has carried bf16
+compute + fp32 masters since PR 3, but every other workload runs the
+Module path, where bf16 was only "grads survive".  This module makes
+bf16 a **bind-time flag** instead of a per-model rewrite:
+
+- ``MXTPU_AMP=bf16`` arms the ``amp_cast`` graph pass (passes/
+  amp_cast.py): Convolution / FullyConnected / dot / batch_dot /
+  FlashAttention compute in bf16 via inserted ``Cast`` nodes, while
+  softmax, losses, and global reductions are cast back to fp32 (norm
+  ops keep fp32 *statistics* internally by construction — ops/nn.py
+  BatchNorm/LayerNorm accumulate moments in f32 whatever the compute
+  dtype).  The pass runs in the PR-8 pipeline, so the program cache
+  keys on the post-pass signature and the fused fwd+bwd traces the
+  rewritten graph.  Unset, the pass returns the symbol object
+  unchanged — bit-identical graphs, signatures, and cache keys.
+- fp32 **master weights**: parameters stored bf16 (a ``type_dict``
+  bind, a bf16 KVStore value, a bf16 embedding table) get a
+  device-resident fp32 master carried as the LAST optimizer-state slot
+  (reference ``multi_precision`` layout) — the fused bucket programs
+  update the master in fp32 and emit the bf16 parameter cast inside
+  the same jitted program; the sharded bucket keeps the master as a
+  1/N-per-replica flat vector (arXiv:2004.13336), and sparse buckets
+  keep fp32 master rows for bf16 tables.
+- **dynamic loss scaling** (``MXTPU_LOSS_SCALE``, off by default):
+  the scale is a DEVICE scalar.  It enters the jitted fwd+bwd as a
+  traced argument and multiplies the gradient cotangents in-trace (at
+  the vjp boundary — MXNet's loss-output ops discard the seed
+  cotangent by reference contract, so seed-side scaling would silently
+  not propagate through ``SoftmaxOutput``-style graphs); unscale +
+  overflow detection fuse into the bucket update (the PR-5 sentinel's
+  isfinite shape), skip-step is a ``jnp.where`` lattice over the
+  bucket's outputs, and the halve/grow schedule
+  (``MXTPU_LOSS_SCALE_WINDOW``) runs as one tiny jitted program over
+  the per-bucket finite flags — scale, growth counter, and the
+  overflow/skip counters all stay device-resident, so steady-state
+  training keeps the zero-per-batch-host-sync property.  Host reads
+  happen only in :meth:`LossScaler.report` (tests/bench/monitoring).
+
+bf16 note: unlike fp16, bf16 shares float32's exponent range, so the
+classic underflow motivation for loss scaling mostly disappears — what
+remains valuable is the fused overflow detection + skip-step ladder,
+which turns a divergence-producing Inf/NaN step into a skipped step
+plus a halved scale instead of a corrupted model.  docs/amp.md is the
+runbook.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import telemetry as _tm
+from .base import MXNetError
+
+__all__ = [
+    "amp_enabled", "amp_dtype", "master_weights_wanted", "is_low_precision",
+    "loss_scale_config", "scaling_active", "global_scaler", "reset_scaler",
+    "LossScaler", "warn_no_master", "maybe_unscale_grad",
+]
+
+# --- telemetry families (docs/telemetry.md "AMP") --------------------------
+_TM_SCALE = _tm.gauge(
+    "amp_loss_scale",
+    "current dynamic loss scale (mirrored from the device scalar at "
+    "reporting boundaries — LossScaler.report(); never per step)")
+_TM_OVERFLOW = _tm.counter(
+    "amp_overflow_total",
+    "optimizer steps on which a bucket saw a non-finite scaled gradient "
+    "(device-accumulated; mirrored at reporting boundaries)")
+_TM_CAST_NODES = _tm.counter(
+    "amp_cast_nodes_total",
+    "Cast nodes the amp_cast graph pass inserted into bound graphs "
+    "(bind-time, host-side count)")
+_TM_SKIPPED = _tm.counter(
+    "amp_skipped_steps_total",
+    "optimizer steps the loss-scale lattice skipped (weights/state held) "
+    "— device-accumulated, mirrored at reporting boundaries")
+
+_LOW_PRECISION = (jnp.bfloat16, jnp.float16)
+
+_DEFAULT_INIT_SCALE = float(2 ** 15)
+_DEFAULT_WINDOW = 2000
+_MIN_SCALE = 1.0
+_MAX_SCALE = float(2 ** 24)
+
+
+def amp_enabled() -> bool:
+    """MXTPU_AMP gate — off by default, ``bf16`` enables."""
+    return amp_dtype() is not None
+
+
+def amp_dtype():
+    """The AMP compute dtype (jnp.bfloat16) or None when AMP is off.
+
+    Only the bf16 policy exists: TPUs have no fast fp16 path, and bf16
+    needs no rescaling tricks to train.  Unknown values raise rather
+    than silently training full-precision under a typo'd knob."""
+    raw = os.environ.get("MXTPU_AMP", "").strip().lower()
+    if raw in ("", "0", "off", "false", "no", "none"):
+        return None
+    if raw in ("bf16", "bfloat16", "1", "on", "true", "yes"):
+        return jnp.bfloat16
+    raise MXNetError(
+        f"MXTPU_AMP={raw!r}: unknown AMP policy (supported: 'bf16', "
+        "'0'/'off')")
+
+
+def is_low_precision(dtype) -> bool:
+    return jnp.dtype(dtype) in [jnp.dtype(d) for d in _LOW_PRECISION]
+
+
+def master_weights_wanted(optimizer, weight_dtype) -> bool:
+    """Should this (optimizer, weight dtype) pair carry an fp32 master?
+
+    True when the weight is low-precision AND the optimizer opted in
+    (``multi_precision=True``) or the process-wide AMP policy is on —
+    ``MXTPU_AMP=bf16`` implies masters for every bf16 parameter, the
+    "first-class" default the reference makes per-optimizer opt-in."""
+    if not is_low_precision(weight_dtype):
+        return False
+    return bool(getattr(optimizer, "multi_precision", False)) \
+        or amp_enabled()
+
+
+_warned_no_master = set()
+
+
+def warn_no_master(name):
+    """Warn ONCE per key when a low-precision weight updates without an
+    fp32 master — silent precision loss (bf16 has ~8 mantissa bits;
+    small updates round to nothing) should be visible, not the quiet
+    default."""
+    key = str(name)
+    if key in _warned_no_master:
+        return
+    _warned_no_master.add(key)
+    warnings.warn(
+        f"parameter {key!r} has a low-precision dtype but updates "
+        "WITHOUT fp32 master weights — small updates will round away. "
+        "Pass multi_precision=True to the optimizer (or set "
+        "MXTPU_AMP=bf16) to keep fp32 masters.", stacklevel=3)
+
+
+def count_cast_nodes(n: int):
+    if n > 0 and _tm.enabled():
+        _TM_CAST_NODES.inc(n)
+
+
+# ---------------------------------------------------------------------------
+# dynamic loss scaling
+# ---------------------------------------------------------------------------
+def loss_scale_config():
+    """(initial_scale, window) from MXTPU_LOSS_SCALE /
+    MXTPU_LOSS_SCALE_WINDOW, or None when loss scaling is off.
+
+    ``MXTPU_LOSS_SCALE``: ``0``/``off`` (default) disables; ``dynamic``
+    uses the standard 2^15 start; a number is the initial scale (the
+    schedule is always dynamic: halve on overflow, double after
+    ``MXTPU_LOSS_SCALE_WINDOW`` consecutive clean steps)."""
+    raw = os.environ.get("MXTPU_LOSS_SCALE", "").strip().lower()
+    if raw in ("", "0", "off", "false", "no", "none"):
+        return None
+    if raw in ("1", "on", "true", "yes", "dynamic", "default"):
+        init = _DEFAULT_INIT_SCALE
+    else:
+        try:
+            init = float(raw)
+        except ValueError:
+            raise MXNetError(
+                f"MXTPU_LOSS_SCALE={raw!r}: expected a number, "
+                "'dynamic', or '0'/'off'") from None
+        if not init > 0:
+            raise MXNetError("MXTPU_LOSS_SCALE must be > 0")
+    try:
+        window = int(os.environ.get("MXTPU_LOSS_SCALE_WINDOW",
+                                    str(_DEFAULT_WINDOW)))
+    except ValueError:
+        window = _DEFAULT_WINDOW
+    return init, max(window, 1)
+
+
+def scaling_active() -> bool:
+    """Loss scaling rides the AMP policy: both knobs must be on."""
+    return amp_enabled() and loss_scale_config() is not None
+
+
+@functools.lru_cache(maxsize=16)
+def _scale_step_fn(window: int, nflags: int):
+    """One jitted lattice updating (scale, good, overflows, skipped)
+    from the step's per-bucket finite flags — pure ``jnp.where``
+    selects, no host value ever enters."""
+
+    def step(scale, good, overflows, skipped, flags):
+        fin = flags[0]
+        for f in flags[1:]:
+            fin = jnp.logical_and(fin, f)
+        grown = jnp.minimum(scale * 2.0, _MAX_SCALE)
+        shrunk = jnp.maximum(scale * 0.5, _MIN_SCALE)
+        hit = good + 1 >= window
+        new_scale = jnp.where(fin, jnp.where(hit, grown, scale), shrunk)
+        new_good = jnp.where(fin, jnp.where(hit, 0, good + 1), 0)
+        bad = (~fin).astype(jnp.int32)
+        return new_scale, new_good, overflows + bad, skipped + bad
+
+    from . import executor as _executor
+
+    return jax.jit(_executor._count_traces(step, "amp_scale"))
+
+
+class LossScaler:
+    """Device-resident dynamic loss scaler.
+
+    Every state item is a device scalar; the per-step path
+    (:meth:`scale_raw` + :meth:`end_step`) never reads one back —
+    reads happen only in :meth:`report`, which also mirrors the values
+    into the ``amp_*`` telemetry families.  ``_sync_count`` counts
+    those reads so tests can assert the hot loop performed none."""
+
+    def __init__(self, init_scale=None, window=None):
+        cfg = loss_scale_config()
+        if init_scale is None:
+            init_scale = cfg[0] if cfg else _DEFAULT_INIT_SCALE
+        if window is None:
+            window = cfg[1] if cfg else _DEFAULT_WINDOW
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._sync_count = 0
+        self._reported_overflows = 0
+        self._reported_skipped = 0
+        self._reset_device_state(float(init_scale))
+
+    def _reset_device_state(self, scale):
+        # plain jnp scalars are UNCOMMITTED: they may join any
+        # computation (single-device or mesh) without a device clash
+        self._scale = jnp.float32(scale)
+        self._good = jnp.int32(0)
+        self._overflows = jnp.int32(0)
+        self._skipped = jnp.int32(0)
+
+    # ------------------------------------------------------------- hot path
+    def scale_raw(self):
+        """The scale as a device scalar (traced into programs)."""
+        return self._scale
+
+    def inv_scale_raw(self):
+        return 1.0 / self._scale
+
+    def end_step(self, flags):
+        """Fold one optimizer step's per-bucket finite flags into the
+        scale lattice — one jitted dispatch, zero host syncs."""
+        if not flags:
+            return
+        fn = _scale_step_fn(self.window, len(flags))
+        with self._lock:
+            (self._scale, self._good, self._overflows,
+             self._skipped) = fn(self._scale, self._good,
+                                 self._overflows, self._skipped,
+                                 tuple(flags))
+
+    # ------------------------------------------------------ boundary reads
+    def report(self) -> dict:
+        """Sync the device state (the ONLY host read) and mirror it
+        into the amp_* telemetry families; returns the snapshot."""
+        with self._lock:
+            self._sync_count += 1
+            snap = {
+                "scale": float(np.asarray(self._scale)),
+                "good_steps": int(np.asarray(self._good)),
+                "overflow_total": int(np.asarray(self._overflows)),
+                "skipped_steps_total": int(np.asarray(self._skipped)),
+                "window": self.window,
+            }
+            if _tm.enabled():
+                _TM_SCALE.set(snap["scale"])
+                d_over = snap["overflow_total"] - self._reported_overflows
+                d_skip = snap["skipped_steps_total"] - self._reported_skipped
+                if d_over > 0:
+                    _TM_OVERFLOW.inc(d_over)
+                if d_skip > 0:
+                    _TM_SKIPPED.inc(d_skip)
+            self._reported_overflows = snap["overflow_total"]
+            self._reported_skipped = snap["skipped_steps_total"]
+        return snap
+
+
+_scaler = None
+_scaler_lock = threading.Lock()
+
+
+def global_scaler() -> LossScaler:
+    """The process-wide scaler (created lazily from the env knobs)."""
+    global _scaler
+    with _scaler_lock:
+        if _scaler is None:
+            _scaler = LossScaler()
+        return _scaler
+
+
+def reset_scaler():
+    """Drop the process scaler (test isolation; next use re-reads env)."""
+    global _scaler
+    with _scaler_lock:
+        _scaler = None
+    _warned_no_master.clear()
+
+
+def maybe_unscale_grad(grad):
+    """Eager-path unscale hook (Updater fallback loops): divide a
+    gradient by the live scale as an async device op.  The fused bucket
+    programs unscale in-trace instead; this keeps interleaved eager
+    updates numerically correct (the skip-step lattice does not apply
+    on the eager path — docs/amp.md)."""
+    if not scaling_active():
+        return grad
+    inv = global_scaler().inv_scale_raw()
+    from .ndarray import NDArray
+
+    if getattr(grad, "stype", "default") == "row_sparse":
+        from .sparse import RowSparseNDArray
+
+        vals = grad.data._read()
+        return RowSparseNDArray(
+            grad.indices,
+            NDArray(vals * inv.astype(vals.dtype)), grad.shape)
+    raw = grad._read()
+    return NDArray(raw * inv.astype(raw.dtype))

@@ -270,6 +270,10 @@ _CL_MULTI = {
 }
 _CL_CHANNEL_AXIS = {"Concat": "dim", "concat": "dim",
                     "SliceChannel": "axis", "split": "axis"}
+# fused residual epilogues (ops/residual_epilogue.py): the two 4D
+# activation inputs ride NHWC (that IS the Pallas kernel's layout);
+# the per-channel affine/stat inputs stay logical 1-D
+_CL_EPILOGUE = {"_residual_epilogue", "_residual_epilogue_bn"}
 
 
 def channels_last_default() -> bool:
@@ -323,6 +327,13 @@ def _cl_adapt(node, ins, lay, hwio_params=frozenset()):
                 and node.inputs[1][0].name in hwio_params):
             attrs["__wlayout__"] = "HWIO"
         return [data] + rest, attrs, True
+    if name in _CL_EPILOGUE and len(ins) >= 2 and any(inlay[:2]) \
+            and ins[0].ndim == 4 and ins[1].ndim == 4:
+        a = ins[0] if inlay[0] else _to_nhwc(ins[0])
+        b = ins[1] if inlay[1] else _to_nhwc(ins[1])
+        rest = [(_to_nchw(x) if l else x)
+                for x, l in zip(ins[2:], inlay[2:])]
+        return [a, b] + rest, {**attrs, "__layout__": "NHWC"}, True
     if name in _CL_UNARY and len(ins) == 1 and inlay[0]:
         return ins, attrs, True
     if name in _CL_MULTI and any(inlay) and all(x.ndim == 4 for x in ins):
@@ -732,9 +743,20 @@ def _make_fwdbwd(graph_fn, placed: bool):
     in-trace unique-row segment-sum into the ``(indices, values)`` pair
     returned as that weight's gradient.  The dense scatter into the
     full table never happens.
+
+    ``loss_scale`` (None when AMP loss scaling is off — the off path
+    traces bit-identically) is the scaler's DEVICE scalar: gradients
+    are multiplied by it in-trace at the vjp boundary.  The boundary —
+    not the ones seed — because the reference's loss-output ops
+    (SoftmaxOutput & co.) discard the head cotangent by contract, so a
+    seed-side scale would silently not propagate through the graphs
+    the Module path actually trains.  The fused kvstore bucket update
+    unscales (and detects overflow / skips) in ITS program; the scale
+    is constant between optimizer steps, so grad_req="add"
+    accumulation across backwards composes exactly.
     """
 
-    def fwdbwd(arg_vals, aux_vals, key, head_grads, grad_ins,
+    def fwdbwd(arg_vals, aux_vals, key, head_grads, grad_ins, loss_scale,
                gnames: tuple, add_names: tuple, rs_specs: tuple = ()):
         def fwd_for_grad(grad_args):
             merged = dict(arg_vals)
@@ -752,12 +774,21 @@ def _make_fwdbwd(graph_fn, placed: bool):
         (outs, new_aux), vjp_fn = jax.vjp(
             lambda ga: fwd_for_grad(ga), grad_args, has_aux=False
         )
+        provided_heads = bool(head_grads)
         if not head_grads:
             # ones seed — custom_vjp loss ops discard it (parity with
             # reference loss-op backward semantics); placement follows
             # each output, so the placed path needs no device_put either
             head_grads = [jnp.ones_like(o) for o in outs]
-        elif placed:
+        else:
+            # caller-provided seeds follow the OUTPUT dtype (an
+            # amp_cast-rewritten graph may emit bf16 outputs; an f32
+            # ones seed would be rejected by the vjp)
+            head_grads = [
+                h.astype(o.dtype) if h.dtype != o.dtype else h
+                for h, o in zip(head_grads, outs)
+            ]
+        if provided_heads and placed:
             # the seed cotangent must sit where its primal output sits,
             # or the last segment's transposed pjit sees mixed device
             # commitments; interior cotangents then follow the
@@ -778,6 +809,13 @@ def _make_fwdbwd(graph_fn, placed: bool):
                 ids = new_aux["__rs_idx__:" + wname]
                 sid, gvals, _first = _sparse.coalesce_rows(ids, vals)
                 grads[wname] = (sid, gvals)
+        if loss_scale is not None:
+            grads = {
+                k: ((g[0], g[1] * loss_scale.astype(g[1].dtype))
+                    if isinstance(g, tuple)
+                    else g * loss_scale.astype(g.dtype))
+                for k, g in grads.items()
+            }
         if add_names:
             grads = dict(grads)
             for k in add_names:
@@ -950,6 +988,12 @@ class Executor:
             self._graph_fn, self._jit_fwd, self._jit_fwdbwd = \
                 _compiled_programs(symbol, self._platform(),
                                    shard_sig=self._shard_sig)
+        # AMP dynamic loss scaling is a BIND-TIME decision (docs/amp.md):
+        # placed (ctx_group segmented) graphs skip the pass pipeline and
+        # therefore the whole AMP policy
+        from . import amp as _amp
+
+        self._amp_scale = (not self._placed) and _amp.scaling_active()
         self._step = 0
         self._pending = None  # (args_raw, aux_raw, key) of last train forward
         self._outputs_cache: Optional[List] = None
@@ -1137,6 +1181,11 @@ class Executor:
 
     def _backward_impl(self, out_grads):
         args, aux, key = self._pending
+        from jax.sharding import NamedSharding, PartitionSpec, \
+            SingleDeviceSharding
+
+        ref = next(iter(args.values()), None)
+        ref_sh = getattr(ref, "sharding", None)
         if out_grads is None:
             # loss-output graphs: ops define their own grads (custom_vjp)
             # and ignore the seed; plain graphs get an in-trace ones seed
@@ -1150,11 +1199,6 @@ class Executor:
             # created them on the default device); a mesh-sharded bind
             # replicates them over its mesh — a single-device committed
             # seed would otherwise refuse to enter the SPMD program
-            from jax.sharding import NamedSharding, PartitionSpec, \
-                SingleDeviceSharding
-
-            ref = next(iter(args.values()), None)
-            ref_sh = getattr(ref, "sharding", None)
             if isinstance(ref_sh, SingleDeviceSharding):
                 head = [
                     jax.device_put(h, ref_sh)
@@ -1172,9 +1216,24 @@ class Executor:
                     for h in head
                 ]
         grad_ins = {k: self.grad_dict[k]._read() for k in self._add_names}
+        loss_scale = None
+        if self._amp_scale:
+            from . import amp as _amp
+
+            loss_scale = _amp.global_scaler().scale_raw()
+            # the scaler's device scalar must share the bind's committed
+            # placement (4 bytes; an async transfer only after the
+            # scale-update program moved it)
+            if isinstance(ref_sh, NamedSharding) and ref_sh.mesh.size > 1:
+                repl = NamedSharding(ref_sh.mesh, PartitionSpec())
+                if getattr(loss_scale, "sharding", None) != repl:
+                    loss_scale = jax.device_put(loss_scale, repl)
+            elif isinstance(ref_sh, SingleDeviceSharding) \
+                    and getattr(loss_scale, "sharding", None) != ref_sh:
+                loss_scale = jax.device_put(loss_scale, ref_sh)
         try:
             outs, new_aux, grads = self._jit_fwdbwd(
-                args, aux, key, head, grad_ins,
+                args, aux, key, head, grad_ins, loss_scale,
                 gnames=self._gnames, add_names=self._add_names,
                 rs_specs=self._rs_specs
             )

@@ -132,7 +132,8 @@ def _state_slots(state) -> Tuple[NDArray, ...]:
 
 
 def _make_bucket_program(rule_name, opt_params, shapes, sizes, wds,
-                         sentinel=False):
+                         sentinel=False, mp=False, wdtype=None,
+                         scaling=False):
     """One jitted program for a bucket: flatten+concat each device's
     grads, ONE flat reduction across devices, then the per-key slices
     run the shared update rule — XLA fuses the whole chain.  ``lrs``
@@ -141,11 +142,25 @@ def _make_bucket_program(rule_name, opt_params, shapes, sizes, wds,
     With ``sentinel`` (MXTPU_SENTINEL) the program ALSO returns a
     per-key isfinite mask and the bucket's gradient-norm scalar —
     computed inside the already-jitted chain, returned as device
-    futures the health layer syncs only at reporting boundaries."""
+    futures the health layer syncs only at reporting boundaries.
+
+    With ``mp`` (fp32 master weights, docs/amp.md) each key's state
+    tuple carries the master as its LAST slot: the rule runs entirely
+    in fp32 against the master, and the fresh ``wdtype`` parameter is
+    cast INSIDE this same program — the bf16 weight is a cache of the
+    master, never the accumulator.
+
+    With ``scaling`` (AMP dynamic loss scaling) the program takes the
+    scale as a traced scalar, detects overflow on the merged gradient
+    (the PR-5 sentinel's isfinite shape), unscales, and SELECTS
+    old-vs-new weights and state per the finite flag — the skip-step
+    is a ``jnp.where`` lattice, and the flag rides out as one device
+    scalar for the scale-update lattice (amp.LossScaler.end_step)."""
     init_state, update = _RULES[rule_name](dict(opt_params))
     del init_state  # states come pre-created through the Updater
+    out_dt = jnp.dtype(wdtype) if wdtype is not None else None
 
-    def bucket_step(dev_parts, weights, states, lrs):
+    def bucket_step(dev_parts, weights, states, lrs, scale=None):
         flats = []
         for part in dev_parts:
             if isinstance(part, (tuple, list)):
@@ -157,6 +172,10 @@ def _make_bucket_program(rule_name, opt_params, shapes, sizes, wds,
         merged = flats[0]
         for f in flats[1:]:
             merged = merged + f
+        fin = None
+        if scaling:
+            fin = jnp.isfinite(merged).all()
+            merged = merged * (1.0 / scale).astype(merged.dtype)
         new_w, new_s = [], []
         fins = []
         off = 0
@@ -168,24 +187,39 @@ def _make_bucket_program(rule_name, opt_params, shapes, sizes, wds,
             # lrs is ONE stacked traced vector (not n scalar leaves —
             # pytree flattening cost scales with leaf count on every
             # dispatch); lrs[i] is the key's traced scalar lr
-            nw, ns = update(weights[i], g, states[i], lrs[i], wds[i])
+            if mp:
+                master = states[i][-1]
+                nm, ns = update(master, g.astype(jnp.float32),
+                                tuple(states[i][:-1]), lrs[i], wds[i])
+                nw = nm.astype(out_dt)
+                ns = tuple(ns) + (nm,)
+            else:
+                nw, ns = update(weights[i], g, states[i], lrs[i], wds[i])
+                ns = tuple(ns)
+            if scaling:
+                nw = jnp.where(fin, nw, weights[i])
+                ns = tuple(jnp.where(fin, a, b)
+                           for a, b in zip(ns, states[i]))
             new_w.append(nw)
-            new_s.append(tuple(ns))
+            new_s.append(ns)
+        outs = [tuple(new_w), tuple(new_s)]
         if sentinel:
             # per-key flags + the bucket's grad norm, packed into ONE
             # extra output leaf (norm rides as the last entry)
             fin_vec = jnp.stack(fins).astype(jnp.float32)
             gnorm = jnp.sqrt(
                 jnp.sum(jnp.square(merged.astype(jnp.float32))))
-            return (tuple(new_w), tuple(new_s),
-                    jnp.concatenate([fin_vec, gnorm[None]]))
-        return tuple(new_w), tuple(new_s)
+            outs.append(jnp.concatenate([fin_vec, gnorm[None]]))
+        if scaling:
+            outs.append(fin)
+        return tuple(outs)
 
     return jax.jit(_executor._count_traces(bucket_step, "kv_update"))
 
 
 def _make_sharded_bucket_program(rule_name, opt_params, shapes, sizes, wds,
-                                 wdtype, mesh, sentinel=False):
+                                 wdtype, mesh, sentinel=False, mp=False,
+                                 scaling=False):
     """One jitted program for a CROSS-REPLICA SHARDED bucket
     (arXiv:2004.13336): the flat gradient/weight/state vectors are
     constrained to ``P(mesh.axis_names)`` so GSPMD gives each replica a
@@ -197,7 +231,16 @@ def _make_sharded_bucket_program(rule_name, opt_params, shapes, sizes, wds,
     and the fresh parameters are all-gathered in-trace by a replicated
     constraint before slicing back to per-key shapes.  Everything static
     (shapes, wd, mesh) keys the program in the executor LRU; lr stays a
-    traced vector so schedules never retrace."""
+    traced vector so schedules never retrace.
+
+    ``mp``: the shard_state's LAST flat vector is the fp32 MASTER
+    (1/N master bytes per replica — the arXiv:2004.13336 saving now
+    covers the masters too): the flat rule runs on the master slice in
+    fp32, and the replicated all-gather moves the freshly-CAST
+    ``wdtype`` vector — for bf16 params that also halves the
+    all-gather payload.  ``scaling``: traced scale in, overflow
+    detection + unscale + jnp.where skip lattice in-trace, finite flag
+    out (docs/amp.md)."""
     nslots, update = flat_rule(rule_name, opt_params)
     total = int(sum(sizes))
     n = mesh.size
@@ -205,39 +248,66 @@ def _make_sharded_bucket_program(rule_name, opt_params, shapes, sizes, wds,
     shard = NamedSharding(mesh, P(mesh.axis_names))
     repl = NamedSharding(mesh, P())
     sizes_np = np.asarray(sizes, np.int64)
-    # per-element wd, cast to the weight dtype exactly as the weak-typed
-    # Python float in the per-key kernel would be; pad region is 0
-    wd_el = np.zeros(padded, np.dtype(wdtype))
+    # per-element wd, cast to the compute dtype exactly as the
+    # weak-typed Python float in the per-key kernel would be (fp32 when
+    # the update runs on fp32 masters); pad region is 0
+    wd_el = np.zeros(padded, np.float32 if mp else np.dtype(wdtype))
     wd_el[:total] = np.repeat(np.asarray(wds, np.float64), sizes_np)
     csc = jax.lax.with_sharding_constraint
+    out_dt = jnp.dtype(wdtype)
 
-    def bucket_step(parts, w_raws, shard_state, lrs):
+    def bucket_step(parts, w_raws, shard_state, lrs, scale=None):
         gflat = jnp.ravel(parts[0]) if len(parts) == 1 else \
             jnp.concatenate([jnp.ravel(p) for p in parts])
+        fin = jnp.isfinite(gflat).all() if scaling else None
         gflat = jnp.pad(gflat, (0, padded - total))
         g = csc(gflat, shard)
-        wflat = jnp.ravel(w_raws[0]) if len(w_raws) == 1 else \
-            jnp.concatenate([jnp.ravel(w) for w in w_raws])
-        wflat = csc(jnp.pad(wflat, (0, padded - total)), shard)
+        if scaling:
+            g = g * (1.0 / scale).astype(g.dtype)
         lr_el = jnp.pad(jnp.repeat(lrs, sizes_np,
                                    total_repeat_length=total),
                         (0, padded - total))
         lr_el = csc(lr_el, shard)
-        new_w, new_s = update(wflat, g, shard_state, lr_el,
-                              jnp.asarray(wd_el))
+        if mp:
+            master = shard_state[-1]
+            new_w, new_s = update(master, g.astype(jnp.float32),
+                                  tuple(shard_state[:-1]), lr_el,
+                                  jnp.asarray(wd_el))
+            new_s = tuple(new_s) + (new_w,)
+        else:
+            wflat = jnp.ravel(w_raws[0]) if len(w_raws) == 1 else \
+                jnp.concatenate([jnp.ravel(w) for w in w_raws])
+            wflat = csc(jnp.pad(wflat, (0, padded - total)), shard)
+            new_w, new_s = update(wflat, g, shard_state, lr_el,
+                                  jnp.asarray(wd_el))
+            new_s = tuple(new_s)
+        if scaling:
+            new_s = tuple(jnp.where(fin, a, b)
+                          for a, b in zip(new_s, shard_state))
+            if mp:
+                new_w = new_s[-1]  # the selected master
+            else:
+                wflat_old = jnp.ravel(w_raws[0]) if len(w_raws) == 1 \
+                    else jnp.concatenate([jnp.ravel(w) for w in w_raws])
+                wflat_old = csc(jnp.pad(wflat_old, (0, padded - total)),
+                                shard)
+                new_w = jnp.where(fin, new_w, wflat_old)
         new_s = tuple(csc(s, shard) for s in new_s)
-        full = csc(new_w, repl)  # the in-trace param all-gather
+        out_flat = new_w.astype(out_dt) if mp else new_w
+        full = csc(out_flat, repl)  # the in-trace param all-gather
         outs, off = [], 0
         for shape, size in zip(shapes, sizes):
             outs.append(full[off:off + size].reshape(shape))
             off += size
+        ret = [tuple(outs), new_s]
         if sentinel:
             fins = jnp.stack([jnp.isfinite(p).all() for p in parts])
             gnorm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
-            return (tuple(outs), new_s,
-                    jnp.concatenate([fins.astype(jnp.float32),
-                                     gnorm[None]]))
-        return tuple(outs), new_s
+            ret.append(jnp.concatenate([fins.astype(jnp.float32),
+                                        gnorm[None]]))
+        if scaling:
+            ret.append(fin)
+        return tuple(ret)
 
     return jax.jit(_executor._count_traces(bucket_step, "kv_update"))
 
@@ -260,7 +330,8 @@ class _Bucket:
                  "target", "tset",
                  # cross-replica sharded update (arXiv:2004.13336)
                  "shard_n", "shard_mesh", "shard_sharding", "padded",
-                 "offsets", "nslots", "wdtype", "shard_state", "shard_src")
+                 "offsets", "nslots", "wdtype", "shard_state", "shard_src",
+                 "mp")
 
     def __init__(self, dtype):
         self.dtype = dtype
@@ -279,6 +350,7 @@ class _Bucket:
         self.wdtype = None        # the bucket's (uniform) weight dtype
         self.shard_state = None   # tuple of SHARDED flat state vectors
         self.shard_src = None     # per-key state fingerprints at ingest
+        self.mp = False           # fp32 master weights (docs/amp.md)
 
 
 class _SparseBucket:
@@ -293,7 +365,7 @@ class _SparseBucket:
     each row's gather/scatter to the shard owning it."""
 
     __slots__ = ("key", "shape", "gdtype", "target", "tset", "repl",
-                 "out_sharding", "mesh_sig", "nparts", "nslots")
+                 "out_sharding", "mesh_sig", "nparts", "nslots", "mp")
 
     def __init__(self, key, w_raw, nparts):
         self.key = key
@@ -306,6 +378,7 @@ class _SparseBucket:
         self.mesh_sig = None
         self.nparts = nparts
         self.nslots = 0
+        self.mp = False   # fp32 master rows for a low-precision table
         if isinstance(self.target, NamedSharding) \
                 and self.target.mesh.size > 1:
             mesh = self.target.mesh
@@ -356,8 +429,13 @@ class FusedUpdateEngine:
                 # sharding included — a "model"-sharded table stays
                 # sharded through the update)
                 w_raw = self._kv._store[keys[i]]._read()
-                sparse_buckets.append(
-                    _SparseBucket(keys[i], w_raw, ndev))
+                sb = _SparseBucket(keys[i], w_raw, ndev)
+                from . import amp as _amp
+
+                sb.mp = _amp.master_weights_wanted(self._opt, sb.gdtype)
+                if _amp.is_low_precision(sb.gdtype) and not sb.mp:
+                    _amp.warn_no_master(self._key_name(keys[i]))
+                sparse_buckets.append(sb)
                 continue
             g0 = vlists[i][0]._read()
             dt = np.dtype(g0.dtype)
@@ -379,7 +457,20 @@ class FusedUpdateEngine:
                 f"kv_sparse[{sb.key}:{'x'.join(map(str, sb.shape))}]",
                 argument=state_b, output=state_b, source="shape_math")
         idx = {k: i for i, k in enumerate(keys)}
+        from . import amp as _amp
+
         for b in buckets:
+            # fp32-master decision is bucket-wide: keys are
+            # dtype-segregated by GRAD dtype, so also require one
+            # uniform WEIGHT dtype before turning masters on
+            wdts = {np.dtype(self._kv._store[k].dtype) for k in b.keys
+                    if k in self._kv._store}
+            if len(wdts) == 1:
+                b.wdtype = wdts.pop()
+                b.mp = _amp.master_weights_wanted(self._opt, b.wdtype)
+                if _amp.is_low_precision(b.wdtype) and not b.mp:
+                    for k in b.keys:
+                        _amp.warn_no_master(self._key_name(k))
             raws = [vlists[idx[b.keys[0]]][d]._read() for d in range(ndev)]
             if ndev == 1:
                 # single (possibly mesh-global) grad per key: execute
@@ -407,10 +498,15 @@ class FusedUpdateEngine:
             # (and its update temp) is resident at 1/N per replica —
             # the row is where the arXiv:2004.13336 memory saving shows
             # up in the health layer's accounting
-            state_b = b.nbytes * max(b.nslots, 1) // b.shard_n
+            # mp adds the fp32 master as one more (weight-sized) state
+            # slot; sharded buckets hold it at 1/N per replica — the
+            # row is where the master-residency saving shows up
+            slots = b.nslots + (1 if b.mp else 0)
+            state_b = b.nbytes * max(slots, 1) // b.shard_n
             _tm.health.record_program(
                 f"kv_bucket{i}[{np.dtype(b.dtype).name}x{len(b.keys)}"
-                + (f"/shard{b.shard_n}" if b.shard_n > 1 else "") + "]",
+                + (f"/shard{b.shard_n}" if b.shard_n > 1 else "")
+                + ("/mp" if b.mp else "") + "]",
                 argument=b.nbytes * (ndev + 1) + state_b,
                 output=b.nbytes + state_b,
                 temp=b.nbytes // b.shard_n, source="shape_math")
@@ -439,11 +535,8 @@ class FusedUpdateEngine:
         flat = flat_rule(*rule) if rule is not None else None
         if flat is None:
             return
-        wdts = {np.dtype(self._kv._store[k].dtype) for k in b.keys
-                if k in self._kv._store}
-        if len(wdts) != 1:
+        if b.wdtype is None:  # mixed weight dtypes (set in _build_plan)
             return
-        b.wdtype = wdts.pop()
         b.nslots = flat[0]
         b.shard_n = int(sh.mesh.size)
         b.shard_mesh = sh.mesh
@@ -485,20 +578,36 @@ class FusedUpdateEngine:
         wds = {k: float(opt._get_wd(k)) for k in keys}
         rule_name, opt_params = opt.fused_rule()
         self._push_count += 1
+        # AMP dynamic loss scaling: the scale enters every bucket
+        # program as a traced device scalar; each program returns a
+        # finite flag, and ONE jitted lattice folds the step's flags
+        # into the scale schedule — all device-side, zero host syncs
+        from . import amp as _amp
+
+        scaling = _amp.scaling_active()
+        scale_raw = _amp.global_scaler().scale_raw() if scaling else None
+        flags: List = []
         try:
             for bi, b in enumerate(self._buckets):
-                self._step_bucket(b, bi, vlists, rule_name, opt_params,
-                                  lrs, wds)
+                flag = self._step_bucket(b, bi, vlists, rule_name,
+                                         opt_params, lrs, wds, scale_raw)
+                if flag is not None:
+                    flags.append(flag)
             if self._sparse_buckets:
                 ts = time.perf_counter() if t0 is not None else None
                 for si, sb in enumerate(self._sparse_buckets):
-                    self._step_sparse_bucket(sb, si, vlists, rule_name,
-                                             opt_params, lrs, wds)
+                    flag = self._step_sparse_bucket(
+                        sb, si, vlists, rule_name, opt_params, lrs, wds,
+                        scale_raw)
+                    if flag is not None:
+                        flags.append(flag)
                 if ts is not None:
                     from .sparse import _TM_SPARSE_SEC
 
                     _TM_SPARSE_SEC.observe(time.perf_counter() - ts,
                                            store=kv.type)
+            if flags:
+                _amp.global_scaler().end_step(flags)
         except Exception as e:  # noqa: BLE001 — OOM gets a report
             _tm.health.reraise_if_oom(e, site="kvstore_fused.push")
             raise
@@ -526,14 +635,16 @@ class FusedUpdateEngine:
             nd_arr._chunk.write(raw)
         return raw
 
-    def _step_bucket(self, b, bi, vlists, rule_name, opt_params, lrs, wds):
+    def _step_bucket(self, b, bi, vlists, rule_name, opt_params, lrs, wds,
+                     scale_raw=None):
         kv, upd = self._kv, self._updater
         sentinel = _tm.health.sentinel_mode() is not None
+        scaling = scale_raw is not None
         weights = [kv._store[k] for k in b.keys]
         if b.shard_n > 1:
             return self._step_bucket_sharded(b, bi, vlists, rule_name,
                                              opt_params, lrs, wds,
-                                             weights, sentinel)
+                                             weights, sentinel, scale_raw)
         slot_lists = [
             _state_slots(upd.ensure_state(k, w))
             for k, w in zip(b.keys, weights)
@@ -563,20 +674,25 @@ class FusedUpdateEngine:
                 flats.append(flat)
             dev_inputs = tuple(flats)
         wd_tuple = tuple(wds[k] for k in b.keys)
-        fn = self._program(b, rule_name, opt_params, wd_tuple, sentinel)
+        fn = self._program(b, rule_name, opt_params, wd_tuple, sentinel,
+                           scaling)
         lr_vec = np.asarray([lrs[k] for k in b.keys], np.float32)
+        args = (dev_inputs, tuple(w_raws), tuple(s_raws), lr_vec)
+        if scaling:
+            sh = getattr(scale_raw, "sharding", None)
+            if sh is not None and sh.device_set != b.tset:
+                scale_raw = jax.device_put(scale_raw, b.target)
+            args = args + (scale_raw,)
+        res = fn(*args)
+        new_w, new_s = res[0], res[1]
+        flag = res[-1] if scaling else None
         if sentinel:
-            new_w, new_s, sent_vec = fn(
-                dev_inputs, tuple(w_raws), tuple(s_raws), lr_vec)
             # park the device future — NO sync here; sentinel_check
             # reads it at the next reporting boundary
             _tm.health.sentinel_record(
                 site=f"kv_bucket{bi}", step=self._push_count,
                 names=[self._key_name(k) for k in b.keys],
-                finite=sent_vec, packed_norm=True)
-        else:
-            new_w, new_s = fn(dev_inputs, tuple(w_raws), tuple(s_raws),
-                              lr_vec)
+                finite=res[2], packed_norm=True)
         for i, w in enumerate(weights):
             # outputs carry the bucket's placement by construction:
             # rebind the chunks directly (NDArray._set would device_put
@@ -589,19 +705,25 @@ class FusedUpdateEngine:
 
             _TM_PUSH.inc(len(b.keys), store=kv.type)
             _TM_PUSH_BYTES.inc(b.nbytes, store=kv.type)
+        return flag
 
     # --------------------------------------------------- sparse bucket step
     def _step_sparse_bucket(self, sb, si, vlists, rule_name, opt_params,
-                            lrs, wds):
+                            lrs, wds, scale_raw=None):
         """One touched-rows-only update: per-device (idx, vals) pairs in,
         ONE jitted program (concat → in-trace segment-sum coalesce →
         gather touched weight/state rows → shared rule → scatter-add
         masked delta) out.  No host syncs: the row count is host-known
-        (it is the pushed slot count), lr is the traced scalar."""
+        (it is the pushed slot count), lr is the traced scalar.  A bf16
+        table under AMP carries an fp32 MASTER table as the last state
+        slot: touched master rows update in fp32 and the bf16 rows are
+        re-cast in the same program (lazy rows stay byte-identical in
+        both)."""
         from . import sparse as _sparse
 
         kv, upd = self._kv, self._updater
         sentinel = _tm.health.sentinel_mode() is not None
+        scaling = scale_raw is not None
         w = kv._store[sb.key]
         slots = _state_slots(upd.ensure_state(sb.key, w))
         sb.nslots = len(slots)
@@ -620,19 +742,24 @@ class FusedUpdateEngine:
             idx_parts.append(ir)
             val_parts.append(vr)
         fn = self._sparse_program(sb, rule_name, opt_params,
-                                  wds[sb.key], sentinel)
+                                  wds[sb.key], sentinel, scaling)
         lr = np.float32(lrs[sb.key])
+        args = (tuple(idx_parts), tuple(val_parts), w_raw, s_raws, lr)
+        if scaling:
+            sc = scale_raw
+            sh = getattr(sc, "sharding", None)
+            if sh is not None and sh.device_set != sb.tset:
+                sc = jax.device_put(
+                    sc, sb.repl if sb.repl is not None else sb.target)
+            args = args + (sc,)
+        res = fn(*args)
+        new_w, new_s = res[0], res[1]
+        flag = res[-1] if scaling else None
         if sentinel:
-            new_w, new_s, sent_vec = fn(tuple(idx_parts),
-                                        tuple(val_parts), w_raw,
-                                        s_raws, lr)
             _tm.health.sentinel_record(
                 site=f"kv_sparse{si}", step=self._push_count,
-                names=[self._key_name(sb.key)], finite=sent_vec,
+                names=[self._key_name(sb.key)], finite=res[2],
                 packed_norm=True)
-        else:
-            new_w, new_s = fn(tuple(idx_parts), tuple(val_parts),
-                              w_raw, s_raws, lr)
         w._chunk.write(new_w)
         for s_nd, s_raw in zip(slots, new_s):
             s_nd._chunk.write(s_raw)
@@ -646,12 +773,15 @@ class FusedUpdateEngine:
             _sparse._TM_SPARSE_ROWS.inc(nrows, store=kv.type)
             _sparse._TM_SPARSE_DENSITY.observe(
                 nrows / max(sb.shape[0], 1), store=kv.type)
+        return flag
 
     def _sparse_program(self, sb, rule_name, opt_params, wd_mult,
-                        sentinel=False):
+                        sentinel=False, scaling=False):
         key = ("kvsparse", rule_name, tuple(sorted(opt_params.items())),
                float(wd_mult), sb.gdtype.str, len(sb.shape), sb.nparts,
                sb.mesh_sig, sentinel)
+        if sb.mp or scaling:
+            key = key + (("amp", sb.mp, scaling),)
         fn = _executor.program_cache_get(key)
         if fn is None:
             fn = self._local_programs.get(key)
@@ -661,21 +791,24 @@ class FusedUpdateEngine:
                 fn = _sparse.make_row_program(
                     rule_name, tuple(sorted(opt_params.items())),
                     float(wd_mult), sb.nparts, sentinel=sentinel,
-                    out_sharding=sb.out_sharding)
+                    out_sharding=sb.out_sharding, mp=sb.mp,
+                    scaling=scaling)
                 _executor.program_cache_put(key, fn)
         self._local_programs[key] = fn
         return fn
 
     # ------------------------------------------- cross-replica sharded step
     def _step_bucket_sharded(self, b, bi, vlists, rule_name, opt_params,
-                             lrs, wds, weights, sentinel):
+                             lrs, wds, weights, sentinel, scale_raw=None):
         """One sharded bucket step (arXiv:2004.13336): grads/weights
         enter per-key (replicated), the jitted program reduce-scatters
         the flat gradient, updates each replica's 1/N slice against the
-        bucket's device-resident SHARDED flat state, and all-gathers
-        fresh per-key weights — one compiled program, no host sync, no
-        per-key state dispatches."""
+        bucket's device-resident SHARDED flat state (fp32 masters
+        included under AMP — 1/N master bytes per replica), and
+        all-gathers fresh per-key weights — one compiled program, no
+        host sync, no per-key state dispatches."""
         kv = self._kv
+        scaling = scale_raw is not None
         self._ensure_shard_state(b)
         idx = self._key_index
         parts = []
@@ -687,18 +820,24 @@ class FusedUpdateEngine:
         w_raws = [self._place(w, b.target, b.tset) for w in weights]
         wd_tuple = tuple(wds[k] for k in b.keys)
         fn = self._shard_program(b, rule_name, opt_params, wd_tuple,
-                                 sentinel)
+                                 sentinel, scaling)
         lr_vec = np.asarray([lrs[k] for k in b.keys], np.float32)
+        args = (tuple(parts), tuple(w_raws), b.shard_state, lr_vec)
+        if scaling:
+            sc = scale_raw
+            sh = getattr(sc, "sharding", None)
+            if sh is not None and sh.device_set != b.tset:
+                sc = jax.device_put(
+                    sc, NamedSharding(b.shard_mesh, P()))
+            args = args + (sc,)
+        res = fn(*args)
+        new_w, new_s = res[0], res[1]
+        flag = res[-1] if scaling else None
         if sentinel:
-            new_w, new_s, sent_vec = fn(tuple(parts), tuple(w_raws),
-                                        b.shard_state, lr_vec)
             _tm.health.sentinel_record(
                 site=f"kv_bucket{bi}", step=self._push_count,
                 names=[self._key_name(k) for k in b.keys],
-                finite=sent_vec, packed_norm=True)
-        else:
-            new_w, new_s = fn(tuple(parts), tuple(w_raws),
-                              b.shard_state, lr_vec)
+                finite=res[2], packed_norm=True)
         b.shard_state = tuple(new_s)
         for i, w in enumerate(weights):
             w._chunk.write(new_w[i])
@@ -713,6 +852,7 @@ class FusedUpdateEngine:
             _executor._TM_COLLECTIVE.inc(
                 b.padded * np.dtype(b.dtype).itemsize // b.shard_n,
                 op="kv_grad_shard")
+        return flag
 
     def _state_fingerprints(self, b):
         """{key: ((chunk, version), ...)} of the per-key state NDArrays
@@ -736,21 +876,30 @@ class FusedUpdateEngine:
         per-key state (an eager interlude, load_optimizer_states, a
         checkpoint restore) are folded in, and their (chunk, version)
         fingerprints recorded so any outside write triggers a
-        re-ingest on the next sharded step."""
+        re-ingest on the next sharded step.
+
+        Under ``b.mp`` the LAST slot is the fp32 master: rule slots
+        ingest fp32, and an absent master initializes from the stored
+        (bf16) weight itself — upcast, never zeros."""
         cur = self._state_fingerprints(b)
         if b.shard_state is not None and cur == b.shard_src:
             return
-        dt = np.dtype(b.wdtype)
+        total_slots = b.nslots + (1 if b.mp else 0)
         flats = []
-        for s in range(b.nslots):
+        for s in range(total_slots):
+            is_master = b.mp and s == total_slots - 1
+            dt = np.float32 if b.mp else np.dtype(b.wdtype)
             segs = []
             for i, k in enumerate(b.keys):
                 st = self._updater.states.get(k)
-                if st is None:
-                    segs.append(jnp.zeros(b.sizes[i], dtype=dt))
+                slots = _state_slots(st) if st is not None else ()
+                if s < len(slots):
+                    segs.append(jnp.ravel(slots[s]._read()).astype(dt))
+                elif is_master:
+                    w = self._kv._store[k]
+                    segs.append(jnp.ravel(w._read()).astype(jnp.float32))
                 else:
-                    segs.append(jnp.ravel(
-                        _state_slots(st)[s]._read()).astype(dt))
+                    segs.append(jnp.zeros(b.sizes[i], dtype=dt))
             flat = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
             flat = jnp.pad(flat, (0, b.padded - int(sum(b.sizes))))
             flats.append(jax.device_put(flat, b.shard_sharding))
@@ -797,9 +946,14 @@ class FusedUpdateEngine:
     def state_memory(self) -> dict:
         """Optimizer-state residency of the current plan: global bytes
         vs bytes per replica (the arXiv:2004.13336 saving, asserted by
-        tests and emitted by bench.py's shard section)."""
+        tests and emitted by bench.py's shard section).  AMP master
+        weights are state slots, so they are counted here — the
+        ``master_*`` fields break them out (a sharded mp bucket holds
+        1/N master bytes per replica; docs/amp.md)."""
         per_replica = 0
         global_b = 0
+        master_global = 0
+        master_per_replica = 0
         sharded = 0
         for b in self._buckets or ():
             if b.shard_state is not None:
@@ -807,54 +961,81 @@ class FusedUpdateEngine:
                              for f in b.shard_state)
                 global_b += bytes_
                 per_replica += bytes_ // b.shard_n
+                if b.mp:
+                    mb = int(b.shard_state[-1].size) * 4
+                    master_global += mb
+                    master_per_replica += mb // b.shard_n
                 sharded += 1
             else:
                 bytes_ = 0
                 for k in b.keys:
-                    for s_nd in _state_slots(self._updater.states.get(k)):
+                    slots = _state_slots(self._updater.states.get(k))
+                    for s_nd in slots:
                         bytes_ += int(s_nd.size) * \
                             np.dtype(s_nd.dtype).itemsize
+                    if b.mp and slots:
+                        mb = int(slots[-1].size) * 4
+                        master_global += mb
+                        master_per_replica += mb
                 global_b += bytes_
                 per_replica += bytes_  # replicated: every replica holds all
         for sb in self._sparse_buckets:
+            slots = _state_slots(self._updater.states.get(sb.key))
             bytes_ = 0
-            for s_nd in _state_slots(self._updater.states.get(sb.key)):
+            for s_nd in slots:
                 bytes_ += int(s_nd.size) * np.dtype(s_nd.dtype).itemsize
+            if sb.mp and slots:
+                mb = int(slots[-1].size) * 4
+                master_global += mb
+                master_per_replica += mb
             global_b += bytes_
             per_replica += bytes_
         return {"global_bytes": global_b, "per_replica_bytes": per_replica,
+                "master_bytes": master_global,
+                "master_bytes_per_replica": master_per_replica,
                 "sharded_buckets": sharded,
                 "replicas": self.shard_replicas}
 
     def _shard_program(self, b, rule_name, opt_params, wd_tuple,
-                       sentinel=False):
+                       sentinel=False, scaling=False):
         mesh = b.shard_mesh
         mesh_sig = (mesh.axis_names, mesh.devices.shape,
                     tuple(d.id for d in mesh.devices.flat))
         key = ("kvshard", rule_name, tuple(sorted(opt_params.items())),
                b.dtype.str, np.dtype(b.wdtype).str, tuple(b.shapes),
                wd_tuple, mesh_sig, sentinel)
+        if b.mp or scaling:
+            # AMP axes join the key only when active, so AMP-off runs
+            # keep the exact pre-AMP cache keys (bit-identity contract)
+            key = key + (("amp", b.mp, scaling),)
         fn = _executor.program_cache_get(key)
         if fn is None:
             fn = self._local_programs.get(key)
             if fn is None:
                 fn = _make_sharded_bucket_program(
                     rule_name, opt_params, tuple(b.shapes),
-                    tuple(b.sizes), wd_tuple, b.wdtype, mesh, sentinel)
+                    tuple(b.sizes), wd_tuple, b.wdtype, mesh, sentinel,
+                    mp=b.mp, scaling=scaling)
                 _executor.program_cache_put(key, fn)
         self._local_programs[key] = fn
         return fn
 
-    def _program(self, b, rule_name, opt_params, wd_tuple, sentinel=False):
+    def _program(self, b, rule_name, opt_params, wd_tuple, sentinel=False,
+                 scaling=False):
         key = ("kvfused", rule_name, tuple(sorted(opt_params.items())),
                b.dtype.str, tuple(b.shapes), wd_tuple, sentinel)
+        if b.mp or scaling:
+            key = key + (("amp", b.mp, np.dtype(b.wdtype).str
+                          if b.wdtype is not None else None, scaling),)
         fn = _executor.program_cache_get(key)
         if fn is None:
             fn = self._local_programs.get(key)
             if fn is None:
                 fn = _make_bucket_program(rule_name, opt_params,
                                           tuple(b.shapes), tuple(b.sizes),
-                                          wd_tuple, sentinel)
+                                          wd_tuple, sentinel,
+                                          mp=b.mp, wdtype=b.wdtype,
+                                          scaling=scaling)
                 _executor.program_cache_put(key, fn)
         self._local_programs[key] = fn
         return fn

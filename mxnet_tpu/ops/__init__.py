@@ -32,3 +32,4 @@ from . import vision  # noqa: F401
 from . import ctc  # noqa: F401
 from . import custom  # noqa: F401
 from . import flash_attention  # noqa: F401
+from . import residual_epilogue  # noqa: F401

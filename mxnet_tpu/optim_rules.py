@@ -15,6 +15,15 @@ tensor.  ``lr`` arrives per-call as a traced scalar — lr schedules (and
 Adam's per-step bias correction, computed on host) never retrace the
 compiled program.  ``wd_mult`` is a static per-tensor float and folds
 into the compile.
+
+AMP fp32 master weights (docs/amp.md) need NO rule variants: every
+rule here is already pure fp32-capable elementwise math, so the bucket
+programs simply run ``update(master, grad.astype(f32), rule_state,
+...)`` against the fp32 master carried as the trailing state slot and
+cast the fresh low-precision parameter afterwards — per-key, flat
+(sharded), and sparse (row-gathered) forms alike.  The master layout
+is owned by optimizer.create_state / kvstore_fused, keeping these
+kernels bit-identical between fp32 and mixed-precision training.
 """
 from __future__ import annotations
 

@@ -14,6 +14,7 @@ import logging
 import math
 from typing import Dict, Optional
 
+import numpy as np
 
 from . import ndarray as nd
 from .base import MXNetError
@@ -34,12 +35,18 @@ class Optimizer:
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
-                 sym=None, begin_num_update=0, **kwargs):
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 **kwargs):
         if "lr" in kwargs:  # widely-used alias; silently dropping it would
             learning_rate = kwargs.pop("lr")  # train at the 0.01 default
         if kwargs:
             logging.warning("Optimizer: ignoring unknown arguments %s",
                             sorted(kwargs))
+        # reference API: multi_precision=True keeps an fp32 master copy
+        # as the LAST optimizer-state slot for low-precision weights and
+        # runs the update in fp32 (optimizer.py SGD multi_precision).
+        # MXTPU_AMP=bf16 implies it for every bf16 param (amp.py).
+        self.multi_precision = bool(multi_precision)
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
@@ -114,6 +121,35 @@ class Optimizer:
             wd *= self.wd_mult.get(self.idx2name[index], 1.0)
         return wd
 
+    # --------------------------------------------- fp32 master weights
+    # which optimizer classes implement the master-state layout (SGD /
+    # ccSGD / Adam / plain RMSProp); subclasses with different update
+    # math (NAG) opt out or their state tuples would be misread
+    master_supported = False
+
+    def _use_master(self, weight) -> bool:
+        """Does this weight's update run through an fp32 master?  True
+        for low-precision weights when ``multi_precision`` (or the
+        process AMP policy) is on — create_state then appends the
+        master as the LAST state slot, and update()/the fused engine
+        compute in fp32 and cast the fresh weight back."""
+        from . import amp as _amp
+
+        return self.master_supported \
+            and _amp.master_weights_wanted(self, weight.dtype)
+
+    def _master_state(self, weight):
+        """The appended master slot: an fp32 copy of the weight."""
+        return weight.astype(np.float32) if hasattr(weight, "astype") \
+            else nd.array(np.asarray(weight, np.float32))
+
+    def _warn_low_precision(self, index, weight):
+        """Warn-once hook for low-precision updates WITHOUT masters."""
+        from . import amp as _amp
+
+        if _amp.is_low_precision(weight.dtype):
+            _amp.warn_no_master(self.idx2name.get(index, index))
+
     # ------------------------------------------------- fused kvstore path
     def fused_rule(self):
         """(rule name, static hyperparams) for the bucketed jit-fused
@@ -143,11 +179,20 @@ class SGD(Optimizer):
     """SGD with momentum (parity: optimizer.py:198); dispatches to the
     fused sgd(_mom)_update kernels (optimizer_op.cc parity)."""
 
+    master_supported = True
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
 
     def create_state(self, index, weight):
+        if self._use_master(weight):
+            # (momentum?, master) — master LAST, all slots fp32
+            mom = () if self.momentum == 0.0 else (
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=np.float32),)
+            return mom + (self._master_state(weight),)
+        self._warn_low_precision(index, weight)
         if self.momentum == 0.0:
             return None
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
@@ -157,6 +202,19 @@ class SGD(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         attrs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
                  "clip_gradient": self.clip_gradient or 0.0}
+        if self._use_master(weight) and isinstance(state, (tuple, list)):
+            master = state[-1]
+            grad32 = grad.astype(np.float32)
+            if self.momentum != 0.0:
+                new_w, new_mom = nd.sgd_mom_update(
+                    master, grad32, state[0], momentum=self.momentum,
+                    **attrs)
+                state[0]._set(new_mom._read())
+            else:
+                new_w = nd.sgd_update(master, grad32, **attrs)
+            master._set(new_w._read())
+            weight._set(new_w._read().astype(weight.dtype))
+            return
         if state is not None:
             new_w, new_mom = nd.sgd_mom_update(weight, grad, state,
                                                momentum=self.momentum, **attrs)
@@ -178,6 +236,8 @@ class SGD(Optimizer):
 @register
 class NAG(SGD):
     """Nesterov accelerated SGD (parity: optimizer.py:374)."""
+
+    master_supported = False  # custom update math; no master layout
 
     def update(self, index, weight, grad, state):
         # reference NAG (optimizer.py:374): mom = momentum*mom + grad';
@@ -219,14 +279,20 @@ class CcSGD(SGD):
 class Adam(Optimizer):
     """Adam (parity: optimizer.py:493) with bias correction; fused kernel."""
 
+    master_supported = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, ctx=weight.context),
-                nd.zeros(weight.shape, ctx=weight.context))
+        slots = (nd.zeros(weight.shape, ctx=weight.context),
+                 nd.zeros(weight.shape, ctx=weight.context))
+        if self._use_master(weight):
+            return slots + (self._master_state(weight),)
+        self._warn_low_precision(index, weight)
+        return slots
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -235,12 +301,20 @@ class Adam(Optimizer):
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
         lr_t = lr * math.sqrt(coef2) / coef1
-        mean, var = state
+        use_master = self._use_master(weight) and len(state) == 3
+        mean, var = state[0], state[1]
+        target = state[2] if use_master else weight
+        grad_in = grad.astype(np.float32) if use_master else grad
         new_w, new_mean, new_var = nd.adam_update(
-            weight, grad, mean, var, lr=lr_t, beta1=self.beta1, beta2=self.beta2,
+            target, grad_in, mean, var, lr=lr_t, beta1=self.beta1,
+            beta2=self.beta2,
             epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
             clip_gradient=self.clip_gradient or 0.0)
-        weight._set(new_w._read())
+        if use_master:
+            target._set(new_w._read())
+            weight._set(new_w._read().astype(weight.dtype))
+        else:
+            weight._set(new_w._read())
         mean._set(new_mean._read())
         var._set(new_var._read())
 
@@ -289,6 +363,8 @@ class AdaGrad(Optimizer):
 class RMSProp(Optimizer):
     """Parity: optimizer.py:632 (Tieleman & Hinton variant w/ gamma1)."""
 
+    master_supported = True  # plain variant only (centered is eager)
+
     def __init__(self, learning_rate=0.001, gamma1=0.95, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -302,19 +378,32 @@ class RMSProp(Optimizer):
             return (nd.zeros(weight.shape, ctx=weight.context),
                     nd.zeros(weight.shape, ctx=weight.context),
                     nd.zeros(weight.shape, ctx=weight.context))
+        if self._use_master(weight):
+            return (nd.zeros(weight.shape, ctx=weight.context),
+                    self._master_state(weight))
+        self._warn_low_precision(index, weight)
         return nd.zeros(weight.shape, ctx=weight.context)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         if not self.centered:
-            n = state
+            use_master = self._use_master(weight) \
+                and isinstance(state, (tuple, list))
+            n = state[0] if use_master else state
+            target = state[1] if use_master else weight
+            grad_in = grad.astype(np.float32) if use_master else grad
             new_w, new_n = nd.rmsprop_update(
-                weight, grad, n, lr=lr, gamma1=self.gamma1, epsilon=self.epsilon,
+                target, grad_in, n, lr=lr, gamma1=self.gamma1,
+                epsilon=self.epsilon,
                 wd=wd, rescale_grad=self.rescale_grad,
                 clip_gradient=self.clip_gradient or 0.0,
                 clip_weights=self.clip_weights or 0.0)
-            weight._set(new_w._read())
+            if use_master:
+                target._set(new_w._read())
+                weight._set(new_w._read().astype(weight.dtype))
+            else:
+                weight._set(new_w._read())
             n._set(new_n._read())
             return
         n, g, delta = state
@@ -424,6 +513,13 @@ class Updater:
         return self.states[index]
 
     def __call__(self, index, grad, weight):
+        from . import amp as _amp
+
+        # AMP dynamic loss scaling: the fused bucket programs unscale
+        # in-trace; this eager entry divides by the live scale here so
+        # fallback loops and fused steps interleave consistently (the
+        # skip-step lattice does NOT apply on the eager path)
+        grad = _amp.maybe_unscale_grad(grad)
         if getattr(grad, "stype", "default") == "row_sparse":
             # touched-rows-only lazy update (sparse.py): same jitted
             # row program as the fused sparse bucket, so eager and

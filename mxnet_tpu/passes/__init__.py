@@ -12,6 +12,10 @@ Pipeline (registration order = run order; docs/graph_passes.md):
 - ``constant_fold``  evaluate constant subgraphs once, bake literals
 - ``cse``            merge structurally identical nodes
 - ``dce``            drop identity/no-op nodes, prune dead ones
+- ``residual_epilogue`` fuse relu(add)/relu(BN(add)) residual tails
+                     into the Pallas epilogue ops (docs/amp.md)
+- ``amp_cast``       MXTPU_AMP=bf16 precision policy as Cast insertion
+                     (no-op — same symbol object — when AMP is off)
 - ``prefuse``        collapse elementwise chains into one fused node
 - ``convbn_fold``    inference-only Conv+BN weight folding (needs the
                      parameter values; Predictor/serving path only)
@@ -178,6 +182,12 @@ def pipeline_report(symbol):
 from . import constant_fold  # noqa: E402,F401
 from . import cse  # noqa: E402,F401
 from . import dce  # noqa: E402,F401
+# residual_epilogue after dce (identity nodes between add/BN/relu are
+# gone by then); amp_cast after it (the fused epilogue ops are
+# pass-through for the precision policy) and before prefuse (inserted
+# Casts join elementwise chains)
+from . import residual_epilogue  # noqa: E402,F401
+from . import amp_cast  # noqa: E402,F401
 from . import prefuse  # noqa: E402,F401
 from . import convbn  # noqa: E402,F401
 from .convbn import fold_conv_bn  # noqa: E402,F401

@@ -1,0 +1,118 @@
+"""AMP bf16 cast-insertion pass (``MXTPU_AMP=bf16``).
+
+nGraph's argument (arXiv:1801.08058) applied to precision instead of
+layout: a framework-level policy applied as an IR transform beats
+per-model hand-casting.  With the policy armed, every bind rewrites
+the same way — Module, Predictor, serving, tests — and the program
+cache keys on the post-pass signature, so an AMP bind and an fp32 bind
+of the same net are two distinct cached programs.
+
+Policy (docs/amp.md):
+
+- **allow list** (compute bf16): Convolution / Deconvolution /
+  FullyConnected / dot / batch_dot / FlashAttention — the MXU ops
+  where bf16 is the fast path.  EVERY float input (data, weights,
+  bias) is cast so the op never promotes back to f32 via a mixed
+  operand.
+- **deny list** (cast back to f32): the softmax family, the loss
+  output ops, and whole-tensor reductions — the places where bf16's
+  ~8-bit mantissa visibly hurts.  Only op-produced inputs are cast:
+  variables feeding a loss are labels/targets whose dtype (often
+  integer-valued) must pass through untouched.
+- everything else is **pass-through**: elementwise chains, pooling,
+  reshapes run in whatever dtype arrives.  The norm ops need no deny
+  entry — ops/nn.py BatchNorm/LayerNorm accumulate their statistics
+  in f32 internally regardless of the compute dtype (that is the
+  "norm statistics stay fp32" half of the policy).
+
+With ``MXTPU_AMP`` unset the pass returns the INPUT SYMBOL OBJECT —
+not a copy — so signatures, program-cache keys, and numerics are
+bit-identical to a build without this pass.
+
+Gradients: jax.vjp through an inserted ``Cast`` transposes to a cast
+back, so parameter gradients leave the fused fwd+bwd in the parameter
+dtype (f32 weights get f32 grads) — the fp32-master story for
+f32-stored params is simply "the params are the masters"; bf16-stored
+params take the bucket-master path in kvstore_fused.py.
+"""
+from __future__ import annotations
+
+from .. import amp as _amp
+from ..symbol import Symbol, _Node
+from . import register_pass
+
+# MXU ops whose float inputs are cast to the AMP compute dtype
+AMP_ALLOW = frozenset({
+    "Convolution", "Deconvolution", "FullyConnected",
+    "dot", "batch_dot", "FlashAttention",
+})
+
+# ops whose op-produced inputs are cast back to f32: softmax family,
+# loss outputs, whole-tensor reductions (sum/mean/... and their
+# aliases).  Norm layers are deliberately absent — their statistics are
+# f32 by construction (ops/nn.py).
+AMP_DENY = frozenset({
+    "softmax", "log_softmax", "SoftmaxActivation",
+    "SoftmaxOutput", "Softmax", "softmax_cross_entropy",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "SVMOutput", "MakeLoss",
+    "sum", "sum_axis", "mean", "prod", "nansum", "nanprod",
+    "max", "max_axis", "min", "min_axis", "norm",
+})
+
+
+@register_pass("amp_cast", training_safe=True)
+def amp_cast(symbol: Symbol) -> Symbol:
+    """Insert the policy's Cast nodes (no-op unless MXTPU_AMP=bf16)."""
+    dtype = _amp.amp_dtype()
+    if dtype is None:
+        return symbol
+    compute = "bfloat16"
+
+    memo: dict = {}
+    casts: dict = {}  # (id(node), oidx, dtype) -> cast entry
+    inserted = 0
+
+    def cast_entry(entry, dt):
+        nonlocal inserted
+        src, oidx = entry
+        if not src.is_variable:
+            if src.op == "Cast" and str(src.attrs.get("dtype")) == dt:
+                return entry
+            if dt == compute and src.op in AMP_ALLOW:
+                return entry  # an allow op already produces bf16
+        key = (id(src), oidx, dt)
+        got = casts.get(key)
+        if got is None:
+            node = _Node("Cast", f"{src.name}_amp_{dt}",
+                         attrs={"dtype": dt}, inputs=[entry])
+            got = (node, 0)
+            casts[key] = got
+            inserted += 1
+        return got
+
+    for node in symbol.nodes:
+        if node.is_variable:
+            memo[id(node)] = ((node, 0),)
+            continue
+        new_inputs = [memo[id(src)][oidx] for src, oidx in node.inputs]
+        if node.op in AMP_ALLOW:
+            new_inputs = [cast_entry(e, compute) for e in new_inputs]
+        elif node.op in AMP_DENY:
+            # only op-produced inputs: variables here are labels /
+            # targets whose dtype must pass through untouched
+            new_inputs = [e if e[0].is_variable else cast_entry(e, "float32")
+                          for e in new_inputs]
+        if all(e[0] is src and e[1] == oidx
+               for e, (src, oidx) in zip(new_inputs, node.inputs)):
+            memo[id(node)] = tuple(
+                (node, k) for k in range(node.num_outputs()))
+        else:
+            clone = _Node(node.op, node.name, attrs=node.attrs,
+                          inputs=new_inputs, extra_attrs=node.extra_attrs)
+            memo[id(node)] = tuple(
+                (clone, k) for k in range(clone.num_outputs()))
+    if not inserted:
+        return symbol
+    _amp.count_cast_nodes(inserted)
+    return Symbol([memo[id(n)][i] for n, i in symbol._outputs])

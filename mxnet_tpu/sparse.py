@@ -356,7 +356,8 @@ def coalesce_rows(idx, vals):
 
 def make_row_program(rule_name: str, opt_params: tuple, wd_mult: float,
                      nparts: int, sentinel: bool = False,
-                     out_sharding=None, donate: bool = True):
+                     out_sharding=None, donate: bool = True,
+                     mp: bool = False, scaling: bool = False):
     """Build the ONE jitted touched-rows-only update program for a
     sparse bucket: concat the per-device ``(idx, vals)`` parts,
     coalesce by sort + segment-sum, gather the touched weight/state
@@ -378,6 +379,16 @@ def make_row_program(rule_name: str, opt_params: tuple, wd_mult: float,
     which adopted the table buffer via a zero-copy pull raises
     "deleted/donated" if read after the NEXT push but before its pull
     (push/pull are adjacent in every Module step) — see docs/sparse.md.
+
+    ``mp`` (AMP fp32 master rows, docs/amp.md): the LAST state slot is
+    the fp32 master TABLE of a low-precision table — touched master
+    rows gather, the rule runs in fp32 on them, and BOTH the master
+    rows and the freshly-cast table rows scatter back in this same
+    program; untouched rows of table and master stay byte-identical
+    (the lazy contract).  ``scaling`` (AMP dynamic loss scaling): a
+    traced scale unscales the pushed rows in-trace, a finite flag
+    selects old-vs-new rows (the skip-step lattice), and the flag
+    rides out for the scale-update program.
     """
     from . import executor as _executor
     from .optim_rules import sparse_rule
@@ -385,44 +396,72 @@ def make_row_program(rule_name: str, opt_params: tuple, wd_mult: float,
     nslots, update = sparse_rule(rule_name, dict(opt_params))
     del nslots
 
-    def step(idx_parts, val_parts, w, slots, lr):
+    def step(idx_parts, val_parts, w, slots, lr, scale=None):
         idx = idx_parts[0] if len(idx_parts) == 1 \
             else jnp.concatenate(idx_parts)
         vals = val_parts[0] if len(val_parts) == 1 \
             else jnp.concatenate(val_parts)
+        fin = jnp.isfinite(vals).all() if scaling else None
+        if scaling:
+            vals = vals * (1.0 / scale).astype(vals.dtype)
         sid, gvals, first = coalesce_rows(idx, vals)
-        w_rows = jnp.take(w, sid, axis=0)
-        s_rows = tuple(jnp.take(s, sid, axis=0) for s in slots)
+        if mp:
+            master, rslots = slots[-1], slots[:-1]
+            w_rows = jnp.take(master, sid, axis=0)
+            gvals = gvals.astype(jnp.float32)
+        else:
+            master, rslots = None, slots
+            w_rows = jnp.take(w, sid, axis=0)
+        s_rows = tuple(jnp.take(s, sid, axis=0) for s in rslots)
         new_rows, new_s_rows = update(w_rows, gvals, s_rows, lr, wd_mult)
+        if scaling:
+            new_rows = jnp.where(fin, new_rows, w_rows)
+            new_s_rows = tuple(jnp.where(fin, ns, sr)
+                               for ns, sr in zip(new_s_rows, s_rows))
         mask = first.reshape((-1,) + (1,) * (vals.ndim - 1))
-        new_w = w.at[sid].add(
-            jnp.where(mask, (new_rows - w_rows).astype(w.dtype), 0))
+        delta = jnp.where(mask, new_rows - w_rows, 0)
         new_slots = tuple(
             s.at[sid].add(jnp.where(mask, (ns - sr).astype(s.dtype), 0))
-            for s, ns, sr in zip(slots, new_s_rows, s_rows))
+            for s, ns, sr in zip(rslots, new_s_rows, s_rows))
+        if mp:
+            new_master = master.at[sid].add(delta)
+            # table rows become cast-of-master: add (cast(new_row) -
+            # current_row) on first occurrences — a masked SET, so the
+            # bf16 row is always the exact cast of its fp32 master
+            cur_rows = jnp.take(w, sid, axis=0)
+            new_w = w.at[sid].add(
+                jnp.where(mask, new_rows.astype(w.dtype) - cur_rows, 0))
+            new_slots = new_slots + (new_master,)
+        else:
+            new_w = w.at[sid].add(delta.astype(w.dtype))
         if out_sharding is not None:
             csc = jax.lax.with_sharding_constraint
             new_w = csc(new_w, out_sharding)
             new_slots = tuple(csc(s, out_sharding) for s in new_slots)
+        ret = [new_w, new_slots]
         if sentinel:
-            fin = jnp.isfinite(vals).all()[None].astype(jnp.float32)
+            sfin = jnp.isfinite(vals).all()[None].astype(jnp.float32)
             gnorm = jnp.sqrt(jnp.sum(
                 jnp.square(gvals.astype(jnp.float32))))
-            return new_w, new_slots, jnp.concatenate([fin, gnorm[None]])
-        return new_w, new_slots
+            ret.append(jnp.concatenate([sfin, gnorm[None]]))
+        if scaling:
+            ret.append(fin)
+        return tuple(ret)
 
     if not donate:
         return jax.jit(_executor._count_traces(step, "kv_sparse"))
     inner = jax.jit(_executor._count_traces(step, "kv_sparse"),
                     donate_argnums=(2, 3))
 
-    def counted(idx_parts, val_parts, w, slots, lr):
+    def counted(idx_parts, val_parts, w, slots, lr, scale=None):
         if _tm.enabled():
             nbytes = int(w.size) * np.dtype(w.dtype).itemsize \
                 + sum(int(s.size) * np.dtype(s.dtype).itemsize
                       for s in slots)
             _tm.health.donation_saved(nbytes, site="kv_sparse")
-        return inner(idx_parts, val_parts, w, slots, lr)
+        if scale is None:
+            return inner(idx_parts, val_parts, w, slots, lr)
+        return inner(idx_parts, val_parts, w, slots, lr, scale)
 
     return counted
 
@@ -480,11 +519,14 @@ def eager_update(optimizer, updater, index, weight: NDArray,
     lr = float(optimizer.fused_lr(index))
     wd_mult = float(optimizer._get_wd(index))
     slots = _state_slots(updater.ensure_state(index, weight))
-    key = (rule_name, tuple(sorted(opt_params.items())), wd_mult)
+    # AMP fp32 master rows: the state's trailing slot is the master
+    # table (optimizer.create_state) — the program must know
+    mp = optimizer._use_master(weight)
+    key = (rule_name, tuple(sorted(opt_params.items())), wd_mult, mp)
     fn = _EAGER_PROGRAMS.get(key)
     if fn is None:
         fn = make_row_program(rule_name, tuple(sorted(opt_params.items())),
-                              wd_mult, nparts=1)
+                              wd_mult, nparts=1, mp=mp)
         _EAGER_PROGRAMS[key] = fn
     new_w, new_slots = fn(
         (rs_grad.indices._read(),), (rs_grad.data._read(),),

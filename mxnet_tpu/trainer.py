@@ -63,7 +63,7 @@ class FusedTrainer:
 
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  optimizer="sgd", optimizer_params=None, mesh: Optional[Mesh] = None,
-                 initializer=None, dtype=jnp.float32, sharding_rules=(),
+                 initializer=None, dtype=None, sharding_rules=(),
                  remat=None, fixed_param_names=(), clip_global_norm=None,
                  lr_scheduler=None):
         # rematerialization = the reference's MXNET_BACKWARD_DO_MIRROR
@@ -77,6 +77,14 @@ class FusedTrainer:
         self.data_names = list(data_names)
         self.label_names = list(label_names)
         self.mesh = mesh
+        # dtype=None follows the process AMP policy (MXTPU_AMP=bf16 →
+        # bf16 compute + the fp32 masters this trainer always keeps);
+        # an explicit dtype still wins — "bf16 by default" is one env
+        # flag for the FusedTrainer path too
+        if dtype is None:
+            from . import amp as _amp
+
+            dtype = _amp.amp_dtype() or jnp.float32
         self.dtype = jnp.dtype(dtype)
         opt_params = dict(optimizer_params or {})
         opt_params.setdefault("lr", opt_params.pop("learning_rate", 0.01))

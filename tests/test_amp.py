@@ -1,0 +1,640 @@
+"""First-class AMP through Module/Executor/KVStore (ISSUE 10).
+
+Contracts pinned here:
+
+- ``MXTPU_AMP`` unset: every path is bit-identical — the amp_cast pass
+  returns the SAME symbol object (signatures and program-cache keys
+  unchanged), two runs agree bitwise.
+- ``MXTPU_AMP=bf16``: amp-vs-fp32 convergence parity on a ResNet-style
+  conv net and a transformer LM through the full
+  Module/Executor/KVStore path, within bf16 tolerance.
+- fp32 master weights: eager ``multi_precision``, fused buckets, the
+  8-virtual-device sharded buckets (1/N master bytes per replica), and
+  sparse bf16 tables (fp32 master rows) all agree with fp32 math.
+- dynamic loss scaling: overflow -> skip-step -> halve -> recovery as
+  a device-side lattice, with ZERO per-batch host syncs (counter
+  asserted).
+- the Pallas residual-epilogue kernel matches the lax lowering fwd AND
+  bwd (interpret mode on CPU).
+"""
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, nd, sym
+from mxnet_tpu import executor as ex_mod
+from mxnet_tpu import models
+from mxnet_tpu.module import Module
+from mxnet_tpu.io import DataBatch
+
+
+@pytest.fixture(autouse=True)
+def _amp_isolation(monkeypatch):
+    """Every test starts AMP-off with a fresh scaler.  The program
+    cache is NOT cleared here: AMP binds key on the post-pass
+    signature, so amp-on/amp-off entries never collide, and sharing
+    compiled programs across tests keeps this file's wall time inside
+    the tier-1 budget (tests needing a cold cache clear it
+    themselves)."""
+    monkeypatch.delenv("MXTPU_AMP", raising=False)
+    monkeypatch.delenv("MXTPU_LOSS_SCALE", raising=False)
+    monkeypatch.delenv("MXTPU_LOSS_SCALE_WINDOW", raising=False)
+    amp.reset_scaler()
+    yield
+    amp.reset_scaler()
+
+
+def _fill(ex, seed=7, nclass=4):
+    rng = np.random.RandomState(seed)
+    for k in sorted(ex.arg_dict):
+        v = ex.arg_dict[k]
+        if k == "softmax_label":
+            v[:] = rng.randint(0, nclass, v.shape).astype(np.float32)
+        elif k == "data" and len(v.shape) == 2:
+            v[:] = rng.randint(0, 50, v.shape).astype(np.float32)
+        else:
+            v[:] = rng.uniform(-0.3, 0.3, v.shape).astype(np.float32)
+    for k in sorted(ex.aux_dict):
+        v = ex.aux_dict[k]
+        v[:] = (rng.uniform(0.5, 1.5, v.shape) if "var" in k
+                else rng.uniform(-0.1, 0.1, v.shape)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# policy / pass behavior
+# ---------------------------------------------------------------------------
+def test_amp_off_is_bit_identical(monkeypatch):
+    """AMP unset: the amp_cast pass is the IDENTITY (same symbol
+    object, so post-pass signatures — the program-cache keys — cannot
+    change), and two runs agree bitwise."""
+    from mxnet_tpu.passes.amp_cast import amp_cast
+
+    net, shapes = models.get_symbol(
+        "resnet-8", num_classes=4, image_shape=(3, 8, 8)), \
+        {"data": (4, 3, 8, 8), "softmax_label": (4,)}
+    assert amp_cast(net) is net
+    monkeypatch.setenv("MXTPU_AMP", "0")
+    assert amp_cast(net) is net
+
+    def run():
+        mx.random.seed(0)
+        ex = net.simple_bind(mx.cpu(), grad_req="write", **shapes)
+        _fill(ex)
+        ex.forward(is_train=True)
+        ex.backward()
+        return ([o.asnumpy() for o in ex.outputs],
+                {k: g.asnumpy() for k, g in ex.grad_dict.items()
+                 if g is not None})
+
+    a, b = run(), run()
+    for x, y in zip(a[0], b[0]):
+        np.testing.assert_array_equal(x, y)
+    for k in a[1]:
+        np.testing.assert_array_equal(a[1][k], b[1][k])
+
+
+def test_amp_cast_policy_structure(monkeypatch):
+    """bf16 policy: MXU op inputs cast to bf16, loss/softmax inputs
+    cast back to f32, labels untouched, cast count recorded."""
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu import passes
+
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    tm.reset()
+    tm.enable()
+    try:
+        d = sym.Variable("data")
+        c = sym.Convolution(d, num_filter=8, kernel=(3, 3), name="ac_c")
+        b = sym.BatchNorm(c, fix_gamma=False, name="ac_b")
+        f = sym.FullyConnected(sym.Flatten(b), num_hidden=4, name="ac_f")
+        net = sym.SoftmaxOutput(f, label=sym.Variable("softmax_label"),
+                                name="softmax")
+        monkeypatch.setenv("MXTPU_GRAPH_PASSES", "amp_cast")
+        out = passes.apply_graph_passes(net)
+        casts = [n for n in out.nodes if n.op == "Cast"]
+        dts = {str(n.attrs["dtype"]) for n in casts}
+        assert dts == {"bfloat16", "float32"}
+        # conv data+weight and fc data+weight+bias -> bf16 casts
+        bf = [n for n in casts if str(n.attrs["dtype"]) == "bfloat16"]
+        assert len(bf) >= 4
+        # the softmax's DATA input is cast f32; its label variable is not
+        soft = [n for n in out.nodes if n.op == "SoftmaxOutput"][0]
+        data_src = soft.inputs[0][0]
+        assert data_src.op == "Cast" \
+            and str(data_src.attrs["dtype"]) == "float32"
+        assert soft.inputs[1][0].is_variable
+        fam = tm.get_registry().get("amp_cast_nodes_total")
+        assert fam is not None and fam.total() >= len(casts)
+    finally:
+        tm.reset()
+        tm.disable()
+
+
+def test_amp_unknown_policy_raises(monkeypatch):
+    monkeypatch.setenv("MXTPU_AMP", "fp8")
+    with pytest.raises(mx.MXNetError):
+        amp.amp_dtype()
+
+
+# ---------------------------------------------------------------------------
+# convergence parity (the acceptance bar): full Module/Executor/KVStore
+# ---------------------------------------------------------------------------
+def _train_module(net, data, labels, nclass, steps=8, lr=0.05,
+                  optimizer="sgd", data_shape=None):
+    mx.random.seed(0)
+    mod = Module(net, context=[mx.cpu()])
+    dshape = data_shape or data.shape
+    mod.bind(data_shapes=[("data", dshape)],
+             label_shapes=[("softmax_label", labels.shape)])
+    mod.init_params(initializer=mx.init.Xavier(factor_type="in",
+                                               magnitude=2.0))
+    mod.init_optimizer(kvstore="local", optimizer=optimizer,
+                       optimizer_params={"learning_rate": lr})
+    batch = DataBatch(data=[nd.array(data)], label=[nd.array(labels)])
+    losses = []
+    for _ in range(steps):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        p = mod.get_outputs()[0].asnumpy().astype(np.float64)
+        p = p.reshape(len(labels), -1)
+        losses.append(float(np.mean(
+            -np.log(np.maximum(p[np.arange(len(labels)),
+                                 labels.astype(int)], 1e-8)))))
+    return losses
+
+
+def test_amp_vs_fp32_convergence_resnet(monkeypatch):
+    """ResNet-style conv net through Module: the bf16 AMP run tracks
+    the fp32 run's loss trajectory and learns (loss drops)."""
+    rng = np.random.RandomState(0)
+    nclass = 4
+    labels = rng.randint(0, nclass, 8)
+    # separable blobs: per-class channel means + noise
+    means = rng.uniform(-1, 1, (nclass, 3))
+    data = (means[labels][:, :, None, None]
+            + rng.uniform(-0.2, 0.2, (8, 3, 8, 8))).astype(np.float32)
+    net = models.get_symbol("resnet-8", num_classes=nclass,
+                            image_shape=(3, 8, 8))
+
+    ref = _train_module(net, data, labels, nclass, steps=6)
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    got = _train_module(net, data, labels, nclass, steps=6)
+    assert got[-1] < got[0], got  # AMP run learns
+    # trajectory parity at bf16 tolerance
+    np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.08)
+
+
+def test_amp_vs_fp32_convergence_lm(monkeypatch):
+    """Tiny transformer LM through Module with Adam: AMP tracks fp32."""
+    V, T = 40, 8
+    net = models.transformer.transformer_lm(
+        num_layers=1, num_heads=2, d_model=16, seq_len=T, vocab_size=V)
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, V, (4, T)).astype(np.float32)
+    labels = np.roll(data, -1, axis=1)
+
+    def run(steps=5):
+        mx.random.seed(0)
+        mod = Module(net, context=[mx.cpu()])
+        mod.bind(data_shapes=[("data", data.shape)],
+                 label_shapes=[("softmax_label", labels.shape)])
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(kvstore="local", optimizer="adam",
+                           optimizer_params={"learning_rate": 3e-3})
+        batch = DataBatch(data=[nd.array(data)], label=[nd.array(labels)])
+        losses = []
+        for _ in range(steps):
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            p = mod.get_outputs()[0].asnumpy().astype(np.float64)
+            p = p.reshape(-1, V)
+            lab = labels.reshape(-1).astype(int)
+            losses.append(float(np.mean(-np.log(np.maximum(
+                p[np.arange(len(lab)), lab], 1e-8)))))
+        return losses
+
+    ref = run()
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    got = run()
+    assert got[-1] < got[0]
+    np.testing.assert_allclose(got, ref, rtol=0.12, atol=0.1)
+
+
+# ---------------------------------------------------------------------------
+# fp32 master weights
+# ---------------------------------------------------------------------------
+def test_multi_precision_eager_masters_match_fp32():
+    """Optimizer(multi_precision=True): a bf16 weight updated eagerly
+    through the master path tracks exact fp32 SGD math."""
+    rng = np.random.RandomState(0)
+    w0 = rng.uniform(-1, 1, (16, 4)).astype(np.float32)
+    g = rng.uniform(-0.1, 0.1, (16, 4)).astype(np.float32)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              multi_precision=True)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.array(w0).astype(jnp.bfloat16)
+    for _ in range(4):
+        upd(0, nd.array(g).astype(jnp.bfloat16), w)
+    state = upd.states[0]
+    assert isinstance(state, tuple) and len(state) == 2
+    assert np.dtype(state[-1].dtype) == np.float32  # the master
+    # fp32 reference from the bf16-rounded start
+    ref = np.asarray(jnp.asarray(w0).astype(jnp.bfloat16)).astype(np.float32)
+    m = np.zeros_like(ref)
+    g32 = np.asarray(jnp.asarray(g).astype(jnp.bfloat16)).astype(np.float32)
+    for _ in range(4):
+        m = 0.9 * m - 0.1 * g32
+        ref = ref + m
+    np.testing.assert_allclose(state[-1].asnumpy(), ref,
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(w.asnumpy().astype(np.float32), ref,
+                               rtol=1e-2, atol=4e-3)
+
+
+def test_warn_once_without_masters():
+    """bf16 weights updating without masters warn exactly once per key."""
+    amp.reset_scaler()
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.zeros((4, 4), dtype=jnp.bfloat16)
+    g = nd.zeros((4, 4), dtype=jnp.bfloat16)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        upd(0, g, w)
+        upd(0, g, w)
+    msgs = [w_ for w_ in rec if "master" in str(w_.message)]
+    assert len(msgs) == 1
+
+
+def test_fused_bucket_masters_match_fp32(monkeypatch):
+    """bf16 params through the fused kvstore buckets: fp32 masters in
+    bucket state, update in fp32, bf16 cast emitted in-program."""
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    rng = np.random.RandomState(0)
+    shapes = [(8, 4), (6,)]
+    ws = [rng.uniform(-1, 1, s).astype(np.float32) for s in shapes]
+    gs = [rng.uniform(-0.1, 0.1, s).astype(np.float32) for s in shapes]
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                         momentum=0.9))
+    keys = [0, 1]
+    kv.init(keys, [nd.array(w).astype(jnp.bfloat16) for w in ws])
+    for _ in range(5):
+        kv.push(keys, [[nd.array(g)] for g in gs])
+    outs = [nd.zeros(s, dtype=jnp.bfloat16) for s in shapes]
+    kv.pull(keys, outs)
+    mem = kv._fused.state_memory()
+    assert mem["master_bytes"] == sum(int(np.prod(s)) * 4 for s in shapes)
+    for i, s in enumerate(shapes):
+        ref = np.asarray(jnp.asarray(ws[i]).astype(
+            jnp.bfloat16)).astype(np.float32)
+        m = np.zeros_like(ref)
+        for _ in range(5):
+            m = 0.9 * m - 0.1 * gs[i]
+            ref = ref + m
+        np.testing.assert_allclose(outs[i].asnumpy().astype(np.float32),
+                                   ref, rtol=1e-2, atol=4e-3)
+        # the Updater's trailing state slot is the fp32 master
+        master = kv._updater.states[i][-1]
+        assert np.dtype(master.dtype) == np.float32
+        np.testing.assert_allclose(master.asnumpy(), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_sharded_masters_one_over_n_bytes(monkeypatch):
+    """8-replica sharded buckets hold 1/8 of the master bytes per
+    replica (ISSUE-10 acceptance) and match the replicated program."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel.mesh import global_mesh
+
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    mesh = global_mesh()
+    repl = NamedSharding(mesh, P())
+    rng = np.random.RandomState(3)
+    shapes = [(64, 16), (33,), (17, 8)]
+    ws = [rng.uniform(-1, 1, s).astype(np.float32) for s in shapes]
+    gs = [rng.uniform(-0.1, 0.1, s).astype(np.float32) for s in shapes]
+    keys = list(range(len(ws)))
+
+    def run(shard):
+        monkeypatch.setenv("MXTPU_SHARD_UPDATE", "1" if shard else "0")
+        kv = mx.kv.create("local")
+        kv.set_optimizer(mx.optimizer.create("adam", learning_rate=1e-2))
+        kv.init(keys, [nd.array(w).astype(jnp.bfloat16) for w in ws])
+        grads = [[nd.NDArray(jax.device_put(g, repl))] for g in gs] \
+            if shard else [[nd.array(g)] for g in gs]
+        for _ in range(4):
+            kv.push(keys, grads)
+        outs = [nd.zeros(s, dtype=jnp.bfloat16) for s in shapes]
+        kv.pull(keys, outs)
+        return kv._fused.state_memory(), [o.asnumpy().astype(np.float32)
+                                          for o in outs]
+
+    mem, outs = run(True)
+    assert mem["sharded_buckets"] >= 1 and mem["replicas"] == 8
+    total = sum(int(np.prod(s)) for s in shapes)
+    padded = -(-total // 8) * 8
+    assert mem["master_bytes"] == padded * 4
+    assert mem["master_bytes_per_replica"] == padded * 4 // 8
+    _, outs_repl = run(False)
+    for a, b in zip(outs, outs_repl):
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=1e-2)
+
+
+def test_sparse_bf16_table_fp32_master_rows(monkeypatch):
+    """A bf16 row-sparse table keeps fp32 master rows: untouched rows
+    (table AND master) byte-identical, touched bf16 rows within one
+    bf16 ulp of cast(master)."""
+    from mxnet_tpu import sparse
+
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    rows, dim = 50, 8
+    rng = np.random.RandomState(1)
+    table = rng.uniform(-1, 1, (rows, dim)).astype(np.float32)
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("adam", learning_rate=0.05))
+    kv.init(0, sparse.full_row_sparse(nd.array(table).astype(jnp.bfloat16)))
+    idx = np.array([3, 7, 3, 20], np.int32)
+    vals = rng.uniform(-1, 1, (4, dim)).astype(np.float32)
+    g = sparse.RowSparseNDArray(nd.NDArray(jnp.asarray(idx)),
+                                nd.NDArray(jnp.asarray(vals)), (rows, dim))
+    before = kv._store[0].asnumpy().copy()
+    for _ in range(3):
+        kv.push([0], [g])
+    after = kv._store[0].asnumpy()
+    touched = sorted(set(idx.tolist()))
+    untouched = [r for r in range(rows) if r not in touched]
+    np.testing.assert_array_equal(before[untouched], after[untouched])
+    master = kv._updater.states[0][-1]
+    assert np.dtype(master.dtype) == np.float32
+    mnp = master.asnumpy()
+    np.testing.assert_array_equal(
+        mnp[untouched],
+        np.asarray(jnp.asarray(table[untouched]).astype(
+            jnp.bfloat16)).astype(np.float32))
+    cast = np.asarray(jnp.asarray(mnp[touched]).astype(
+        jnp.bfloat16)).astype(np.float32)
+    got = after[touched].astype(np.float32)
+    # the delta-scatter re-aims at the master each step, so table rows
+    # stay within ~an ulp of cast(master) — the ulp of the UPDATE's
+    # magnitude, hence the small absolute slack for near-zero weights
+    np.testing.assert_allclose(got, cast, rtol=2 ** -6, atol=2 ** -8)
+
+
+# ---------------------------------------------------------------------------
+# dynamic loss scaling
+# ---------------------------------------------------------------------------
+def _scaled_push(kv, keys, gs, inf_key=None):
+    s = float(np.asarray(amp.global_scaler().scale_raw()))
+    vals = []
+    for i, g in enumerate(gs):
+        arr = np.full(g.shape, np.inf, np.float32) if i == inf_key \
+            else g * s
+        vals.append([nd.array(arr)])
+    kv.push(keys, vals)
+
+
+def test_loss_scale_overflow_skip_recovery(monkeypatch):
+    """The device-side lattice: grow after window clean steps, skip +
+    halve on overflow, recover after."""
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    monkeypatch.setenv("MXTPU_LOSS_SCALE", "1024")
+    monkeypatch.setenv("MXTPU_LOSS_SCALE_WINDOW", "2")
+    amp.reset_scaler()
+    rng = np.random.RandomState(0)
+    shapes = [(8, 4), (6,)]
+    ws = [rng.uniform(-1, 1, s).astype(np.float32) for s in shapes]
+    gs = [rng.uniform(-0.1, 0.1, s).astype(np.float32) for s in shapes]
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    keys = [0, 1]
+    kv.init(keys, [nd.array(w).astype(jnp.bfloat16) for w in ws])
+    for _ in range(2):
+        _scaled_push(kv, keys, gs)
+    rep = amp.global_scaler().report()
+    assert rep["scale"] == 2048.0  # grew after the 2-step window
+    assert rep["overflow_total"] == 0
+    outs = [nd.zeros(s, dtype=jnp.bfloat16) for s in shapes]
+    kv.pull(keys, outs)
+    snap = [o.asnumpy().copy() for o in outs]
+    # overflow in ONE bucket's grads
+    _scaled_push(kv, keys, gs, inf_key=0)
+    rep = amp.global_scaler().report()
+    assert rep["scale"] == 1024.0  # halved
+    assert rep["overflow_total"] == 1 and rep["skipped_steps_total"] == 1
+    kv.pull(keys, outs)
+    # the overflowed bucket held its weights (skip-step)
+    np.testing.assert_array_equal(snap[0], outs[0].asnumpy())
+    # recovery: clean steps keep training and re-grow the scale
+    for _ in range(2):
+        _scaled_push(kv, keys, gs)
+    rep = amp.global_scaler().report()
+    assert rep["scale"] == 2048.0
+    kv.pull(keys, outs)
+    assert not np.array_equal(snap[0], outs[0].asnumpy())
+
+
+def test_zero_per_batch_host_sync_with_amp(monkeypatch):
+    """Steady-state Module training with AMP + dynamic loss scaling
+    performs ZERO per-batch host syncs of the scaler state: every
+    report()/float() goes through LossScaler._sync_count, which must
+    stay 0 across the loop (the acceptance counter)."""
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    monkeypatch.setenv("MXTPU_LOSS_SCALE", "dynamic")
+    amp.reset_scaler()
+    rng = np.random.RandomState(0)
+    nclass = 4
+    labels = rng.randint(0, nclass, 8)
+    # same net/shapes as the convergence test: the fwd program entry is
+    # shared through the program cache (only the loss-scaled fwdbwd
+    # traces fresh), keeping this file's wall time down
+    data = rng.uniform(-1, 1, (8, 3, 8, 8)).astype(np.float32)
+    net = models.get_symbol("resnet-8", num_classes=nclass,
+                            image_shape=(3, 8, 8))
+    mod = Module(net, context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", data.shape)],
+             label_shapes=[("softmax_label", labels.shape)])
+    mod.init_params(initializer=mx.init.Xavier())
+    # an EXPLICIT store: single-device Module defaults to the no-kvstore
+    # eager updater (reference _create_kvstore rule) — the fused
+    # in-trace scaling lattice is what this test must exercise
+    mod.init_optimizer(kvstore=mx.kv.create("device"), optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    batch = DataBatch(data=[nd.array(data)], label=[nd.array(labels)])
+    scaler = amp.global_scaler()
+    base = scaler._sync_count
+    for _ in range(5):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert scaler._sync_count == base  # the whole loop synced NOTHING
+    rep = scaler.report()              # the boundary read is explicit
+    assert scaler._sync_count == base + 1
+    assert rep["overflow_total"] == 0
+    # the lattice actually ran: 5 clean steps counted device-side
+    assert rep["good_steps"] == 5
+    assert rep["scale"] >= 2 ** 15  # still the dynamic default (or grown)
+
+
+def test_eager_fallback_unscales(monkeypatch):
+    """A custom-updater (eager) path still sees UNSCALED gradients:
+    Updater.__call__ divides by the live scale."""
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    monkeypatch.setenv("MXTPU_LOSS_SCALE", "512")
+    amp.reset_scaler()
+    opt = mx.optimizer.create("test")  # weight += grad * rescale
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.zeros((4,))
+    g = nd.array(np.ones(4, np.float32) * 512.0)  # "scaled" grad
+    upd(0, g, w)
+    np.testing.assert_allclose(w.asnumpy(), np.ones(4), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Pallas residual epilogue
+# ---------------------------------------------------------------------------
+def test_epilogue_pallas_vs_lax_fwd_bwd_parity():
+    """The interpreted Pallas kernel and the lax lowering agree on
+    forward AND all four gradients."""
+    from mxnet_tpu.ops import residual_epilogue as re_mod
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.uniform(-1, 1, (2, 4, 4, 128)).astype(np.float32))
+    s = jnp.asarray(rng.uniform(-1, 1, (2, 4, 4, 128)).astype(np.float32))
+    scale = jnp.asarray(rng.uniform(0.5, 1.5, (128,)).astype(np.float32))
+    bias = jnp.asarray(rng.uniform(-0.5, 0.5, (128,)).astype(np.float32))
+    assert re_mod.supports(int(np.prod(x.shape[:-1])), x.shape[-1])
+
+    def loss(impl):
+        def f(x_, s_, sc_, b_):
+            out = re_mod.residual_epilogue(x_, s_, sc_, b_,
+                                           channel_axis=-1, impl=impl)
+            return jnp.sum(out * jnp.cos(out))
+
+        return f
+
+    for impl in ("lax", "pallas_interpret"):
+        outs = re_mod.residual_epilogue(x, s, scale, bias,
+                                        channel_axis=-1, impl=impl)
+        if impl == "lax":
+            ref_out = outs
+            ref_g = jax.grad(loss("lax"), argnums=(0, 1, 2, 3))(
+                x, s, scale, bias)
+        else:
+            np.testing.assert_allclose(np.asarray(outs),
+                                       np.asarray(ref_out),
+                                       rtol=1e-6, atol=1e-6)
+            got_g = jax.grad(loss("pallas_interpret"),
+                             argnums=(0, 1, 2, 3))(x, s, scale, bias)
+            for a, b in zip(ref_g, got_g):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-5)
+
+
+def test_epilogue_shape_gate_falls_back():
+    """Ragged shapes (C not a lane multiple) refuse the kernel even
+    when forced, and still compute correctly via lax."""
+    from mxnet_tpu.ops import residual_epilogue as re_mod
+
+    assert not re_mod.supports(32, 100)
+    x = jnp.ones((2, 3, 3, 100), jnp.float32)
+    s = jnp.ones((2, 3, 3, 100), jnp.float32) * -0.5
+    out = re_mod.residual_epilogue(x, s, channel_axis=-1, impl="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.full(x.shape, 0.5),
+                               rtol=1e-6)
+
+
+def test_epilogue_op_matches_unfused_composite(monkeypatch):
+    """The _residual_epilogue_bn op replays the exact add+BN+relu
+    composite in train mode: graph-level parity on a residual net (the
+    pass's training_safe contract, exercised END to end through the
+    executor including NHWC layout)."""
+    d = sym.Variable("data")
+    c1 = sym.Convolution(d, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                         no_bias=True, name="ep_c1")
+    c2 = sym.Convolution(d, num_filter=8, kernel=(1, 1), no_bias=True,
+                         name="ep_c2")
+    added = c1 + c2
+    bn = sym.BatchNorm(added, fix_gamma=False, name="ep_bn")
+    r = sym.Activation(bn, act_type="relu", name="ep_r")
+    # a plain relu(add) tail as well
+    r2 = sym.Activation(c1 + c2, act_type="relu", name="ep_r2")
+    net = sym.Group([r, r2])
+    shapes = {"data": (2, 3, 8, 8)}
+
+    def run(env):
+        monkeypatch.setenv("MXTPU_GRAPH_PASSES", env)
+        ex_mod.program_cache_clear()
+        mx.random.seed(0)
+        ex = net.simple_bind(mx.cpu(), grad_req="write", **shapes)
+        _fill(ex)
+        ex.forward(is_train=True)
+        ex.backward([nd.ones(o.shape) for o in ex.outputs])
+        return ([o.asnumpy() for o in ex.outputs],
+                {k: g.asnumpy() for k, g in ex.grad_dict.items()})
+
+    ref = run("off")
+    got = run("residual_epilogue")
+    # structural: the rewrite actually fused both patterns
+    from mxnet_tpu import passes
+
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "residual_epilogue")
+    out = passes.apply_graph_passes(net)
+    ops_after = [n.op for n in out.nodes if not n.is_variable]
+    assert "_residual_epilogue_bn" in ops_after
+    assert "_residual_epilogue" in ops_after
+    assert "elemwise_add" not in ops_after
+    for a, b in zip(ref[0], got[0]):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    for k in ref[1]:
+        np.testing.assert_allclose(ref[1][k], got[1][k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# satellites: tolerances / check_consistency threading
+# ---------------------------------------------------------------------------
+def test_assert_almost_equal_bf16_default_tols():
+    from mxnet_tpu.test_utils import assert_almost_equal, default_tols
+
+    a = jnp.asarray(np.linspace(0.1, 1.0, 16), jnp.bfloat16)
+    b = jnp.asarray(np.asarray(a).astype(np.float32) * 1.004)
+    # fp32-calibrated defaults would flag a 0.4% bf16 difference
+    assert_almost_equal(np.asarray(a), np.asarray(b))
+    r, t = default_tols(a, b)
+    assert r >= 1e-2
+    r32, _ = default_tols(np.zeros(2, np.float32))
+    assert r32 == 1e-5
+    with pytest.raises(AssertionError):
+        assert_almost_equal(np.ones(4, np.float32),
+                            np.ones(4, np.float32) * 1.004)
+
+
+def test_check_consistency_threads_amp(monkeypatch):
+    from mxnet_tpu.test_utils import check_consistency
+
+    d = sym.Variable("data")
+    f = sym.FullyConnected(d, num_hidden=8, name="cc_f")
+    net = sym.Activation(f, act_type="tanh")
+    seen = {}
+    orig = sym.Symbol.simple_bind
+
+    def spy(self, *a, **kw):
+        seen["amp"] = os.environ.get("MXTPU_AMP")
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(sym.Symbol, "simple_bind", spy)
+    check_consistency(net, [{"ctx": mx.cpu(), "data": (4, 8)},
+                            {"ctx": mx.cpu(), "data": (4, 8)}],
+                      amp="bf16")
+    assert seen["amp"] == "bf16"
+    assert os.environ.get("MXTPU_AMP") is None  # restored

@@ -17,7 +17,8 @@ from mxnet_tpu import passes, sym
 from mxnet_tpu import executor as ex_mod
 from mxnet_tpu.base import MXNetError
 
-ALL_GRAPH_PASSES = ["constant_fold", "cse", "dce", "prefuse"]
+ALL_GRAPH_PASSES = ["constant_fold", "cse", "dce", "residual_epilogue",
+                    "amp_cast", "prefuse"]
 
 
 @pytest.fixture
@@ -208,6 +209,36 @@ def test_cse_merges_duplicate_subexpression(monkeypatch):
     out = passes.apply_graph_passes(a * b + a * b)
     muls = [n for n in out.nodes if n.op == "elemwise_mul"]
     assert len(muls) == 1
+
+
+def test_residual_epilogue_fuses_resnet_tails(monkeypatch):
+    """The "residual_epilogue" pass collapses every relu(BN(add))
+    residual tail of a model-zoo resnet into one fused node; parity of
+    the rewrite is pinned by test_single_pass_parity_fwd_bwd (this
+    file) and end-to-end in tests/test_amp.py."""
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "residual_epilogue")
+    net, _ = _model_zoo("resnet")
+    before = passes.op_node_count(net)
+    out = passes.apply_graph_passes(net)
+    ops_after = [n.op for n in out.nodes if not n.is_variable]
+    assert "_residual_epilogue_bn" in ops_after
+    assert passes.op_node_count(out) < before
+
+
+def test_amp_cast_is_identity_without_policy(monkeypatch):
+    """The "amp_cast" pass with MXTPU_AMP unset returns the SAME
+    symbol object — signatures and program-cache keys untouched (the
+    AMP-off bit-identity contract; the armed-policy behavior is pinned
+    in tests/test_amp.py)."""
+    monkeypatch.delenv("MXTPU_AMP", raising=False)
+    monkeypatch.setenv("MXTPU_GRAPH_PASSES", "amp_cast")
+    net, _ = _mixed_net()
+    assert passes.apply_graph_passes(net) is net
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    out = passes.apply_graph_passes(net)
+    assert out is not net
+    assert any(n.op == "Cast" for n in out.nodes if not n.is_variable)
+    assert out.structural_signature() != net.structural_signature()
 
 
 def test_cse_never_merges_rng_ops(monkeypatch):
