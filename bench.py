@@ -48,9 +48,58 @@ def _emit(payload):
     print(json.dumps(payload), flush=True)
 
 
+def _fallback_streak():
+    """Consecutive most-recent bench rounds (committed BENCH_r*.json)
+    that ended in a backend-init fallback.  The r03–r05 pattern — three
+    rounds silently embedding the same committed artifact — must read
+    as a harness bug, not a footnote: the CURRENT failure makes the
+    streak one longer."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for path in glob.glob(os.path.join(glob.escape(here),
+                                       "BENCH_r[0-9]*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except Exception:  # noqa: BLE001 — unreadable round: not a fallback
+            parsed = {}
+        err = str(parsed.get("error") or "")
+        fell = "last_measured" in parsed or "backend init" in err
+        rounds.append((int(m.group(1)), fell))
+    rounds.sort(reverse=True)
+    streak = 1  # the failure being emitted right now
+    for _, fell in rounds:
+        if not fell:
+            break
+        streak += 1
+    return streak
+
+
 def _fail(msg, metric="resnet50_train_imgs_per_sec_per_chip"):
     payload = {"metric": metric, "value": 0.0, "unit": "img/s",
                "vs_baseline": 0.0, "error": msg}
+    if "backend init" in msg:
+        streak = _fallback_streak()
+        payload["fallback_streak"] = streak
+        if streak >= 3:
+            # ROADMAP item 3 honesty gate: a third consecutive
+            # backend-init fallback is a HARD harness failure — no
+            # committed artifact is embedded (stale numbers reading as
+            # live ones is exactly the r03–r05 failure mode), the
+            # nonzero exit stands, and the error says to fix the
+            # harness, not the footnote
+            payload["error"] = (
+                f"HARD FAILURE: {streak} consecutive backend-init "
+                f"fallbacks — fix the bench harness/backend before "
+                f"trusting any committed artifact ({msg})")
+            _emit(payload)
+            return
     # a backend outage at bench time should not erase the round's real
     # measurement: embed the committed artifact (captured by
     # tools/tpu_watch.sh during an earlier backend window) so the error
@@ -456,6 +505,74 @@ def _pipeline_micro():
                 os.environ[k_] = v_
         if not was_enabled:
             tm.disable()
+
+
+def _survival_micro():
+    """Survival-layer micro-bench (round 15): what checkpointing costs
+    the training loop.  ckpt_capture_us_per_step is the HOT-LOOP tax —
+    the async device-copy dispatch at a snapshot step (the fetch + file
+    IO run on the writer thread and must not appear here);
+    ckpt_write_ms is the background writer's wall time for the full
+    state (fetch + fsync + atomic publish); ckpt_resume_ms is
+    checksum-validated restore."""
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import checkpoint as ck
+    from mxnet_tpu import sym
+    from mxnet_tpu.trainer import FusedTrainer
+
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=256,
+                           name="surv_fc"), name="softmax")
+    rs = np.random.RandomState(11)
+    b = 64
+    x = rs.uniform(-1, 1, (b, 512)).astype(np.float32)
+    y = rs.randint(0, 256, b).astype(np.float32)
+    tr = FusedTrainer(net, optimizer="adam",
+                      optimizer_params={"lr": 0.05,
+                                        "rescale_grad": 1.0 / b})
+    tr.init(data=(b, 512))
+    tr.step(data=x, softmax_label=y)  # compile
+    name = sorted(tr.params)[0]
+    float(np.asarray(tr.params[name]).ravel()[0])  # barrier
+
+    n = 40
+    tic = time.perf_counter()
+    for _ in range(n):
+        tr.step(data=x, softmax_label=y)
+    float(np.asarray(tr.params[name]).ravel()[0])
+    plain_us = (time.perf_counter() - tic) / n * 1e6
+
+    out = {}
+    with tempfile.TemporaryDirectory() as d:
+        writes = []
+        tic = time.perf_counter()
+        for i in range(n):
+            tr.step(data=x, softmax_label=y)
+            if i % 10 == 0:  # capture WITHOUT draining: dispatch only
+                writes.append(tr.save_state(d, background=True))
+        float(np.asarray(tr.params[name]).ravel()[0])
+        armed_us = (time.perf_counter() - tic) / n * 1e6
+        for w in writes:
+            w.wait()
+        tic = time.perf_counter()
+        tr.save_state(d, background=False)
+        write_ms = (time.perf_counter() - tic) * 1e3
+        tic = time.perf_counter()
+        tr.restore_state(d)
+        resume_ms = (time.perf_counter() - tic) * 1e3
+        state_bytes = sum(
+            int(v.size) * np.dtype(v.dtype).itemsize
+            for v in tr._checkpoint_arrays().values())
+    out["ckpt_step_us_plain"] = round(plain_us, 1)
+    out["ckpt_step_us_armed"] = round(armed_us, 1)
+    out["ckpt_capture_us_per_step"] = round(armed_us - plain_us, 1)
+    out["ckpt_write_ms"] = round(write_ms, 2)
+    out["ckpt_resume_ms"] = round(resume_ms, 2)
+    out["ckpt_state_bytes"] = int(state_bytes)
+    return out
 
 
 def _health_micro():
@@ -1398,6 +1515,14 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
             # (ISSUE 5)
             if os.environ.get("BENCH_HEALTH", "1") == "1":
                 for k_, v_ in _health_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # survival layer: async-checkpoint capture tax on the hot
+            # loop + writer wall time + validated-resume time (ISSUE 11)
+            if os.environ.get("BENCH_CKPT", "1") == "1":
+                for k_, v_ in _survival_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

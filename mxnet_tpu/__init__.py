@@ -63,6 +63,8 @@ from . import storage
 from . import image
 from . import kvstore as kv
 from . import kvstore_server
+from . import checkpoint
+from . import faults
 from . import model
 from .model import FeedForward, save_checkpoint, load_checkpoint
 from . import executor_manager

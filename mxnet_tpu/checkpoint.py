@@ -1,0 +1,514 @@
+"""Async sharded checkpointing + preemption-safe resume — the survival
+layer under the training loops (docs/fault_tolerance.md).
+
+Production scale demands survival, not just speed (ROADMAP item 1): a
+multi-day run must lose at most one checkpoint window to a preemption,
+a corrupted file must fall back to the previous complete checkpoint
+instead of training on garbage, and capture must not stall the
+zero-per-batch-host-sync training loop the async stack (PR 4/5/7/10)
+was built around.  Three properties carry the design:
+
+- **capture is a device-side snapshot** — ``jnp.copy`` per array,
+  dispatched asynchronously *behind* the in-flight training steps, so
+  the snapshot reflects exactly the state after the last dispatched
+  step without draining the AsyncWindow; the slow device→host fetch and
+  the file IO run on a background writer thread (the Orbax-style async
+  device snapshot, keyed the way our program cache keys artifacts —
+  TVM arXiv:1802.04799 motivates persisting by structural signature),
+- **a checkpoint is complete iff its manifest says so** — arrays land
+  in a temp directory (write + flush + fsync per file), the manifest
+  (per-array crc32 checksum, shape/dtype, shard layout, the bound
+  graph's ``structural_signature``, step/epoch/batch cursor, RNG state)
+  is written last, then ONE atomic ``os.replace`` publishes the
+  directory.  A crash at any byte leaves either the previous complete
+  checkpoint or a ``.tmp`` directory the next run sweeps,
+- **resume never trusts a file** — :func:`latest` walks newest→oldest,
+  re-hashing every array against the manifest, and falls back (with a
+  warning) past truncated/corrupt checkpoints; a manifest whose
+  structural signature disagrees with the current bind raises instead
+  of loading mismatched weights.
+
+Env knobs (docs/how_to/env_var.md round 15): ``MXTPU_CKPT_DIR`` (arming
+the train loops), ``MXTPU_CKPT_EVERY`` (steps between snapshots,
+default 0 = only on preemption/epoch), ``MXTPU_CKPT_KEEP`` (complete
+checkpoints retained, default 3).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import signal
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from . import telemetry as _tm
+from .base import MXNetError
+
+__all__ = [
+    "CheckpointError", "CheckpointCorrupt", "Preempted",
+    "save", "load", "latest", "list_checkpoints", "validate",
+    "CheckpointWrite", "CheckpointManager",
+]
+
+_logger = logging.getLogger("mxnet_tpu.checkpoint")
+
+MANIFEST = "manifest.json"
+_PREFIX = "ckpt-"
+FORMAT_VERSION = 1
+
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_WRITE_SEC = _tm.histogram(
+    "checkpoint_write_seconds",
+    "wall time of one checkpoint write on the background writer thread "
+    "(device->host fetch + file IO + fsync + atomic publish)")
+_TM_BYTES = _tm.counter(
+    "checkpoint_bytes_total",
+    "array payload bytes written into published checkpoints")
+_TM_RESUME = _tm.counter(
+    "checkpoint_resume_total",
+    "training-state restores (status=ok: newest complete checkpoint; "
+    "fallback: a newer corrupt/incomplete checkpoint was skipped first)",
+    labels=("status",))
+
+
+class CheckpointError(MXNetError):
+    """Checkpoint write/restore failure."""
+
+
+class CheckpointCorrupt(CheckpointError):
+    """A checkpoint that exists on disk but fails validation
+    (truncated file, checksum mismatch, unreadable manifest)."""
+
+
+class Preempted(MXNetError):
+    """Raised by a training loop after a SIGTERM-triggered boundary
+    checkpoint landed — the run was asked to die and its state is safe;
+    the message carries the checkpoint path to resume from."""
+
+
+# ------------------------------------------------------------------ env
+def ckpt_dir():
+    return os.environ.get("MXTPU_CKPT_DIR", "").strip() or None
+
+
+def ckpt_every() -> int:
+    try:
+        return max(int(os.environ.get("MXTPU_CKPT_EVERY", "0") or 0), 0)
+    except ValueError:
+        return 0
+
+
+def ckpt_keep() -> int:
+    try:
+        return max(int(os.environ.get("MXTPU_CKPT_KEEP", "3") or 3), 1)
+    except ValueError:
+        return 3
+
+
+# ------------------------------------------------------------------ snapshot
+def snapshot(arrays: dict) -> dict:
+    """Device-side copy of every jax array in ``arrays`` (numpy values
+    pass through).  The copies are dispatched asynchronously and ordered
+    AFTER every in-flight donated-step program, so they capture the
+    post-last-dispatched-step state without a host sync and without the
+    next step's donation invalidating them."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    for name, v in arrays.items():
+        if isinstance(v, jax.Array):
+            out[name] = jnp.copy(v)
+        else:
+            out[name] = np.asarray(v)
+    return out
+
+
+def _sharding_desc(v):
+    try:
+        sh = getattr(v, "sharding", None)
+        if sh is None:
+            return "host"
+        spec = getattr(sh, "spec", None)
+        ndev = len(getattr(sh, "device_set", ()) or ())
+        return f"{type(sh).__name__}({spec})/{max(ndev, 1)}dev"
+    except Exception:  # noqa: BLE001 — layout is advisory metadata
+        return "unknown"
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # platforms without directory fsync
+
+
+class CheckpointWrite:
+    """Handle for one (possibly background) checkpoint write.
+
+    ``path`` is the final directory the write will publish; ``wait()``
+    joins the writer and re-raises its error; ``alive`` says whether the
+    writer is still running."""
+
+    def __init__(self, path):
+        self.path = path
+        self.exc = None
+        self._thread = None
+        self.skipped = False
+
+    @property
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+        if self.exc is not None:
+            raise self.exc
+        return self.path
+
+
+def save(directory, step, arrays: dict, meta=None, keep=None,
+         background=True) -> CheckpointWrite:
+    """Write checkpoint ``ckpt-<step>`` under ``directory``.
+
+    ``arrays`` maps name -> jax array / numpy array; device arrays are
+    snapshotted (async device copy) BEFORE this call returns, so the
+    caller may keep training immediately — the device→host fetch and
+    all file IO happen on the writer thread when ``background``.
+    ``meta`` is JSON-serializable run state (step cursor, RNG key,
+    signature, ...).  Retention prunes the oldest complete checkpoints
+    beyond ``keep`` (default ``MXTPU_CKPT_KEEP``) after a successful
+    publish.  Returns a :class:`CheckpointWrite`."""
+    from . import faults as _faults
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    keep = ckpt_keep() if keep is None else max(int(keep), 1)
+    step = int(step)
+    final = os.path.join(directory, f"{_PREFIX}{step:012d}")
+    handle = CheckpointWrite(final)
+    if os.path.isdir(final) and os.path.exists(
+            os.path.join(final, MANIFEST)):
+        handle.skipped = True  # this step is already published
+        return handle
+    snap = snapshot(arrays)
+    meta = dict(meta or {})
+
+    def _write():
+        t0 = time.perf_counter()
+        tmp = os.path.join(directory,
+                           f".tmp-{_PREFIX}{step:012d}-{os.getpid()}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        try:
+            # the injection site covers the whole write: an err here is
+            # a writer crash mid-checkpoint — no manifest ever appears,
+            # resume must fall back to the previous complete checkpoint
+            _faults.maybe_fail("ckpt_write")
+            entries = {}
+            total = 0
+            for i, (name, v) in enumerate(sorted(snap.items())):
+                host = np.asarray(v)  # the device->host fetch
+                fname = f"a{i:05d}.npy"
+                fpath = os.path.join(tmp, fname)
+                with open(fpath, "wb") as f:
+                    np.save(f, host, allow_pickle=False)
+                    f.flush()
+                    os.fsync(f.fileno())
+                with open(fpath, "rb") as f:
+                    crc = zlib.crc32(f.read())
+                entries[name] = {
+                    "file": fname,
+                    "shape": list(host.shape),
+                    "dtype": str(host.dtype),
+                    "crc32": int(crc),
+                    "bytes": int(host.nbytes),
+                    "sharding": _sharding_desc(snap[name]),
+                }
+                total += int(host.nbytes)
+            manifest = {
+                "version": FORMAT_VERSION,
+                "step": step,
+                "time": time.time(),
+                "arrays": entries,
+                "meta": meta,
+            }
+            mpath = os.path.join(tmp, MANIFEST)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f, indent=1, default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)
+            # one atomic publish: complete checkpoints are exactly the
+            # directories holding a manifest under their final name
+            if os.path.isdir(final):
+                shutil.rmtree(final, ignore_errors=True)
+            os.replace(tmp, final)
+            _fsync_dir(directory)
+            if _tm.enabled():
+                _TM_BYTES.inc(total)
+                _TM_WRITE_SEC.observe(time.perf_counter() - t0)
+            _prune(directory, keep)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    if not background:
+        _write()
+        return handle
+
+    def _runner():
+        try:
+            _write()
+        except BaseException as e:  # noqa: BLE001 — surfaced via wait()
+            handle.exc = e
+            _logger.warning("background checkpoint write for step %d "
+                            "failed: %r", step, e)
+
+    t = threading.Thread(target=_runner, daemon=False,
+                         name=f"mxtpu-ckpt-writer-{step}")
+    handle._thread = t
+    t.start()
+    return handle
+
+
+def _prune(directory, keep):
+    """Retention: drop the oldest complete checkpoints beyond ``keep``
+    and sweep stale temp directories from crashed writers."""
+    complete = list_checkpoints(directory)
+    for _, path in complete[:-keep] if keep else []:
+        shutil.rmtree(path, ignore_errors=True)
+    for name in os.listdir(directory):
+        if name.startswith(".tmp-" + _PREFIX):
+            full = os.path.join(directory, name)
+            # only sweep another pid's leftovers / our published steps:
+            # an in-flight writer's tmp dir ends with our live pid
+            if not name.endswith(f"-{os.getpid()}"):
+                shutil.rmtree(full, ignore_errors=True)
+
+
+def list_checkpoints(directory):
+    """``[(step, path)]`` of COMPLETE checkpoints (manifest present),
+    oldest first.  Directories without a manifest are invisible —
+    they are torn writes."""
+    out = []
+    if not directory or not os.path.isdir(directory):
+        return out
+    for name in os.listdir(directory):
+        if not name.startswith(_PREFIX):
+            continue
+        path = os.path.join(directory, name)
+        if not os.path.exists(os.path.join(path, MANIFEST)):
+            continue
+        try:
+            step = int(name[len(_PREFIX):])
+        except ValueError:
+            continue
+        out.append((step, path))
+    out.sort()
+    return out
+
+
+def validate(path) -> dict:
+    """Re-hash every array file against the manifest.  Returns the
+    manifest dict; raises :class:`CheckpointCorrupt` naming the first
+    offending file."""
+    mpath = os.path.join(path, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r}: unreadable manifest: {exc}") from exc
+    if manifest.get("version") != FORMAT_VERSION:
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r}: format version "
+            f"{manifest.get('version')!r} (this build reads "
+            f"{FORMAT_VERSION})")
+    for name, ent in manifest.get("arrays", {}).items():
+        fpath = os.path.join(path, ent["file"])
+        try:
+            with open(fpath, "rb") as f:
+                crc = zlib.crc32(f.read())
+        except OSError as exc:
+            raise CheckpointCorrupt(
+                f"checkpoint {path!r}: array {name!r} file "
+                f"{ent['file']!r} unreadable: {exc}") from exc
+        if crc != int(ent["crc32"]):
+            raise CheckpointCorrupt(
+                f"checkpoint {path!r}: array {name!r} failed its "
+                f"checksum (file {ent['file']!r}) — truncated or "
+                "corrupt")
+    return manifest
+
+
+def load(path, validate_data=True):
+    """Read one checkpoint: ``(arrays, manifest)`` with arrays as host
+    numpy, names as saved.  ``validate_data`` re-hashes first (resume
+    always should; tooling that just peeks metadata may skip)."""
+    manifest = validate(path) if validate_data else None
+    if manifest is None:
+        with open(os.path.join(path, MANIFEST)) as f:
+            manifest = json.load(f)
+    arrays = {}
+    for name, ent in manifest.get("arrays", {}).items():
+        arrays[name] = np.load(os.path.join(path, ent["file"]),
+                               allow_pickle=False)
+    return arrays, manifest
+
+
+def latest(directory, validate_data=True):
+    """Newest checkpoint that VALIDATES, or ``None``.
+
+    Walks newest→oldest; a corrupt/truncated checkpoint is skipped with
+    a warning (and counts a ``fallback`` resume when an older one is
+    eventually used) — resuming on garbage is the one unacceptable
+    outcome."""
+    candidates = list_checkpoints(directory)
+    fell_back = False
+    for step, path in reversed(candidates):
+        if not validate_data:
+            return path
+        try:
+            validate(path)
+            if fell_back and _tm.enabled():
+                _TM_RESUME.inc(status="fallback")
+            return path
+        except CheckpointCorrupt as exc:
+            fell_back = True
+            _logger.warning(
+                "skipping corrupt checkpoint %s (falling back to the "
+                "previous complete one): %s", path, exc)
+    return None
+
+
+# ------------------------------------------------------------------ manager
+class CheckpointManager:
+    """Policy + lifecycle glue for a training loop.
+
+    Owns the directory, the ``every``/``keep`` cadence, the in-flight
+    background write (at most ONE — a slow writer skips the next
+    snapshot rather than queueing unboundedly), and the SIGTERM
+    preemption flag the loops poll at window boundaries."""
+
+    def __init__(self, directory, every=None, keep=None):
+        if not directory:
+            raise MXNetError("CheckpointManager needs a directory "
+                             "(set MXTPU_CKPT_DIR or pass one)")
+        self.directory = os.path.abspath(directory)
+        self.every = ckpt_every() if every is None else max(int(every), 0)
+        self.keep = ckpt_keep() if keep is None else max(int(keep), 1)
+        self._write = None
+        self._last_step = None
+        self.preempted = False
+        self._prev_handler = None
+
+    @classmethod
+    def from_env(cls):
+        """A manager when ``MXTPU_CKPT_DIR`` is set, else ``None``."""
+        d = ckpt_dir()
+        return cls(d) if d else None
+
+    # -- cadence ---------------------------------------------------------
+    def due(self, step) -> bool:
+        """Should the loop snapshot at this step?  (Pure host-side int
+        math — safe on the per-batch hot path.)"""
+        if self.every <= 0:
+            return False
+        if self._last_step is not None and step <= self._last_step:
+            return False
+        return step % self.every == 0
+
+    def save(self, step, arrays, meta=None, background=True):
+        """Snapshot + write.  A still-running background write makes
+        this a no-op (returns None) — checkpoints are best-effort
+        overlap, and a writer slower than the cadence must not stack
+        threads."""
+        if self._write is not None and self._write.alive:
+            if not background:
+                self._write.wait()
+            else:
+                _logger.warning(
+                    "checkpoint writer for step %s still running; "
+                    "skipping the step-%d snapshot (slow storage? "
+                    "raise MXTPU_CKPT_EVERY)",
+                    os.path.basename(self._write.path), step)
+                return None
+        self._write = save(self.directory, step, arrays, meta=meta,
+                           keep=self.keep, background=background)
+        self._last_step = int(step)
+        return self._write
+
+    def wait(self):
+        """Join the in-flight write (epoch/exit boundary)."""
+        if self._write is not None:
+            self._write.wait()
+
+    def latest(self):
+        return latest(self.directory)
+
+    # -- preemption ------------------------------------------------------
+    def install_preempt_handler(self):
+        """SIGTERM -> set :attr:`preempted`; the training loop saves a
+        boundary checkpoint and raises :class:`Preempted` at the next
+        window boundary, so a preempted run loses at most one window.
+        Chains any previous handler; main-thread only (no-op
+        elsewhere)."""
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _handler(signum, frame):
+                self.preempted = True
+                _logger.warning("SIGTERM: checkpoint at the next window "
+                                "boundary, then exiting")
+                if callable(prev) and prev not in (signal.SIG_DFL,
+                                                   signal.SIG_IGN):
+                    prev(signum, frame)
+
+            self._prev_handler = prev
+            signal.signal(signal.SIGTERM, _handler)
+            return True
+        except (ValueError, OSError):  # non-main thread
+            return False
+
+    def uninstall_preempt_handler(self):
+        if self._prev_handler is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_handler)
+            except (ValueError, OSError):
+                pass
+            self._prev_handler = None
+
+
+def resolve_resume(resume, manager=None):
+    """Turn a ``fit(resume=...)`` value into a checkpoint path or None.
+
+    ``True``/``"auto"`` discover the newest complete checkpoint in the
+    manager's directory (or ``MXTPU_CKPT_DIR``); a string path is used
+    directly — a directory of checkpoints resolves to its newest
+    complete one, an explicit ``ckpt-*`` directory is validated as-is.
+    """
+    if resume in (None, False):
+        return None
+    if resume is True or resume == "auto":
+        directory = manager.directory if manager is not None else ckpt_dir()
+        if not directory:
+            raise MXNetError(
+                "resume=True needs a checkpoint directory: set "
+                "MXTPU_CKPT_DIR or pass a CheckpointManager/path")
+        return latest(directory)
+    path = str(resume)
+    if os.path.exists(os.path.join(path, MANIFEST)):
+        validate(path)
+        return path
+    return latest(path)

@@ -1,0 +1,205 @@
+"""Fault-injection harness (``MXTPU_FAULT_PLAN``) — the chaos half of the
+survival layer (docs/fault_tolerance.md).
+
+The paper's parameter-server design (ps-lite) assumed workers and
+servers die and rejoin; the recovery paths that make that survivable —
+dist retry/backoff, checkpoint fallback, serving admission guards —
+only stay correct if they are *exercised*.  This module injects failures
+at named sites so tests (and chaos soaks) can prove every fault path
+terminates in either recovery or a clean, named error carrying the
+flight-record dump, never a hang or silent corruption.
+
+Plan grammar (comma-separated entries, one per site; last entry for a
+site wins)::
+
+    MXTPU_FAULT_PLAN="kv_push:err:0.01,dist_send:drop:0.05,ckpt_write:crash_after:3"
+
+    <site> : <mode> : <arg>
+
+Modes:
+
+``err:<p>``
+    Raise :class:`InjectedFault` at the site with probability ``p``
+    (``err:1`` = every hit).
+``drop:<p>``
+    Simulated transport loss with probability ``p`` — the call site
+    interprets it (dist send/recv: the socket breaks mid-RPC; the
+    retry/backoff path must recover).
+``err_first:<n>`` / ``drop_first:<n>``
+    Deterministic variants: fail the first ``n`` hits of the site, then
+    pass forever — the shape tests use to pin "fails once, recovers".
+``crash_after:<n>``
+    Let ``n`` hits pass, then hard-kill the process (``os._exit(137)``)
+    on hit ``n+1`` — a preemption simulator for kill/resume tests.
+
+Sites wired in this codebase: ``kv_push`` / ``kv_pull`` (kvstore eager +
+fused batched entry), ``dist_send`` / ``dist_recv`` (KVStoreDist RPC
+transport), ``ckpt_write`` (checkpoint writer), ``serve_admit`` (serving
+admission).  Any other site string is legal — call sites define the
+namespace; unknown sites in a plan simply never fire.
+
+Draws are deterministic under ``MXTPU_FAULT_SEED`` (default 0) so a
+failing chaos soak replays exactly.  Every injected fault counts in
+``fault_injected_total{site,mode}``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+
+from .base import MXNetError
+from . import telemetry as _tm
+
+__all__ = ["InjectedFault", "plan", "active", "fire", "maybe_fail",
+           "should_drop", "reset"]
+
+_logger = logging.getLogger("mxnet_tpu.faults")
+
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_INJECTED = _tm.counter(
+    "fault_injected_total",
+    "faults injected by the MXTPU_FAULT_PLAN harness at a named site "
+    "(mode=err/drop/crash)", labels=("site", "mode"))
+
+_MODES = ("err", "drop", "err_first", "drop_first", "crash_after")
+
+
+class InjectedFault(MXNetError):
+    """A failure injected by ``MXTPU_FAULT_PLAN`` (never raised in
+    production configurations — the plan env is the only trigger)."""
+
+
+class _Entry:
+    __slots__ = ("site", "mode", "arg", "hits")
+
+    def __init__(self, site, mode, arg):
+        self.site = site
+        self.mode = mode
+        self.arg = arg
+        self.hits = 0
+
+
+_lock = threading.Lock()
+_state = {"raw": None, "plan": {}, "rng": None}
+
+
+def _parse(raw: str):
+    entries = {}
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        if len(parts) != 3:
+            raise MXNetError(
+                f"MXTPU_FAULT_PLAN entry {item!r}: expected "
+                "'<site>:<mode>:<arg>' "
+                "(e.g. 'kv_push:err:0.01,ckpt_write:crash_after:3')")
+        site, mode, arg = (p.strip() for p in parts)
+        if mode not in _MODES:
+            raise MXNetError(
+                f"MXTPU_FAULT_PLAN entry {item!r}: unknown mode {mode!r} "
+                f"(supported: {', '.join(_MODES)})")
+        try:
+            if mode in ("err", "drop"):
+                val = float(arg)
+                if not 0.0 <= val <= 1.0:
+                    raise ValueError
+            else:
+                val = int(arg)
+                if val < 0:
+                    raise ValueError
+        except ValueError:
+            kind = ("a probability in [0, 1]" if mode in ("err", "drop")
+                    else "a non-negative integer")
+            raise MXNetError(
+                f"MXTPU_FAULT_PLAN entry {item!r}: arg must be {kind}, "
+                f"got {arg!r}") from None
+        entries[site] = _Entry(site, mode, val)
+    return entries
+
+
+def plan() -> dict:
+    """The parsed plan (site -> entry), re-read when the env changes so
+    monkeypatched tests see their plan without a process restart."""
+    raw = os.environ.get("MXTPU_FAULT_PLAN", "")
+    with _lock:
+        if raw != _state["raw"]:
+            _state["plan"] = _parse(raw) if raw.strip() else {}
+            _state["raw"] = raw
+            _state["rng"] = random.Random(
+                int(os.environ.get("MXTPU_FAULT_SEED", "0") or 0))
+        return _state["plan"]
+
+
+def active() -> bool:
+    return bool(plan())
+
+
+def reset():
+    """Forget hit counters and the RNG stream (test isolation)."""
+    with _lock:
+        _state["raw"] = None
+        _state["plan"] = {}
+        _state["rng"] = None
+
+
+def fire(site: str):
+    """Evaluate the plan at ``site``.  Returns ``None`` (no fault),
+    ``"err"`` or ``"drop"``; a tripped ``crash_after`` never returns
+    (``os._exit(137)`` — the SIGKILL-shaped exit preemption tests
+    expect).  Counts ``fault_injected_total{site,mode}``."""
+    entries = plan()
+    if not entries:
+        return None
+    e = entries.get(site)
+    if e is None:
+        return None
+    with _lock:
+        e.hits += 1
+        hits = e.hits
+        rng = _state["rng"]
+        if e.mode in ("err", "drop"):
+            tripped = rng.random() < e.arg
+            action = e.mode if tripped else None
+        elif e.mode in ("err_first", "drop_first"):
+            action = e.mode.split("_")[0] if hits <= e.arg else None
+        else:  # crash_after
+            action = "crash" if hits > e.arg else None
+    if action is None:
+        return None
+    if _tm.enabled():
+        _TM_INJECTED.inc(site=site, mode=action)
+    if action == "crash":
+        _logger.error("MXTPU_FAULT_PLAN: crashing at site %r after %d "
+                      "hits (crash_after:%d)", site, hits - 1, e.arg)
+        # best-effort black box before the simulated preemption
+        _tm.health.auto_dump("fault")
+        os._exit(137)
+    _logger.warning("MXTPU_FAULT_PLAN: injected %r at site %r (hit %d)",
+                    action, site, hits)
+    return action
+
+
+def maybe_fail(site: str) -> bool:
+    """Common call-site helper: raises :class:`InjectedFault` on ``err``
+    (message names the site), returns True on ``drop`` (the caller
+    simulates the transport loss), False when nothing fired."""
+    action = fire(site)
+    if action == "err":
+        # the named error carries the black box (when
+        # MXTPU_FLIGHT_RECORD names a dump path)
+        dump = _tm.health.auto_dump("fault")
+        raise InjectedFault(
+            f"injected fault at site {site!r} (MXTPU_FAULT_PLAN)"
+            + (f" (flight record: {dump})" if dump else ""))
+    return action == "drop"
+
+
+def should_drop(site: str) -> bool:
+    """True when the plan asks this hit of ``site`` to lose its payload
+    (``drop``/``drop_first``); ``err`` entries raise from here too so a
+    transport site honors both shapes."""
+    return maybe_fail(site)

@@ -66,6 +66,30 @@ _TM_PULL_SEC = _tm.histogram(
     "kvstore_pull_seconds",
     "per-key pull latency (local: broadcast dispatch; dist: the RPC)",
     labels=("store",))
+_TM_DIST_RETRY = _tm.counter(
+    "kvstore_dist_retries_total",
+    "KVStoreDist RPC attempts retried after a transport failure "
+    "(broken pipe / reset / injected drop); each retry reconnects with "
+    "exponential backoff + jitter and retransmits idempotently by "
+    "request id", labels=("op",))
+
+
+def dist_retries() -> int:
+    """MXTPU_DIST_RETRIES — transport retries per RPC (default 5)."""
+    try:
+        return max(int(os.environ.get("MXTPU_DIST_RETRIES", "5")), 0)
+    except ValueError:
+        return 5
+
+
+def dist_backoff_ms() -> float:
+    """MXTPU_DIST_BACKOFF_MS — base retry backoff (default 50ms,
+    doubled per attempt with jitter, capped at 5s)."""
+    try:
+        return max(float(os.environ.get("MXTPU_DIST_BACKOFF_MS", "50")),
+                   1.0)
+    except ValueError:
+        return 50.0
 
 
 def _nbytes(arr) -> int:
@@ -150,6 +174,9 @@ class KVStore:
         """Parity: KVStore::Push.  value may be one NDArray or a list of
         per-device NDArrays — lists are reduced (summed) like Comm::Reduce
         (src/kvstore/comm.h:212-254)."""
+        from . import faults as _faults
+
+        _faults.maybe_fail("kv_push")
         keys, single = _key_list(key)
         if single:
             values = [value]
@@ -320,6 +347,9 @@ class KVStore:
         for row subsets); a DENSE out on a row-sparse key densifies —
         the stored table is a dense device array, so this is the
         whole-table broadcast the Module weight pull performs."""
+        from . import faults as _faults
+
+        _faults.maybe_fail("kv_pull")
         keys, single = _key_list(key)
         outs = [out] if isinstance(out, NDArray) else out
         if single and isinstance(out, (list, tuple)):
@@ -458,6 +488,7 @@ class _PSClient:
     one server (EncodeKey, kvstore_dist.h:264-302)."""
 
     def __init__(self, servers, rank=0):
+        import itertools
         import socket
         import threading
         import time
@@ -469,6 +500,12 @@ class _PSClient:
         self.rank = rank
         self._socks = []
         self._locks = []
+        # request ids for idempotent retransmit: non-idempotent RPCs
+        # (push/barrier/init/control) carry one so a retry after a
+        # broken connection replays the server's cached reply instead
+        # of re-applying (pid included — a recovered worker reuses its
+        # rank but must not collide with its previous life's ids)
+        self._rids = itertools.count(1)
         # persistent pool: one slot per server (matches the per-socket
         # locks) — spawning a pool per push/pull would dominate small RPCs
         self._pool = ThreadPoolExecutor(max_workers=max(len(servers), 1))
@@ -555,10 +592,85 @@ class _PSClient:
                 continue
         return sorted(dead)
 
-    def rpc(self, server, msg):
-        with self._locks[server]:
-            self._ps.send_msg(self._socks[server], msg)
-            return self._ps.recv_msg(self._socks[server])
+    _MUTATING_CMDS = ("init", "push", "barrier", "control")
+
+    def _connect_server(self, server, timeout=5.0):
+        import socket
+
+        host, port = self._servers[server].rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(None)  # reads may legitimately park (sync mode)
+        return s
+
+    def _drop_sock(self, server):
+        s = self._socks[server]
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks[server] = None
+
+    def rpc(self, server, msg, retries=None):
+        """One RPC with bounded retry: a transport failure (broken
+        pipe, reset, truncated reply, injected ``dist_send``/
+        ``dist_recv`` drop) closes the socket, backs off exponentially
+        with jitter (``MXTPU_DIST_BACKOFF_MS``), reconnects, and
+        retransmits — idempotently, via the request id the server
+        dedupes on.  After ``MXTPU_DIST_RETRIES`` retries the failure
+        surfaces as an MXNetError naming the peer and attempt count
+        (callers add the key) instead of a raw socket.error."""
+        import random as _random_mod
+        import socket
+        import time
+
+        from . import faults as _faults
+
+        if msg.get("cmd") in self._MUTATING_CMDS and "rid" not in msg:
+            msg["rid"] = f"{self.rank}:{os.getpid()}:{next(self._rids)}"
+        max_attempts = (dist_retries() if retries is None
+                        else max(int(retries), 0)) + 1
+        delay = dist_backoff_ms() / 1000.0
+        last_exc = None
+        for attempt in range(1, max_attempts + 1):
+            try:
+                with self._locks[server]:
+                    if self._socks[server] is None:
+                        self._socks[server] = self._connect_server(server)
+                    sock = self._socks[server]
+                    try:
+                        if _faults.should_drop("dist_send"):
+                            raise OSError("injected dist_send drop")
+                        self._ps.send_msg(sock, msg)
+                        if _faults.should_drop("dist_recv"):
+                            raise OSError("injected dist_recv drop")
+                        reply = self._ps.recv_msg(sock)
+                        if reply is None:
+                            raise OSError("connection closed by peer")
+                    except (OSError, socket.timeout):
+                        # the stream may hold a half-sent request or an
+                        # unread reply: never reuse it
+                        self._drop_sock(server)
+                        raise
+                return reply
+            except (OSError, socket.timeout) as exc:
+                last_exc = exc
+                if attempt >= max_attempts:
+                    break
+                if _tm.enabled():
+                    _TM_DIST_RETRY.inc(op=str(msg.get("cmd", "?")))
+                time.sleep(delay * (0.5 + _random_mod.random()))
+                delay = min(delay * 2.0, 5.0)
+        from . import telemetry as _tm_mod
+
+        dump = _tm_mod.health.auto_dump("fault")
+        raise MXNetError(
+            f"KVStoreDist RPC {msg.get('cmd')!r} to server "
+            f"{self._servers[server]} failed after {max_attempts} "
+            f"attempt(s): {last_exc!r}"
+            + (f" (flight record: {dump})" if dump else "")
+        ) from last_exc
 
     def rpc_all(self, msg):
         return list(self._pool.map(lambda i: self.rpc(i, dict(msg)),
@@ -632,9 +744,13 @@ class _PSClient:
             try:
                 # a hung-but-alive server must not block process exit:
                 # bound the shutdown RPC (normal RPCs block indefinitely
-                # by design — sync-mode pulls park server-side)
-                self._socks[i].settimeout(5.0)
-                self.rpc(i, {"cmd": "control", "head": head, "body": body})
+                # by design — sync-mode pulls park server-side) and skip
+                # the retry/backoff ladder (retries=0): at exit a dead
+                # server is reported, not courted
+                if self._socks[i] is not None:
+                    self._socks[i].settimeout(5.0)
+                self.rpc(i, {"cmd": "control", "head": head,
+                             "body": body}, retries=0)
             except Exception as exc:  # noqa: BLE001 — collected, not hidden
                 errors.append((i, exc))
         return errors
@@ -643,6 +759,8 @@ class _PSClient:
         self._hb_stop.set()
         self._pool.shutdown(wait=False)
         for s in self._socks:
+            if s is None:  # dropped by the retry path, never reopened
+                continue
             try:
                 s.close()
             except OSError:
@@ -729,6 +847,14 @@ class KVStoreDist(KVStore):
         return self._size
 
     # ------------------------------------------------------------------ ops
+    @staticmethod
+    def _named_comm_error(op, k, exc):
+        """The actionable error contract (ISSUE-11): a dead peer must
+        surface the KEY being moved, the peer address + attempt count
+        (already in the transport error), and the flight-record dump —
+        never a raw socket.error the operator has to strace."""
+        return MXNetError(f"KVStoreDist.{op}: key {k!r}: {exc}")
+
     def init(self, key, value):
         if self._client is None:
             return super().init(key, value)
@@ -738,7 +864,10 @@ class KVStoreDist(KVStore):
         for k, v in zip(keys, values):
             self._shapes[k] = (v.shape, np.dtype(v.dtype))
             if self._rank == 0 and not self._recovery:
-                self._client.init(k, v.asnumpy())
+                try:
+                    self._client.init(k, v.asnumpy())
+                except (MXNetError, OSError) as exc:
+                    raise self._named_comm_error("init", k, exc) from exc
         if not self._recovery:
             # a recovered worker skips the init barrier: the other workers
             # passed it long ago and will never arrive again
@@ -747,6 +876,9 @@ class KVStoreDist(KVStore):
     def push(self, key, value, priority=0):
         if self._client is None:
             return super().push(key, value, priority)
+        from . import faults as _faults
+
+        _faults.maybe_fail("kv_push")
         keys, single = _key_list(key)
         values = [value] if single else value
         if not single:
@@ -772,7 +904,10 @@ class KVStoreDist(KVStore):
                 self._shapes[k] = (merged.shape, np.dtype(merged.dtype))
             if self._engine is None:
                 t0 = time.perf_counter() if _tm.enabled() else None
-                self._client.push(k, merged.asnumpy())
+                try:
+                    self._client.push(k, merged.asnumpy())
+                except (MXNetError, OSError) as exc:
+                    raise self._named_comm_error("push", k, exc) from exc
                 if t0 is not None:
                     _TM_PUSH.inc(store=self.type)
                     _TM_PUSH_BYTES.inc(_nbytes(merged), store=self.type)
@@ -795,7 +930,11 @@ class KVStoreDist(KVStore):
                 with _prof.span(f"kvstore_push[{k}]", category="kvstore"):
                     # the device->host fetch happens HERE, on the engine
                     # worker — the caller thread never blocks on the RPC
-                    self._client.push(k, np.asarray(raw))
+                    try:
+                        self._client.push(k, np.asarray(raw))
+                    except (MXNetError, OSError) as exc:
+                        raise self._named_comm_error("push", k,
+                                                     exc) from exc
                 if t0 is not None:
                     _TM_PUSH.inc(store=self.type)
                     _TM_PUSH_BYTES.inc(_nbytes(raw), store=self.type)
@@ -808,6 +947,9 @@ class KVStoreDist(KVStore):
     def pull(self, key, out=None, priority=0):
         if self._client is None:
             return super().pull(key, out, priority)
+        from . import faults as _faults
+
+        _faults.maybe_fail("kv_pull")
         keys, single = _key_list(key)
         outs = [out] if isinstance(out, NDArray) else out
         if single and isinstance(out, (list, tuple)):
@@ -819,7 +961,10 @@ class KVStoreDist(KVStore):
             targets = o if isinstance(o, (list, tuple)) else [o]
             if self._engine is None:
                 t0 = time.perf_counter() if _tm.enabled() else None
-                val = self._client.pull(k, shape, dtype)
+                try:
+                    val = self._client.pull(k, shape, dtype)
+                except (MXNetError, OSError) as exc:
+                    raise self._named_comm_error("pull", k, exc) from exc
                 for oo in targets:
                     oo._set(val)
                 if t0 is not None:
@@ -835,7 +980,11 @@ class KVStoreDist(KVStore):
 
                 t0 = time.perf_counter() if _tm.enabled() else None
                 with _prof.span(f"kvstore_pull[{k}]", category="kvstore"):
-                    val = self._client.pull(k, shape, dtype)
+                    try:
+                        val = self._client.pull(k, shape, dtype)
+                    except (MXNetError, OSError) as exc:
+                        raise self._named_comm_error("pull", k,
+                                                     exc) from exc
                     for oo in targets:
                         oo._set(val, _from_engine=True)
                 if t0 is not None:

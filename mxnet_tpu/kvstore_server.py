@@ -39,6 +39,7 @@ import struct
 import sys
 import threading
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -129,7 +130,14 @@ def recv_msg(sock):
 def _recv_exact(sock, n):
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except ConnectionResetError:
+            # a peer that died (or a worker's retry/backoff path that
+            # abandoned a broken stream) reads as EOF, not a handler
+            # traceback — the retransmitted request arrives on a fresh
+            # connection
+            return None
         if not chunk:
             return None
         buf.extend(chunk)
@@ -156,6 +164,17 @@ class _ServerState:
         self.heartbeats = {}       # rank -> time.monotonic() of last sign of life
         self.stopped_ranks = set()  # ranks that sent a clean kStopServer
         self.start_time = time.monotonic()
+        # idempotent retransmit (ISSUE-11): workers attach a request id
+        # to every NON-idempotent message (push/barrier/init/control);
+        # a retransmitted rid whose first delivery already applied must
+        # replay the cached reply, never re-apply — a re-applied sync
+        # push double-counts the gradient, a re-applied barrier releases
+        # the round early.  ``rid_inflight`` parks retransmissions that
+        # race the first delivery (e.g. a barrier parked server-side
+        # whose client connection died).
+        self.rid_done = OrderedDict()   # rid -> cached reply
+        self.rid_inflight = set()
+        self.rid_cap = 4096
 
     def dead_nodes(self, timeout):
         """Worker ranks with no sign of life within ``timeout`` seconds.
@@ -205,28 +224,60 @@ class _Handler(socketserver.BaseRequestHandler):
                 with st.cond:
                     dead = st.dead_nodes(float(msg.get("timeout", 60)))
                 send_msg(sock, {"dead": dead})
-            elif cmd == "init":
-                with st.cond:
-                    st.store[msg["key"]] = np.array(msg["value"], copy=True)
-                send_msg(sock, {"ok": True})
-            elif cmd == "push":
-                self._push(st, msg)
-                send_msg(sock, {"ok": True})
             elif cmd == "pull":
+                # pulls are idempotent — retransmissions just re-read
                 send_msg(sock, {"value": self._pull(st, msg["key"],
                                                     msg.get("rank", -1))})
-            elif cmd == "barrier":
-                self._barrier(st)
-                send_msg(sock, {"ok": True})
-            elif cmd == "control":
-                self._control(st, msg["head"], msg.get("body"))
-                send_msg(sock, {"ok": True})
-                if msg["head"] == K_STOP_SERVER:
+            elif cmd in ("init", "push", "barrier", "control"):
+                send_msg(sock, self._apply_once(st, cmd, msg))
+                if cmd == "control" and msg["head"] == K_STOP_SERVER:
                     with st.cond:
                         if st.stop_count >= st.num_workers:
                             return
             else:
                 send_msg(sock, {"error": f"unknown cmd {cmd}"})
+
+    def _apply_once(self, st, cmd, msg):
+        """Apply one non-idempotent message exactly once.  A message
+        carrying a request id that was already applied replays the
+        cached reply (the worker retransmitted after a broken
+        connection); one still in flight (a parked barrier whose client
+        socket died) blocks until the first delivery completes."""
+        rid = msg.get("rid")
+        if rid is not None:
+            with st.cond:
+                while rid in st.rid_inflight:
+                    st.cond.wait()
+                if rid in st.rid_done:
+                    return st.rid_done[rid]
+                st.rid_inflight.add(rid)
+        reply = None
+        try:
+            reply = self._apply(st, cmd, msg)
+        finally:
+            if rid is not None:
+                # discard + cache under ONE lock hold: a retransmission
+                # woken between them would re-apply the message
+                with st.cond:
+                    st.rid_inflight.discard(rid)
+                    if reply is not None:
+                        st.rid_done[rid] = reply
+                        while len(st.rid_done) > st.rid_cap:
+                            st.rid_done.popitem(last=False)
+                    st.cond.notify_all()
+        return reply
+
+    def _apply(self, st, cmd, msg):
+        if cmd == "init":
+            with st.cond:
+                st.store[msg["key"]] = np.array(msg["value"], copy=True)
+        elif cmd == "push":
+            self._push(st, msg)
+        elif cmd == "barrier":
+            self._barrier(st)
+        else:  # control
+            self._control(st, msg["head"], msg.get("body"))
+        return {"ok": True}
 
     # parity: DataHandle (kvstore_dist_server.h:136-227)
     def _push(self, st, msg):

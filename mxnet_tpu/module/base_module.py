@@ -7,6 +7,7 @@ update_metric, with epoch/batch callbacks, eval data, and checkpointing.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from collections import namedtuple
 
@@ -144,9 +145,19 @@ class BaseModule:
             eval_end_callback=None, eval_batch_end_callback=None,
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
-            begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None):
-        """Parity: BaseModule.fit (base_module.py:315)."""
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, checkpoint=None, resume=None):
+        """Parity: BaseModule.fit (base_module.py:315).
+
+        Survival layer (docs/fault_tolerance.md): ``checkpoint`` is a
+        CheckpointManager or directory (default: armed by
+        ``MXTPU_CKPT_DIR`` + ``MXTPU_CKPT_EVERY``); ``resume=True`` (or
+        a path) restores the newest complete checkpoint — params, aux,
+        optimizer state, RNG, and the epoch/batch cursor — before
+        training, and a SIGTERM saves a boundary checkpoint then raises
+        :class:`mxnet_tpu.checkpoint.Preempted`."""
         assert num_epoch is not None, "please specify number of epochs"
+        from .. import checkpoint as _ckpt
         from ..initializer import Uniform
 
         initializer = initializer or Uniform(0.01)
@@ -162,28 +173,88 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
+        if isinstance(checkpoint, _ckpt.CheckpointManager):
+            mgr = checkpoint
+        elif checkpoint:
+            mgr = _ckpt.CheckpointManager(str(checkpoint))
+        else:
+            mgr = _ckpt.CheckpointManager.from_env()
+        if mgr is not None and not hasattr(self, "_checkpoint_arrays"):
+            self.logger.warning(
+                "checkpointing requested but %s has no checkpoint "
+                "provider; disabled", type(self).__name__)
+            mgr = None
+        resume_nbatch, resume_step = -1, 0
+        if resume not in (None, False):
+            if mgr is None and not checkpoint:
+                raise MXNetError("fit(resume=...) needs MXTPU_CKPT_DIR "
+                                 "(or a checkpoint= manager/directory)")
+            if not hasattr(self, "_restore_checkpoint"):
+                raise MXNetError(f"{type(self).__name__} has no "
+                                 "checkpoint provider; resume is "
+                                 "unsupported")
+            path = (resume if isinstance(resume, str)
+                    and os.path.exists(os.path.join(resume, _ckpt.MANIFEST))
+                    else _ckpt.resolve_resume(resume, mgr))
+            if path is None:
+                self.logger.warning("fit(resume=%r): no complete "
+                                    "checkpoint found; starting fresh",
+                                    resume)
+            else:
+                arrays, manifest = _ckpt.load(path)
+                meta = self._restore_checkpoint(arrays, manifest)
+                if meta.get("epoch") is not None:
+                    begin_epoch = int(meta["epoch"])
+                if meta.get("nbatch") is not None:
+                    resume_nbatch = int(meta["nbatch"])
+                resume_step = int(meta.get("step") or 0)
+                if _tm.enabled():
+                    _ckpt._TM_RESUME.inc(status="ok")
+                self.logger.info(
+                    "resumed from %s (step %d, epoch %d, batch cursor "
+                    "%d)", path, resume_step, begin_epoch, resume_nbatch)
+
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        if mgr is not None:
+            mgr.install_preempt_handler()
         try:
-            self._fit_epochs(train_data, eval_data, eval_metric,
-                             validation_metric, begin_epoch, num_epoch,
-                             epoch_end_callback, batch_end_callback,
-                             eval_end_callback, eval_batch_end_callback,
-                             monitor)
+            final_step = self._fit_epochs(
+                train_data, eval_data, eval_metric,
+                validation_metric, begin_epoch, num_epoch,
+                epoch_end_callback, batch_end_callback,
+                eval_end_callback, eval_batch_end_callback,
+                monitor, mgr, resume_nbatch, resume_step)
+            if mgr is not None:
+                # terminal checkpoint: resuming a finished run is a
+                # no-op instead of a silent full retrain
+                self._save_checkpoint_state(mgr, final_step, num_epoch,
+                                            -1, background=False)
         except BaseException:
             # black box first, then crash: dump the flight record (ring
             # + registry + memory report) when MXTPU_FLIGHT_RECORD
             # names a path, then let the exception propagate
             _tm.health.auto_dump("exception")
             raise
+        finally:
+            if mgr is not None:
+                try:
+                    mgr.wait()
+                except Exception as exc:  # noqa: BLE001 — log, not mask
+                    self.logger.warning("checkpoint writer failed: %r",
+                                        exc)
+                mgr.uninstall_preempt_handler()
 
     def _fit_epochs(self, train_data, eval_data, eval_metric,
                     validation_metric, begin_epoch, num_epoch,
                     epoch_end_callback, batch_end_callback,
-                    eval_end_callback, eval_batch_end_callback, monitor):
+                    eval_end_callback, eval_batch_end_callback, monitor,
+                    mgr=None, resume_nbatch=-1, start_step=0):
+        from .. import checkpoint as _ckpt
+
         flight = _tm.health.flight_enabled()
         program = None
         if flight:
@@ -192,7 +263,7 @@ class BaseModule:
                                   "_program_label", None)
             except Exception:  # noqa: BLE001 — PythonModule variants
                 pass
-        step_id = 0
+        step_id = start_step
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -203,6 +274,11 @@ class BaseModule:
             # epoch boundary where values are genuinely needed
             window = _engine.AsyncWindow()
             for nbatch, data_batch in enumerate(train_data):
+                if epoch == begin_epoch and nbatch <= resume_nbatch:
+                    # mid-epoch resume: the checkpoint's cursor already
+                    # trained these batches — replay the iterator past
+                    # them so the step/schedule sequence lines up
+                    continue
                 if monitor is not None:
                     monitor.tic()
                 step_id += 1
@@ -217,6 +293,18 @@ class BaseModule:
                         nbatch=nbatch, depth=len(window),
                         dispatch_s=time.perf_counter() - t0,
                         program=program)
+                if mgr is not None:
+                    if mgr.preempted:
+                        w = self._save_checkpoint_state(
+                            mgr, step_id, epoch, nbatch,
+                            background=False)
+                        raise _ckpt.Preempted(
+                            "SIGTERM: checkpoint saved to "
+                            f"{getattr(w, 'path', mgr.directory)!r}; "
+                            "restart with fit(resume=True)")
+                    if mgr.due(step_id):
+                        self._save_checkpoint_state(mgr, step_id, epoch,
+                                                    nbatch)
                 if _tm.enabled() and data_batch.data:
                     _TM_SAMPLES.inc(
                         data_batch.data[0].shape[0]
@@ -256,6 +344,26 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
             train_data.reset()
+        return step_id
+
+    def _save_checkpoint_state(self, mgr, step, epoch, nbatch,
+                               background=True):
+        """One survival-layer snapshot through the module's checkpoint
+        provider (:meth:`_checkpoint_arrays`): device-resident arrays
+        only — capture dispatches async copies, the writer thread does
+        the fetch + IO, and the training loop never blocks."""
+        from .. import random as _random
+
+        arrays, extra = self._checkpoint_arrays()
+        key = np.asarray(_random.current_key())
+        meta = {"module": type(self).__name__, "step": int(step),
+                "epoch": int(epoch), "nbatch": int(nbatch),
+                "rng_key": key.tolist(), "rng_dtype": str(key.dtype)}
+        sig = getattr(self._symbol, "structural_signature", None)
+        if callable(sig):
+            meta["signature"] = sig()
+        meta.update(extra)
+        return mgr.save(step, arrays, meta=meta, background=background)
 
     # -------------------------------------------------------- to be overridden
     @property

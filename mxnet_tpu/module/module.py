@@ -388,3 +388,202 @@ class Module(BaseModule):
         else:
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
+
+    # ---------------------------------------------------- survival layer
+    def _active_updater(self):
+        if self._update_on_kvstore and self._kvstore is not None:
+            return getattr(self._kvstore, "_updater", None)
+        return self._updater
+
+    def _checkpoint_arrays(self):
+        """Checkpoint provider (docs/fault_tolerance.md): the device-
+        resident arrays of this module's training state — exec-group
+        params/aux, per-key optimizer-state slots, and (when the fused
+        engine shards) the flat sharded state vectors captured AS-IS
+        from the device (no ``sync_shard_state`` gather on the hot
+        loop; the shard layout rides in the meta so restore can decode
+        into per-key form and re-shard through the engine's fingerprint
+        re-ingest).  Returns ``(arrays, extra_meta)``."""
+        assert self.binded and self.params_initialized
+        from .. import amp as _amp
+        from ..kvstore_fused import _state_slots
+
+        ex = self._exec_group.execs[0]
+        arrs = {}
+        for name in self._param_names:
+            if name in ex.arg_dict:
+                arrs["param/" + name] = ex.arg_dict[name]._read()
+        for name in self._aux_names:
+            if name in ex.aux_dict:
+                arrs["aux/" + name] = ex.aux_dict[name]._read()
+        extra = {}
+        kv = self._kvstore
+        dist = kv is not None and "dist" in kv.type
+        sharded_keys = set()
+        shard_meta = {}
+        fused = getattr(kv, "_fused", None) if (kv is not None
+                                                and not dist) else None
+        if fused is not None:
+            for bi, b in enumerate(fused._buckets or ()):
+                if b.shard_state is None:
+                    continue
+                for s, f in enumerate(b.shard_state):
+                    arrs[f"optflat/{bi}/{s}"] = f
+                shard_meta[str(bi)] = {
+                    "keys": list(b.keys),
+                    "offsets": [int(o) for o in b.offsets],
+                    "sizes": [int(s_) for s_ in b.sizes],
+                    "shapes": [list(sh) for sh in b.shapes],
+                    "slots": len(b.shard_state),
+                    "mp": bool(b.mp),
+                }
+                sharded_keys.update(b.keys)
+        if shard_meta:
+            extra["optflat"] = shard_meta
+        upd = None if dist else self._active_updater()
+        if upd is not None:
+            for key, st in upd.states.items():
+                if key in sharded_keys or st is None:
+                    continue
+                for j, leaf in enumerate(_state_slots(st)):
+                    arrs[f"opt/{key}/{j}"] = leaf._read()
+        if self._optimizer is not None:
+            extra["opt_counts"] = {
+                str(k): int(v) for k, v in getattr(
+                    self._optimizer, "_index_update_count", {}).items()}
+            extra["num_update"] = int(getattr(self._optimizer,
+                                              "num_update", 0))
+        if _amp.scaling_active():
+            sc = _amp.global_scaler()
+            arrs["amp/scale"] = sc._scale
+            arrs["amp/good"] = sc._good
+            arrs["amp/overflows"] = sc._overflows
+            arrs["amp/skipped"] = sc._skipped
+        if dist:
+            extra["dist_note"] = ("dist store: optimizer state lives "
+                                  "server-side; weights only")
+        return arrs, extra
+
+    @staticmethod
+    def _ckpt_key(raw):
+        """JSON round-trips int kvstore keys as strings in some meta
+        positions; normalize back."""
+        if isinstance(raw, str) and raw.lstrip("-").isdigit():
+            return int(raw)
+        return raw
+
+    def _restore_checkpoint(self, arrays, manifest):
+        """Restore a survival-layer checkpoint into this bound+
+        initialized module: exec-group params/aux, the kvstore's
+        canonical weight copies, per-key optimizer state (sharded flat
+        vectors decoded through the saved layout; the fused engine's
+        (chunk, version) fingerprints then re-ingest them into the
+        CURRENT shard layout on the next step — restore re-shards),
+        optimizer step counters, the loss-scale scalar, and the RNG
+        stream.  Returns the checkpoint's meta dict."""
+        import jax.numpy as jnp
+
+        from .. import amp as _amp
+        from .. import checkpoint as _ckpt
+        from .. import random as _random
+        from ..kvstore_fused import _state_slots
+
+        assert self.binded and self.params_initialized
+        meta = manifest.get("meta", {})
+        sig = getattr(self._symbol, "structural_signature", None)
+        saved_sig = meta.get("signature")
+        if callable(sig) and saved_sig is not None and saved_sig != sig():
+            raise _ckpt.CheckpointError(
+                "checkpoint was saved from a different graph (signature "
+                f"{saved_sig[:16]}... vs bound {sig()[:16]}...); "
+                "refusing to load mismatched weights")
+        missing = [n for n in self._param_names
+                   if "param/" + n not in arrays]
+        if missing:
+            raise _ckpt.CheckpointError(
+                f"checkpoint lacks params {missing[:5]}...")
+        missing_aux = [n for n in self._aux_names
+                       if "aux/" + n not in arrays]
+        if missing_aux:
+            raise _ckpt.CheckpointError(
+                f"checkpoint lacks aux states {missing_aux[:5]}...")
+        arg_params = {n: nd.array(arrays["param/" + n])
+                      for n in self._param_names}
+        aux_params = {n: nd.array(arrays["aux/" + n])
+                      for n in self._aux_names}
+        self.set_params(arg_params, aux_params, force_init=True)
+        kv = self._kvstore
+        dist = kv is not None and "dist" in kv.type
+        ex = self._exec_group.execs[0]
+        if kv is not None and not dist:
+            # the store's canonical weight copies feed the next update;
+            # leaving them stale would undo the restore on step 1
+            for idx, name in enumerate(self._param_names):
+                if idx in kv._store:
+                    kv._store[idx]._set(
+                        jnp.asarray(arrays["param/" + name]))
+        upd = None if dist else self._active_updater()
+        if upd is not None:
+            def _weight_for(key):
+                if kv is not None and key in kv._store:
+                    return kv._store[key]
+                if isinstance(key, int) and key < len(self._param_names):
+                    return ex.arg_dict.get(self._param_names[key])
+                return ex.arg_dict.get(key)
+
+            def _fill(key, slot_hosts):
+                w = _weight_for(key)
+                if w is None:
+                    return
+                leaves = _state_slots(upd.ensure_state(key, w))
+                for j, host in slot_hosts:
+                    if j >= len(leaves):
+                        continue
+                    leaf = leaves[j]
+                    leaf._chunk.write(jnp.asarray(host).reshape(
+                        leaf.shape).astype(leaf.dtype))
+
+            per_key = {}
+            for name, host in arrays.items():
+                if not name.startswith("opt/"):
+                    continue
+                _, key, j = name.split("/", 2)
+                per_key.setdefault(self._ckpt_key(key), []).append(
+                    (int(j), host))
+            for key, slot_hosts in per_key.items():
+                _fill(key, sorted(slot_hosts))
+            for bi, bm in (meta.get("optflat") or {}).items():
+                flats = [arrays[f"optflat/{bi}/{s}"]
+                         for s in range(int(bm["slots"]))]
+                for i, key in enumerate(bm["keys"]):
+                    key = self._ckpt_key(key)
+                    off = int(bm["offsets"][i])
+                    size = int(bm["sizes"][i])
+                    shape = tuple(bm["shapes"][i])
+                    _fill(key, [(s, flats[s][off:off + size]
+                                 .reshape(shape))
+                                for s in range(len(flats))])
+            # the fused engine's shard_src fingerprints now disagree
+            # with the rewritten per-key chunks: the next sharded step
+            # re-ingests them into the CURRENT mesh layout
+        if self._optimizer is not None:
+            counts = meta.get("opt_counts") or {}
+            self._optimizer._index_update_count = {
+                self._ckpt_key(k): int(v) for k, v in counts.items()}
+            if meta.get("num_update") is not None:
+                self._optimizer.num_update = int(meta["num_update"])
+        if "amp/scale" in arrays and _amp.scaling_active():
+            sc = _amp.global_scaler()
+            with sc._lock:
+                sc._scale = jnp.asarray(arrays["amp/scale"])
+                sc._good = jnp.asarray(arrays["amp/good"])
+                sc._overflows = jnp.asarray(arrays["amp/overflows"])
+                sc._skipped = jnp.asarray(arrays["amp/skipped"])
+        if meta.get("rng_key") is not None:
+            import numpy as _np
+
+            _random._state["key"] = jnp.asarray(_np.array(
+                meta["rng_key"],
+                dtype=_np.dtype(meta.get("rng_dtype", "uint32"))))
+        self._params_dirty = False
+        return meta

@@ -69,6 +69,11 @@ _TM_TICK = _tm.histogram(
     "one engine tick: a fused decode step over all slots + host sampling")
 
 
+class SchedulerDraining(MXNetError):
+    """Submitted while draining (POST /admin/drain): the server is
+    finishing in-flight work before a restart — resubmit elsewhere."""
+
+
 class AdmissionQueueFull(MXNetError):
     """The bounded admission queue is full — shed load (HTTP 429)."""
 
@@ -193,6 +198,7 @@ class SlotScheduler:
         self._queue = deque()
         self._cond = threading.Condition()
         self._stop = False
+        self._draining = False
         self._idle_wait = float(idle_wait)
         # rolled-up engine stats (bench + /healthz): mean slot occupancy
         # = slot_ticks / ticks
@@ -224,6 +230,11 @@ class SlotScheduler:
         with self._cond:
             if self._stop:
                 raise MXNetError("scheduler is shut down")
+            if self._draining:
+                _TM_REQS.inc(outcome="rejected")
+                raise SchedulerDraining(
+                    "scheduler is draining: not admitting new requests "
+                    "(in-flight and queued requests will finish)")
             if len(self._queue) >= self.queue_size:
                 _TM_REQS.inc(outcome="rejected")
                 raise AdmissionQueueFull(
@@ -240,6 +251,36 @@ class SlotScheduler:
         if limit is None and req.deadline is not None:
             limit = max(req.deadline - time.monotonic(), 0.0) + 5.0
         return req.wait(limit)
+
+    # ------------------------------------------------------------- draining
+    def drain(self):
+        """Stop admitting new requests; queued and in-flight requests
+        finish normally (the rolling-restart half of the survival
+        layer: an orchestrator drains a replica, waits for
+        :attr:`drained`, then restarts it under live traffic).
+        Idempotent; ``submit`` raises :class:`SchedulerDraining` until
+        shutdown or :meth:`undrain`."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def undrain(self):
+        """Re-open admission (a drain that was cancelled)."""
+        with self._cond:
+            self._draining = False
+            self._cond.notify_all()
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def drained(self):
+        """True when a draining scheduler has no queued or in-flight
+        work left — safe to restart."""
+        with self._cond:
+            return (self._draining and not self._queue
+                    and all(r is None for r in self.slots))
 
     @property
     def occupied(self):
@@ -339,6 +380,9 @@ class SlotScheduler:
                 # the whole admission for THIS request — prefill, first
                 # sample, cache adoption — fails only this request; the
                 # slot stays free and the engine moves on
+                from .. import faults as _faults
+
+                _faults.maybe_fail("serve_admit")
                 row, logits = self.decoder.prefill_padded(padded, [plen])
                 first = self._sample(
                     req, np.asarray(logits[0, -1], np.float32))

@@ -23,6 +23,12 @@ Endpoints:
 ``GET /healthz``
     ``{"status", "slots", "occupied", "queue_depth", "ticks"}`` —
     liveness + the two saturation signals an orchestrator scales on.
+    ``status`` is ``"draining"`` after ``/admin/drain`` (and
+    ``"drained"`` once nothing is in flight — safe to restart).
+``POST /admin/drain``
+    Rolling-restart support (docs/fault_tolerance.md): stop admitting
+    (new ``/generate`` calls get 503 + Retry-After), finish queued and
+    in-flight requests, report drain progress.  Idempotent.
 """
 from __future__ import annotations
 
@@ -32,7 +38,8 @@ import threading
 
 from .. import telemetry as _tm
 from ..base import MXNetError
-from .scheduler import AdmissionQueueFull, SlotScheduler
+from .scheduler import (AdmissionQueueFull, SchedulerDraining,
+                        SlotScheduler)
 
 __all__ = ["start_server", "serve_decoder"]
 
@@ -132,8 +139,11 @@ def start_server(scheduler: SlotScheduler, port: int = 0,
             elif path == "/metrics.json":
                 self._reply(200, _tm.json_snapshot(reg))
             elif path == "/healthz":
+                status = "ok"
+                if scheduler.draining:
+                    status = "drained" if scheduler.drained else "draining"
                 self._reply(200, {
-                    "status": "ok",
+                    "status": status,
                     "slots": scheduler.num_slots,
                     "occupied": scheduler.occupied,
                     "queue_depth": scheduler.queue_depth,
@@ -144,6 +154,15 @@ def start_server(scheduler: SlotScheduler, port: int = 0,
 
         def do_POST(self):
             path = self.path.split("?", 1)[0]
+            if path == "/admin/drain":
+                scheduler.drain()
+                self._reply(200, {
+                    "status": "drained" if scheduler.drained
+                    else "draining",
+                    "occupied": scheduler.occupied,
+                    "queue_depth": scheduler.queue_depth,
+                })
+                return
             if path != "/generate":
                 self._reply(404, {"error": f"no such path {path!r}"})
                 return
@@ -159,6 +178,12 @@ def start_server(scheduler: SlotScheduler, port: int = 0,
                 return
             try:
                 req = scheduler.submit(prompt, **kwargs)
+            except SchedulerDraining as exc:
+                # the orchestrator asked this replica to die: clients
+                # retry against another replica, not this one
+                self._reply(503, {"error": str(exc)},
+                            headers=(("Retry-After", "5"),))
+                return
             except AdmissionQueueFull as exc:
                 self._reply(429, {"error": str(exc)},
                             headers=(("Retry-After", "1"),))

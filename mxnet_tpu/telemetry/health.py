@@ -452,19 +452,69 @@ def dump_flight_record(path=None, trigger: str = "manual") -> str:
     return path
 
 
-def auto_dump(trigger: str):
-    """Best-effort dump for crash/signal paths.
+def _step_suffix_path(path: str) -> str:
+    """``.../flight.json`` -> ``.../flight_step<seq>.json`` — each auto
+    dump gets its own file keyed by the flight ring's step sequence, so
+    a SIGUSR1 (or repeated faults) never clobbers the previous dump."""
+    root, ext = os.path.splitext(path)
+    return f"{root}_step{_step_seq}{ext or '.json'}"
 
-    ``exception`` dumps only when ``MXTPU_FLIGHT_RECORD`` names a path
-    (an uncaught exception must not litter the cwd by default);
-    ``signal`` always dumps (the operator asked).  Never raises."""
+
+def _prune_dumps(path: str):
+    """Bounded dump retention: keep the newest ``MXTPU_FLIGHT_RING``
+    step-suffixed dumps sharing this path's stem (same knob as the
+    in-memory ring — the black boxes rotate like the records do)."""
+    import glob
+    import re
+
+    root, ext = os.path.splitext(path)
+    base = re.sub(r"_step\d+$", "", root)
+    pat = re.compile(re.escape(base) + r"_step(\d+)" + re.escape(ext or
+                                                                 ".json")
+                     + "$")
+    found = []
+    for f in glob.glob(glob.escape(base) + "_step*" + (ext or ".json")):
+        m = pat.match(f)
+        if m:
+            found.append((int(m.group(1)), f))
+    found.sort()
+    for _, f in found[:-flight_ring_size()] if found else []:
+        try:
+            os.remove(f)
+        except OSError:
+            pass
+
+
+def auto_dump(trigger: str):
+    """Best-effort dump for crash/signal/fault paths.
+
+    ``exception``/``fault`` dump only when ``MXTPU_FLIGHT_RECORD``
+    names a path (an uncaught exception must not litter the cwd by
+    default); ``signal`` always dumps (the operator asked).  Dumps are
+    step-suffixed and rotated (``MXTPU_FLIGHT_RING`` files max) so
+    successive triggers never clobber each other.  Never raises;
+    returns the path written (or None)."""
     try:
         if not flight_enabled():
             return None
         path = _auto_dump_path()
         if path is None and trigger != "signal":
             return None
-        return dump_flight_record(path, trigger=trigger)
+        if path is None:
+            path = f"mxtpu_flight_record_{os.getpid()}.json"
+        if os.path.isdir(path):
+            path = os.path.join(path,
+                                f"mxtpu_flight_record_{os.getpid()}.json")
+        if trigger != "exception":
+            # live-run triggers (SIGUSR1, injected faults) recur: each
+            # dump gets a step-id suffix and the set rotates under the
+            # MXTPU_FLIGHT_RING retention; the terminal exception dump
+            # keeps the exact configured path (one per process death)
+            path = _step_suffix_path(path)
+        out = dump_flight_record(path, trigger=trigger)
+        if trigger != "exception":
+            _prune_dumps(out)
+        return out
     except Exception:  # noqa: BLE001 — a dump failure must not mask the crash
         _logger.exception("flight-record auto-dump failed")
         return None

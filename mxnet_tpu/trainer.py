@@ -655,14 +655,25 @@ class FusedTrainer:
     # ------------------------------------------------------------------- fit
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             validation_metric=None, num_epoch=1, batch_end_callback=None,
-            epoch_end_callback=None, logger=None):
+            epoch_end_callback=None, logger=None, checkpoint=None,
+            resume=None):
         """Module.fit-shaped loop on the fused step (the whole-step-
         compiled perf path): per-batch metric updates, Speedometer-style
         callbacks, per-epoch eval — without hand-rolling the loop.
 
         Calls init() from the first batch's shapes if needed.  Returns
         self.  The metric sees the step's outputs (same contract as
-        Module.update_metric)."""
+        Module.update_metric).
+
+        Survival layer (docs/fault_tolerance.md): ``checkpoint`` is a
+        CheckpointManager or a directory (default: armed by
+        ``MXTPU_CKPT_DIR`` + ``MXTPU_CKPT_EVERY``) — snapshots every N
+        steps without draining the async window, saves a boundary
+        checkpoint on SIGTERM (raising ``checkpoint.Preempted``), and a
+        final one when training completes.  ``resume=True`` (or a
+        path) restores the newest complete checkpoint — params,
+        optimizer state, RNG, and the mid-epoch batch cursor — so a
+        killed run continues step-exact."""
         import logging as _logging
 
         from . import metric as metric_mod
@@ -694,28 +705,74 @@ class FusedTrainer:
         eval_names = ([d[0] for d in eval_data.provide_data]
                       + eval_label_names if eval_data is not None else None)
         from . import engine as _engine
+        from . import checkpoint as _ckpt
 
+        if isinstance(checkpoint, _ckpt.CheckpointManager):
+            mgr = checkpoint
+        elif checkpoint:
+            mgr = _ckpt.CheckpointManager(str(checkpoint))
+        else:
+            mgr = _ckpt.CheckpointManager.from_env()
+        start_epoch, resume_nbatch = 0, -1
+        if resume not in (None, False):
+            if not self.params:
+                shapes = {d[0]: tuple(d[1]) for d in
+                          list(train_data.provide_data)
+                          + list(train_data.provide_label or [])}
+                self.init(**shapes)
+            path = (resume if isinstance(resume, str)
+                    and os.path.exists(os.path.join(resume, _ckpt.MANIFEST))
+                    else _ckpt.resolve_resume(resume, mgr))
+            if path is None:
+                log.warning("fit(resume=%r): no complete checkpoint "
+                            "found; starting fresh", resume)
+            else:
+                meta = self.restore_state(path)
+                if meta.get("epoch") is not None:
+                    start_epoch = int(meta["epoch"])
+                if meta.get("nbatch") is not None:
+                    resume_nbatch = int(meta["nbatch"])
+                log.info("resumed from %s (step %d, epoch %d, batch "
+                         "cursor %d)", path, self._step, start_epoch,
+                         resume_nbatch)
+        if mgr is not None:
+            mgr.install_preempt_handler()
         try:
             self._fit_impl(train_data, eval_data, eval_metric,
                            validation_metric, num_epoch,
                            batch_end_callback, epoch_end_callback, log,
                            train_names, eval_names, eval_label_names,
-                           _engine, _time)
+                           _engine, _time, mgr, start_epoch,
+                           resume_nbatch)
+            if mgr is not None and self.params:
+                # terminal checkpoint: a resume of a finished run is a
+                # no-op instead of a silent full retrain
+                self.save_state(mgr, epoch=num_epoch, nbatch=-1,
+                                background=False)
         except BaseException:
             # black box first, then crash: the ring + registry +
             # memory report of the dying run (MXTPU_FLIGHT_RECORD path)
             _tm.health.auto_dump("exception")
             raise
+        finally:
+            if mgr is not None:
+                try:
+                    mgr.wait()
+                except Exception as exc:  # noqa: BLE001 — log, don't mask
+                    log.warning("checkpoint writer failed: %r", exc)
+                mgr.uninstall_preempt_handler()
         return self
 
     def _fit_impl(self, train_data, eval_data, eval_metric,
                   validation_metric, num_epoch, batch_end_callback,
                   epoch_end_callback, log, train_names, eval_names,
-                  eval_label_names, _engine, _time):
+                  eval_label_names, _engine, _time, mgr=None,
+                  start_epoch=0, resume_nbatch=-1):
+        from . import checkpoint as _ckpt
         from .module.base_module import BatchEndParam, _as_list
 
         flight = _tm.health.flight_enabled()
-        for epoch in range(num_epoch):
+        for epoch in range(start_epoch, num_epoch):
             tic = _time.time()
             eval_metric.reset()
             train_data.reset()
@@ -724,6 +781,11 @@ class FusedTrainer:
             # the only place the steady-state loop blocks
             window = _engine.AsyncWindow()
             for nbatch, batch in enumerate(train_data):
+                if epoch == start_epoch and nbatch <= resume_nbatch:
+                    # mid-epoch resume: the checkpoint's cursor already
+                    # trained these batches — replay the iterator past
+                    # them so the step/RNG/schedule sequence lines up
+                    continue
                 feed = dict(zip(train_names,
                                 list(batch.data) + list(batch.label)))
                 if not self.params:
@@ -739,6 +801,20 @@ class FusedTrainer:
                         nbatch=nbatch, depth=len(window),
                         dispatch_s=_time.perf_counter() - t0,
                         program=f"fused_step[{self.symbol.name or 'graph'}]")
+                if mgr is not None:
+                    if mgr.preempted:
+                        # window boundary under preemption: capture is
+                        # ordered behind the in-flight steps, written
+                        # synchronously, then the run dies a named death
+                        w = self.save_state(mgr, epoch=epoch,
+                                            nbatch=nbatch,
+                                            background=False)
+                        raise _ckpt.Preempted(
+                            "SIGTERM: checkpoint saved to "
+                            f"{getattr(w, 'path', mgr.directory)!r}; "
+                            "restart with fit(resume=True)")
+                    if mgr.due(self._step):
+                        self.save_state(mgr, epoch=epoch, nbatch=nbatch)
                 if batch_end_callback is not None:
                     params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                            eval_metric=eval_metric,
@@ -771,6 +847,151 @@ class FusedTrainer:
                 for name, val in vm.get_global_name_value():
                     log.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
         return self
+
+    # ------------------------------------------------------- survival layer
+    def _checkpoint_arrays(self):
+        """Device-resident snapshot set for the async checkpointer: the
+        f32 masters, aux states, and every optimizer-state slot — the
+        arrays the fused step owns (the bf16 compute cache is derived,
+        never saved).  Values are live jax arrays; checkpoint.snapshot
+        makes the detached device copies."""
+        arrs = {}
+        for k, v in self.params.items():
+            arrs["param/" + k] = v
+        for k, v in self.aux.items():
+            arrs["aux/" + k] = v
+        for k, slots in self.opt_state.items():
+            for i, s in enumerate(slots):
+                arrs[f"opt/{k}/{i}"] = s
+        return arrs
+
+    def _checkpoint_meta(self, epoch=None, nbatch=None):
+        key = np.asarray(_random.current_key())
+        return {
+            "trainer": "fused",
+            "step": int(self._step),
+            "epoch": None if epoch is None else int(epoch),
+            "nbatch": None if nbatch is None else int(nbatch),
+            "signature": self._exec_symbol.structural_signature(),
+            "hwio": sorted(self._hwio),
+            "rng_key": key.tolist(),
+            "rng_dtype": str(key.dtype),
+        }
+
+    def save_state(self, target, epoch=None, nbatch=None, background=True):
+        """Write a resumable checkpoint (params + aux + optimizer state
+        + step/epoch/batch cursor + RNG state) through the survival
+        layer (checkpoint.py): device-side capture ordered after the
+        in-flight steps — the AsyncWindow is NOT drained — with the
+        fetch + file IO on a background writer.  ``target`` is a
+        :class:`~mxnet_tpu.checkpoint.CheckpointManager` or a
+        directory.  Returns the write handle (or None when the
+        manager skipped an in-flight duplicate)."""
+        from . import checkpoint as _ckpt
+
+        if not self.params:
+            raise MXNetError("save_state: trainer not initialized")
+        meta = self._checkpoint_meta(epoch=epoch, nbatch=nbatch)
+        arrays = self._checkpoint_arrays()
+        if isinstance(target, _ckpt.CheckpointManager):
+            return target.save(self._step, arrays, meta=meta,
+                               background=background)
+        return _ckpt.save(str(target), self._step, arrays, meta=meta,
+                          background=background)
+
+    def restore_state(self, source):
+        """Restore from a survival-layer checkpoint into this
+        INITIALIZED trainer: validates the manifest (checksums + the
+        bound graph's structural signature), re-applies this trainer's
+        shardings/layouts (the checkpoint may come from a different
+        shard layout or HWIO config), and restores the step cursor and
+        RNG stream for bit-parity resume.  ``source`` is a checkpoint
+        path, a directory of checkpoints (newest complete wins), or a
+        CheckpointManager.  Returns the checkpoint's meta dict."""
+        import jax.numpy as jnp
+
+        from . import checkpoint as _ckpt
+
+        if not self.params:
+            raise MXNetError("restore_state: call init() first (shapes/"
+                             "shardings come from init)")
+        if isinstance(source, _ckpt.CheckpointManager):
+            path = source.latest()
+        elif isinstance(source, str) and os.path.exists(
+                os.path.join(source, _ckpt.MANIFEST)):
+            path = source
+        else:
+            path = _ckpt.latest(str(source))
+        if path is None:
+            raise _ckpt.CheckpointError(
+                f"no complete checkpoint found under {source!r}")
+        arrays, manifest = _ckpt.load(path)
+        meta = manifest.get("meta", {})
+        sig = self._exec_symbol.structural_signature()
+        saved_sig = meta.get("signature")
+        if saved_sig is not None and saved_sig != sig:
+            raise _ckpt.CheckpointError(
+                f"checkpoint {path!r} was saved from a different graph "
+                f"(signature {saved_sig[:16]}... vs bound "
+                f"{sig[:16]}...); refusing to load mismatched weights")
+        saved_hwio = set(meta.get("hwio", ()))
+
+        def _relayout(k, host):
+            # stored-layout translation between configs: the checkpoint
+            # carries arrays in ITS stored layout and names the HWIO set
+            if host.ndim != 4:
+                return host
+            if k in saved_hwio and k not in self._hwio:
+                return np.transpose(host, (3, 2, 0, 1))
+            if k not in saved_hwio and k in self._hwio:
+                return np.transpose(host, (2, 3, 1, 0))
+            return host
+
+        def _put(host, like):
+            raw = jnp.asarray(host)
+            if raw.shape != like.shape:
+                raise _ckpt.CheckpointError(
+                    f"checkpoint {path!r}: shape {raw.shape} does not "
+                    f"match the bound {tuple(like.shape)}")
+            return (jax.device_put(raw, like.sharding)
+                    if self.mesh is not None else raw)
+
+        for k in self.params:
+            name = "param/" + k
+            if name not in arrays:
+                raise _ckpt.CheckpointError(
+                    f"checkpoint {path!r} lacks param {k!r}")
+            self.params[k] = _put(_relayout(k, arrays[name]),
+                                  self.params[k])
+        for k in self.aux:
+            name = "aux/" + k
+            if name not in arrays:
+                raise _ckpt.CheckpointError(
+                    f"checkpoint {path!r} lacks aux state {k!r}")
+            self.aux[k] = _put(arrays[name], self.aux[k])
+        for k, slots in self.opt_state.items():
+            new = []
+            for i, s in enumerate(slots):
+                name = f"opt/{k}/{i}"
+                if name not in arrays:
+                    raise _ckpt.CheckpointError(
+                        f"checkpoint {path!r} lacks optimizer state "
+                        f"{k}:{i} (different optimizer?)")
+                host = arrays[name]
+                if host.ndim == 4 and host.shape != tuple(s.shape):
+                    host = _relayout(k, host)
+                new.append(_put(host, s))
+            self.opt_state[k] = tuple(new)
+        if meta.get("step") is not None:
+            self._step = int(meta["step"])
+        if meta.get("rng_key") is not None:
+            _random._state["key"] = jnp.asarray(np.array(
+                meta["rng_key"],
+                dtype=np.dtype(meta.get("rng_dtype", "uint32"))))
+        self._refresh_compute_cache()
+        if _tm.enabled():
+            _ckpt._TM_RESUME.inc(status="ok")
+        return meta
 
     # ------------------------------------------------------------ checkpoints
     def _gather(self, v):

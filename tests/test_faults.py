@@ -1,0 +1,490 @@
+"""Fault-injection harness + dist retry/backoff + serving drain tests
+(ISSUE-11).
+
+Contract under test: every injected-fault path terminates in either
+RECOVERY (retry/backoff, checkpoint fallback, engine survival) or a
+clean, NAMED error (site/key/peer/attempts; flight record attached when
+a dump path is configured) — never a hang, a raw socket.error, or
+silent corruption."""
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import faults  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.faults import InjectedFault  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan(monkeypatch):
+    monkeypatch.delenv("MXTPU_FAULT_PLAN", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# plan grammar
+# ---------------------------------------------------------------------------
+def test_plan_parses_the_documented_grammar(monkeypatch):
+    monkeypatch.setenv(
+        "MXTPU_FAULT_PLAN",
+        "kv_push:err:0.01,dist_send:drop:0.05,ckpt_write:crash_after:3")
+    p = faults.plan()
+    assert p["kv_push"].mode == "err" and p["kv_push"].arg == 0.01
+    assert p["dist_send"].mode == "drop" and p["dist_send"].arg == 0.05
+    assert p["ckpt_write"].mode == "crash_after" and \
+        p["ckpt_write"].arg == 3
+    assert faults.active()
+
+
+@pytest.mark.parametrize("bad", [
+    "kv_push:err",            # missing arg
+    "kv_push:explode:1",      # unknown mode
+    "kv_push:err:2.0",        # probability out of range
+    "kv_push:crash_after:-1",  # negative count
+    "kv_push:err:x",          # non-numeric
+])
+def test_plan_rejects_bad_entries_with_named_error(monkeypatch, bad):
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", bad)
+    faults.reset()
+    with pytest.raises(MXNetError, match="MXTPU_FAULT_PLAN"):
+        faults.plan()
+
+
+def test_plan_is_deterministic_under_seed(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "x:err:0.5")
+    monkeypatch.setenv("MXTPU_FAULT_SEED", "42")
+
+    def draw():
+        faults.reset()
+        return [faults.fire("x") for _ in range(32)]
+
+    assert draw() == draw()
+
+
+def test_first_n_modes_are_deterministic(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "s:err_first:2")
+    faults.reset()
+    assert faults.fire("s") == "err"
+    assert faults.fire("s") == "err"
+    assert faults.fire("s") is None
+    assert faults.fire("s") is None
+
+
+def test_unlisted_site_never_fires(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "s:err:1")
+    faults.reset()
+    assert faults.fire("other_site") is None
+
+
+def test_injection_counts_telemetry(monkeypatch):
+    import mxnet_tpu.telemetry as tm
+
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "s:err_first:3")
+    faults.reset()
+    tm.reset()
+    tm.enable()
+    try:
+        for _ in range(5):
+            faults.fire("s")
+        fam = {f.name: f for f in tm.get_registry().collect()}
+        total = sum(v for _, v in fam["fault_injected_total"].samples())
+        assert total == 3
+    finally:
+        tm.disable()
+        tm.reset()
+
+
+# ---------------------------------------------------------------------------
+# kvstore sites
+# ---------------------------------------------------------------------------
+def test_kv_push_injected_error_is_named_and_carries_dump(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "kv_push:err_first:1")
+    monkeypatch.setenv("MXTPU_FLIGHT_RECORD", str(tmp_path))
+    faults.reset()
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((2, 2)))
+    with pytest.raises(InjectedFault) as exc_info:
+        kv.push("w", mx.nd.ones((2, 2)))
+    msg = str(exc_info.value)
+    assert "kv_push" in msg and "flight record" in msg
+    # the named error carries a REAL dump the operator can open
+    dumps = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert dumps
+    with open(tmp_path / dumps[0]) as f:
+        assert json.load(f)["trigger"] == "fault"
+    # recovery: the next push (fault exhausted) trains normally
+    kv.push("w", mx.nd.ones((2, 2)))
+
+
+# ---------------------------------------------------------------------------
+# dist transport: retry/backoff + idempotent retransmit + named errors
+# ---------------------------------------------------------------------------
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def ps_server(monkeypatch):
+    """In-process parameter server + a dist_async client environment."""
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    port = _free_port()
+    monkeypatch.setenv("MXTPU_PS_SERVERS", f"127.0.0.1:{port}")
+    monkeypatch.setenv("MXTPU_NUM_WORKERS", "1")
+    monkeypatch.setenv("MXTPU_PS_ASYNC", "0")
+    monkeypatch.setenv("MXTPU_DIST_BACKOFF_MS", "5")
+    srv = KVStoreServer(num_workers=1, port=port, host="127.0.0.1")
+    thread = threading.Thread(target=srv.run, daemon=True)
+    thread.start()
+    yield srv
+    with srv.state.cond:
+        srv.state.stopped = True
+        srv.state.cond.notify_all()
+
+
+def test_dist_recovers_under_random_drops(ps_server, monkeypatch):
+    """drop faults on both transport directions: every push/pull still
+    lands exactly once, retries counted."""
+    import mxnet_tpu.telemetry as tm
+
+    monkeypatch.setenv("MXTPU_FAULT_PLAN",
+                       "dist_send:drop:0.3,dist_recv:drop:0.2")
+    monkeypatch.setenv("MXTPU_DIST_RETRIES", "12")
+    faults.reset()
+    tm.reset()
+    tm.enable()
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("w", mx.nd.ones((4, 5)))
+        kv.set_optimizer(mx.optimizer.create(
+            "sgd", learning_rate=1.0, rescale_grad=1.0))
+        for _ in range(6):
+            kv.push("w", mx.nd.ones((4, 5)))
+        out = mx.nd.zeros((4, 5))
+        kv.pull("w", out=out)
+        # 6 pushes, each applied EXACTLY once: w = 1 - 6*1 = -5.  A
+        # retransmitted push that re-applied would land below -5.
+        np.testing.assert_allclose(out.asnumpy(), -5.0)
+        fam = {f.name: f for f in tm.get_registry().collect()}
+        retries = sum(v for _, v in
+                      fam["kvstore_dist_retries_total"].samples())
+        assert retries > 0
+        monkeypatch.setenv("MXTPU_FAULT_PLAN", "")
+        faults.reset()
+        kv._send_stop()
+    finally:
+        tm.disable()
+        tm.reset()
+
+
+def test_dist_recv_drop_exactly_once_deterministic(ps_server, monkeypatch):
+    """The sharpest double-apply shape: the reply (not the request) is
+    lost, so the server HAS applied the push — the retransmit must hit
+    the rid cache, not the updater."""
+    monkeypatch.setenv("MXTPU_DIST_RETRIES", "4")
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.zeros((3, 3)))
+    kv.set_optimizer(mx.optimizer.create(
+        "sgd", learning_rate=1.0, rescale_grad=1.0))
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "dist_recv:drop_first:1")
+    faults.reset()
+    kv.push("w", mx.nd.ones((3, 3)))  # reply dropped once -> retransmit
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "")
+    faults.reset()
+    out = mx.nd.zeros((3, 3))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), -1.0)  # once, not twice
+    kv._send_stop()
+
+
+def test_dead_peer_error_names_key_peer_and_attempts(ps_server,
+                                                     monkeypatch):
+    """ISSUE-11 satellite: a dead server must surface an MXNetError
+    naming the key, the peer address, and the attempt count — not a
+    raw BrokenPipeError."""
+    monkeypatch.setenv("MXTPU_DIST_RETRIES", "1")
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.ones((2, 2)))
+    # kill the server out from under the client...
+    with ps_server.state.cond:
+        ps_server.state.stopped = True
+        ps_server.state.cond.notify_all()
+    addr = os.environ["MXTPU_PS_SERVERS"]
+    host, port = addr.rsplit(":", 1)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:  # wait until the listener is really gone
+            socket.create_connection((host, int(port)), timeout=1).close()
+            time.sleep(0.05)
+        except OSError:
+            break
+    # ...and break the client's established connection too (the
+    # listener is closed but the old handler thread still holds it):
+    # the first send is dropped, every reconnect hits a dead port
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "dist_send:drop_first:1")
+    faults.reset()
+    with pytest.raises(MXNetError) as exc_info:
+        kv.push("w", mx.nd.ones((2, 2)))
+    msg = str(exc_info.value)
+    assert "'w'" in msg, msg                      # the key
+    assert addr in msg, msg                       # the peer
+    assert "2 attempt" in msg, msg                # 1 retry + original
+    kv._client = None  # the server is gone; skip the atexit stop
+
+
+def test_barrier_retransmit_does_not_double_count(ps_server, monkeypatch):
+    """A barrier whose reply is lost must not release a later round
+    early: the retransmitted rid parks/replays server-side."""
+    monkeypatch.setenv("MXTPU_DIST_RETRIES", "4")
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.zeros((2, 2)))
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "dist_recv:drop_first:1")
+    faults.reset()
+    kv.barrier()   # reply dropped once; retransmit replays cached reply
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "")
+    faults.reset()
+    # with num_workers=1 a lingering phantom barrier count would release
+    # (or deadlock) this one incorrectly; it must just pass
+    kv.barrier()
+    kv._send_stop()
+
+
+# ---------------------------------------------------------------------------
+# serving: drain + admission faults
+# ---------------------------------------------------------------------------
+L, H, D, T, V = 2, 2, 32, 32, 17
+
+
+@pytest.fixture(scope="module")
+def decoder():
+    from mxnet_tpu import models
+    from mxnet_tpu.models.decode import KVDecoder
+
+    net = models.transformer.transformer_lm(
+        num_layers=L, num_heads=H, d_model=D, seq_len=T, vocab_size=V)
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="null",
+                         data=(1, T), softmax_label=(1, T))
+    rs = np.random.RandomState(0)
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        arr[:] = rs.normal(0, 0.08, arr.shape).astype(np.float32)
+        params[name] = arr
+    return KVDecoder(params, num_layers=L, num_heads=H, max_len=T)
+
+
+def test_drain_endpoint_finishes_in_flight_then_reports_drained(decoder):
+    from mxnet_tpu.serving import SlotScheduler, start_server
+
+    sched = SlotScheduler(decoder, num_slots=2, queue_size=4)
+    srv = start_server(sched, port=0)
+    port = srv.server_address[1]
+    try:
+        rs = np.random.RandomState(0)
+        # a long request rides through the drain
+        result = {}
+
+        def client():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"prompt": rs.randint(0, V, 4).tolist(),
+                                 "max_tokens": 12}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                result["status"] = r.status
+                result["body"] = json.loads(r.read())
+
+        t = threading.Thread(target=client)
+        t.start()
+        # wait until it is admitted (occupied > 0)
+        deadline = time.monotonic() + 60
+        while sched.occupied == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.occupied > 0
+        # drain: POST /admin/drain
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/drain", data=b"")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["status"] in ("draining",
+                                                      "drained")
+        # healthz reports the drain
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            assert json.loads(r.read())["status"] in ("draining",
+                                                      "drained")
+        # new admissions are shed with 503 + Retry-After
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"prompt": [1, 2]}).encode(),
+                headers={"Content-Type": "application/json"}),
+                timeout=30)
+            pytest.fail("draining server admitted a request")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers.get("Retry-After")
+        # the in-flight request still finishes OK
+        t.join(timeout=120)
+        assert result.get("status") == 200
+        assert result["body"]["outcome"] == "ok"
+        # and the replica reaches the safe-to-restart state
+        deadline = time.monotonic() + 60
+        while not sched.drained and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sched.drained
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            assert json.loads(r.read())["status"] == "drained"
+    finally:
+        srv.shutdown()
+        sched.close()
+
+
+def test_serve_admit_fault_kills_request_not_engine(decoder, monkeypatch):
+    """An injected admission fault terminates ONE request with outcome
+    error; the engine thread survives and serves the next request."""
+    from mxnet_tpu.serving import SlotScheduler
+
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "serve_admit:err_first:1")
+    faults.reset()
+    sched = SlotScheduler(decoder, num_slots=2, queue_size=4)
+    try:
+        rs = np.random.RandomState(2)
+        bad = sched.generate(rs.randint(0, V, 4), max_new_tokens=3,
+                             timeout=60)
+        assert bad.outcome == "error"
+        assert isinstance(bad.error, InjectedFault)
+        ok = sched.generate(rs.randint(0, V, 4), max_new_tokens=3,
+                            timeout=60)
+        assert ok.outcome == "ok"
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# flight-record dump rotation (ISSUE-11 satellite)
+# ---------------------------------------------------------------------------
+def test_signal_dumps_rotate_with_step_suffix(tmp_path, monkeypatch):
+    from mxnet_tpu.telemetry import health
+
+    monkeypatch.setenv("MXTPU_FLIGHT_RECORD", str(tmp_path))
+    monkeypatch.setenv("MXTPU_FLIGHT_RING", "4")
+    paths = []
+    for i in range(7):
+        health.record_step(loop="t", step=i)
+        paths.append(health.auto_dump("signal"))
+    assert all(p is not None for p in paths)
+    # each dump is its own file (step suffix), never a clobber
+    assert len(set(paths)) == len(paths)
+    assert all("_step" in os.path.basename(p) for p in paths)
+    # retention: at most MXTPU_FLIGHT_RING dumps remain
+    remaining = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert len(remaining) == 4
+    # the survivors are the NEWEST ones
+    assert sorted(remaining) == sorted(
+        os.path.basename(p) for p in paths[-4:])
+
+
+# ---------------------------------------------------------------------------
+# chaos soak (slow): randomized plan, 200 steps, loss must decrease
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_soak(tmp_path, monkeypatch):
+    """200 training steps under a randomized fault plan (checkpoint
+    writer failures + dist transport drops on a live PS): loss
+    decreases and NO unhandled exception escapes the loop."""
+    from mxnet_tpu import checkpoint as ckpt
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    port = _free_port()
+    monkeypatch.setenv("MXTPU_PS_SERVERS", f"127.0.0.1:{port}")
+    monkeypatch.setenv("MXTPU_NUM_WORKERS", "1")
+    monkeypatch.setenv("MXTPU_PS_ASYNC", "0")
+    monkeypatch.setenv("MXTPU_DIST_RETRIES", "16")
+    monkeypatch.setenv("MXTPU_DIST_BACKOFF_MS", "2")
+    srv = KVStoreServer(num_workers=1, port=port, host="127.0.0.1")
+    threading.Thread(target=srv.run, daemon=True).start()
+    monkeypatch.setenv(
+        "MXTPU_FAULT_PLAN",
+        "ckpt_write:err:0.3,dist_send:drop:0.05,dist_recv:drop:0.05")
+    monkeypatch.setenv("MXTPU_FAULT_SEED", "1234")
+    faults.reset()
+
+    # dist leg: a PS-backed weight hammered by pushes under drops
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.ones((8, 8)))
+    kv.set_optimizer(mx.optimizer.create(
+        "sgd", learning_rate=0.01, rescale_grad=1.0))
+
+    # training leg: FusedTrainer with a flaky checkpoint writer armed
+    from mxnet_tpu.trainer import FusedTrainer
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mx.random.seed(0)
+    t = FusedTrainer(net, optimizer="sgd", optimizer_params={"lr": 0.1})
+    t.init(data=(16, 8), softmax_label=(16,))
+    mgr = ckpt.CheckpointManager(str(tmp_path), every=5, keep=3)
+
+    rs = np.random.RandomState(0)
+    w_true = rs.randn(8)
+    X = rs.randn(16 * 200, 8).astype(np.float32)
+    Y = (X @ w_true > 0).astype(np.float32)
+
+    import jax
+
+    first_loss = last_loss = None
+    for i in range(200):
+        b = slice(i * 16, (i + 1) * 16)
+        outs = t.step(data=X[b], softmax_label=Y[b])
+        probs = np.asarray(jax.device_get(outs[0]))
+        loss = -np.mean(np.log(np.clip(
+            probs[np.arange(16), Y[b].astype(int)], 1e-9, 1.0)))
+        if i < 10:
+            first_loss = loss if first_loss is None else \
+                (first_loss + loss)
+        if i >= 190:
+            last_loss = loss if last_loss is None else (last_loss + loss)
+        if mgr.due(t._step):
+            # a failing writer is logged+skipped, never raises here
+            mgr.save(t._step, t._checkpoint_arrays(),
+                     meta=t._checkpoint_meta(0, i))
+        kv.push("w", mx.nd.ones((8, 8)))
+        if i % 20 == 0:
+            out = mx.nd.zeros((8, 8))
+            kv.pull("w", out=out)
+    try:
+        mgr.wait()
+    except InjectedFault:
+        pass  # the last background write may have drawn the fault
+    assert last_loss / 10 < first_loss / 10, (first_loss, last_loss)
+    # some checkpoints survived the 30%-failure writer, all complete
+    complete = ckpt.list_checkpoints(str(tmp_path))
+    assert complete
+    for _, path in complete:
+        ckpt.validate(path)
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "")
+    faults.reset()
+    kv._send_stop()
