@@ -1,0 +1,40 @@
+"""Static invariant lint engine (docs/static_analysis.md).
+
+The repo's load-bearing guarantees — zero per-batch host syncs on the
+steady-state training/serving loops, trace-purity of everything that
+enters a jitted program, and thread safety across the background
+machinery — were historically enforced by runtime counter tests that
+cover only the loops they instrument.  This package proves the same
+invariants *statically*, over the whole ``mxnet_tpu`` source tree, on
+every PR, the way compiler-framework stacks gate IR rewrites with
+structural validity checks (TVM, arXiv:1802.04799; Relay,
+arXiv:1810.00952) instead of sampled execution.
+
+Everything here is stdlib-only (``ast`` + ``tokenize``) so the suite
+runs without importing jax or the package under analysis —
+``tools/lint.py`` loads it standalone for pre-commit use.
+
+Rule families (see each module for the model and its approximations):
+
+- ``host_sync``    — escape analysis: no device→host sync primitive
+                     reachable from a declared steady-state entry point.
+- ``trace_purity`` — functions that get traced must not touch host
+                     state (telemetry, time, np.random, captured-state
+                     mutation, host branching on traced values).
+- ``locks``        — lock-acquisition-order cycles (deadlock
+                     candidates) and attributes written from multiple
+                     thread domains with no common lock (race
+                     candidates).
+- ``env_docs``     — every MXTPU_*/BENCH_* knob read in source is
+                     documented in docs/how_to/env_var.md and vice
+                     versa.
+
+Violations are suppressed only by an inline annotation with a reason
+(``# sync-ok: <why>``, ``# trace-ok: <why>``, ``# lock-ok: <why>``,
+``# race-ok: <why>``) or an allowlist entry (tools/lint_allowlist.json)
+— a bare annotation with no reason is itself a violation.
+"""
+from .report import Finding, render_text, render_json          # noqa: F401
+from .astutil import PackageIndex, load_package                # noqa: F401
+from .callgraph import CallGraph                               # noqa: F401
+from .engine import run_all, RULES, repo_root                  # noqa: F401

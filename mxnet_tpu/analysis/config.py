@@ -1,0 +1,132 @@
+"""Analyzer configuration: the declared invariants.
+
+This file is the single place where the package names its steady-state
+entry points, its sanctioned sync boundaries, and the primitive sets
+each rule family matches on.  Growing the system (a new trainer loop,
+a new background thread) means growing THIS file — the lint then
+proves the new surface obeys the same invariants.
+"""
+
+# --------------------------------------------------------------- host-sync
+# Steady-state entry points: code reachable from these must never block
+# on the device.  These are the per-batch/per-tick hot loops the
+# zero-host-sync counter tests (test_async_pipeline / test_parallel /
+# test_amp / test_checkpoint) sample dynamically.
+ENTRY_POINTS = (
+    "mxnet_tpu.module.base_module.BaseModule._fit_epochs",
+    "mxnet_tpu.trainer.FusedTrainer.step",
+    "mxnet_tpu.trainer.FusedTrainer.step_multi",
+    "mxnet_tpu.serving.scheduler.SlotScheduler._tick",
+    "mxnet_tpu.kvstore_fused.FusedUpdateEngine.handle_push",
+    "mxnet_tpu.kvstore_fused.FusedUpdateEngine.handle_pull",
+    "mxnet_tpu.checkpoint.snapshot",
+    "mxnet_tpu.checkpoint.CheckpointManager.save",
+)
+
+# Sanctioned sync boundaries: the analyzer does not descend into these.
+# Each entry is qualname -> why syncing behind it is the design, not a
+# leak.  A boundary is NOT a free pass for its callers — the call site
+# itself stays on the hot path; only the callee's interior is excused.
+BOUNDARIES = {
+    "mxnet_tpu.engine.AsyncWindow.drain":
+        "the explicit epoch/checkpoint-boundary drain — THE sanctioned "
+        "sync point of the bounded-window design",
+    "mxnet_tpu.engine.AsyncWindow._wait_one":
+        "window-full backpressure: blocking when MXTPU_ASYNC_DEPTH is "
+        "exceeded is the bounded-depth contract",
+    "mxnet_tpu.telemetry.health.sentinel_check":
+        "sentinel reporting boundary: syncs parked device futures only "
+        "at drain/window-overflow sites by contract (PR 5)",
+    "mxnet_tpu.checkpoint.CheckpointWrite.__init__":
+        "background writer thread: device->host fetch + file IO run "
+        "off-loop; capture only dispatches jnp.copy",
+    "mxnet_tpu.monitor.Monitor.toc_print":
+        "opt-in debugging Monitor: interval-gated stat rendering syncs "
+        "by contract (PR-5 keeps the per-batch tic() sync-free; "
+        "production loops install no monitor)",
+}
+
+# Device->host sync primitives, matched as method names on any receiver.
+SYNC_METHODS = frozenset({
+    "asnumpy", "wait_to_read", "item", "tolist", "block_until_ready",
+})
+# …and as resolved/dotted calls (module functions).
+SYNC_CALLS = frozenset({
+    "jax.device_get", "device_get",
+})
+# numpy module aliases whose asarray/array on an NDArray-typed argument
+# is a hidden host sync (goes through NDArray.__array__ -> asnumpy).
+NUMPY_MODULES = frozenset({"numpy"})
+NUMPY_SYNC_FUNCS = frozenset({"asarray", "array", "ascontiguousarray"})
+# builtins that trigger NDArray.__float__/__int__/__bool__ host syncs
+# when applied to an NDArray-typed argument.
+BUILTIN_CASTS = frozenset({"float", "int", "bool"})
+# NDArray-ish class names for the cheap local type inference.
+NDARRAY_CLASSES = frozenset({"NDArray", "RowSparseNDArray"})
+
+# ------------------------------------------------------------ trace-purity
+# Extra trace roots beyond what static jit/pallas/scan detection finds:
+# whole modules whose functions are traced by construction.
+TRACED_MODULES = (
+    "mxnet_tpu.optim_rules",      # fused/flat/sparse optimizer kernels
+)
+# Decorators that mark a function as an op implementation — op bodies
+# are traced by the executor's graph_fn.
+OP_REGISTER_DECORATORS = frozenset({
+    "register()", "registry.register()", "ops.register()",
+})
+# jax entry points whose function argument becomes traced code.
+TRACING_CALLS = frozenset({
+    "jit", "pallas_call", "scan", "vmap", "pmap", "custom_vjp",
+    "custom_jvp", "checkpoint", "remat", "shard_map", "while_loop",
+    "fori_loop", "cond", "switch", "defvjp", "defjvp",
+})
+# Module prefixes that must not be called from traced code (host-impure).
+TRACE_BANNED_MODULE_PREFIXES = (
+    ("time", "host clock read inside a traced function"),
+    ("numpy.random", "host RNG inside a traced function (use the ctx key)"),
+    ("random", "host RNG inside a traced function (use the ctx key)"),
+    ("mxnet_tpu.telemetry", "telemetry from traced code runs at trace "
+                            "time only and vanishes from the compiled "
+                            "program — record at the dispatch site"),
+)
+# Telemetry instrument method names (module-global Counter/Gauge/
+# Histogram objects created from the telemetry registry).
+TELEMETRY_INSTRUMENT_METHODS = frozenset({"inc", "observe", "set", "dec"})
+# Parameter names that are NOT traced arrays in op-impl signatures.
+UNTRACED_PARAM_NAMES = frozenset({
+    "self", "cls", "ctx", "attrs", "key", "is_train", "platform",
+    "mesh", "sharding", "axis", "name",
+})
+
+# ------------------------------------------------------------------- locks
+# Thread-entry markers: functions handed to these run on another thread.
+THREAD_TARGET_CALLS = frozenset({
+    "Thread", "threading.Thread", "Timer", "threading.Timer",
+})
+THREAD_REGISTER_CALLS = frozenset({
+    "signal.signal", "atexit.register", "weakref.finalize",
+})
+# Method names that are thread entries by framework contract.
+THREAD_ENTRY_METHOD_NAMES = frozenset({
+    "do_GET", "do_POST", "do_PUT", "do_DELETE", "handle", "handle_error",
+    "service_actions", "run",
+})
+# Lock-ish constructors (Condition aliases the lock it wraps).
+LOCK_CONSTRUCTORS = frozenset({
+    "Lock", "RLock", "Condition", "threading.Lock", "threading.RLock",
+    "threading.Condition",
+})
+
+# --------------------------------------------------------------- env-docs
+ENV_VAR_PATTERN = r"\b((?:MXTPU|BENCH)_[A-Z0-9_]+)\b"
+ENV_DOC = "docs/how_to/env_var.md"
+# Extra scan surface beyond mxnet_tpu/ (repo-relative).
+ENV_EXTRA_FILES = ("bench.py",)
+ENV_EXTRA_DIRS = ("tools",)
+# Documented knobs that are read outside the scanned surface (tests/,
+# pytest.ini, examples) — documented-but-not-in-source is fine for these.
+ENV_DOC_ONLY_OK = frozenset({
+    "MXTPU_TPU_TESTS",      # read by tests/test_tpu_consistency.py gate
+    "MXTPU_LC_PLATFORM",    # read by examples/transformer-lm/train_long_context.py
+})

@@ -1,0 +1,76 @@
+"""Env-knob drift lint — the static analog of the telemetry docs-drift
+test.  Every ``MXTPU_*``/``BENCH_*`` environment variable appearing in
+``mxnet_tpu/``, ``bench.py``, or ``tools/`` must be documented in
+``docs/how_to/env_var.md``, and every knob the doc catalogs must still
+exist in the scanned surface (modulo config.ENV_DOC_ONLY_OK, for knobs
+read by tests/examples outside the scan).  Plain text scan on both
+sides — a knob mentioned only in a comment still names a real contract
+and must be documented or renamed."""
+import os
+import re
+
+from . import config
+from .report import Finding
+
+
+def _scan_file(path):
+    """-> {var: first lineno} for env-pattern hits in one file."""
+    out = {}
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for ln, line in enumerate(fh, 1):
+                for m in re.finditer(config.ENV_VAR_PATTERN, line):
+                    out.setdefault(m.group(1), ln)
+    except OSError:
+        pass
+    return out
+
+
+def scan_source(root):
+    """-> {var: (relpath, lineno)} over the configured source surface."""
+    hits = {}
+
+    def take(path):
+        rel = os.path.relpath(path, root)
+        for var, ln in _scan_file(path).items():
+            hits.setdefault(var, (rel, ln))
+
+    pkg = os.path.join(root, "mxnet_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in ("__pycache__", "analysis")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                take(os.path.join(dirpath, fn))
+    for rel in config.ENV_EXTRA_FILES:
+        take(os.path.join(root, rel))
+    for d in config.ENV_EXTRA_DIRS:
+        dpath = os.path.join(root, d)
+        if not os.path.isdir(dpath):
+            continue
+        for fn in sorted(os.listdir(dpath)):
+            if fn.endswith((".py", ".sh")):
+                take(os.path.join(dpath, fn))
+    return hits
+
+
+def run(index, graph):
+    root = index.root
+    src = scan_source(root)
+    doc_path = os.path.join(root, config.ENV_DOC)
+    doc = _scan_file(doc_path)
+    findings = []
+    for var in sorted(set(src) - set(doc)):
+        rel, ln = src[var]
+        findings.append(Finding(
+            rule="env-docs", path=rel, line=ln, symbol=var,
+            detail="undocumented",
+            message=f"{var} is read in source but missing from "
+                    f"{config.ENV_DOC}"))
+    for var in sorted(set(doc) - set(src) - config.ENV_DOC_ONLY_OK):
+        findings.append(Finding(
+            rule="env-docs", path=config.ENV_DOC, line=doc[var],
+            symbol=var, detail="stale-doc",
+            message=f"{var} is documented but no longer read anywhere "
+                    "in mxnet_tpu/, bench.py, or tools/"))
+    return findings

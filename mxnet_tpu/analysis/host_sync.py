@@ -1,0 +1,171 @@
+"""Rule family 1 — host-sync escape analysis.
+
+The zero-per-batch-host-sync property (PRs 4/5/7/10/11) says: from a
+steady-state entry point (``Module.fit`` step body, the fused trainer
+step, a serving tick, a fused KV push/pull, a checkpoint capture), no
+device→host synchronization primitive may execute.  The runtime
+counter tests sample this per loop; this rule proves it over the whole
+call graph:
+
+    flag every sync primitive lexically inside any function reachable
+    from an entry point, unless the site carries ``# sync-ok: <why>``
+    or the traversal was stopped by a registered boundary function.
+
+Primitives: ``.asnumpy() / .wait_to_read() / .item() / .tolist() /
+.block_until_ready()`` on anything, ``jax.device_get``, and — through
+a branch-sensitive local type walk — ``np.asarray``-family calls and
+``float()/int()/bool()`` casts applied to values known to be NDArray
+(those dispatch to ``NDArray.__array__``/``__float__`` = ``asnumpy``).
+"""
+import ast
+
+from . import config
+from .astutil import dotted
+from .callgraph import iter_body_calls
+from .report import Finding
+
+
+def _narrowed_ndarrayish(fn_node):
+    """-> {ast.Call id: set of ndarray-ish names in scope at that call}.
+
+    Branch-sensitive: ``isinstance(x, NDArray)`` narrows x inside the
+    if-body only (and un-narrows it in the else); ``x = NDArray(...)``
+    narrows x for the rest of the block.  Cheap and local by design —
+    it exists to catch the `np.asarray(nd)` / `float(nd)` shape of
+    sync, not to type the package.
+    """
+    out = {}
+
+    def isinstance_target(test):
+        if (isinstance(test, ast.Call) and isinstance(test.func, ast.Name)
+                and test.func.id == "isinstance"
+                and len(test.args) == 2
+                and isinstance(test.args[0], ast.Name)):
+            classes = test.args[1]
+            names = ([dotted(classes)] if not isinstance(classes, ast.Tuple)
+                     else [dotted(e) for e in classes.elts])
+            if any(n.rsplit(".", 1)[-1] in config.NDARRAY_CLASSES
+                   for n in names if n):
+                return test.args[0].id
+        return None
+
+    def mark(node, env):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                out.setdefault(id(sub), set()).update(env)
+
+    def visit_block(stmts, env):
+        env = set(env)
+        for st in stmts:
+            if isinstance(st, ast.If):
+                tgt = isinstance_target(st.test)
+                mark(st.test, env)
+                visit_block(st.body, env | {tgt} if tgt else env)
+                visit_block(st.orelse, env - {tgt} if tgt else env)
+                continue
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                    isinstance(st.targets[0], ast.Name):
+                name = st.targets[0].id
+                mark(st.value, env)
+                ctor = ""
+                if isinstance(st.value, ast.Call):
+                    ctor = dotted(st.value.func).rsplit(".", 1)[-1]
+                if ctor in config.NDARRAY_CLASSES:
+                    env.add(name)
+                else:
+                    env.discard(name)
+                continue
+            if isinstance(st, (ast.For, ast.While, ast.With, ast.Try)):
+                mark(getattr(st, "test", None) or getattr(st, "iter", None)
+                     or st, env)
+                for attr in ("body", "orelse", "finalbody"):
+                    visit_block(getattr(st, attr, []) or [], env)
+                for h in getattr(st, "handlers", []) or []:
+                    visit_block(h.body, env)
+                continue
+            mark(st, env)
+        return env
+
+    visit_block(fn_node.body, set())
+    return out
+
+
+def _numpy_recv(recv, mi):
+    head = recv.split(".")[0] if recv else ""
+    target = mi.imports.get(head, "")
+    return target.split(".")[0] in config.NUMPY_MODULES
+
+
+def _arg_name(call):
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+def sync_sites(index, fi):
+    """All sync-primitive call sites lexically in one function:
+    yields (lineno, primitive, description)."""
+    mi = index.modules[fi.module]
+    ndarrayish = None
+    for call in iter_body_calls(fi.node):
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            recv = dotted(func.value)
+            if name in config.SYNC_METHODS:
+                yield (call.lineno, name,
+                       f".{name}() on {recv or 'expression'}")
+                continue
+            head = recv.split(".")[0] if recv else ""
+            resolved = mi.imports.get(head, head)
+            if (f"{resolved}.{name}" in config.SYNC_CALLS
+                    or name in ("device_get",)):
+                yield (call.lineno, name, f"{recv}.{name}() blocks for "
+                       "the device value")
+                continue
+            if (_numpy_recv(recv, mi) and
+                    name in config.NUMPY_SYNC_FUNCS):
+                if ndarrayish is None:
+                    ndarrayish = _narrowed_ndarrayish(fi.node)
+                arg = _arg_name(call)
+                if arg and arg in ndarrayish.get(id(call), ()):
+                    yield (call.lineno, f"np.{name}",
+                           f"np.{name}({arg}) on an NDArray goes through "
+                           "__array__ -> asnumpy")
+        elif isinstance(func, ast.Name) and func.id in config.BUILTIN_CASTS:
+            if ndarrayish is None:
+                ndarrayish = _narrowed_ndarrayish(fi.node)
+            arg = _arg_name(call)
+            if arg and arg in ndarrayish.get(id(call), ()):
+                yield (call.lineno, func.id,
+                       f"{func.id}({arg}) on an NDArray triggers "
+                       f"__{func.id}__ -> host sync")
+
+
+def run(index, graph):
+    boundaries = frozenset(config.BOUNDARIES)
+    witness = graph.reachable(config.ENTRY_POINTS, boundaries=boundaries)
+    findings = []
+    missing = [e for e in config.ENTRY_POINTS
+               if e not in index.functions]
+    for e in missing:
+        findings.append(Finding(
+            rule="host-sync", path="", line=0, symbol=e,
+            detail="missing-entry",
+            message=f"declared steady-state entry point {e} does not "
+                    "exist — update analysis/config.py"))
+    for qn in sorted(witness):
+        if qn in boundaries:
+            continue  # interior excused by registration
+        fi = index.functions[qn]
+        for lineno, prim, desc in sync_sites(index, fi):
+            findings.append(Finding(
+                rule="host-sync", path=fi.relpath, line=lineno,
+                symbol=qn, detail=prim,
+                message=f"host sync on a steady-state path: {desc} "
+                        f"(in {qn})",
+                chain=graph.chain(witness, qn)))
+    return findings

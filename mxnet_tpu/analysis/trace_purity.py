@@ -1,0 +1,358 @@
+"""Rule family 2 — trace-purity lint.
+
+Anything that executes under ``jax.jit`` / ``pallas_call`` / ``lax``
+control-flow tracing runs ONCE at trace time; host side effects there
+(telemetry, clocks, host RNG, mutating captured state) silently bake
+one stale value into the compiled program or vanish on cache hits, and
+Python-side branching on traced values either concretizes (hidden
+sync) or crashes on abstract tracers.  This rule finds every traced
+function statically and walks its transitive callees:
+
+trace roots
+    - any function reference passed to a jax tracing entry point
+      (``jit``, ``pallas_call``, ``scan``, ``while_loop``, ``cond``,
+      ``custom_vjp``/``defvjp``, ``shard_map``, …) or decorated with
+      one;
+    - every op implementation registered via ``@register(...)`` in
+      ``mxnet_tpu/ops/`` (the executor's graph_fn traces those);
+    - every function of the modules in config.TRACED_MODULES
+      (``optim_rules`` — the bucket/sentinel/loss-scale kernels).
+
+checks, per reached function
+    - calls into banned host modules (``time``, ``numpy.random``,
+      ``random``, ``mxnet_tpu.telemetry``) and ``print``;
+    - calls on module-global telemetry instruments (``_TM_X.inc``);
+    - host-sync primitives (shared with the host-sync rule);
+    - mutation of captured state (``self.attr = …``, ``global``
+      writes, subscript/attr stores into closed-over names);
+    - Python branching on a traced parameter (bare-name truthiness or
+      comparison in ``if``/``while`` — ``x.shape``/``x.ndim`` stay
+      static on tracers and are not flagged), checked on root
+      functions where parameterhood is known.
+
+Every violation names the trace root that reaches it.
+"""
+import ast
+
+from . import config
+from .astutil import dotted
+from .callgraph import iter_body_calls, iter_body_nodes
+from .host_sync import sync_sites
+from .report import Finding
+
+# Boundaries for the purity walk: trace-time helpers that are allowed
+# host behavior by contract (filled as triage demands, like
+# config.BOUNDARIES for host-sync).
+TRACE_BOUNDARIES = {}
+
+
+def _resolve_fn_ref(index, graph, fi, node):
+    """Resolve an expression used as a function *reference* (not call)
+    to a qualname, mirroring the call-graph's name resolution.  ``fi``
+    may be a module-level shim (qualname == module, no class)."""
+    if isinstance(node, ast.Name):
+        name = node.id
+        nested = f"{fi.qualname}.<locals>.{name}"
+        if nested in index.functions:
+            return nested
+        if fi.parent:
+            sibling = f"{fi.parent}.<locals>.{name}"
+            if sibling in index.functions:
+                return sibling
+        flat = f"{fi.module}.{name}"
+        if flat in index.functions:
+            return flat
+        target = index.modules[fi.module].imports.get(name)
+        if target in index.functions:
+            return target
+    elif isinstance(node, ast.Attribute):
+        recv = dotted(node.value)
+        if recv == "self" and fi.cls:
+            return index.mro_method(fi.cls, node.attr)
+        if recv and isinstance(node.value, ast.Name) and \
+                hasattr(fi.node, "body"):
+            # local object: v = ClassName(...); jit(v.method)
+            mi = index.modules[fi.module]
+            for sub in iter_body_nodes(fi.node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name) \
+                        and sub.targets[0].id == recv \
+                        and isinstance(sub.value, ast.Call):
+                    cls = index.resolve_class(dotted(sub.value.func), mi)
+                    if cls:
+                        return index.mro_method(cls, node.attr)
+    return None
+
+
+class _ModuleShim:
+    """FunctionInfo stand-in for module-level statements so the fn-ref
+    resolver works on top-level ``f = jax.jit(g)`` assignments."""
+
+    def __init__(self, mi):
+        self.qualname = mi.name
+        self.module = mi.name
+        self.cls = ""
+        self.parent = ""
+        self.relpath = mi.relpath
+
+        class _NoBody:
+            pass
+
+        self.node = _NoBody()   # no .body: local-var scan is skipped
+
+
+def _is_jax_recv(mi, recv):
+    head = recv.split(".")[0] if recv else ""
+    target = mi.imports.get(head, head)
+    return target.split(".")[0] in ("jax", "pl", "pallas", "lax") or \
+        target.startswith("jax.")
+
+
+def find_trace_roots(index, graph):
+    """-> {qualname: how} for every statically-traced function."""
+    roots = {}
+    # whole traced modules (optimizer kernels)
+    for qn, fi in index.functions.items():
+        if fi.module in config.TRACED_MODULES:
+            roots.setdefault(qn, f"function in traced module {fi.module}")
+    # op implementations
+    for qn, fi in index.functions.items():
+        if not fi.module.startswith("mxnet_tpu.ops"):
+            continue
+        for dec in fi.decorators:
+            if dec in config.OP_REGISTER_DECORATORS or \
+                    dec.endswith(".register()"):
+                roots.setdefault(qn, "op implementation (@register)")
+    # jit/pallas/lax-control-flow decorators and call arguments
+    for qn, fi in index.functions.items():
+        mi = index.modules[fi.module]
+        for dec in fi.decorators:
+            base = dec.rsplit(".", 1)[-1].rstrip("()")
+            if base in config.TRACING_CALLS and ("jax" in dec or "jit" in dec
+                                                 or "pallas" in dec):
+                roots.setdefault(qn, f"decorated @{dec.rstrip('()')}")
+        for call in iter_body_calls(fi.node):
+            _scan_tracing_call(index, graph, fi, mi, call, roots)
+    # module-level `f = jax.jit(g)` assignments
+    for mi in index.modules.values():
+        shim = _ModuleShim(mi)
+        for node in ast.iter_child_nodes(mi.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    _scan_tracing_call(index, graph, shim, mi, sub, roots)
+    return roots
+
+
+def _scan_tracing_call(index, graph, fi, mi, call, roots):
+    func = call.func
+    if not isinstance(func, (ast.Attribute, ast.Name)):
+        return
+    name = func.attr if isinstance(func, ast.Attribute) else func.id
+    if name not in config.TRACING_CALLS:
+        return
+    if isinstance(func, ast.Attribute):
+        recv = dotted(func.value)
+        # defvjp hangs off a custom_vjp object, any receiver ok
+        if name not in ("defvjp", "defjvp") and not _is_jax_recv(mi, recv):
+            return
+    else:
+        target = mi.imports.get(name, "")
+        if not target.startswith("jax"):
+            return
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        # look through one wrapper call: jit(wrap(f)), partial(f, …)
+        cands = [arg]
+        if isinstance(arg, ast.Call):
+            cands = list(arg.args) + [kw.value for kw in arg.keywords]
+        for cand in cands:
+            ref = _resolve_fn_ref(index, graph, fi, cand)
+            if ref:
+                roots.setdefault(
+                    ref, f"passed to {name} at {fi.relpath}:{call.lineno}")
+
+
+def _module_instruments(index):
+    """Per module: names of module-global telemetry instrument objects
+    (assigned from a call into mxnet_tpu.telemetry*)."""
+    out = {}
+    for modname, mi in index.modules.items():
+        names = set()
+        for node in ast.iter_child_nodes(mi.tree):
+            if not (isinstance(node, ast.Assign) and
+                    isinstance(node.value, ast.Call)):
+                continue
+            text = dotted(node.value.func)
+            head = text.split(".")[0] if text else ""
+            target = mi.imports.get(head, head)
+            full = text.replace(head, target, 1) if text else ""
+            if target.startswith("mxnet_tpu.telemetry") or \
+                    full.startswith("mxnet_tpu.telemetry"):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+        out[modname] = names
+    return out
+
+
+def _local_names(fn_node):
+    """Names bound in the function scope (params, assignments, loop and
+    with targets, nested defs, imports)."""
+    names = set()
+    args = fn_node.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs +
+              ([args.vararg] if args.vararg else []) +
+              ([args.kwarg] if args.kwarg else [])):
+        names.add(a.arg)
+    for node in iter_body_nodes(fn_node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+    for child in ast.iter_child_nodes(fn_node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(child.name)
+    return names
+
+
+def _traced_params(fi):
+    args = fi.node.args
+    pos = [a.arg for a in (args.posonlyargs + args.args)]
+    return {p for p in pos if p not in config.UNTRACED_PARAM_NAMES
+            and not p.startswith("_")
+            # static selector/config params by naming convention
+            and not p.endswith(("_name", "_names", "_params", "_attrs"))}
+
+
+def purity_violations(index, fi, instruments, is_root):
+    mi = index.modules[fi.module]
+    # --- banned calls
+    for call in iter_body_calls(fi.node):
+        func = call.func
+        if isinstance(func, ast.Name) and func.id == "print":
+            yield (call.lineno, "print", "print() inside traced code runs "
+                   "at trace time only")
+            continue
+        if not isinstance(func, ast.Attribute):
+            continue
+        recv = dotted(func.value)
+        if not recv:
+            continue
+        head = recv.split(".")[0]
+        target = mi.imports.get(head, head)
+        resolved = recv.replace(head, target, 1)
+        full = f"{resolved}.{func.attr}"
+        for prefix, why in config.TRACE_BANNED_MODULE_PREFIXES:
+            if resolved == prefix or resolved.startswith(prefix + ".") or \
+                    full == prefix:
+                yield (call.lineno, prefix, f"{recv}.{func.attr}(): {why}")
+                break
+        else:
+            if (recv in instruments.get(fi.module, ()) and
+                    func.attr in config.TELEMETRY_INSTRUMENT_METHODS):
+                yield (call.lineno, "telemetry-instrument",
+                       f"{recv}.{func.attr}() telemetry write from traced "
+                       "code — move to the dispatch site")
+    # --- host syncs inside trace
+    for lineno, prim, desc in sync_sites(index, fi):
+        yield (lineno, f"sync:{prim}",
+               f"{desc} — forces concretization inside a traced function")
+    # --- captured-state mutation (constructors exempt: __init__ writes
+    # populate a brand-new object, they don't mutate captured state)
+    if fi.name in ("__init__", "__new__", "__post_init__"):
+        return
+    local = None
+    for node in iter_body_nodes(fi.node):
+        if isinstance(node, ast.Global):
+            yield (node.lineno, "captured-mutation",
+                   f"global statement mutates module state from traced "
+                   f"code ({', '.join(node.names)})")
+        tgt_list = []
+        if isinstance(node, ast.Assign):
+            tgt_list = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            tgt_list = [node.target]
+        for tgt in tgt_list:
+            if isinstance(tgt, ast.Attribute):
+                base = dotted(tgt.value).split(".")[0]
+                if base == "self":
+                    yield (tgt.lineno, "captured-mutation",
+                           f"self.{tgt.attr} = … mutates captured object "
+                           "state inside traced code")
+                    continue
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                base = dotted(tgt.value).split(".")[0] if \
+                    dotted(tgt.value) else (
+                        tgt.value.id if isinstance(tgt.value, ast.Name)
+                        else "")
+                if not base:
+                    continue
+                if local is None:
+                    local = _local_names(fi.node)
+                if base not in local and base != "self":
+                    yield (tgt.lineno, "captured-mutation",
+                           f"store into captured/global '{base}' inside "
+                           "traced code")
+    # --- host branching on traced params (roots only: parameterhood known)
+    if is_root:
+        params = _traced_params(fi)
+        for node in iter_body_nodes(fi.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            bad = _branch_on_param(node.test, params)
+            if bad:
+                yield (node.lineno, "traced-branch",
+                       f"Python branch on traced value '{bad}' — use "
+                       "jnp.where/lax.cond (static facts like .shape "
+                       "are fine and not flagged)")
+
+
+def _branch_on_param(test, params):
+    if isinstance(test, ast.Name) and test.id in params:
+        return test.id
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _branch_on_param(test.operand, params)
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            bad = _branch_on_param(v, params)
+            if bad:
+                return bad
+    if isinstance(test, ast.Compare):
+        ops = test.ops
+        if any(isinstance(o, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for o in ops):
+            return None
+        for side in [test.left] + list(test.comparators):
+            if isinstance(side, ast.Name) and side.id in params:
+                return side.id
+    return None
+
+
+def run(index, graph):
+    roots = find_trace_roots(index, graph)
+    instruments = _module_instruments(index)
+    witness = graph.reachable(sorted(roots), boundaries=frozenset(
+        TRACE_BOUNDARIES))
+    findings = []
+    for qn in sorted(witness):
+        if qn in TRACE_BOUNDARIES:
+            continue
+        fi = index.functions[qn]
+        # find the root whose witness chain reaches qn
+        cur, root = qn, qn
+        while witness.get(cur, (None, None))[0] is not None:
+            cur = witness[cur][0]
+        root = cur
+        how = roots.get(root, "")
+        for lineno, kind, desc in purity_violations(
+                index, fi, instruments, is_root=qn in roots):
+            findings.append(Finding(
+                rule="trace-purity", path=fi.relpath, line=lineno,
+                symbol=qn, detail=kind,
+                message=f"impure traced code: {desc} "
+                        f"[trace root: {root} — {how or 'transitive'}]",
+                chain=graph.chain(witness, qn)))
+    return findings

@@ -223,6 +223,8 @@ def conv_precision(*arrays):
         return jax.lax.Precision.HIGHEST
     if pref in ("high", "bfloat16_3x", "tensorfloat32"):
         return jax.lax.Precision.HIGH
+    # trace-ok: warn-once latch flips at trace time on purpose; the
+    # compiled program is unaffected and retraces stay silent
     global _conv_precision_warned
     if not _conv_precision_warned and any(
             str(getattr(a, "dtype", "")) == "float32" for a in arrays):

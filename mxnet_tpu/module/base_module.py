@@ -358,6 +358,9 @@ class BaseModule:
         key = np.asarray(_random.current_key())
         meta = {"module": type(self).__name__, "step": int(step),
                 "epoch": int(epoch), "nbatch": int(nbatch),
+                # sync-ok: checkpoint cadence only (mgr.due/preempt), never
+                # per-batch; the tiny RNG key was fetched by np.asarray
+                # above and must serialize into the manifest
                 "rng_key": key.tolist(), "rng_dtype": str(key.dtype)}
         sig = getattr(self._symbol, "structural_signature", None)
         if callable(sig):

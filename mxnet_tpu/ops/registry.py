@@ -57,6 +57,8 @@ class OpCtx:
     def rng(self):
         if self._key is None:
             raise MXNetError("op requires a PRNG key but none was supplied")
+        # trace-ok: OpCtx lives for one trace; the key-split counter is
+        # trace-time bookkeeping that gives each rng() call a distinct fold
         self._nsplit += 1
         return jax.random.fold_in(self._key, self._nsplit)
 

@@ -115,6 +115,8 @@ def _epilogue(x, s, scale, bias, channel_axis, use_pallas, interpret):
 
 
 def _epilogue_fwd(x, s, scale, bias, channel_axis, use_pallas, interpret):
+    # trace-ok: use_pallas/channel_axis/interpret are custom_vjp
+    # nondiff_argnums — static Python values at trace time, never tracers
     if use_pallas and channel_axis == x.ndim - 1:
         c = x.shape[-1]
         rows = int(np.prod(x.shape[:-1]))

@@ -317,6 +317,9 @@ class SlotScheduler:
         for req in self.slots:
             if req is not None:
                 self._terminal(req, "shutdown")
+        # race-ok: reached only after _thread.join() proved the engine
+        # thread dead (is_alive() returns above otherwise) — the join is
+        # the happens-before edge static analysis can't see
         self.slots = [None] * self.num_slots
         _TM_OCCUPANCY.set(0)
 

@@ -1,0 +1,44 @@
+"""Host-sync fixture: known-bad and known-good sites for the
+host-sync escape analysis (tests/test_lint.py pins which lines each
+rule catches).  Never imported — parsed by the analyzer only."""
+import numpy as np
+
+from .nd import NDArray
+
+
+def step(batch):
+    """Declared steady-state entry point (test monkeypatches config)."""
+    out = compute(batch)
+    bad_direct = out.asnumpy()                      # KNOWN-BAD: direct sync
+    helper(out)
+    boundary_report(out)
+    ok = out.asnumpy()  # sync-ok: fixture's sanctioned epoch-boundary read
+    return bad_direct, ok
+
+
+def compute(batch):
+    return batch
+
+
+def helper(out):
+    """Reached from step() through one call edge."""
+    out.wait_to_read()                              # KNOWN-BAD: chained sync
+    v = NDArray(out)
+    host = np.asarray(v)                            # KNOWN-BAD: __array__ sync
+    scalar = float(v)                               # KNOWN-BAD: __float__ sync
+    if isinstance(out, NDArray):
+        also = np.asarray(out)                      # KNOWN-BAD: narrowed
+    else:
+        fine = np.asarray(out)                      # KNOWN-GOOD: not NDArray
+    plain = np.asarray([1.0, 2.0])                  # KNOWN-GOOD: host list
+    return host, scalar
+
+
+def boundary_report(out):
+    """Registered boundary in the test — interior syncs are excused."""
+    return out.asnumpy()
+
+
+def cold_path(out):
+    """KNOWN-GOOD: not reachable from step() — syncing is fine here."""
+    return out.asnumpy()

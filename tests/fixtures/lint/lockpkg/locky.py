@@ -1,0 +1,108 @@
+"""Lock-order / shared-state fixture: an AB-BA deadlock pair, a plain
+Lock self-deadlock, a Condition alias, a genuine cross-thread race, a
+lock-disciplined twin, and a join-ordered annotated case.  Parsed
+only, never run."""
+import threading
+
+
+class Deadlocky:
+    """KNOWN-BAD: transfer_ab holds a then takes b; transfer_ba holds b
+    then takes a — classic order cycle."""
+
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+        self.balance = 0
+
+    def transfer_ab(self, n):
+        with self.lock_a:
+            with self.lock_b:
+                self.balance += n
+
+    def transfer_ba(self, n):
+        with self.lock_b:
+            with self.lock_a:
+                self.balance -= n
+
+
+class SelfDeadlocky:
+    """KNOWN-BAD: re-acquires a plain (non-reentrant) Lock it holds —
+    transitively, through a helper call."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.n = 0
+
+    def outer(self):
+        with self.lock:
+            self.inner()
+
+    def inner(self):
+        with self.lock:
+            self.n += 1
+
+
+class CondAliased:
+    """KNOWN-GOOD: the Condition wraps the same lock — nesting them is
+    one identity, not an order edge."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.items = []
+
+    def put(self, x):
+        with self.cond:
+            self.items.append(x)
+            self.cond.notify()
+
+
+class Racy:
+    """KNOWN-BAD: the worker thread and the public API both write
+    self.total; the worker takes no lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        self.total = self.total + 1
+
+    def deposit(self, n):
+        with self._lock:
+            self.total += n
+
+
+class Disciplined:
+    """KNOWN-GOOD: same shape as Racy but every write holds the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        with self._lock:
+            self.total = self.total + 1
+
+    def deposit(self, n):
+        with self._lock:
+            self.total += n
+
+
+class JoinOrdered:
+    """KNOWN-GOOD (annotated): the main-thread write happens only after
+    join(), which static analysis can't order."""
+
+    def __init__(self):
+        self.state = []
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        self.state = self.state + [1]
+
+    def shutdown(self):
+        self._thread.join()
+        # race-ok: join() above is the happens-before edge
+        self.state = []

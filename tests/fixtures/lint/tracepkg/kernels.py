@@ -1,0 +1,55 @@
+"""Trace-purity fixture: a jitted kernel with every banned behavior,
+and a clean one.  Parsed only, never imported (the telemetry import is
+resolved lexically by the analyzer's import map)."""
+import time
+
+import jax
+import numpy as np
+
+from mxnet_tpu import telemetry as _tm
+
+_TM_STEPS = _tm.counter("fixture_steps_total", "doc")
+
+_CACHE = {}
+
+
+def bad_kernel(x, scale):
+    _TM_STEPS.inc()                      # KNOWN-BAD: telemetry instrument
+    t0 = time.perf_counter()             # KNOWN-BAD: host clock
+    noise = np.random.rand()             # KNOWN-BAD: host RNG
+    print("tracing", t0)                 # KNOWN-BAD: print
+    _CACHE["last"] = x                   # KNOWN-BAD: captured-state store
+    if x > 0:                            # KNOWN-BAD: branch on traced value
+        x = x * scale
+    helper_impure(x)
+    return x + noise
+
+
+def helper_impure(x):
+    _tm.enabled()                        # KNOWN-BAD: reached transitively
+    return x
+
+
+class Stateful:
+    def __init__(self):
+        self.calls = 0
+
+    def bad_method_kernel(self, x):
+        self.calls += 1                  # KNOWN-BAD: mutates captured self
+        return x * 2
+
+
+def good_kernel(x, scale):
+    if x.ndim > 1:                       # KNOWN-GOOD: static shape fact
+        x = x.reshape((-1,))
+    ann = time.time()  # trace-ok: fixture's sanctioned trace-time read
+    return x * scale + ann
+
+
+bad_jit = jax.jit(bad_kernel)
+good_jit = jax.jit(good_kernel)
+
+
+def make_stateful_jit():
+    s = Stateful()
+    return jax.jit(s.bad_method_kernel)

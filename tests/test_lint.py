@@ -1,0 +1,323 @@
+"""Invariant lint engine (ISSUE 12, docs/static_analysis.md).
+
+Two layers:
+
+1. fixture tests — known-bad/known-good snippets under
+   tests/fixtures/lint/ prove each rule family catches what it claims
+   (and stays quiet on the clean twins);
+2. the repo-wide gate — ``tools/lint.py --json`` over ``mxnet_tpu/``
+   must exit 0 with zero unsuppressed violations, so every future PR
+   is checked automatically and the zero-per-batch-host-sync /
+   trace-purity / thread-safety counter tests gain a whole-package
+   static backstop.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.analysis import (annotations, astutil, callgraph, config,
+                                engine, env_docs, host_sync, locks,
+                                trace_purity)
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "lint")
+
+
+def _fixture_run(package, rules, monkeypatch, entry=(), boundaries=None):
+    if entry:
+        monkeypatch.setattr(config, "ENTRY_POINTS", tuple(entry))
+        monkeypatch.setattr(config, "BOUNDARIES", dict(boundaries or {}))
+    index = astutil.load_package(FIXTURES, package=package)
+    graph = callgraph.CallGraph(index)
+    findings, _, _ = engine.run_all(root=FIXTURES, rules=rules,
+                                    index=index, graph=graph,
+                                    allowlist_path="")
+    return findings
+
+
+def _active(findings, rule=None):
+    return [f for f in findings if not f.suppressed
+            and (rule is None or f.rule == rule)]
+
+
+def _suppressed(findings, rule=None):
+    return [f for f in findings if f.suppressed
+            and (rule is None or f.rule == rule)]
+
+
+# ------------------------------------------------------------- host-sync
+class TestHostSyncFixtures:
+    @pytest.fixture()
+    def findings(self, monkeypatch):
+        return _fixture_run(
+            "hotpkg", ["host-sync"], monkeypatch,
+            entry=("hotpkg.hot.step",),
+            boundaries={"hotpkg.hot.boundary_report": "fixture boundary"})
+
+    def test_known_bad_sites_caught(self, findings):
+        got = {(f.detail, f.line) for f in _active(findings, "host-sync")}
+        details = {d for d, _ in got}
+        # direct sync in the entry, chained sync, np.asarray on a
+        # constructed NDArray, float() cast, isinstance-narrowed asarray
+        assert "asnumpy" in details
+        assert "wait_to_read" in details
+        assert "np.asarray" in details
+        assert "float" in details
+        narrowed = [f for f in _active(findings, "host-sync")
+                    if f.detail == "np.asarray"]
+        assert len(narrowed) == 2  # NDArray(...) ctor + isinstance branch
+
+    def test_chain_evidence_names_entry(self, findings):
+        chained = [f for f in _active(findings, "host-sync")
+                   if f.detail == "wait_to_read"]
+        assert chained and any("hotpkg.hot.step" in s
+                               for s in chained[0].chain)
+
+    def test_annotation_suppresses_with_reason(self, findings):
+        sup = _suppressed(findings, "host-sync")
+        assert any("sanctioned epoch-boundary read" in f.suppressed_by
+                   for f in sup)
+
+    def test_good_sites_quiet(self, findings):
+        # boundary interior, the unreachable cold path, non-NDArray
+        # asarray calls: none may fire
+        active_lines = {f.line for f in _active(findings, "host-sync")}
+        src = open(os.path.join(FIXTURES, "hotpkg", "hot.py")).read()
+        for marker in ("KNOWN-GOOD: not NDArray", "KNOWN-GOOD: host list"):
+            ln = next(i for i, t in enumerate(src.splitlines(), 1)
+                      if marker in t)
+            assert ln not in active_lines
+        assert not any(f.symbol.endswith("boundary_report") or
+                       f.symbol.endswith("cold_path")
+                       for f in _active(findings, "host-sync"))
+
+    def test_missing_entry_point_is_a_finding(self, monkeypatch):
+        findings = _fixture_run("hotpkg", ["host-sync"], monkeypatch,
+                                entry=("hotpkg.hot.not_a_function",))
+        assert any(f.detail == "missing-entry" for f in findings)
+
+
+# ---------------------------------------------------------- trace-purity
+class TestTracePurityFixtures:
+    @pytest.fixture()
+    def findings(self, monkeypatch):
+        return _fixture_run("tracepkg", ["trace-purity"], monkeypatch)
+
+    def test_roots_detected(self):
+        index = astutil.load_package(FIXTURES, package="tracepkg")
+        graph = callgraph.CallGraph(index)
+        roots = trace_purity.find_trace_roots(index, graph)
+        assert "tracepkg.kernels.bad_kernel" in roots       # module-level jit
+        assert "tracepkg.kernels.good_kernel" in roots
+        # method reference through a locally-constructed object
+        assert "tracepkg.kernels.Stateful.bad_method_kernel" in roots
+
+    def test_all_banned_behaviors_caught(self, findings):
+        kinds = {f.detail for f in _active(findings, "trace-purity")}
+        assert "telemetry-instrument" in kinds
+        assert "time" in kinds
+        assert "numpy.random" in kinds
+        assert "print" in kinds
+        assert "captured-mutation" in kinds
+        assert "traced-branch" in kinds
+        assert "mxnet_tpu.telemetry" in kinds   # transitive, via helper
+
+    def test_violation_names_trace_root(self, findings):
+        helper = [f for f in _active(findings, "trace-purity")
+                  if f.symbol.endswith("helper_impure")]
+        assert helper and "bad_kernel" in helper[0].message
+
+    def test_self_mutation_in_jitted_method(self, findings):
+        meth = [f for f in _active(findings, "trace-purity")
+                if f.symbol.endswith("bad_method_kernel")]
+        assert meth and meth[0].detail == "captured-mutation"
+
+    def test_good_kernel_clean_and_annotated(self, findings):
+        active = [f for f in _active(findings, "trace-purity")
+                  if f.symbol.endswith("good_kernel")]
+        assert active == []     # shape branch not flagged; time.time annotated
+        sup = [f for f in _suppressed(findings, "trace-purity")
+               if f.symbol.endswith("good_kernel")]
+        assert sup and "sanctioned trace-time read" in sup[0].suppressed_by
+
+
+# ----------------------------------------------------------------- locks
+class TestLockFixtures:
+    @pytest.fixture()
+    def findings(self, monkeypatch):
+        return _fixture_run("lockpkg", ["locks"], monkeypatch)
+
+    def test_ab_ba_cycle_detected(self, findings):
+        cycles = [f for f in _active(findings, "lock-order")
+                  if f.detail == "cycle"]
+        assert len(cycles) == 1
+        assert "lock_a" in cycles[0].message and "lock_b" in cycles[0].message
+        assert cycles[0].chain  # edge evidence present
+
+    def test_transitive_self_deadlock(self, findings):
+        self_dl = [f for f in _active(findings, "lock-order")
+                   if f.detail.startswith("self-deadlock")]
+        assert any("SelfDeadlocky" in f.message for f in self_dl)
+
+    def test_condition_alias_is_not_an_edge(self, findings):
+        assert not any("CondAliased" in (f.symbol + f.message)
+                       for f in _active(findings, "lock-order"))
+
+    def test_unlocked_cross_thread_write_is_a_race(self, findings):
+        races = _active(findings, "shared-state")
+        racy = [f for f in races if f.symbol.endswith("Racy.total")]
+        assert len(racy) == 1
+        assert "no common lock" in racy[0].message
+
+    def test_lock_discipline_is_quiet(self, findings):
+        assert not any("Disciplined" in f.symbol
+                       for f in _active(findings, "shared-state"))
+
+    def test_join_ordered_annotation_matches_either_site(self, findings):
+        jo = [f for f in findings if f.rule == "shared-state"
+              and "JoinOrdered" in f.symbol]
+        assert jo and jo[0].suppressed
+        assert "happens-before" in jo[0].suppressed_by
+
+
+# -------------------------------------------------------------- env-docs
+class TestEnvDocsFixture:
+    def test_both_drift_directions(self, tmp_path):
+        pkg = tmp_path / "mxnet_tpu"
+        pkg.mkdir()
+        (pkg / "knobs.py").write_text(
+            'import os\nA = os.environ.get("MXTPU_FIXTURE_A", "")\n')
+        doc = tmp_path / "docs" / "how_to"
+        doc.mkdir(parents=True)
+        (doc / "env_var.md").write_text("* `MXTPU_FIXTURE_B` — gone.\n")
+        index = astutil.load_package(str(tmp_path))
+        findings = env_docs.run(index, None)
+        details = {(f.symbol, f.detail) for f in findings}
+        assert ("MXTPU_FIXTURE_A", "undocumented") in details
+        assert ("MXTPU_FIXTURE_B", "stale-doc") in details
+
+    def test_repo_env_docs_green_both_ways(self):
+        findings, _, _ = engine.run_all(root=ROOT, rules=["env-docs"])
+        assert _active(findings) == [], "\n".join(
+            f.message for f in _active(findings))
+
+
+# ------------------------------------------- annotation/allowlist grammar
+class TestSuppressionGrammar:
+    def _mini_root(self, tmp_path, line):
+        pkg = tmp_path / "mxnet_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(
+            "def entry(x):\n"
+            f"    {line}\n"
+            "    return x\n")
+        return str(tmp_path)
+
+    def test_bare_annotation_is_its_own_violation(self, tmp_path,
+                                                  monkeypatch):
+        root = self._mini_root(tmp_path, "y = x.asnumpy()  # sync-ok:")
+        monkeypatch.setattr(config, "ENTRY_POINTS", ("mxnet_tpu.m.entry",))
+        monkeypatch.setattr(config, "BOUNDARIES", {})
+        findings, _, _ = engine.run_all(root=root, rules=["host-sync"],
+                                        allowlist_path="")
+        assert _active(findings, "host-sync")      # NOT suppressed
+        assert any(f.detail == "bare-sync-ok"
+                   for f in _active(findings, "annotation"))
+
+    def test_stale_annotation_reported_on_full_run(self, tmp_path,
+                                                   monkeypatch):
+        root = self._mini_root(tmp_path,
+                               "y = x + 1  # trace-ok: nothing here")
+        monkeypatch.setattr(config, "ENTRY_POINTS", ())
+        monkeypatch.setattr(config, "BOUNDARIES", {})
+        findings, _, _ = engine.run_all(root=root, allowlist_path="")
+        assert any(f.detail == "stale-trace-ok"
+                   for f in _active(findings, "annotation"))
+
+    def test_allowlist_requires_reason_and_reports_stale(self, tmp_path,
+                                                         monkeypatch):
+        root = self._mini_root(tmp_path, "y = x.asnumpy()")
+        allow = tmp_path / "allow.json"
+        allow.write_text(json.dumps([{"key": "nope"}]))
+        with pytest.raises(ValueError, match="non-empty 'reason'"):
+            annotations.load_allowlist(str(allow))
+        monkeypatch.setattr(config, "ENTRY_POINTS", ("mxnet_tpu.m.entry",))
+        monkeypatch.setattr(config, "BOUNDARIES", {})
+        findings, _, _ = engine.run_all(root=root, rules=["host-sync"],
+                                        allowlist_path="")
+        key = _active(findings, "host-sync")[0].key
+        allow.write_text(json.dumps(
+            [{"key": key, "reason": "fixture-reviewed"},
+             {"key": "stale-key", "reason": "old"}]))
+        findings, _, _ = engine.run_all(root=root, rules=["host-sync"],
+                                        allowlist_path=str(allow))
+        assert not _active(findings, "host-sync")
+        assert any(f.detail == "stale-allowlist" for f in findings)
+
+    def test_unknown_rule_raises(self):
+        with pytest.raises(ValueError, match="unknown rule family"):
+            engine.run_all(root=ROOT, rules=["bogus"])
+
+
+# -------------------------------------------------------- repo-wide gate
+class TestRepoGate:
+    @pytest.fixture(scope="class")
+    def cli_json(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+             "--json"],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        return proc.returncode, proc.stdout, proc.stderr
+
+    def test_zero_unannotated_violations(self, cli_json):
+        rc, out, err = cli_json
+        doc = json.loads(out)
+        active = [f for f in doc["findings"] if not f["suppressed_by"]]
+        assert active == [], "lint gate broken:\n" + "\n".join(
+            f"{f['path']}:{f['line']} [{f['rule']}] {f['message']}"
+            for f in active)
+        assert rc == 0, err
+
+    def test_suppressions_all_carry_reasons(self, cli_json):
+        _, out, _ = cli_json
+        doc = json.loads(out)
+        for f in doc["findings"]:
+            if f["suppressed_by"]:
+                kind, _, reason = f["suppressed_by"].partition(":")
+                assert kind in ("annotation", "allowlist", "baseline")
+                assert reason.strip(), f
+
+    def test_entry_points_and_boundaries_exist(self):
+        index = astutil.load_package(ROOT)
+        for qn in config.ENTRY_POINTS:
+            assert qn in index.functions, f"stale entry point {qn}"
+        for qn in config.BOUNDARIES:
+            assert qn in index.functions, f"stale boundary {qn}"
+        for qn, why in config.BOUNDARIES.items():
+            assert why.strip(), f"boundary {qn} needs a reason"
+
+    def test_cli_exit_codes(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "lint_cli", os.path.join(ROOT, "tools", "lint.py"))
+        cli = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cli)
+        assert cli.main(["--rules", "bogus"]) == 2
+        # baseline round-trip on a seeded-violation fixture root
+        pkg = tmp_path / "mxnet_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(
+            'import os\nX = os.environ.get("MXTPU_NOT_DOCUMENTED")\n')
+        (tmp_path / "docs" / "how_to").mkdir(parents=True)
+        (tmp_path / "docs" / "how_to" / "env_var.md").write_text("")
+        base = str(tmp_path / "base.json")
+        args = ["--rules", "env-docs", "--root", str(tmp_path),
+                "--allowlist", ""]
+        assert cli.main(args) == 1                          # violation
+        assert cli.main(args + ["--write-baseline", base]) == 0
+        assert cli.main(args + ["--baseline", base]) == 0   # adopted

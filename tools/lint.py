@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Invariant lint gate — CLI over mxnet_tpu/analysis (docs/static_analysis.md).
+
+Runs the static rule families (host-sync escape analysis, trace-purity,
+lock-order/shared-state, env-knob drift) over the package source and
+exits nonzero on any unsuppressed violation, so it slots straight into
+pre-commit/CI without pytest:
+
+    python tools/lint.py                      # full suite, text report
+    python tools/lint.py --rules host-sync,env-docs
+    python tools/lint.py --json               # structured findings
+    python tools/lint.py --write-baseline lint_baseline.json
+    python tools/lint.py --baseline lint_baseline.json   # only NEW findings
+
+Exit codes: 0 clean, 1 violations, 2 internal/usage error.
+
+The analysis package is pure stdlib; this script loads it standalone so
+linting never pays (or depends on) the jax import.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Load mxnet_tpu.analysis WITHOUT executing mxnet_tpu/__init__.py
+    (which imports jax).  Registering the submodule spec directly makes
+    its relative imports resolve against itself."""
+    name = "mxnet_tpu.analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    if "mxnet_tpu" not in sys.modules:
+        # synthetic parent so the submodule import machinery resolves
+        # without executing mxnet_tpu/__init__.py (no __init__ exec =
+        # no jax import). Fine for this short-lived CLI process only.
+        import types
+        parent = types.ModuleType("mxnet_tpu")
+        parent.__path__ = [os.path.join(ROOT, "mxnet_tpu")]
+        sys.modules["mxnet_tpu"] = parent
+    pkg_dir = os.path.join(ROOT, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:
+        del sys.modules[name]
+        raise
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tools/lint.py",
+        description="static invariant lint over mxnet_tpu/")
+    ap.add_argument("--rules", default="",
+                    help="comma list: host-sync,trace-purity,locks,env-docs "
+                         "(default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the structured finding list as JSON")
+    ap.add_argument("--baseline", default="",
+                    help="suppress findings whose keys are in this baseline "
+                         "file (adopt-then-ratchet mode)")
+    ap.add_argument("--write-baseline", default="",
+                    help="write current unsuppressed finding keys to this "
+                         "file and exit 0")
+    ap.add_argument("--allowlist", default=None,
+                    help="override the allowlist path "
+                         "(default tools/lint_allowlist.json)")
+    ap.add_argument("--root", default=ROOT, help=argparse.SUPPRESS)
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="show suppressed findings and full call chains")
+    args = ap.parse_args(argv)
+
+    try:
+        analysis = _load_analysis()
+    except Exception as e:  # noqa: BLE001 — loader problems are exit-2
+        print(f"lint: cannot load mxnet_tpu/analysis: {e!r}", file=sys.stderr)
+        return 2
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    try:
+        findings, _, _ = analysis.run_all(
+            root=args.root, rules=rules, allowlist_path=args.allowlist)
+    except ValueError as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.baseline:
+        try:
+            with open(args.baseline, "r", encoding="utf-8") as fh:
+                base = set(json.load(fh).get("keys", []))
+        except (OSError, ValueError) as e:
+            print(f"lint: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        for f in findings:
+            if not f.suppressed and f.key in base:
+                f.suppressed_by = f"baseline:{args.baseline}"
+
+    active = [f for f in findings if not f.suppressed]
+    if args.write_baseline:
+        doc = {"keys": sorted({f.key for f in active})}
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2)
+        print(f"lint: wrote {len(doc['keys'])} baseline key(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.as_json:
+        print(analysis.render_json(
+            findings, meta={"rules": rules or sorted(analysis.RULES)}))
+    else:
+        print(analysis.render_text(findings, verbose=args.verbose,
+                                   show_suppressed=args.verbose))
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
