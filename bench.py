@@ -765,6 +765,179 @@ def _shard_micro():
             % (r.returncode, (r.stderr or r.stdout)[-300:])}
 
 
+_DIST_PS_WORKER = r'''
+import os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+keys = list(range(8))
+shapes = [(256, 64)] * 8
+kv.init(keys, [mx.nd.ones(s) for s in shapes])
+kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.01,
+                                     rescale_grad=1.0))
+grads = [[mx.nd.ones(s)] for s in shapes]
+outs = [mx.nd.zeros(s) for s in shapes]
+kv.push(keys, grads); kv.pull(keys, outs)  # warm
+kv.barrier()
+n = 20
+tic = time.perf_counter()
+for _ in range(n):
+    kv.push(keys, grads)
+    kv.pull(keys, outs)
+us = (time.perf_counter() - tic) / n * 1e6
+if kv.rank == 0:
+    print('{"dist_ps_us": %f}' % us, flush=True)
+kv.barrier()
+'''
+
+_DIST_ELASTIC_WORKER = r'''
+import os, time
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2")
+slot = int(os.environ["MXTPU_ELASTIC_SLOT"])
+gen = int(os.environ["MXTPU_DIST_GENERATION"])
+if slot == 1 and gen == 0:
+    os.environ["MXTPU_FAULT_PLAN"] = "host_crash:crash_after:6"
+os.environ["MXTPU_ASYNC_DEPTH"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import io as mx_io, sym
+from mxnet_tpu.parallel import dist
+from mxnet_tpu.parallel.mesh import create_mesh
+from mxnet_tpu.trainer import FusedTrainer
+
+OUT = os.environ["DIST_MICRO_OUT"]
+net = sym.SoftmaxOutput(
+    sym.FullyConnected(sym.Variable("data"), num_hidden=32, name="fc"),
+    sym.Variable("softmax_label"), name="softmax")
+rs = np.random.RandomState(5)
+X = rs.uniform(-1, 1, (160, 16)).astype(np.float32)
+Y = rs.randint(0, 10, 160).astype(np.float32)
+
+
+def main():
+    np.random.seed(0)
+    mx.random.seed(0)
+    tr = FusedTrainer(net, optimizer="sgd",
+                      optimizer_params={"lr": 0.05},
+                      mesh=create_mesh((2,), ("data",)))
+    train = mx_io.NDArrayIter(X, Y, batch_size=8)
+    marked = []
+
+    def cb(param):
+        if not marked:
+            marked.append(1)
+            with open(os.path.join(OUT, "gen%d_first_step_%d"
+                                   % (gen, slot)), "w") as f:
+                f.write(repr(time.time()))
+
+    tr.fit(train, num_epoch=30, resume=True, batch_end_callback=cb)
+
+
+dist.elastic_main(main)
+'''
+
+
+def _dist_micro():
+    """Multi-host runtime micro (round 17, docs/multihost.md): the
+    per-step kvstore cost of the collective dist_sync path (fused
+    bucketed dispatch — the cross-host all-reduce is in-trace) vs the
+    PS transport (per-key RPCs over the 2-worker/1-server local rig),
+    plus generation_failover_ms — the end-to-end wall time from a
+    SIGKILL-shaped host death to the shrunk generation's first resumed
+    training step under the elastic launcher (detect via lease expiry
+    + relaunch + checkpoint resume + re-bind)."""
+    import re
+    import subprocess
+    import sys
+    import tempfile
+    from datetime import datetime
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    out = {}
+    # collective transport, in-process: batched push/pull through the
+    # fused bucket engine (same math a pod runs over DCN)
+    kv = mx.kv.create("dist_sync")
+    keys = list(range(8))
+    shapes = [(256, 64)] * 8
+    kv.init(keys, [mx.nd.ones(s) for s in shapes])
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.01,
+                                         rescale_grad=1.0))
+    grads = [[mx.nd.ones(s)] for s in shapes]
+    outs_ = [mx.nd.zeros(s) for s in shapes]
+    kv.push(keys, grads)
+    kv.pull(keys, outs_)
+    outs_[0].asnumpy()
+    n = 20
+    tic = time.perf_counter()
+    for _ in range(n):
+        kv.push(keys, grads)
+        kv.pull(keys, outs_)
+    outs_[0].asnumpy()
+    out["dist_step_us_per_step_collective"] = round(
+        (time.perf_counter() - tic) / n * 1e6, 1)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    launch = os.path.join(repo, "tools", "launch.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_PLATFORM="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    with tempfile.TemporaryDirectory() as d:
+        # PS transport: real worker+server processes on localhost
+        ps_path = os.path.join(d, "ps_worker.py")
+        with open(ps_path, "w") as f:
+            f.write(_DIST_PS_WORKER)
+        r = subprocess.run(
+            [sys.executable, launch, "-n", "2", "-s", "1",
+             "--launcher", "local", sys.executable, ps_path],
+            capture_output=True, text=True, timeout=300, env=env)
+        m = re.search(r'\{"dist_ps_us": ([0-9.]+)\}', r.stdout)
+        if m:
+            out["dist_step_us_per_step_ps"] = round(float(m.group(1)), 1)
+        else:
+            out["dist_ps_error"] = "rc=%d: %s" % (
+                r.returncode, (r.stderr or r.stdout)[-200:])
+
+        # elastic failover: kill one of two hosts mid-epoch, measure
+        # death-observed -> first resumed step of the shrunk generation
+        ew_path = os.path.join(d, "elastic_worker.py")
+        with open(ew_path, "w") as f:
+            f.write(_DIST_ELASTIC_WORKER)
+        eenv = dict(env, DIST_MICRO_OUT=d, MXTPU_CKPT_DIR=os.path.join(
+            d, "ckpt"), MXTPU_CKPT_EVERY="2", MXTPU_COORD_LEASE_S="1.0",
+            MXTPU_DIST_BARRIER_TIMEOUT_S="8", XLA_FLAGS="")
+        r = subprocess.run(
+            [sys.executable, launch, "-n", "2", "--max-restarts", "1",
+             "--launcher", "elastic", "--rejoin-progress", "3",
+             "--exit-grace", "60", sys.executable, ew_path],
+            capture_output=True, text=True, timeout=420, env=eenv)
+        log = r.stdout + r.stderr
+        crash = re.search(
+            r"^([0-9-]+ [0-9:,]+) launch\.py slot 1 crashed", log, re.M)
+        marker = os.path.join(d, "gen1_first_step_0")
+        if crash and os.path.exists(marker):
+            t_crash = datetime.strptime(
+                crash.group(1), "%Y-%m-%d %H:%M:%S,%f").timestamp()
+            with open(marker) as f:
+                t_resume = float(f.read())
+            out["generation_failover_ms"] = round(
+                (t_resume - t_crash) * 1e3, 1)
+            out["dist_generations"] = len(re.findall(
+                r"launch\.py generation \d+: world=", log))
+        else:
+            out["dist_failover_error"] = "rc=%d: %s" % (
+                r.returncode, log[-200:])
+    return out
+
+
 def _serve_micro():
     """Serving micro-bench (round 10): the continuous-batching decode
     scheduler (mxnet_tpu/serving/) under a synthetic Poisson arrival
@@ -1532,6 +1705,15 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
             # payload — the MULTICHIP runs' primary section (ISSUE 7)
             if os.environ.get("BENCH_SHARD", "1") == "1":
                 for k_, v_ in _shard_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # elastic multi-host runtime: collective-vs-PS kvstore step
+            # cost + the generation failover wall time on the
+            # multi-process CPU rig (ISSUE 13)
+            if os.environ.get("BENCH_DIST", "1") == "1":
+                for k_, v_ in _dist_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

@@ -21,6 +21,9 @@ ENTRY_POINTS = (
     "mxnet_tpu.kvstore_fused.FusedUpdateEngine.handle_pull",
     "mxnet_tpu.checkpoint.snapshot",
     "mxnet_tpu.checkpoint.CheckpointManager.save",
+    # elastic membership poll: runs every batch inside the fit loops —
+    # must stay pure host-side flag reads (ISSUE 13)
+    "mxnet_tpu.parallel.coordinator.CoordinatorClient.step_poll",
 )
 
 # Sanctioned sync boundaries: the analyzer does not descend into these.

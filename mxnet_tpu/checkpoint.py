@@ -116,14 +116,29 @@ def snapshot(arrays: dict) -> dict:
     pass through).  The copies are dispatched asynchronously and ordered
     AFTER every in-flight donated-step program, so they capture the
     post-last-dispatched-step state without a host sync and without the
-    next step's donation invalidating them."""
+    next step's donation invalidating them.
+
+    Multi-host exception (docs/multihost.md): on a mesh spanning other
+    processes ``jnp.copy`` is a cross-process program, and checkpoint
+    cadence is NOT symmetric across hosts (a busy writer skips a
+    snapshot) — asymmetric collective dispatch deadlocks the fabric.
+    Fully-replicated arrays therefore capture via a LOCAL host fetch
+    (no program, no rendezvous); only non-replicated arrays keep the
+    device copy, which their (symmetric, sharded-update) producers
+    guarantee is dispatched on every host."""
     import jax
     import jax.numpy as jnp
 
+    me = jax.process_index()
     out = {}
     for name, v in arrays.items():
         if isinstance(v, jax.Array):
-            out[name] = jnp.copy(v)
+            spans = any(d.process_index != me
+                        for d in getattr(v.sharding, "device_set", ()))
+            if spans and v.is_fully_replicated:
+                out[name] = np.asarray(v)
+            else:
+                out[name] = jnp.copy(v)
         else:
             out[name] = np.asarray(v)
     return out

@@ -69,11 +69,27 @@ class Context:
 
 
 def _device_list(device_type: str):
+    """Devices a Context's device_id indexes into: the devices THIS
+    process can address.  Under jax.distributed `jax.devices()` is the
+    global list — another host's device is non-addressable, and
+    resolving `cpu(0)` there would make every NDArray constructor fail
+    on rank > 0.  The process-spanning view lives in `process_mesh()`."""
+    if device_type in ("gpu", "tpu"):
+        default = jax.local_devices()
+        if default and default[0].platform != "cpu":
+            return default
+        # CPU-only host: accelerator contexts fold onto virtual CPU devices.
+        return jax.local_devices(backend="cpu")
+    return jax.local_devices(backend="cpu")
+
+
+def _global_device_list(device_type: str):
+    """The cross-process device list (`process_mesh` spans hosts once
+    jax.distributed is initialized — docs/multihost.md)."""
     if device_type in ("gpu", "tpu"):
         default = jax.devices()
         if default and default[0].platform != "cpu":
             return default
-        # CPU-only host: accelerator contexts fold onto virtual CPU devices.
         return jax.devices("cpu")
     return jax.devices("cpu")
 
@@ -103,10 +119,13 @@ def process_mesh():
     global_mesh over the accelerator devices; MXTPU_MESH_SHAPE picks the
     factorization, default pure data parallel).  This is what group2ctx
     PartitionSpec annotations and mesh-spanning executor groups resolve
-    against — the named-axis replacement for raw device-id lists."""
+    against — the named-axis replacement for raw device-id lists.  Once
+    jax.distributed is initialized the mesh SPANS hosts (its "batch"
+    axis grows across processes): the same SPMD program covers 8 chips
+    or a pod slice, with GSPMD routing the cross-host collectives."""
     from .parallel.mesh import global_mesh
 
-    return global_mesh(_device_list("tpu"))
+    return global_mesh(_global_device_list("tpu"))
 
 
 def mesh_sharding(spec=None):

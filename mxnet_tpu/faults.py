@@ -35,8 +35,14 @@ Modes:
 Sites wired in this codebase: ``kv_push`` / ``kv_pull`` (kvstore eager +
 fused batched entry), ``dist_send`` / ``dist_recv`` (KVStoreDist RPC
 transport), ``ckpt_write`` (checkpoint writer), ``serve_admit`` (serving
-admission).  Any other site string is legal — call sites define the
-namespace; unknown sites in a plan simply never fire.
+admission), ``dist_barrier`` (cross-host barrier — a drop simulates the
+dead-peer timeout and raises ``HostLostError`` without the wait),
+``coord_heartbeat`` (coordinator client heartbeat — a drop loses the
+beat so the lease decays and the coordinator declares the host dead),
+``host_crash`` (fired per step from the coordinator poll —
+``crash_after:n`` is the SIGKILL-shaped mid-training death the elastic
+chaos tests use).  Any other site string is legal — call sites define
+the namespace; unknown sites in a plan simply never fire.
 
 Draws are deterministic under ``MXTPU_FAULT_SEED`` (default 0) so a
 failing chaos soak replays exactly.  Every injected fault counts in

@@ -72,6 +72,11 @@ _TM_DIST_RETRY = _tm.counter(
     "(broken pipe / reset / injected drop); each retry reconnects with "
     "exponential backoff + jitter and retransmits idempotently by "
     "request id", labels=("op",))
+_TM_DEAD_WORKERS = _tm.gauge(
+    "kvstore_dead_workers",
+    "worker ranks whose heartbeats went stale (PS: the server-side "
+    "staleness the client unions via get_num_dead_node; collective: "
+    "hosts the coordinator declared dead); also surfaced in /healthz")
 
 
 def dist_retries() -> int:
@@ -131,6 +136,18 @@ class KVStore:
         # bucketed jit-fused update engine (kvstore_fused.py), built by
         # set_optimizer when the optimizer has a fused rule
         self._fused = None
+        # collective dist mode (KVStoreDist without a PS transport):
+        # cross-host aggregation rides in-trace mesh collectives through
+        # the fused/sharded bucket engine instead of per-key RPCs
+        self._collective = False
+
+    @property
+    def collective(self) -> bool:
+        """True for a dist store whose sync aggregation rides mesh
+        collectives (no PS transport): callers batch push/pull like a
+        local store — one bucketed dispatch per step, zero per-batch
+        host syncs — instead of the per-key RPC priority loop."""
+        return self._collective
 
     def _merge_context(self, k, vals):
         """Pick (once per key) the least-loaded device among the pushed
@@ -423,8 +440,13 @@ class KVStore:
             # it can map back to per-key NDArrays
             self._fused.ensure_host_state()
         self._fused = None
-        if "dist" in self.type or self._optimizer is None:
-            return  # dist stores keep the per-key RPC/priority contract
+        if self._optimizer is None or (
+                "dist" in self.type and not self._collective):
+            # PS-transport dist stores keep the per-key RPC/priority
+            # contract; COLLECTIVE dist_sync routes through the bucket
+            # engine — the cross-host all-reduce, 1/N-per-host update
+            # and param all-gather all happen in-trace (ISSUE 13)
+            return
         from . import kvstore_fused as kvf
 
         if not kvf.fused_update_enabled():
@@ -808,6 +830,25 @@ class KVStoreDist(KVStore):
         self._engine = None
         self._key_vars = {}
         servers = os.environ.get("MXTPU_PS_SERVERS", "")
+        if not servers:
+            # COLLECTIVE transport (ISSUE 13): no parameter server — sync
+            # aggregation rides DCN/ICI collectives over the fused
+            # sharded buckets on the process-spanning mesh.  Initialize
+            # jax.distributed from the launcher env (validated) so
+            # process_mesh() spans hosts, and take rank/size from the
+            # live runtime.  dist_async still needs the PS for its
+            # no-barrier semantics — without servers it degrades to
+            # local update semantics like the reference without a
+            # tracker (rank 0 / size 1 when single-process).
+            from .parallel import dist as _dist
+
+            self._collective = "async" not in kv_type
+            if _dist.is_multi_host():
+                _dist.init_from_env()
+                import jax
+
+                self._rank = jax.process_index()
+                self._size = jax.process_count()
         if servers:
             self._client = _PSClient(servers.split(","), rank=self._rank)
             if (os.environ.get("MXTPU_PS_ASYNC", "1") == "1"
@@ -875,6 +916,15 @@ class KVStoreDist(KVStore):
 
     def push(self, key, value, priority=0):
         if self._client is None:
+            if self._collective and self._size > 1 and _tm.enabled():
+                # dispatch-side payload accounting for the in-trace
+                # cross-host grad all-reduce (host shape math only)
+                from .parallel import dist as _dist
+
+                vals = value if isinstance(key, (list, tuple)) else [value]
+                _dist.count_allreduce_bytes(sum(
+                    _nbytes(v[0] if isinstance(v, (list, tuple)) else v)
+                    for v in vals))
             return super().push(key, value, priority)
         from . import faults as _faults
 
@@ -1039,24 +1089,34 @@ class KVStoreDist(KVStore):
             self._client.barrier()
             return
         # with a live jax.distributed backend this is a cross-host sync
-        try:
-            import jax
+        # under the MXTPU_DIST_BARRIER_TIMEOUT_S watchdog — a dead peer
+        # raises HostLostError instead of parking this worker forever
+        import jax
 
-            if jax.process_count() > 1:
-                from .parallel import dist as _dist
+        if jax.process_count() > 1:
+            from .parallel import dist as _dist
 
-                _dist.barrier()
-        except Exception:
-            pass
+            _dist.barrier()
 
     def get_num_dead_node(self, node_id, timeout=60):
         """Parity: KVStore::get_num_dead_node (kvstore_dist.h:151-160) —
         count of worker ranks whose heartbeats went stale.  node_id is
         accepted for signature parity; the TCP PS has a single worker
-        group."""
+        group.  Collective stores ask the coordinator (lease-expiry
+        deaths) when one is armed.  Either way the count lands on the
+        ``kvstore_dead_workers`` gauge (and /healthz)."""
         if self._client is None:
-            return 0
-        return len(self._client.dead_nodes(timeout))
+            from .parallel import coordinator as _coord
+
+            n = 0
+            client = _coord.client_from_env()
+            if client is not None:
+                n = len(client.cluster().get("dead", []))
+        else:
+            n = len(self._client.dead_nodes(timeout))
+        if _tm.enabled():
+            _TM_DEAD_WORKERS.set(n)
+        return n
 
     def _send_stop(self):
         if self._client is not None:

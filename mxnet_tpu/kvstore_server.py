@@ -410,11 +410,21 @@ class KVStoreServer:
         srv.state = self.state
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()
+        # the staleness the server already tracks, surfaced (ISSUE-13
+        # satellite): the run loop wakes every 2s anyway — publish the
+        # dead count on the kvstore_dead_workers gauge so /healthz and
+        # scrapers see it without an extra RPC round
+        from . import telemetry as _tm
+        from .kvstore import _TM_DEAD_WORKERS
+
         with self.state.cond:
             while not self.state.stopped:
                 if self.state.should_stop(dead_timeout):
                     self.state.stopped = True
                     break
+                if _tm.enabled():
+                    _TM_DEAD_WORKERS.set(
+                        len(self.state.dead_nodes(dead_timeout)))
                 self.state.cond.wait(timeout=2.0)
         srv.shutdown()
         srv.server_close()

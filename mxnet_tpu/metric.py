@@ -180,12 +180,27 @@ class EvalMetric:
 
             if not isinstance(sh, NamedSharding):
                 return False  # unknown multi-device layout: eager path
+
+            def _replicate(val, _rep):
+                # a mesh spanning other processes cannot device_put a
+                # committed local array (non-addressable devices): each
+                # process contributes its addressable shards of the
+                # replicated value instead (docs/multihost.md)
+                import numpy as _np
+
+                me = jax.process_index()
+                if all(d.process_index == me for d in _rep.device_set):
+                    return jax.device_put(val, _rep)
+                host = _np.asarray(val)
+                return jax.make_array_from_callback(
+                    host.shape, _rep, lambda idx, _h=host: _h[idx])
+
             rep = NamedSharding(sh.mesh, PartitionSpec())
             if label is None:
-                raw_l = jax.device_put(jnp.float32(0.0), rep)
+                raw_l = _replicate(jnp.float32(0.0), rep)
             elif len(getattr(raw_l, "sharding",
                              sh).device_set) != len(sh.device_set):
-                raw_l = jax.device_put(raw_l, rep)
+                raw_l = _replicate(raw_l, rep)
         if (rep is None and self._dev_sum is not None
                 and len(self._dev_sum.sharding.device_set) > 1):
             # mesh -> single-device transition (metric reused across
@@ -196,8 +211,8 @@ class EvalMetric:
             self._dev_num = jnp.zeros((), jnp.float32)
         if rep is not None and len(
                 self._dev_sum.sharding.device_set) != len(sh.device_set):
-            self._dev_sum = jax.device_put(self._dev_sum, rep)
-            self._dev_num = jax.device_put(self._dev_num, rep)
+            self._dev_sum = _replicate(self._dev_sum, rep)
+            self._dev_num = _replicate(self._dev_num, rep)
         self._dev_sum, self._dev_num = fn(self._dev_sum, self._dev_num,
                                           raw_l, raw_p)
         self._version += 1

@@ -254,7 +254,11 @@ class BaseModule:
                     eval_end_callback, eval_batch_end_callback, monitor,
                     mgr=None, resume_nbatch=-1, start_step=0):
         from .. import checkpoint as _ckpt
+        from ..parallel import coordinator as _coordinator
 
+        # elastic membership (docs/multihost.md): armed by
+        # MXTPU_COORD_ADDR; step_poll is a pure host-side flag check
+        coord = _coordinator.client_from_env()
         flight = _tm.health.flight_enabled()
         program = None
         if flight:
@@ -293,6 +297,18 @@ class BaseModule:
                         nbatch=nbatch, depth=len(window),
                         dispatch_s=time.perf_counter() - t0,
                         program=program)
+                if coord is not None and coord.step_poll():
+                    # the cluster generation moved (a host died or a
+                    # rejoiner announced): checkpoint this boundary,
+                    # then leave with the named error — the elastic
+                    # launcher relaunches the new generation, which
+                    # re-binds on the new mesh shape via resume
+                    w = None
+                    if mgr is not None:
+                        w = self._save_checkpoint_state(
+                            mgr, step_id, epoch, nbatch, background=False)
+                    coord.raise_generation_changed(
+                        getattr(w, "path", None))
                 if mgr is not None:
                     if mgr.preempted:
                         w = self._save_checkpoint_state(

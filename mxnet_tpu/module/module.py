@@ -239,7 +239,13 @@ class Module(BaseModule):
             kvstore, len(self._context), self._arg_params)
 
         batch_size = self._exec_group.batch_size
-        if kvstore_inst and "dist" in kvstore_inst.type and "_sync" in kvstore_inst.type:
+        if (kvstore_inst and "dist" in kvstore_inst.type
+                and "_sync" in kvstore_inst.type
+                and not kvstore_inst.collective):
+            # PS sync mode: every worker contributes its OWN batch and
+            # the server sums, so the effective batch is B * workers.
+            # Collective mode feeds ONE mesh-global batch shared by all
+            # hosts (GSPMD shards it) — B already IS the global batch.
             batch_size *= kvstore_inst.num_workers
         rescale_grad = 1.0 / batch_size
 
@@ -315,13 +321,18 @@ class Module(BaseModule):
         Non-dist stores take the batched path — ONE ``push(keys, grads)``
         + ``pull(keys, outs)`` per step, which the kvstore routes to the
         bucketed jit-fused update engine (kvstore_fused.py) when the
-        optimizer qualifies.  dist stores keep the per-key loop: their
-        comm/compute overlap rides per-key engine priorities (SURVEY
-        §3.4), which a single batched RPC would flatten."""
+        optimizer qualifies.  PS-transport dist stores keep the per-key
+        loop: their comm/compute overlap rides per-key engine priorities
+        (SURVEY §3.4), which a single batched RPC would flatten.
+        COLLECTIVE dist_sync (no PS servers — ISSUE 13) batches like a
+        local store: the cross-host all-reduce is already inside the
+        compiled step/bucket programs, so per-key RPC priorities have
+        nothing left to overlap."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
         ex = self._exec_group.execs[0]
-        dist = self._kvstore is not None and "dist" in self._kvstore.type
+        dist = (self._kvstore is not None and "dist" in self._kvstore.type
+                and not self._kvstore.collective)
         if self._kvstore is not None and not dist:
             idxs, grads, weights = self._exec_group.get_update_data()
             self._kvstore.push(idxs, grads)

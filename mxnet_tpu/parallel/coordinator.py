@@ -1,0 +1,569 @@
+"""Cluster coordinator — generation-epoch membership that survives host
+death (the ``dist_async``/elastic control plane of docs/multihost.md).
+
+The paper's parameter server tracked liveness through ps-lite scheduler
+heartbeats (``KVStore::get_num_dead_node``); on TPU pods the synchronous
+data path needs no server, but *membership* still needs an authority:
+who is in the cluster, which epoch ("generation") of the cluster is
+current, and who died.  This module is that authority, riding the same
+stdlib-HTTP skeleton as the telemetry ``/metrics`` endpoint:
+
+- :class:`CoordinatorService` — rank 0 (or the elastic launcher) hosts
+  it on ``MXTPU_COORD_PORT``.  Members hold **leases**
+  (``MXTPU_COORD_LEASE_S``) refreshed by heartbeats on a dedicated
+  thread (the kvstore_server heartbeat/``MXTPU_PS_DEAD_TIMEOUT_S``
+  shape, generalized); a lease that expires declares the host dead,
+  records it, and **publishes the next generation**.  ``GET /cluster``
+  is the operator's status JSON.
+- :class:`CoordinatorClient` — every worker joins, heartbeats in the
+  background, and polls :meth:`CoordinatorClient.step_poll` from the
+  training loop (pure host-side flag check — nothing on the hot path
+  touches the device).  A published generation != the joined one means
+  the membership changed: the loop checkpoints at the boundary and
+  raises :class:`~mxnet_tpu.parallel.dist.GenerationChanged`.  A worker
+  wedged inside a dead collective can never reach the next poll, so the
+  heartbeat thread doubles as the **barrier watchdog**: once a change
+  is published and the loop stays silent past
+  ``MXTPU_DIST_BARRIER_TIMEOUT_S``, it dumps the flight record and
+  exits :data:`~mxnet_tpu.parallel.dist.EXIT_HOST_LOST` — the one exit
+  jax.distributed leaves open (see parallel/dist.py).
+
+Fault sites (docs/fault_tolerance.md): ``coord_heartbeat`` (drop =
+lost heartbeats → lease expiry at the service), ``host_crash``
+(``crash_after:n`` = a SIGKILL-shaped death mid-training for chaos
+tests).
+
+Every RPC carries a socket timeout; an unreachable coordinator
+surfaces as a named :class:`~mxnet_tpu.parallel.dist.HostLostError`
+(site=coordinator) at the next loop boundary, never a hang.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+
+from ..base import MXNetError
+from .. import telemetry as _tm
+from .dist import (EXIT_HOST_LOST, GenerationChanged, HostLostError,
+                   barrier_timeout_s)
+
+__all__ = ["CoordinatorService", "CoordinatorClient", "coord_lease_s",
+           "coord_addr", "maybe_start_from_env", "client_from_env"]
+
+_logger = logging.getLogger("mxnet_tpu.parallel.coordinator")
+
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_GEN = _tm.gauge(
+    "dist_generation",
+    "current cluster generation epoch (bumped on every membership "
+    "change: lease-expiry death, rejoin announcement, clean leave)")
+_TM_ALIVE = _tm.gauge(
+    "dist_hosts_alive",
+    "hosts holding a live coordinator lease in the current generation")
+_TM_EXPIRED = _tm.counter(
+    "coordinator_lease_expired_total",
+    "host leases the coordinator declared dead (no heartbeat within "
+    "MXTPU_COORD_LEASE_S); each expiry publishes the next generation")
+
+
+def coord_lease_s() -> float:
+    """MXTPU_COORD_LEASE_S — membership lease (default 10s).  Heartbeats
+    go every lease/3; a host silent for a full lease is declared dead."""
+    try:
+        return max(float(os.environ.get("MXTPU_COORD_LEASE_S", "10")), 0.2)
+    except ValueError:
+        return 10.0
+
+
+def coord_addr():
+    """MXTPU_COORD_ADDR — ``host:port`` of the coordinator service (set
+    by the elastic launcher), or None."""
+    return os.environ.get("MXTPU_COORD_ADDR", "").strip() or None
+
+
+class CoordinatorService:
+    """Membership + generation authority (one per cluster, on rank 0 or
+    the elastic launcher).  Thread-safe; start() binds the HTTP server
+    on a daemon thread and returns self."""
+
+    def __init__(self, port=None, lease_s=None, generation=0):
+        self.lease_s = coord_lease_s() if lease_s is None else float(lease_s)
+        self.port = int(os.environ.get("MXTPU_COORD_PORT", "0") or 0) \
+            if port is None else int(port)
+        self._lock = threading.Lock()
+        self.generation = int(generation)
+        # member id -> {host, pid, rank, beat (monotonic), generation}
+        self._members = {}
+        # members announced for the NEXT generation (rejoiners): they
+        # hold no lease yet — they enter when the launcher relaunches
+        self._standby = {}
+        self._dead = []      # [{member, host, generation, time}]
+        self._events = []    # bounded human-readable history
+        self._srv = None
+        self._stop = threading.Event()
+        self._monitor = None
+        self.started = time.time()
+
+    # -- state transitions (all under _lock) -------------------------------
+    def _bump(self, why):
+        # race-ok: every caller (join/leave/expire_leases) already holds
+        # self._lock around this helper; it is never called bare
+        self.generation += 1
+        self._events.append(
+            {"time": time.time(), "generation": self.generation,
+             "why": why})
+        del self._events[:-64]
+        if _tm.enabled():
+            _TM_GEN.set(self.generation)
+            _TM_ALIVE.set(len(self._members))
+        _logger.warning("coordinator: generation -> %d (%s)",
+                        self.generation, why)
+
+    def join(self, member, host="?", pid=0, rank=-1, generation=None,
+             standby=False):
+        """Register a member.  A normal join enters the CURRENT
+        generation (bring-up: the launcher started this world).  A
+        ``standby`` join is a rejoin announcement: the host is back but
+        must enter at the next generation boundary — it is recorded,
+        the generation is bumped so running members leave their step
+        loops at the boundary, and the launcher relaunches everyone."""
+        with self._lock:
+            info = {"host": host, "pid": int(pid), "rank": int(rank),
+                    "beat": time.monotonic(),
+                    "generation": self.generation if generation is None
+                    else int(generation)}
+            if standby:
+                self._standby[member] = info
+                self._bump(f"rejoin announced: {member}")
+            else:
+                self._members[member] = info
+                self._standby.pop(member, None)
+                if _tm.enabled():
+                    _TM_ALIVE.set(len(self._members))
+                    _TM_GEN.set(self.generation)
+            return {"generation": self.generation,
+                    "lease_s": self.lease_s, "ok": True}
+
+    def heartbeat(self, member, generation=None, progress=None):
+        with self._lock:
+            m = self._members.get(member)
+            if m is not None:
+                m["beat"] = time.monotonic()
+                if progress is not None:
+                    # batches trained this incarnation: the elastic
+                    # launcher gates rejoin announcements on the shrunk
+                    # world having made real progress
+                    m["progress"] = int(progress)
+            return {"generation": self.generation,
+                    "ok": m is not None
+                    and (generation is None
+                         or int(generation) == self.generation)}
+
+    def leave(self, member, why="leave"):
+        with self._lock:
+            was = self._members.pop(member, None)
+            self._standby.pop(member, None)
+            if was is not None and self._members:
+                # remaining members must react to the shrink; an empty
+                # cluster (normal completion) has nobody left to tell
+                self._bump(f"{why}: {member}")
+            elif _tm.enabled():
+                _TM_ALIVE.set(len(self._members))
+            return {"generation": self.generation, "ok": was is not None}
+
+    def advance(self, generation, why="relaunch"):
+        """Launcher-driven generation sync: the elastic launcher is
+        about to (re)launch the world as ``generation``.  The service
+        adopts the counter (never going backwards) and clears every
+        stale lease and standby entry — members of dead incarnations
+        must not expire INTO the new generation and push it out."""
+        with self._lock:
+            self.generation = max(self.generation, int(generation))
+            self._members.clear()
+            self._standby.clear()
+            self._events.append(
+                {"time": time.time(), "generation": self.generation,
+                 "why": why})
+            del self._events[:-64]
+            if _tm.enabled():
+                _TM_GEN.set(self.generation)
+                _TM_ALIVE.set(0)
+            return {"generation": self.generation, "ok": True}
+
+    def expire_leases(self):
+        """Declare members whose lease lapsed dead; one generation bump
+        per sweep (a simultaneous multi-host failure is ONE membership
+        change).  Called by the monitor thread and by tests."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [mid for mid, m in self._members.items()
+                    if now - m["beat"] > self.lease_s]
+            for mid in dead:
+                m = self._members.pop(mid)
+                self._dead.append({"member": mid, "host": m["host"],
+                                   "generation": m["generation"],
+                                   "time": time.time()})
+                del self._dead[:-64]
+                if _tm.enabled():
+                    _TM_EXPIRED.inc()
+                _logger.warning(
+                    "coordinator: lease expired for %s (host %s) — "
+                    "declared dead", mid, m["host"])
+            if dead:
+                self._bump("lease expired: " + ",".join(sorted(dead)))
+            return dead
+
+    def cluster(self):
+        """The ``/cluster`` status JSON."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "generation": self.generation,
+                "lease_s": self.lease_s,
+                "hosts_alive": len(self._members),
+                "members": {
+                    mid: {"host": m["host"], "pid": m["pid"],
+                          "rank": m["rank"],
+                          "joined_generation": m["generation"],
+                          "progress": m.get("progress", 0),
+                          "lease_age_s": round(now - m["beat"], 3)}
+                    for mid, m in self._members.items()},
+                "standby": sorted(self._standby),
+                "dead": list(self._dead),
+                "events": list(self._events),
+                "uptime_s": round(time.time() - self.started, 3),
+            }
+
+    # -- HTTP ---------------------------------------------------------------
+    def start(self, addr="127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        svc = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _reply(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path in ("/", "/cluster"):
+                    self._reply(svc.cluster())
+                elif path == "/healthz":
+                    self._reply({"status": "ok",
+                                 "generation": svc.generation})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    n = int(self.headers.get("Content-Length", "0") or 0)
+                    msg = json.loads(self.rfile.read(n) or b"{}")
+                    member = str(msg.get("member", ""))
+                    if not member and path in ("/join", "/heartbeat",
+                                               "/leave"):
+                        raise ValueError("missing 'member'")
+                    if path == "/join":
+                        self._reply(svc.join(
+                            member, host=str(msg.get("host", "?")),
+                            pid=int(msg.get("pid", 0)),
+                            rank=int(msg.get("rank", -1)),
+                            generation=msg.get("generation"),
+                            standby=bool(msg.get("standby", False))))
+                    elif path == "/heartbeat":
+                        self._reply(svc.heartbeat(
+                            member, generation=msg.get("generation"),
+                            progress=msg.get("progress")))
+                    elif path == "/leave":
+                        self._reply(svc.leave(
+                            member, why=str(msg.get("why", "leave"))))
+                    elif path == "/advance":
+                        self._reply(svc.advance(
+                            int(msg.get("generation", 0)),
+                            why=str(msg.get("why", "relaunch"))))
+                    else:
+                        self.send_error(404)
+                except (ValueError, TypeError, json.JSONDecodeError) as exc:
+                    self._reply({"ok": False, "error": str(exc)}, code=400)
+
+            def log_message(self, *args):
+                pass
+
+        srv = ThreadingHTTPServer((addr, self.port), _Handler)
+        srv.daemon_threads = True
+        self.port = srv.server_address[1]
+        self._srv = srv
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="mxtpu-coordinator-http").start()
+        if _tm.enabled():
+            _TM_GEN.set(self.generation)
+            _TM_ALIVE.set(0)
+
+        def _monitor():
+            interval = max(self.lease_s / 4.0, 0.05)
+            while not self._stop.wait(interval):
+                try:
+                    self.expire_leases()
+                except Exception:  # noqa: BLE001 — monitor must survive
+                    _logger.exception("coordinator lease monitor failed")
+
+        self._monitor = threading.Thread(target=_monitor, daemon=True,
+                                         name="mxtpu-coordinator-leases")
+        self._monitor.start()
+        _logger.info("coordinator serving on %s:%d (lease %.1fs)",
+                     addr, self.port, self.lease_s)
+        return self
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}" if self._srv is not None else ""
+
+    def stop(self):
+        self._stop.set()
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+
+
+def _http_json(addr, path, payload=None, timeout=5.0):
+    """One JSON RPC to the coordinator with a bounded socket timeout —
+    a dead coordinator must surface as an error, never a hang."""
+    import http.client
+
+    host, port = str(addr).rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        if payload is None:
+            conn.request("GET", path)
+        else:
+            body = json.dumps(payload).encode()
+            conn.request("POST", path, body,
+                         {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            raise MXNetError(f"coordinator {addr}{path}: HTTP "
+                             f"{resp.status}: {data[:200]!r}")
+        return json.loads(data)
+    finally:
+        conn.close()
+
+
+class CoordinatorClient:
+    """Worker-side membership: join + background heartbeats + the
+    step-loop poll.  One per process; built by
+    :func:`client_from_env` when the elastic launcher armed
+    ``MXTPU_COORD_ADDR``."""
+
+    _MISS_LIMIT = 5  # consecutive heartbeat failures = coordinator lost
+
+    def __init__(self, addr, member=None, rank=None, generation=None,
+                 standby=False):
+        from . import dist as _dist
+
+        self.addr = str(addr)
+        self.rank = _dist._rank_or_env() if rank is None else int(rank)
+        self.member = member or f"rank{self.rank}:{socket.gethostname()}" \
+                                f":{os.getpid()}"
+        self.generation = (_dist.generation() if generation is None
+                           else int(generation))
+        self.lease_s = coord_lease_s()
+        self._changed_at = None       # monotonic time a bump was seen
+        self._seen_generation = self.generation
+        self._polls = 0               # batches polled this incarnation
+        self._lost = False            # coordinator unreachable
+        self._misses = 0
+        self._polled = False          # loop is actively polling
+        self._last_poll = time.monotonic()
+        self._stop = threading.Event()
+        self._hb = None
+        reply = self._rpc("/join", {"member": self.member,
+                                    "host": socket.gethostname(),
+                                    "pid": os.getpid(), "rank": self.rank,
+                                    "generation": self.generation,
+                                    "standby": bool(standby)})
+        self.lease_s = float(reply.get("lease_s", self.lease_s))
+        self._observe_generation(int(reply["generation"]))
+        if not standby:
+            self._hb = threading.Thread(target=self._heartbeat_loop,
+                                        daemon=True,
+                                        name="mxtpu-coord-heartbeat")
+            self._hb.start()
+
+    def _rpc(self, path, payload=None):
+        try:
+            return _http_json(self.addr, path, payload,
+                              timeout=max(self.lease_s, 2.0))
+        except (OSError, MXNetError, ValueError) as exc:
+            raise HostLostError(
+                "coordinator", host=self.addr, rank=self.rank,
+                generation=self.generation,
+                dump=_tm.health.auto_dump("fault"),
+                detail=f"coordinator RPC {path} failed: {exc!r}") from exc
+
+    def _observe_generation(self, gen):
+        if gen != self._seen_generation:
+            self._seen_generation = gen
+            if self._changed_at is None:
+                self._changed_at = time.monotonic()
+
+    # -- background heartbeats + wedge watchdog -----------------------------
+    def _heartbeat_loop(self):
+        from .. import faults as _faults
+
+        interval = max(self.lease_s / 3.0, 0.05)
+        while not self._stop.wait(interval):
+            try:
+                if _faults.should_drop("coord_heartbeat"):
+                    continue  # simulated lost heartbeat: lease decays
+                reply = _http_json(self.addr, "/heartbeat",
+                                   {"member": self.member,
+                                    "generation": self.generation,
+                                    "progress": self._polls},
+                                   timeout=max(interval, 2.0))
+                self._misses = 0
+                self._observe_generation(int(reply["generation"]))
+            except Exception:  # noqa: BLE001 — counted, surfaced at poll
+                self._misses += 1
+                if self._misses >= self._MISS_LIMIT:
+                    self._lost = True
+            # wedge watchdog: a membership change was published but the
+            # training loop never reached its next poll — it is parked
+            # inside a dead collective.  Past the barrier timeout the
+            # only way out jax leaves us is a named exit; the last
+            # periodic checkpoint (PR 11) is the resume point.
+            if (self._changed_at is not None and self._polled
+                    and not self._stop.is_set()):
+                wedged_s = time.monotonic() - max(self._changed_at,
+                                                  self._last_poll)
+                timeout = barrier_timeout_s()
+                if timeout > 0 and wedged_s > timeout:
+                    dump = _tm.health.auto_dump("fault")
+                    _logger.error(
+                        "generation %d -> %d published %.1fs ago and the "
+                        "step loop never surfaced (wedged collective); "
+                        "exiting %d for the elastic launcher%s",
+                        self.generation, self._seen_generation, wedged_s,
+                        EXIT_HOST_LOST,
+                        f" (flight record: {dump})" if dump else "")
+                    os._exit(EXIT_HOST_LOST)
+
+    # -- loop-facing API ----------------------------------------------------
+    def changed(self) -> bool:
+        """True once the coordinator published a different generation
+        (host death or rejoin) — the loop must leave at this boundary."""
+        return self._changed_at is not None
+
+    def step_poll(self) -> bool:
+        """Per-batch poll from the training loops: pure host-side flag
+        reads (never touches the device).  Fires the ``host_crash``
+        chaos site, surfaces a lost coordinator as a named error, and
+        returns :meth:`changed`."""
+        from .. import faults as _faults
+
+        _faults.maybe_fail("host_crash")
+        self._polled = True
+        self._polls += 1
+        self._last_poll = time.monotonic()
+        if self._lost:
+            raise HostLostError(
+                "coordinator", host=self.addr, rank=self.rank,
+                generation=self.generation,
+                dump=_tm.health.auto_dump("fault"),
+                detail=f"{self._MISS_LIMIT} consecutive heartbeats failed")
+        return self.changed()
+
+    def raise_generation_changed(self, ckpt_path=None):
+        """Build + raise the named boundary error (the fit loops call
+        this AFTER their boundary checkpoint landed)."""
+        raise GenerationChanged(
+            "membership", host=self.addr, rank=self.rank,
+            generation=self._seen_generation,
+            dump=_tm.health.auto_dump("fault"),
+            detail="cluster generation "
+                   f"{self.generation} -> {self._seen_generation}"
+                   + (f"; checkpoint: {ckpt_path}" if ckpt_path else
+                      "; resume from the latest checkpoint"))
+
+    def cluster(self):
+        return self._rpc("/cluster")
+
+    def leave(self, why="leave"):
+        self._stop.set()
+        try:
+            self._rpc("/leave", {"member": self.member, "why": why})
+        except HostLostError:
+            pass  # leaving a dead coordinator is still leaving
+
+    def stop(self):
+        self._stop.set()
+        if self._hb is not None:
+            self._hb.join(timeout=2.0)
+
+
+_default_client = None
+_default_lock = threading.Lock()
+
+
+def client_from_env():
+    """The process-wide client when ``MXTPU_COORD_ADDR`` is armed (the
+    elastic launcher sets it), else None.  Built once; the fit loops
+    call this per run, not per batch."""
+    global _default_client
+    addr = coord_addr()
+    if not addr:
+        return None
+    with _default_lock:
+        if _default_client is None or _default_client.addr != addr:
+            _default_client = CoordinatorClient(addr)
+        return _default_client
+
+
+def maybe_start_from_env(generation=None):
+    """Rank 0 hosts the membership endpoint when ``MXTPU_COORD_PORT``
+    is set (the non-launcher bring-up mode of docs/multihost.md);
+    returns the service or None."""
+    from . import dist as _dist
+
+    port = os.environ.get("MXTPU_COORD_PORT", "").strip()
+    if not port or _dist._rank_or_env() != 0:
+        return None
+    svc = CoordinatorService(
+        port=int(port),
+        generation=_dist.generation() if generation is None else generation)
+    return svc.start()
+
+
+def _main(argv=None):
+    """Standalone coordinator: ``python -m mxnet_tpu.parallel.coordinator
+    --port P [--lease S]`` — the elastic launcher runs this as its
+    failure-detector subprocess."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="mxnet_tpu cluster coordinator")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--lease", type=float, default=None)
+    ap.add_argument("--generation", type=int, default=0)
+    args = ap.parse_args(argv)
+    svc = CoordinatorService(port=args.port, lease_s=args.lease,
+                             generation=args.generation).start()
+    print(f"coordinator ready on {svc.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        svc.stop()
+
+
+if __name__ == "__main__":
+    _main()

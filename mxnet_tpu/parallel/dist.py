@@ -1,20 +1,192 @@
-"""Multi-host process group helpers over jax.distributed.
+"""Multi-host process runtime over jax.distributed — the collective side
+of ``dist_sync`` plus the elastic control-plane primitives.
 
 Parity: the ps-lite ``Postoffice`` role (ranks, barriers, dead-node
 surface — include/mxnet/kvstore.h:158-242) for TPU pods, where process
 wiring is jax.distributed + ICI/DCN collectives instead of a ZMQ
 scheduler.  The host-TCP parameter server lives in kvstore_server.py;
-this module is the collective-native side.
+this module is the collective-native side:
+
+- :func:`init_from_env` wires jax.distributed from launcher env vars
+  (validated — a bad rank used to surface as an opaque jax hang), and
+  enables gloo CPU collectives so the multi-process-on-CPU test rig
+  runs the SAME cross-process XLA programs a pod runs over DCN.
+- :func:`barrier` is the cross-host rendezvous with a **watchdog**:
+  ``MXTPU_DIST_BARRIER_TIMEOUT_S`` bounds the wait, and expiry raises
+  :class:`HostLostError` naming host/rank/generation + the
+  flight-record dump instead of hanging the survivors forever inside
+  ``sync_global_devices``.
+- **Generations** (:func:`generation`): the elastic runtime's epoch
+  number.  Every process of one training incarnation shares a
+  generation; membership changes (host death, rejoin) publish the next
+  one through the coordinator (parallel/coordinator.py) and every
+  member re-enters through checkpoint-resume on the new mesh.
+
+Why restart-per-generation instead of shrinking in place: a peer death
+wedges survivors inside the blocked collective, and the jax runtime
+hard-aborts the process on coordination-service heartbeat timeout
+(~100s) — there is no supported in-process world-shrink.  The elastic
+contract is therefore: detect FAST (coordinator leases, seconds),
+checkpoint at the boundary (or fall back to the PR-11 periodic async
+checkpoint when wedged mid-collective), exit with
+:data:`EXIT_HOST_LOST`, and let the launcher (tools/launch.py
+``--elastic``) relaunch the surviving membership at the next generation
+— `Module.fit`/`FusedTrainer.fit` re-bind on the new mesh shape via the
+checkpoint re-shard contract (``sync_shard_state``).
 """
 from __future__ import annotations
 
+import logging
 import os
+import threading
+
+from ..base import MXNetError
+from .. import telemetry as _tm
+
+_logger = logging.getLogger("mxnet_tpu.parallel.dist")
+
+#: Process exit code for "this worker left its generation on purpose"
+#: (host lost / membership changed): the elastic launcher relaunches the
+#: next generation instead of counting it as a crash.
+EXIT_HOST_LOST = 43
+
+# --- telemetry families (docs/telemetry.md) --------------------------------
+_TM_ALLREDUCE_BYTES = _tm.counter(
+    "dist_allreduce_bytes_total",
+    "logical gradient bytes entering the cross-host in-trace all-reduce "
+    "of the collective dist_sync path (dispatch-side accounting; the "
+    "reduction itself runs inside the compiled step)")
+_TM_BARRIER_SEC = _tm.histogram(
+    "dist_barrier_seconds",
+    "cross-host barrier wall time (sync_global_devices under the "
+    "MXTPU_DIST_BARRIER_TIMEOUT_S watchdog)")
+
+
+class HostLostError(MXNetError):
+    """A cross-host blocking site timed out or the cluster membership
+    changed under us: a peer host is gone (or joining) and this
+    process must leave its generation.
+
+    Attributes name everything the operator (and the elastic launcher)
+    needs: ``host`` (the peer believed dead, or ``"?"``), ``rank`` /
+    ``generation`` of THIS process, ``site`` (barrier / collective /
+    coordinator), and ``dump`` (flight-record path, when
+    ``MXTPU_FLIGHT_RECORD`` names one).  Exit with
+    :data:`EXIT_HOST_LOST` after catching it so the elastic launcher
+    relaunches the next generation.
+    """
+
+    def __init__(self, site, host="?", rank=None, generation=None,
+                 dump=None, detail=""):
+        self.site = site
+        self.host = host
+        self.rank = _rank_or_env() if rank is None else int(rank)
+        self.generation = generation if generation is not None \
+            else _generation_env()
+        self.dump = dump
+        msg = (f"host lost at site {site!r}: host={host} "
+               f"rank={self.rank} generation={self.generation}")
+        if detail:
+            msg += f" ({detail})"
+        if dump:
+            msg += f" (flight record: {dump})"
+        super().__init__(msg)
+
+
+class GenerationChanged(HostLostError):
+    """The coordinator published a new cluster generation (a host died
+    or a new one joined) and this process checkpointed at the boundary:
+    leave cleanly with :data:`EXIT_HOST_LOST` and rejoin the next
+    generation through resume."""
+
+
+def _generation_env() -> int:
+    try:
+        return int(os.environ.get("MXTPU_DIST_GENERATION", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def generation() -> int:
+    """The cluster generation this process was launched into (set by
+    the elastic launcher; 0 for non-elastic runs)."""
+    return _generation_env()
+
+
+def _rank_or_env() -> int:
+    """This process's rank WITHOUT initializing jax backends (env view;
+    error paths must be safe before/without jax.distributed)."""
+    try:
+        return int(os.environ.get("MXTPU_RANK",
+                                  os.environ.get("DMLC_RANK", "0")) or 0)
+    except ValueError:
+        return 0
+
+
+def barrier_timeout_s() -> float:
+    """MXTPU_DIST_BARRIER_TIMEOUT_S — watchdog bound on every
+    cross-host rendezvous (default 600s; must stay well under the jax
+    coordination-service abort at ~100s only when tuned down — see
+    docs/multihost.md).  <=0 disables the watchdog."""
+    try:
+        return float(os.environ.get("MXTPU_DIST_BARRIER_TIMEOUT_S", "600"))
+    except ValueError:
+        return 600.0
+
+
+def _validate_coordinator(coord: str):
+    """A well-formed ``host:port``.  jax.distributed turns a malformed
+    address into an opaque hang/abort — name the offending value."""
+    host, sep, port = str(coord).rpartition(":")
+    ok = bool(sep) and bool(host)
+    if ok:
+        try:
+            ok = 0 < int(port) < 65536
+        except ValueError:
+            ok = False
+    if not ok:
+        raise MXNetError(
+            f"MXTPU_COORDINATOR={coord!r}: expected 'host:port' with a "
+            "port in 1..65535 (e.g. '10.0.0.1:8476')")
+
+
+def _enable_cpu_collectives():
+    """Cross-process collectives on the CPU backend need the gloo
+    implementation — without it every multi-process CPU program fails
+    with 'Multiprocess computations aren't implemented on the CPU
+    backend'.  Harmless on accelerator backends (config only affects
+    CPU); skipped when the installed jax predates the option.
+
+    CPU dispatch also goes synchronous: gloo context creation races
+    when concurrently-executing programs bring up communicators at the
+    same time (observed as a hard ``gloo::EnforceNotMet`` preamble-
+    mismatch abort on jaxlib 0.4.36), and serializing CPU execution
+    removes the concurrency.  Accelerator programs never run on the
+    CPU backend, so pods are unaffected; the multi-process CPU rig is
+    a test/bench vehicle where throughput is irrelevant."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — older jax: accelerator-only dist
+        _logger.warning("jax_cpu_collectives_implementation unavailable; "
+                        "multi-process CPU collectives will not work")
+        return
+    try:
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+    except Exception:  # noqa: BLE001 — option renamed/absent: best effort
+        pass
 
 
 def init_from_env():
     """Initialize jax.distributed from standard launcher env vars
     (parity: InitPSEnv, include/mxnet/kvstore.h:158-208).  No-op if
-    single-process or already initialized."""
+    single-process or already initialized.
+
+    Validates the env contract FIRST: ``MXTPU_RANK`` must be an integer
+    in ``[0, MXTPU_NUM_WORKERS)`` and ``MXTPU_COORDINATOR`` a
+    well-formed ``host:port`` — a bad rank used to surface as an opaque
+    jax.distributed hang."""
     import jax
 
     # NB: do not probe jax.process_count() here — it initializes the XLA
@@ -29,11 +201,25 @@ def init_from_env():
         pass
     coord = os.environ.get("MXTPU_COORDINATOR",
                            os.environ.get("JAX_COORDINATOR_ADDRESS"))
-    nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
-    rank = int(os.environ.get("MXTPU_RANK", "0"))
-    if coord and nproc > 1:
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=nproc, process_id=rank)
+    try:
+        nproc = int(os.environ.get("MXTPU_NUM_WORKERS", "1"))
+        rank = int(os.environ.get("MXTPU_RANK", "0"))
+    except ValueError as exc:
+        raise MXNetError(
+            f"MXTPU_RANK={os.environ.get('MXTPU_RANK')!r} / "
+            f"MXTPU_NUM_WORKERS={os.environ.get('MXTPU_NUM_WORKERS')!r}: "
+            "both must be integers") from exc
+    if not coord or nproc <= 1:
+        return
+    if not 0 <= rank < nproc:
+        raise MXNetError(
+            f"MXTPU_RANK={rank} out of range for "
+            f"MXTPU_NUM_WORKERS={nproc} (need 0 <= rank < num_workers); "
+            "check the launcher env")
+    _validate_coordinator(coord)
+    _enable_cpu_collectives()
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=rank)
 
 
 def rank() -> int:
@@ -48,13 +234,137 @@ def num_workers() -> int:
     return jax.process_count()
 
 
-def barrier(name: str = "mxtpu_barrier"):
-    """Cross-host sync (parity: KVStore::Barrier → ps::Postoffice
-    barrier).  Rides a tiny DCN all-reduce."""
-    import jax
+def is_multi_host() -> bool:
+    """True when jax.distributed spans >1 process (without initializing
+    it: env says multi-worker, or a live backend says so)."""
+    try:
+        from jax._src import distributed as _jd
 
-    if jax.process_count() <= 1:
-        return
+        if _jd.global_state.client is not None:
+            import jax
+
+            return jax.process_count() > 1
+    except Exception:
+        pass
+    try:
+        return int(os.environ.get("MXTPU_NUM_WORKERS", "1")) > 1 and bool(
+            os.environ.get("MXTPU_COORDINATOR",
+                           os.environ.get("JAX_COORDINATOR_ADDRESS")))
+    except ValueError:
+        return False
+
+
+def _sync_global_devices(name):
+    """Indirection point for the barrier collective (tests substitute a
+    slow double to exercise the watchdog without a real second host)."""
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+def barrier(name: str = "mxtpu_barrier", timeout: float = None):
+    """Cross-host sync (parity: KVStore::Barrier → ps::Postoffice
+    barrier).  Rides a tiny DCN all-reduce — under a watchdog.
+
+    A dead peer parks ``sync_global_devices`` forever (and the jax
+    runtime only aborts the process minutes later): the collective runs
+    on a helper thread and the caller waits at most ``timeout``
+    (default ``MXTPU_DIST_BARRIER_TIMEOUT_S``), then raises
+    :class:`HostLostError` carrying rank/generation + the flight-record
+    dump.  The helper thread stays parked in the dead collective — the
+    process is expected to exit (:data:`EXIT_HOST_LOST`) and be
+    relaunched into the next generation, which is the only recovery
+    jax.distributed supports.
+    """
+    import time
+
+    import jax
+
+    from .. import faults as _faults
+
+    if jax.process_count() <= 1:
+        return
+    if _faults.maybe_fail("dist_barrier"):
+        # injected drop = simulated dead-peer timeout, without the wait
+        raise HostLostError("barrier", dump=_tm.health.auto_dump("fault"),
+                            detail=f"injected dist_barrier drop ({name})")
+    timeout = barrier_timeout_s() if timeout is None else float(timeout)
+    t0 = time.perf_counter()
+    if timeout <= 0:
+        _sync_global_devices(name)
+    else:
+        done = threading.Event()
+        err = []
+
+        def _run():
+            try:
+                _sync_global_devices(name)
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                err.append(exc)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_run, daemon=True,
+                             name=f"mxtpu-barrier[{name}]")
+        t.start()
+        if not done.wait(timeout):
+            raise HostLostError(
+                "barrier", dump=_tm.health.auto_dump("fault"),
+                detail=f"barrier {name!r} timed out after {timeout:g}s "
+                       "(a peer host stopped participating)")
+        if err:
+            raise err[0]
+    if _tm.enabled():
+        _TM_BARRIER_SEC.observe(time.perf_counter() - t0)
+
+
+def elastic_main(fn):
+    """Run one generation of an elastic worker: call ``fn()`` and
+    convert a :class:`HostLostError` (membership change, dead peer,
+    lost coordinator) into :data:`EXIT_HOST_LOST` so the elastic
+    launcher relaunches the next generation.
+
+    The exit is ``os._exit`` ON PURPOSE: after a peer death the jax
+    atexit shutdown parks on the distributed shutdown barrier and the
+    coordination client hard-aborts the process (exit -6) — the state
+    worth saving is already in the boundary/periodic checkpoint, so the
+    clean move is to skip interpreter teardown entirely."""
+    import sys
+
+    def _leave(exc):
+        _logger.warning("leaving generation: %s", exc)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(EXIT_HOST_LOST)
+
+    try:
+        return fn()
+    except HostLostError as exc:
+        _leave(exc)
+    except BaseException as exc:
+        # a dead peer usually surfaces FIRST as a wedged collective
+        # blowing a runtime error (gloo context timeout) — before the
+        # loop reaches its next coordinator poll.  If the membership
+        # moved (or the coordinator is gone), this IS a host-lost exit,
+        # not a crash: the launcher should relaunch, resuming from the
+        # last complete checkpoint.
+        try:
+            from . import coordinator as _coord
+
+            client = _coord._default_client
+        except Exception:  # noqa: BLE001 — conversion is best-effort
+            client = None
+        if client is not None and (client.changed() or client._lost):
+            _tm.health.auto_dump("fault")
+            _leave(HostLostError(
+                "collective", rank=client.rank,
+                generation=client.generation,
+                detail=f"runtime error after membership change: {exc!r}"))
+        raise
+
+
+def count_allreduce_bytes(nbytes: int):
+    """Dispatch-side accounting for the collective dist_sync gradient
+    payload (the all-reduce itself is inside the compiled step)."""
+    if _tm.enabled() and nbytes > 0:
+        _TM_ALLREDUCE_BYTES.inc(int(nbytes))

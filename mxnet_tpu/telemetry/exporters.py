@@ -135,11 +135,27 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1",
                 # cardinality) of a full exposition render
                 from . import health as _health
 
-                body = json.dumps({
+                payload = {
                     "status": "ok",
                     "families": len(reg.collect()),
                     "flight_ring_len": len(_health.flight_ring()),
-                }).encode("utf-8")
+                }
+                # cluster-health gauges ride along when their families
+                # exist (ISSUE-13): the dead-worker count the PS /
+                # coordinator tracks, and the elastic generation — the
+                # two numbers an operator probing a sick cluster needs
+                for fam_name, key in (("kvstore_dead_workers",
+                                       "kvstore_dead_workers"),
+                                      ("dist_generation",
+                                       "dist_generation"),
+                                      ("dist_hosts_alive",
+                                       "dist_hosts_alive")):
+                    for fam in reg.collect():
+                        if fam.name == fam_name:
+                            vals = [v for _, v in fam.samples()]
+                            if vals:
+                                payload[key] = max(vals)
+                body = json.dumps(payload).encode("utf-8")
                 ctype = "application/json"
             else:
                 self.send_error(404)

@@ -446,6 +446,28 @@ class FusedTrainer:
         self._eval_fn = jax.jit(eval_step)
 
     # ---------------------------------------------------------------- running
+    def _mesh_spans_hosts(self) -> bool:
+        """True when this trainer's mesh includes another process's
+        devices (the multi-host collective path, docs/multihost.md)."""
+        if self.mesh is None:
+            return False
+        me = jax.process_index()
+        return any(d.process_index != me for d in self.mesh.devices.flat)
+
+    def _place_global(self, raw, sharding):
+        """Place one batch array onto the mesh.  Single-host meshes take
+        the plain transfer; a mesh spanning other processes cannot
+        ``device_put`` a committed local array (non-addressable
+        devices), so each process contributes its ADDRESSABLE shards of
+        the replicated global batch via make_array_from_callback — the
+        canonical multi-host feed (every host constructs the same
+        global batch; XLA sees one sharded array)."""
+        if not self._mesh_spans_hosts():
+            return jax.device_put(raw, sharding)
+        host = np.asarray(raw)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx, _h=host: _h[idx])
+
     def _shard_batch(self, batch):
         out = {}
         for k, v in batch.items():
@@ -456,8 +478,8 @@ class FusedTrainer:
             else:
                 raw = jnp.asarray(np.asarray(v))
             if self.mesh is not None:
-                out[k] = jax.device_put(
-                    raw, NamedSharding(self.mesh, P("data", *([None] * (raw.ndim - 1)))))
+                out[k] = self._place_global(raw, NamedSharding(
+                    self.mesh, P("data", *([None] * (raw.ndim - 1)))))
             else:
                 out[k] = raw
         return out
@@ -573,7 +595,8 @@ class FusedTrainer:
                 if self.mesh is not None:
                     sh = NamedSharding(self.mesh, P(
                         "data", *([None] * (sb[k_][0].ndim - 1))))
-                    sb[k_] = tuple(jax.device_put(e, sh) for e in sb[k_])
+                    sb[k_] = tuple(self._place_global(e, sh)
+                                   for e in sb[k_])
                 continue
             if isinstance(v, NDArray):
                 raw = v._read()
@@ -585,7 +608,7 @@ class FusedTrainer:
                 raw = jnp.asarray(np.asarray(v))
             if self.mesh is not None:
                 # axis 0 is steps — the data-parallel shard axis is 1
-                sb[k_] = jax.device_put(raw, NamedSharding(
+                sb[k_] = self._place_global(raw, NamedSharding(
                     self.mesh, P(None, "data", *([None] * (raw.ndim - 2)))))
             else:
                 sb[k_] = raw
@@ -770,7 +793,11 @@ class FusedTrainer:
                   start_epoch=0, resume_nbatch=-1):
         from . import checkpoint as _ckpt
         from .module.base_module import BatchEndParam, _as_list
+        from .parallel import coordinator as _coordinator
 
+        # elastic membership (docs/multihost.md): armed by
+        # MXTPU_COORD_ADDR; step_poll is a pure host-side flag check
+        coord = _coordinator.client_from_env()
         flight = _tm.health.flight_enabled()
         for epoch in range(start_epoch, num_epoch):
             tic = _time.time()
@@ -801,6 +828,18 @@ class FusedTrainer:
                         nbatch=nbatch, depth=len(window),
                         dispatch_s=_time.perf_counter() - t0,
                         program=f"fused_step[{self.symbol.name or 'graph'}]")
+                if coord is not None and coord.step_poll():
+                    # membership changed: boundary checkpoint, then the
+                    # named exit — the next generation resumes on the
+                    # surviving mesh (re-bind via the checkpoint
+                    # re-shard contract)
+                    w = None
+                    if mgr is not None:
+                        w = self.save_state(mgr, epoch=epoch,
+                                            nbatch=nbatch,
+                                            background=False)
+                    coord.raise_generation_changed(
+                        getattr(w, "path", None))
                 if mgr is not None:
                     if mgr.preempted:
                         # window boundary under preemption: capture is
@@ -846,6 +885,51 @@ class FusedTrainer:
                 window.drain()
                 for name, val in vm.get_global_name_value():
                     log.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+        return self
+
+    def rebind_mesh(self, mesh: Optional[Mesh]):
+        """Re-bind the step loop onto a new mesh shape (elastic shrink/
+        grow — ISSUE 13): re-place params, aux, and optimizer state on
+        the new mesh (XLA re-shards; the flat sharded kvstore state
+        follows the same ``sync_shard_state`` contract on its next plan
+        build) and recompile the step programs.  ``None`` collapses to
+        single-device.  Training state is carried, not reset — step
+        counter, RNG stream, and schedules continue; this is the
+        in-process half of the generation restart (a restarted process
+        gets the same effect from init() + restore_state())."""
+        if not self.params:
+            self.mesh = mesh
+            return self
+        self.mesh = mesh
+        if mesh is not None:
+            try:
+                self._platform = next(iter(mesh.devices.flat)).platform
+            except Exception:  # noqa: BLE001
+                pass
+            from .parallel.mesh import shard_params
+
+            self.params = shard_params(mesh, self.params,
+                                       self._sharding_rules)
+            repl = NamedSharding(mesh, P())
+            self.aux = {k: jax.device_put(v, repl)
+                        for k, v in self.aux.items()}
+            self.opt_state = {
+                k: tuple(jax.device_put(s, self.params[k].sharding)
+                         if s.ndim else jax.device_put(s, repl)
+                         for s in v)
+                for k, v in self.opt_state.items()}
+        else:
+            # collapse to the default device: host round-trip is the
+            # portable way off an arbitrary sharding layout
+            self.params = {k: jnp.asarray(np.asarray(v))
+                           for k, v in self.params.items()}
+            self.aux = {k: jnp.asarray(np.asarray(v))
+                        for k, v in self.aux.items()}
+            self.opt_state = {k: tuple(jnp.asarray(np.asarray(s))
+                                       for s in v)
+                              for k, v in self.opt_state.items()}
+        self._refresh_compute_cache()
+        self._build_step()
         return self
 
     # ------------------------------------------------------- survival layer

@@ -1,0 +1,240 @@
+"""Coordinator service + elastic control-plane tests (ISSUE 13).
+
+The membership authority must detect death by lease expiry, publish
+generation epochs, survive injected heartbeat loss, and never let a
+blocking site hang — all provable in-process with short leases.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import dist
+from mxnet_tpu.parallel.coordinator import (CoordinatorClient,
+                                            CoordinatorService)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def service():
+    svc = CoordinatorService(port=0, lease_s=0.5).start()
+    yield svc
+    svc.stop()
+
+
+def test_join_heartbeat_cluster_roundtrip(service):
+    c0 = CoordinatorClient(service.address, member="h0", rank=0)
+    c1 = CoordinatorClient(service.address, member="h1", rank=1)
+    try:
+        status = c0.cluster()
+        assert status["generation"] == 0
+        assert status["hosts_alive"] == 2
+        assert set(status["members"]) == {"h0", "h1"}
+        assert status["members"]["h1"]["rank"] == 1
+        assert not c0.step_poll() and not c1.step_poll()
+        # /cluster is also plain HTTP for operators
+        with urllib.request.urlopen(
+                f"http://{service.address}/cluster", timeout=5) as resp:
+            raw = json.loads(resp.read())
+        assert raw["hosts_alive"] == 2
+    finally:
+        c0.stop()
+        c1.stop()
+
+
+def test_lease_expiry_declares_death_and_bumps_generation(service):
+    tm.enable()
+    c0 = CoordinatorClient(service.address, member="h0", rank=0)
+    c1 = CoordinatorClient(service.address, member="h1", rank=1)
+    try:
+        c1.stop()  # heartbeats stop; the lease decays
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not c0.changed():
+            time.sleep(0.1)
+        assert c0.changed(), "survivor never saw the generation bump"
+        status = c0.cluster()
+        assert status["generation"] == 1
+        assert status["hosts_alive"] == 1
+        assert [d["member"] for d in status["dead"]] == ["h1"]
+        # the named boundary error carries generation + guidance
+        with pytest.raises(dist.GenerationChanged) as ei:
+            c0.raise_generation_changed("/tmp/ck-42")
+        assert ei.value.generation == 1
+        assert "ck-42" in str(ei.value)
+        assert isinstance(ei.value, dist.HostLostError)
+    finally:
+        c0.stop()
+
+
+def test_generation_bump_under_heartbeat_fault_injection(service, monkeypatch):
+    """ISSUE-13 satellite: coord_heartbeat drops starve the lease and
+    the coordinator publishes the next generation — the chaos path the
+    elastic runtime depends on, driven by MXTPU_FAULT_PLAN alone.
+    (The plan drops EVERY heartbeat in this process, so the assertion
+    reads the service side: death record, bump, counter.)"""
+    from mxnet_tpu import faults
+
+    tm.enable()
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "coord_heartbeat:drop:1")
+    faults.reset()
+    try:
+        c1 = CoordinatorClient(service.address, member="h1", rank=1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and service.generation == 0:
+            time.sleep(0.1)
+        assert service.generation == 1, \
+            "dropped heartbeats never expired the lease"
+        status = service.cluster()
+        assert [d["member"] for d in status["dead"]] == ["h1"]
+        assert status["hosts_alive"] == 0
+    finally:
+        monkeypatch.delenv("MXTPU_FAULT_PLAN")
+        faults.reset()
+        c1.stop()
+
+
+def test_standby_rejoin_announcement_bumps(service):
+    c0 = CoordinatorClient(service.address, member="h0", rank=0)
+    try:
+        gen0 = service.generation
+        rejoiner = CoordinatorClient(service.address, member="h1-reborn",
+                                     rank=1, standby=True)
+        status = c0.cluster()
+        assert status["generation"] == gen0 + 1
+        assert status["standby"] == ["h1-reborn"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not c0.changed():
+            time.sleep(0.05)
+        assert c0.changed()
+        rejoiner.stop()
+    finally:
+        c0.stop()
+
+
+def test_clean_leave_bumps_only_with_survivors(service):
+    c0 = CoordinatorClient(service.address, member="h0", rank=0)
+    c1 = CoordinatorClient(service.address, member="h1", rank=1)
+    gen0 = service.generation
+    c1.leave()
+    assert service.generation == gen0 + 1  # survivors must react
+    c0.leave()
+    assert service.generation == gen0 + 1  # empty cluster: nobody to tell
+
+
+def test_host_crash_fault_site_fires_from_step_poll(service, monkeypatch):
+    from mxnet_tpu import faults
+
+    c0 = CoordinatorClient(service.address, member="h0", rank=0)
+    monkeypatch.setenv("MXTPU_FAULT_PLAN", "host_crash:err:1")
+    faults.reset()
+    try:
+        with pytest.raises(faults.InjectedFault, match="host_crash"):
+            c0.step_poll()
+    finally:
+        monkeypatch.delenv("MXTPU_FAULT_PLAN")
+        faults.reset()
+        c0.stop()
+
+
+def test_unreachable_coordinator_is_named_not_hung():
+    """No surviving-worker hang path: every coordinator RPC carries a
+    socket timeout and a dead coordinator surfaces as HostLostError
+    naming the address — never a park."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here
+    t0 = time.monotonic()
+    with pytest.raises(dist.HostLostError) as ei:
+        CoordinatorClient(f"127.0.0.1:{port}", member="h0", rank=0)
+    assert time.monotonic() - t0 < 30
+    assert ei.value.site == "coordinator"
+    assert f"127.0.0.1:{port}" == ei.value.host
+
+
+def test_healthz_surfaces_cluster_gauges(service):
+    """ISSUE-13 satellite: /healthz answers with the dead-worker count
+    and the elastic generation without a full exposition render."""
+    tm.enable()
+    kv = mx.kv.create("dist_sync")          # collective, no coordinator
+    assert kv.get_num_dead_node(0) == 0     # sets kvstore_dead_workers
+    srv = tm.start_http_server(0)
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+            payload = json.loads(resp.read())
+        assert payload["status"] == "ok"
+        assert payload["kvstore_dead_workers"] == 0
+        # the coordinator service in this process set the generation
+        assert "dist_generation" in payload
+        assert "dist_hosts_alive" in payload
+    finally:
+        srv.shutdown()
+
+
+def test_maybe_start_from_env(monkeypatch):
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv("MXTPU_COORD_PORT", str(port))
+    monkeypatch.setenv("MXTPU_RANK", "1")
+    from mxnet_tpu.parallel import coordinator
+
+    assert coordinator.maybe_start_from_env() is None  # rank 1 never hosts
+    monkeypatch.setenv("MXTPU_RANK", "0")
+    svc = coordinator.maybe_start_from_env()
+    try:
+        assert svc is not None and svc.port == port
+    finally:
+        svc.stop()
+
+
+WEDGE_WATCHDOG = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MXTPU_DIST_BARRIER_TIMEOUT_S"] = "0.5"
+    os.environ["MXTPU_COORD_LEASE_S"] = "0.4"
+    from mxnet_tpu.parallel.coordinator import (CoordinatorClient,
+                                                CoordinatorService)
+    svc = CoordinatorService(port=0, lease_s=0.4).start()
+    me = CoordinatorClient(svc.address, member="h0", rank=0)
+    other = CoordinatorClient(svc.address, member="h1", rank=1)
+    me.step_poll()            # the loop is live
+    other.stop()              # peer dies; lease decays; generation bumps
+    print("wedging", flush=True)
+    time.sleep(30)            # simulated wedged collective: never polls again
+    print("WATCHDOG FAILED TO FIRE", flush=True)
+    sys.exit(7)
+""")
+
+
+def test_wedge_watchdog_exits_host_lost():
+    """A worker wedged inside a dead collective can never reach its
+    next poll: the heartbeat thread must exit EXIT_HOST_LOST within the
+    barrier timeout so the elastic launcher can relaunch — the one exit
+    jax.distributed leaves open (docs/multihost.md no-hang contract)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, "-c", WEDGE_WATCHDOG], env=env,
+                          timeout=120, capture_output=True, text=True)
+    assert proc.returncode == dist.EXIT_HOST_LOST, (
+        proc.returncode, proc.stdout, proc.stderr)
+    assert "WATCHDOG FAILED" not in proc.stdout
+    assert time.monotonic() - t0 < 60

@@ -404,6 +404,8 @@ def test_metric_catalog_matches_registered_families():
         "import mxnet_tpu.mp_io\n"
         "import mxnet_tpu.module.base_module\n"
         "import mxnet_tpu.serving\n"
+        "import mxnet_tpu.parallel.dist\n"
+        "import mxnet_tpu.parallel.coordinator\n"
         "for f in mxnet_tpu.telemetry.get_registry().collect():\n"
         "    print(f.name)\n")
     res = subprocess.run([sys.executable, "-c", code], capture_output=True,

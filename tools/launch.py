@@ -11,15 +11,27 @@ Launchers:
 - ``local``  (default): N workers + S servers as subprocesses on this
   host — the mode the reference's nightly dist tests use
   (tests/nightly/test_all.sh:37 ``launch.py -n 4 --launcher local``).
+  ``--max-restarts R`` auto-restarts a crashed worker up to R times
+  with ``MXTPU_KV_RECOVERY=1`` (the kvstore_dist.h:35-39 recovery
+  contract: skip re-init/re-barrier, the servers still hold the model),
+  logging the rank and exit code of every death.
 - ``ssh``: one process per host from ``-H hostfile`` (round-robin),
-  sharing the same env contract over ``ssh -q``.  Limitation: server
-  ports are probed on the launcher, not the remote hosts — pick hosts
-  with those ports free (a bind failure surfaces as workers timing out
-  after their 120s connect-retry window).
-Other reference launchers (mpi/sge/yarn) map to cluster schedulers that
-do not exist for TPU pods — there, use ``--launcher pod`` which simply
-execs the command once per host under `jax.distributed` coordinates
-(GKE/xmanager-style schedulers start one process per host already).
+  sharing the same env contract over ``ssh -q``.  Server ports are
+  probed ON the remote host that will bind them (a port free on the
+  launcher is not necessarily free there — the old launcher-side probe
+  surfaced remote bind failures as workers timing out 120s later).
+- ``pod``: one-process-per-host schedulers (TPU pods) — exec the
+  command once with worker env; jax.distributed coordinates
+  (parallel/dist.py).
+- ``elastic`` (docs/multihost.md): the collective dist_sync mode with
+  generation-epoch fault tolerance.  The launcher runs the membership
+  coordinator (mxnet_tpu.parallel.coordinator) and relaunches the
+  training world one **generation** at a time: a worker death shrinks
+  the next generation to the survivors (who left at a checkpoint
+  boundary with exit code 43 — EXIT_HOST_LOST), a crashed rank with
+  restart budget rejoins at a later generation and the world
+  re-expands.  Workers resume from the survival-layer checkpoint
+  (MXTPU_CKPT_DIR) and re-bind on the new mesh shape.
 
 On TPU pods the sync data-parallel path needs NO server processes
 (gradients ride ICI/DCN collectives inside the step); ``-s`` is for the
@@ -28,11 +40,23 @@ parameter-server semantics (dist_async / server-side optimizer).
 from __future__ import annotations
 
 import argparse
+import json
+import logging
 import os
 import signal
 import socket
 import subprocess
 import sys
+import time
+import urllib.request
+
+# keep in sync with mxnet_tpu.parallel.dist.EXIT_HOST_LOST (this script
+# must stay importable without the package on the PYTHONPATH)
+EXIT_HOST_LOST = 43
+
+logging.basicConfig(level=logging.INFO,
+                    format="%(asctime)s launch.py %(message)s")
+_log = logging.getLogger("launch")
 
 
 def _free_ports(n):
@@ -45,6 +69,31 @@ def _free_ports(n):
     for s in socks:
         s.close()
     return ports
+
+
+_REMOTE_PROBE = (
+    "import socket\n"
+    "ss=[socket.socket() for _ in range({n})]\n"
+    "[s.bind(('0.0.0.0',0)) for s in ss]\n"
+    "print(','.join(str(s.getsockname()[1]) for s in ss))\n"
+    "[s.close() for s in ss]\n"
+)
+
+
+def _remote_free_ports(host, n):
+    """Probe ``n`` free ports ON ``host`` (the machine that will bind
+    them) — a launcher-side probe only proves the port is free HERE."""
+    if n <= 0:
+        return []
+    out = subprocess.run(
+        ["ssh", "-q", "-o", "StrictHostKeyChecking=no", host,
+         f"python3 -c \"{_REMOTE_PROBE.format(n=n)}\""],
+        capture_output=True, text=True, timeout=60)
+    if out.returncode != 0:
+        raise SystemExit(
+            f"port probe on {host} failed (rc={out.returncode}): "
+            f"{out.stderr.strip()[:500]}")
+    return [int(p) for p in out.stdout.strip().split(",")]
 
 
 def _role_env(base, role, rank, args, servers):
@@ -74,16 +123,40 @@ def launch_local(args, command):
         for i in range(args.num_servers):
             procs.append(subprocess.Popen(
                 command, env=_role_env(os.environ, "server", i, args, servers)))
-        workers = []
+        workers = {}   # rank -> Popen
+        restarts = {i: args.max_restarts for i in range(args.num_workers)}
         for i in range(args.num_workers):
-            p = subprocess.Popen(
+            workers[i] = subprocess.Popen(
                 command, env=_role_env(os.environ, "worker", i, args, servers))
-            procs.append(p)
-            workers.append(p)
         rc = 0
-        for p in workers:
-            rc = p.wait() or rc
-        for p in procs:
+        pending = set(workers)
+        while pending:
+            time.sleep(0.2)
+            for rank in sorted(pending):
+                p = workers[rank]
+                wrc = p.poll()
+                if wrc is None:
+                    continue
+                if wrc != 0 and restarts.get(rank, 0) > 0:
+                    restarts[rank] -= 1
+                    _log.warning(
+                        "worker %d exited with code %d; restarting with "
+                        "MXTPU_KV_RECOVERY=1 (%d restart(s) left)",
+                        rank, wrc, restarts[rank])
+                    env = _role_env(os.environ, "worker", rank, args,
+                                    servers)
+                    # the recovery contract (kvstore_dist.h:35-39): the
+                    # servers still hold the model; the restarted worker
+                    # must not re-init keys or wait on long-gone barriers
+                    env["MXTPU_KV_RECOVERY"] = "1"
+                    workers[rank] = subprocess.Popen(command, env=env)
+                    continue
+                if wrc != 0:
+                    _log.error("worker %d exited with code %d "
+                               "(no restarts left)", rank, wrc)
+                rc = wrc or rc
+                pending.discard(rank)
+        for p in list(workers.values()) + procs:
             if p.poll() is None:
                 try:
                     p.wait(timeout=30)
@@ -91,7 +164,7 @@ def launch_local(args, command):
                     p.kill()
         return rc
     except BaseException:
-        for p in procs:
+        for p in list(procs) + [w for w in locals().get("workers", {}).values()]:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
         raise
@@ -102,9 +175,17 @@ def launch_ssh(args, command):
         raise SystemExit("--launcher ssh requires -H/--hostfile")
     with open(args.hostfile) as f:
         hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
-    ports = _free_ports(args.num_servers)
-    # servers round-robin over hosts; workers likewise
-    servers = [f"{hosts[i % len(hosts)]}:{ports[i]}" for i in range(args.num_servers)]
+    # probe server ports on the host that will BIND them: round-robin
+    # the server ranks over hosts first, then ask each host for as many
+    # free ports as it will run servers
+    server_hosts = [hosts[i % len(hosts)] for i in range(args.num_servers)]
+    per_host = {}
+    for h in server_hosts:
+        per_host[h] = per_host.get(h, 0) + 1
+    host_ports = {h: _remote_free_ports(h, n) for h, n in per_host.items()}
+    servers = []
+    for h in server_hosts:
+        servers.append(f"{h}:{host_ports[h].pop(0)}")
     procs = []
     cmd_str = " ".join(command)
 
@@ -116,7 +197,7 @@ def launch_ssh(args, command):
              f"cd {os.getcwd()} && env {env_str} {cmd_str}"])
 
     for i in range(args.num_servers):
-        procs.append(remote(hosts[i % len(hosts)],
+        procs.append(remote(server_hosts[i],
                             _role_env({}, "server", i, args, servers)))
     rc = 0
     workers = []
@@ -140,13 +221,248 @@ def launch_pod(args, command):
     os.execvpe(command[0], command, env)
 
 
+# --------------------------------------------------------------- elastic
+def _coord_post(addr, path, payload):
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_coordinator(addr, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(f"http://{addr}/healthz",
+                                        timeout=2) as resp:
+                json.loads(resp.read())
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise SystemExit(f"coordinator on {addr} never came up")
+
+
+def _cluster_progress(addr, n_members):
+    """min batches-trained across the current world per /cluster, or
+    None until every member has joined and reported progress."""
+    try:
+        with urllib.request.urlopen(f"http://{addr}/cluster",
+                                    timeout=5) as resp:
+            status = json.loads(resp.read())
+    except OSError:
+        return None
+    members = status.get("members", {})
+    if len(members) < n_members:
+        return None
+    return min(m.get("progress", 0) for m in members.values())
+
+
+def launch_elastic(args, command):
+    """Generation-at-a-time supervisor for collective dist_sync
+    (docs/multihost.md lifecycle): membership lives in the coordinator,
+    compute worlds are immutable per generation, and every membership
+    change is a relaunch of the surviving (or re-expanded) world that
+    resumes from the survival-layer checkpoint."""
+    coord_port = _free_ports(1)[0]
+    coord_addr = f"127.0.0.1:{coord_port}"
+    coord_env = dict(os.environ)
+    coord_env.setdefault("JAX_PLATFORMS", "cpu")  # detector needs no chips
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.parallel.coordinator",
+         "--port", str(coord_port)], env=coord_env)
+    try:
+        _wait_coordinator(coord_addr)
+        generation = 0
+        # stable member slots: env/restart budget follows the SLOT, the
+        # per-generation rank is its index in the current world
+        world = list(range(args.num_workers))
+        restarts = {i: args.max_restarts for i in range(args.num_workers)}
+        rejoin_after = []     # slots relaunching into a later generation
+        announced = set()
+        fabric_retries = args.fabric_retries
+        while world:
+            jax_port = _free_ports(1)[0]
+            _log.info("generation %d: world=%s (jax coordinator :%d)",
+                      generation, world, jax_port)
+            # sync the membership authority to THIS generation and clear
+            # stale leases — a dead incarnation expiring mid-generation
+            # must not push the fresh world out
+            _coord_post(coord_addr, "/advance",
+                        {"generation": generation})
+            procs = {}
+            for rank, slot in enumerate(world):
+                env = dict(os.environ)
+                env.update({
+                    "MXTPU_ROLE": "worker",
+                    "MXTPU_RANK": str(rank),
+                    "DMLC_RANK": str(rank),
+                    "MXTPU_NUM_WORKERS": str(len(world)),
+                    "DMLC_NUM_WORKER": str(len(world)),
+                    "MXTPU_COORDINATOR": f"127.0.0.1:{jax_port}",
+                    "MXTPU_COORD_ADDR": coord_addr,
+                    "MXTPU_DIST_GENERATION": str(generation),
+                    "MXTPU_ELASTIC_SLOT": str(slot),
+                })
+                if generation > 0:
+                    env["MXTPU_KV_RECOVERY"] = "1"
+                procs[slot] = subprocess.Popen(command, env=env)
+            # a standby announcement mid-generation tells the running
+            # workers (via the generation bump) to leave at their next
+            # boundary so the world can re-expand — gated on the shrunk
+            # world having made REAL progress (every member trained
+            # >= --rejoin-progress batches per its heartbeat reports),
+            # so a rejoin never preempts a world still booting
+            rcs = {}
+            deadline = None
+            last_probe = 0.0
+            while len(rcs) < len(procs):
+                time.sleep(0.2)
+                now = time.monotonic()
+                if (rejoin_after and announced != set(rejoin_after)
+                        and now - last_probe > 0.5):
+                    last_probe = now
+                    progress = _cluster_progress(coord_addr, len(world))
+                    if progress is not None \
+                            and progress >= args.rejoin_progress:
+                        for slot in rejoin_after:
+                            if slot not in announced:
+                                _coord_post(coord_addr, "/join",
+                                            {"member": f"slot{slot}",
+                                             "standby": True})
+                                announced.add(slot)
+                                _log.info("announced rejoin of slot %d "
+                                          "(next generation)", slot)
+                for slot, p in procs.items():
+                    if slot in rcs:
+                        continue
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    rcs[slot] = rc
+                    if rc == 0:
+                        _log.info("slot %d finished (generation %d)",
+                                  slot, generation)
+                    elif rc == EXIT_HOST_LOST:
+                        _log.info("slot %d left generation %d at a "
+                                  "checkpoint boundary (exit %d)",
+                                  slot, generation, rc)
+                    else:
+                        _log.warning("slot %d crashed with exit code %d "
+                                     "in generation %d", slot, rc,
+                                     generation)
+                    if deadline is None and rc != 0:
+                        # once one member is gone the rest must follow
+                        # (watchdog-bounded); give them that long, then
+                        # reap stragglers
+                        deadline = now + args.exit_grace
+                if deadline is not None and now > deadline:
+                    for slot, p in procs.items():
+                        if slot not in rcs:
+                            _log.warning("slot %d still running past the "
+                                         "exit grace; killing", slot)
+                            p.kill()
+            if all(rc == 0 for rc in rcs.values()):
+                return 0
+            survivors = [s for s in world if rcs[s] == EXIT_HOST_LOST]
+            crashed = [s for s in world if rcs[s] not in (0, EXIT_HOST_LOST)]
+            finished = [s for s in world if rcs[s] == 0]
+            # collateral classification: once one member really dies
+            # (or leaves), the shared collective fabric hard-aborts the
+            # others (gloo std::terminate -> SIGABRT) faster than they
+            # can reach their checkpoint boundary.  A SIGABRT next to
+            # any OTHER outcome is collateral: the slot continues as a
+            # survivor (resuming from its last periodic checkpoint) and
+            # pays no restart budget.  A generation where EVERY member
+            # aborts is a fabric failure (transient collective-runtime
+            # breakage, no member at fault): relaunch the same world,
+            # budget untouched, bounded by --fabric-retries.
+            aborted = [s for s in crashed if rcs[s] == -signal.SIGABRT]
+            primary = [s for s in crashed if rcs[s] != -signal.SIGABRT]
+            if aborted and (primary or survivors or finished):
+                for slot in aborted:
+                    _log.info(
+                        "slot %d (SIGABRT) is collateral of the "
+                        "generation-%d failure; rejoining as a survivor",
+                        slot, generation)
+                survivors += aborted
+                crashed = primary
+            elif aborted and not (primary or survivors or finished):
+                if fabric_retries <= 0:
+                    _log.error("generation %d: collective fabric failed "
+                               "and no fabric retries left", generation)
+                    return 1
+                fabric_retries -= 1
+                generation += 1
+                _log.warning(
+                    "generation %d: every member aborted (collective "
+                    "fabric failure); relaunching world unchanged as "
+                    "generation %d (%d fabric retries left)",
+                    generation - 1, generation, fabric_retries)
+                continue
+            next_world = sorted(survivors + rejoin_after)
+            rejoin_after = []
+            announced.clear()
+            for slot in crashed:
+                if restarts[slot] > 0:
+                    restarts[slot] -= 1
+                    rejoin_after.append(slot)
+                    _log.warning(
+                        "slot %d (exit %d) rejoins at a later generation "
+                        "(%d restart(s) left)", slot, rcs[slot],
+                        restarts[slot])
+                else:
+                    _log.error("slot %d (exit %d) has no restarts left; "
+                               "world shrinks permanently", slot,
+                               rcs[slot])
+            if not next_world and rejoin_after:
+                # everyone died but restart budget remains: the next
+                # generation IS the rejoiners
+                next_world = sorted(rejoin_after)
+                rejoin_after = []
+            if finished and next_world:
+                # some members finished while others still want a
+                # generation (e.g. a collateral abort near the end):
+                # relaunch only the unfinished — they resume from the
+                # checkpoint and complete the same schedule
+                _log.warning("generation %d: slots %s finished; "
+                             "relaunching %s to complete", generation,
+                             finished, next_world)
+            generation += 1
+            world = next_world
+        _log.error("no members left with restart budget; giving up")
+        return 1
+    finally:
+        coord.terminate()
+        try:
+            coord.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            coord.kill()
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=0)
     ap.add_argument("--launcher", default="local",
-                    choices=["local", "ssh", "pod"])
+                    choices=["local", "ssh", "pod", "elastic"])
     ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="restart a crashed worker up to N times "
+                         "(MXTPU_KV_RECOVERY=1 / elastic rejoin)")
+    ap.add_argument("--rejoin-progress", type=int, default=3,
+                    help="elastic: batches every member of the shrunk "
+                         "generation must have trained (per heartbeat "
+                         "progress reports) before a restarted slot "
+                         "announces its rejoin")
+    ap.add_argument("--exit-grace", type=float, default=90.0,
+                    help="elastic: seconds the remaining members of a "
+                         "broken generation get to reach their "
+                         "checkpoint boundary before being reaped")
+    ap.add_argument("--fabric-retries", type=int, default=3,
+                    help="elastic: relaunches granted (budget-free) "
+                         "when a whole generation dies to a collective-"
+                         "fabric abort rather than a member crash")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     command = [c for c in args.command if c != "--"]
@@ -156,6 +472,8 @@ def main():
         sys.exit(launch_local(args, command))
     elif args.launcher == "ssh":
         sys.exit(launch_ssh(args, command))
+    elif args.launcher == "elastic":
+        sys.exit(launch_elastic(args, command))
     else:
         launch_pod(args, command)
 
