@@ -81,9 +81,40 @@ def _fallback_streak():
     return streak
 
 
+def _bench_trend_check(current_fallback=None):
+    """Run the committed-trajectory regression sentinel
+    (tools/bench_trend.py) and surface its table on stderr; returns its
+    exit code (0 clean, 1 regression/fallback, negative = the sentinel
+    itself failed).  ``current_fallback`` marks the round being captured
+    RIGHT NOW as an artifact fallback, so a non-live round is loud in
+    its own log instead of a footnote discovered rounds later."""
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_trend.py")
+    cmd = [sys.executable, script]
+    if current_fallback:
+        cmd += ["--current-fallback", str(current_fallback)[:200]]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=120)
+        text = (r.stdout or "") + (r.stderr or "")
+        if text.strip():
+            print("[bench_trend] " + text.strip().replace(
+                "\n", "\n[bench_trend] "), file=sys.stderr, flush=True)
+        return r.returncode
+    except Exception as exc:  # noqa: BLE001 — the sentinel must not kill the bench
+        print("[bench_trend] sentinel failed: %r" % (exc,),
+              file=sys.stderr, flush=True)
+        return -1
+
+
 def _fail(msg, metric="resnet50_train_imgs_per_sec_per_chip"):
     payload = {"metric": metric, "value": 0.0, "unit": "img/s",
                "vs_baseline": 0.0, "error": msg}
+    # regression sentinel, loud-on-fallback: the failing round reports
+    # the committed trajectory AND its own non-liveness on stderr
+    payload["bench_trend_rc"] = _bench_trend_check(current_fallback=msg)
     if "backend init" in msg:
         streak = _fallback_streak()
         payload["fallback_streak"] = streak
@@ -938,6 +969,104 @@ def _dist_micro():
     return out
 
 
+def _fleet_micro():
+    """Fleet observability micro (round 18, docs/multihost.md): the
+    coordinator-side federation + straggler plane on an in-process
+    2-member rig — fleet_scrape_ms (one /metrics.json federation sweep
+    over both members' HTTP endpoints), straggler_detect_ms (first
+    inflated heartbeat to the coordinator naming the slow host), and
+    merge_trace_ms (two synthetic per-host flight dumps folded into one
+    chrome trace by tools/fleetstat.py merge-trace)."""
+    import importlib.util
+    import tempfile
+
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu.parallel.coordinator import CoordinatorService
+
+    out = {}
+    was_enabled = tm.enabled()
+    tm.enable()
+    servers = []
+    svc = None
+    try:
+        # two per-"host" registries behind real HTTP = a 2-member fleet
+        # in one process (the same shape a pod runs, minus the DCN)
+        for i in range(2):
+            reg = tm.Registry()
+            reg.get_or_create(tm.Counter, "trainer_samples_total",
+                              "samples", ("loop",)).inc(64 * (i + 1),
+                                                        loop="fused")
+            servers.append(tm.start_http_server(0, registry=reg))
+        svc = CoordinatorService(port=0, lease_s=1.0).start()
+        for i, srv in enumerate(servers):
+            svc.join("h%d" % i, host="h%d" % i, rank=i,
+                     telemetry_addr="127.0.0.1:%d"
+                                    % srv.server_address[1])
+        tic = time.perf_counter()
+        snap = svc.scraper.scrape_once()
+        out["fleet_scrape_ms"] = round(
+            (time.perf_counter() - tic) * 1e3, 2)
+        if not all(s.get("ok") for s in snap.values()):
+            out["fleet_scrape_error"] = "scrape failed: %r" % (snap,)
+
+        # injected slow host: h1's heartbeats carry a 10x step wall;
+        # measure first slow report -> the coordinator naming it
+        tic = time.perf_counter()
+        named = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            svc.heartbeat("h0", steps={"count": 32, "step_wall_s": 0.01,
+                                       "dispatch_s": 0.002})
+            svc.heartbeat("h1", steps={"count": 32, "step_wall_s": 0.10,
+                                       "dispatch_s": 0.002})
+            named = svc.cluster().get("straggler")
+            if named:
+                break
+            time.sleep(0.05)
+        if named and named.get("member") == "h1":
+            out["straggler_detect_ms"] = round(
+                (time.perf_counter() - tic) * 1e3, 1)
+        else:
+            out["straggler_error"] = "straggler never flagged: %r" % (
+                named,)
+    finally:
+        for srv in servers:
+            srv.shutdown()
+        if svc is not None:
+            svc.stop()
+        if not was_enabled:
+            tm.disable()
+
+    # merge-trace over synthetic two-host dumps (h1's clock runs 2.5s
+    # behind, its dump carries the matching offset estimate)
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_fleetstat",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "fleetstat.py"))
+    fleetstat = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleetstat)
+    with tempfile.TemporaryDirectory() as d:
+        paths = []
+        for i in range(2):
+            skew = 0.0 if i == 0 else -2.5
+            ring = [{"seq": s, "step": s, "loop": "fused",
+                     "t": 1000.0 + 0.01 * s + skew,
+                     "wall_s": 0.01, "dispatch_s": 0.004}
+                    for s in range(256)]
+            dump = {"version": 2, "ring": ring,
+                    "identity": {"host": "h%d" % i, "rank": i,
+                                 "generation": 0,
+                                 "clock": {"offset_s": -skew}}}
+            p = os.path.join(d, "flight_h%d.json" % i)
+            with open(p, "w") as f:
+                json.dump(dump, f)
+            paths.append(p)
+        tic = time.perf_counter()
+        fleetstat.merge_trace(paths, os.path.join(d, "trace.json"))
+        out["merge_trace_ms"] = round((time.perf_counter() - tic) * 1e3, 2)
+    return out
+
+
 def _serve_micro():
     """Serving micro-bench (round 10): the continuous-batching decode
     scheduler (mxnet_tpu/serving/) under a synthetic Poisson arrival
@@ -1718,6 +1847,14 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))
         try:
+            # fleet observability plane: federation scrape, straggler
+            # detection latency, merge-trace cost (ISSUE 14)
+            if os.environ.get("BENCH_FLEET", "1") == "1":
+                for k_, v_ in _fleet_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
             # serving hot path: continuous-batching scheduler under a
             # Poisson arrival load — served tok/s, TTFT tail, slot
             # occupancy (ISSUE 6)
@@ -1895,6 +2032,10 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
             extras["lm_mfu_error"] = repr(exc)  # the headline must not
             #                                     vanish behind an earlier
             #                                     block's unrelated error
+        # postamble: the regression sentinel judges the COMMITTED
+        # trajectory (this round's numbers land in it next commit); its
+        # table goes to stderr, its verdict rides the payload
+        extras["bench_trend_rc"] = _bench_trend_check()
         if not claim():
             return 0  # the watchdog already emitted the primary payload
         payload.update(extras)

@@ -24,6 +24,12 @@ ENTRY_POINTS = (
     # elastic membership poll: runs every batch inside the fit loops —
     # must stay pure host-side flag reads (ISSUE 13)
     "mxnet_tpu.parallel.coordinator.CoordinatorClient.step_poll",
+    # fleet plane steady-state loops (ISSUE 14): the heartbeat carries
+    # the flight-ring step-timing feed, the coordinator's federation
+    # sweep scrapes member /metrics.json — both must stay pure
+    # host-side (HTTP + ring reads), never touching the device
+    "mxnet_tpu.parallel.coordinator.CoordinatorClient._heartbeat_loop",
+    "mxnet_tpu.telemetry.fleet.FleetScraper.scrape_once",
 )
 
 # Sanctioned sync boundaries: the analyzer does not descend into these.

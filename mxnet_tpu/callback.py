@@ -34,6 +34,15 @@ _TM_SPEED_SAMPLES = _tm.counter(
     "samples covered by completed Speedometer windows")
 
 
+def _log_prefix() -> str:
+    """``[rank/size@generation]`` on multi-host runs: N workers'
+    Speedometer lines interleave in the elastic launcher's output and
+    must stay attributable (parallel.dist.log_prefix)."""
+    from .parallel import dist as _dist
+
+    return _dist.log_prefix()
+
+
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     """Epoch-end checkpoint callback bound to a Module.
 
@@ -138,8 +147,9 @@ class Speedometer:
                 parts = "".join(
                     "\tTrain-%s=%f" % nv
                     for nv in metric.get_name_value())
-                logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
-                             param.epoch, param.nbatch, speed, parts)
+                logging.info(
+                    "%sEpoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                    _log_prefix(), param.epoch, param.nbatch, speed, parts)
                 if self.auto_reset:
                     # reset only the local window: the epoch-end Train-*
                     # log (base_module.fit -> get_global_name_value) must
@@ -150,11 +160,12 @@ class Speedometer:
                 self._last_stamp = (stamp_fn() if stamp_fn is not None
                                     else None)
             else:
-                logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                             param.epoch, param.nbatch, speed)
+                logging.info(
+                    "%sEpoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                    _log_prefix(), param.epoch, param.nbatch, speed)
         else:
-            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                         param.epoch, param.nbatch, speed)
+            logging.info("%sIter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         _log_prefix(), param.epoch, param.nbatch, speed)
         self._mark = (now, param.nbatch)
 
 

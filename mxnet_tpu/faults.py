@@ -41,8 +41,11 @@ dead-peer timeout and raises ``HostLostError`` without the wait),
 beat so the lease decays and the coordinator declares the host dead),
 ``host_crash`` (fired per step from the coordinator poll —
 ``crash_after:n`` is the SIGKILL-shaped mid-training death the elastic
-chaos tests use).  Any other site string is legal — call sites define
-the namespace; unknown sites in a plan simply never fire.
+chaos tests use), ``slow_step`` (flight-recorder step record — a drop
+parks the host ``MXTPU_FAULT_SLOW_S`` per step, the injected-straggler
+the fleet skew detector must name).  Any other site string is legal —
+call sites define the namespace; unknown sites in a plan simply never
+fire.
 
 Draws are deterministic under ``MXTPU_FAULT_SEED`` (default 0) so a
 failing chaos soak replays exactly.  Every injected fault counts in

@@ -277,6 +277,7 @@ class BaseModule:
             # the host only blocks here when the window fills, and at the
             # epoch boundary where values are genuinely needed
             window = _engine.AsyncWindow()
+            prev_tick = None  # per-epoch: wall_s must not span eval/reset
             for nbatch, data_batch in enumerate(train_data):
                 if epoch == begin_epoch and nbatch <= resume_nbatch:
                     # mid-epoch resume: the checkpoint's cursor already
@@ -292,11 +293,19 @@ class BaseModule:
                 self.update_metric(eval_metric, data_batch.label)
                 window.push(self._output_handles())
                 if flight:
+                    # step-timing feed (ISSUE 14): wall_s is the full
+                    # batch-to-batch host wall — what the coordinator
+                    # heartbeat reports for straggler detection.  Pure
+                    # perf_counter reads, no device sync.
+                    now = time.perf_counter()
                     _tm.health.record_step(
                         loop="module", step=step_id, epoch=epoch,
                         nbatch=nbatch, depth=len(window),
-                        dispatch_s=time.perf_counter() - t0,
+                        dispatch_s=now - t0,
+                        wall_s=(now - prev_tick if prev_tick is not None
+                                else now - t0),
                         program=program)
+                    prev_tick = now
                 if coord is not None and coord.step_poll():
                     # the cluster generation moved (a host died or a
                     # rejoiner announced): checkpoint this boundary,

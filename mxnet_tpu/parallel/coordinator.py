@@ -15,6 +15,18 @@ stdlib-HTTP skeleton as the telemetry ``/metrics`` endpoint:
   shape, generalized); a lease that expires declares the host dead,
   records it, and **publishes the next generation**.  ``GET /cluster``
   is the operator's status JSON.
+- **fleet observability** (ISSUE 14, telemetry/fleet.py): joins carry
+  each member's telemetry endpoint, so the coordinator federates every
+  member's ``/metrics.json`` on a background scrape thread and serves
+  the merged, host-labeled view at ``GET /fleet``
+  (``tools/fleetstat.py`` is the operator CLI).  Heartbeats carry
+  per-step wall/dispatch timings from the flight-recorder ring; the
+  lease monitor computes the per-generation step-time skew, publishes
+  ``dist_step_skew_ratio`` / ``dist_straggler_host``, and names a
+  sustained straggler in ``/cluster`` and ``/fleet``.  Heartbeat
+  replies carry the coordinator's wall clock, and the client records
+  the RTT-midpoint clock offset into the flight record so
+  ``fleetstat.py merge-trace`` puts per-host lanes on one timebase.
 - :class:`CoordinatorClient` — every worker joins, heartbeats in the
   background, and polls :meth:`CoordinatorClient.step_poll` from the
   training loop (pure host-side flag check — nothing on the hot path
@@ -48,6 +60,7 @@ import time
 
 from ..base import MXNetError
 from .. import telemetry as _tm
+from ..telemetry import fleet as _fleet
 from .dist import (EXIT_HOST_LOST, GenerationChanged, HostLostError,
                    barrier_timeout_s)
 
@@ -107,6 +120,12 @@ class CoordinatorService:
         self._stop = threading.Event()
         self._monitor = None
         self.started = time.time()
+        # fleet plane (ISSUE 14): metrics federation over the members'
+        # advertised telemetry endpoints + step-skew straggler state
+        self.scraper = _fleet.FleetScraper(self._scrape_targets)
+        self._straggler = None        # flagged {member, host, ratio, ...}
+        self._skew = 0.0              # latest skew ratio (worst/median)
+        self._strag_streaks = {}      # member -> consecutive hot sweeps
 
     # -- state transitions (all under _lock) -------------------------------
     def _bump(self, why):
@@ -124,16 +143,20 @@ class CoordinatorService:
                         self.generation, why)
 
     def join(self, member, host="?", pid=0, rank=-1, generation=None,
-             standby=False):
+             standby=False, telemetry_addr=None):
         """Register a member.  A normal join enters the CURRENT
         generation (bring-up: the launcher started this world).  A
         ``standby`` join is a rejoin announcement: the host is back but
         must enter at the next generation boundary — it is recorded,
         the generation is bumped so running members leave their step
-        loops at the boundary, and the launcher relaunches everyone."""
+        loops at the boundary, and the launcher relaunches everyone.
+        ``telemetry_addr`` (``host:port`` of the member's /metrics
+        server) opts the member into the fleet federation scrape."""
         with self._lock:
             info = {"host": host, "pid": int(pid), "rank": int(rank),
                     "beat": time.monotonic(),
+                    "telemetry": (str(telemetry_addr)
+                                  if telemetry_addr else None),
                     "generation": self.generation if generation is None
                     else int(generation)}
             if standby:
@@ -148,7 +171,7 @@ class CoordinatorService:
             return {"generation": self.generation,
                     "lease_s": self.lease_s, "ok": True}
 
-    def heartbeat(self, member, generation=None, progress=None):
+    def heartbeat(self, member, generation=None, progress=None, steps=None):
         with self._lock:
             m = self._members.get(member)
             if m is not None:
@@ -158,7 +181,16 @@ class CoordinatorService:
                     # launcher gates rejoin announcements on the shrunk
                     # world having made real progress
                     m["progress"] = int(progress)
+                if isinstance(steps, dict):
+                    # per-step wall/dispatch timings from the member's
+                    # flight ring — the straggler-detection feed
+                    m["steps"] = {k: float(v) for k, v in steps.items()
+                                  if isinstance(v, (int, float))
+                                  and not isinstance(v, bool)}
+            # server_time lets the member estimate its clock offset from
+            # the RTT midpoint (merge-trace's common timebase)
             return {"generation": self.generation,
+                    "server_time": time.time(),
                     "ok": m is not None
                     and (generation is None
                          or int(generation) == self.generation)}
@@ -217,6 +249,112 @@ class CoordinatorService:
                 self._bump("lease expired: " + ",".join(sorted(dead)))
             return dead
 
+    # -- fleet plane (ISSUE 14) ---------------------------------------------
+    def _scrape_targets(self):
+        """Live members' advertised telemetry endpoints (the federation
+        sweep's target list — dead leases drop out automatically)."""
+        with self._lock:
+            return {mid: m["telemetry"] for mid, m in self._members.items()
+                    if m.get("telemetry")}
+
+    def eval_straggler(self):
+        """Per-generation straggler detection from heartbeat timings.
+
+        Skew = the slowest member's mean step wall over the fleet
+        median.  A member above ``MXTPU_STRAGGLER_RATIO`` for
+        ``STRAGGLER_SUSTAIN`` consecutive monitor sweeps (one GC pause
+        is not a sick host) is *named*: logged, flagged in ``/cluster``
+        and ``/fleet``, and set in ``dist_straggler_host``.  Called by
+        the lease-monitor thread every lease/4 — detection latency is
+        well inside one federation scrape interval."""
+        import statistics
+
+        with self._lock:
+            stats = {}
+            for mid, m in self._members.items():
+                s = m.get("steps") or {}
+                if (s.get("count", 0) >= _fleet.STRAGGLER_MIN_STEPS
+                        and s.get("step_wall_s", 0) > 0):
+                    stats[mid] = float(s["step_wall_s"])
+            if len(stats) < 2:
+                self._set_straggler(None, 0.0)
+                return None
+            worst = max(stats, key=stats.get)
+            # the fleet median EXCLUDES the candidate: on a 2-host world
+            # max/median(all) is bounded below 2x no matter how sick the
+            # slow host is, which would blind the default threshold
+            median = statistics.median(
+                [v for mid, v in stats.items() if mid != worst])
+            ratio = stats[worst] / median if median > 0 else 0.0
+            threshold = _fleet.straggler_ratio()
+            if threshold > 1.0 and ratio >= threshold:
+                self._strag_streaks = {
+                    worst: self._strag_streaks.get(worst, 0) + 1}
+            else:
+                self._strag_streaks = {}
+            flagged = (self._strag_streaks.get(worst, 0)
+                       >= _fleet.STRAGGLER_SUSTAIN)
+            info = None
+            if flagged:
+                m = self._members[worst]
+                info = {"member": worst, "host": m["host"],
+                        "rank": m["rank"], "generation": self.generation,
+                        "step_wall_s": round(stats[worst], 6),
+                        "fleet_median_s": round(median, 6),
+                        "ratio": round(ratio, 3)}
+            self._set_straggler(info, ratio)
+            return self._straggler
+
+    def _set_straggler(self, info, ratio):
+        # every caller (eval_straggler) already holds self._lock around
+        # this helper; it is never called bare
+        self._skew = float(ratio)  # race-ok: caller holds self._lock
+        prev = self._straggler
+        self._straggler = info  # race-ok: caller holds self._lock
+        if _tm.enabled():
+            _fleet._TM_SKEW.set(self._skew)
+            if prev and (info is None or info["member"] != prev["member"]):
+                _fleet._TM_STRAGGLER.set(0, host=prev["member"])
+            if info:
+                _fleet._TM_STRAGGLER.set(1, host=info["member"])
+        if info and (prev is None or prev["member"] != info["member"]):
+            _logger.warning(
+                "coordinator: straggler detected: %s (host %s) at %.2fx "
+                "the fleet median step time (%.1fms vs %.1fms)",
+                info["member"], info["host"], info["ratio"],
+                info["step_wall_s"] * 1e3, info["fleet_median_s"] * 1e3)
+
+    def fleet(self):
+        """The ``GET /fleet`` JSON: per-host rows (membership + latest
+        scrape status + heartbeat step timings), the merged host-labeled
+        metric families, and the generation/straggler state — the one
+        view that used to be N disconnected dashboards."""
+        cl = self.cluster()
+        snaps = self.scraper.snapshot()
+        hosts = {}
+        for mid, m in cl["members"].items():
+            row = dict(m)
+            s = snaps.get(mid)
+            row["scrape_ok"] = bool(s and s.get("ok"))
+            if s:
+                row["scraped_at"] = s.get("at")
+                if s.get("error"):
+                    row["scrape_error"] = s["error"]
+            hosts[mid] = row
+        merged = _fleet.merge_snapshots(
+            {mid: s.get("metrics") or {} for mid, s in snaps.items()
+             if s.get("ok") and mid in cl["members"]})
+        return {
+            "generation": cl["generation"],
+            "hosts_alive": cl["hosts_alive"],
+            "straggler": cl["straggler"],
+            "step_skew_ratio": cl["step_skew_ratio"],
+            "scrape_interval_s": self.scraper.interval_s,
+            "dead": cl["dead"],
+            "hosts": hosts,
+            "metrics": merged,
+        }
+
     def cluster(self):
         """The ``/cluster`` status JSON."""
         now = time.monotonic()
@@ -230,10 +368,14 @@ class CoordinatorService:
                           "rank": m["rank"],
                           "joined_generation": m["generation"],
                           "progress": m.get("progress", 0),
+                          "telemetry": m.get("telemetry"),
+                          "steps": m.get("steps"),
                           "lease_age_s": round(now - m["beat"], 3)}
                     for mid, m in self._members.items()},
                 "standby": sorted(self._standby),
                 "dead": list(self._dead),
+                "straggler": self._straggler,
+                "step_skew_ratio": round(self._skew, 3),
                 "events": list(self._events),
                 "uptime_s": round(time.time() - self.started, 3),
             }
@@ -257,6 +399,8 @@ class CoordinatorService:
                 path = self.path.split("?", 1)[0]
                 if path in ("/", "/cluster"):
                     self._reply(svc.cluster())
+                elif path == "/fleet":
+                    self._reply(svc.fleet())
                 elif path == "/healthz":
                     self._reply({"status": "ok",
                                  "generation": svc.generation})
@@ -278,11 +422,13 @@ class CoordinatorService:
                             pid=int(msg.get("pid", 0)),
                             rank=int(msg.get("rank", -1)),
                             generation=msg.get("generation"),
-                            standby=bool(msg.get("standby", False))))
+                            standby=bool(msg.get("standby", False)),
+                            telemetry_addr=msg.get("telemetry")))
                     elif path == "/heartbeat":
                         self._reply(svc.heartbeat(
                             member, generation=msg.get("generation"),
-                            progress=msg.get("progress")))
+                            progress=msg.get("progress"),
+                            steps=msg.get("steps")))
                     elif path == "/leave":
                         self._reply(svc.leave(
                             member, why=str(msg.get("why", "leave"))))
@@ -313,14 +459,17 @@ class CoordinatorService:
             while not self._stop.wait(interval):
                 try:
                     self.expire_leases()
+                    self.eval_straggler()
                 except Exception:  # noqa: BLE001 — monitor must survive
                     _logger.exception("coordinator lease monitor failed")
 
         self._monitor = threading.Thread(target=_monitor, daemon=True,
                                          name="mxtpu-coordinator-leases")
         self._monitor.start()
-        _logger.info("coordinator serving on %s:%d (lease %.1fs)",
-                     addr, self.port, self.lease_s)
+        self.scraper.start()
+        _logger.info("coordinator serving on %s:%d (lease %.1fs, fleet "
+                     "scrape every %.1fs)", addr, self.port, self.lease_s,
+                     self.scraper.interval_s)
         return self
 
     @property
@@ -329,6 +478,7 @@ class CoordinatorService:
 
     def stop(self):
         self._stop.set()
+        self.scraper.stop()
         if self._srv is not None:
             self._srv.shutdown()
             self._srv.server_close()
@@ -368,7 +518,7 @@ class CoordinatorClient:
     _MISS_LIMIT = 5  # consecutive heartbeat failures = coordinator lost
 
     def __init__(self, addr, member=None, rank=None, generation=None,
-                 standby=False):
+                 standby=False, telemetry_addr=None):
         from . import dist as _dist
 
         self.addr = str(addr)
@@ -378,6 +528,10 @@ class CoordinatorClient:
         self.generation = (_dist.generation() if generation is None
                            else int(generation))
         self.lease_s = coord_lease_s()
+        # advertised /metrics endpoint for the fleet federation scrape
+        # (default: the import-time MXTPU_TELEMETRY_HTTP_PORT server)
+        self.telemetry_addr = (telemetry_addr if telemetry_addr is not None
+                               else _tm.http_address())
         self._changed_at = None       # monotonic time a bump was seen
         self._seen_generation = self.generation
         self._polls = 0               # batches polled this incarnation
@@ -391,7 +545,8 @@ class CoordinatorClient:
                                     "host": socket.gethostname(),
                                     "pid": os.getpid(), "rank": self.rank,
                                     "generation": self.generation,
-                                    "standby": bool(standby)})
+                                    "standby": bool(standby),
+                                    "telemetry": self.telemetry_addr})
         self.lease_s = float(reply.get("lease_s", self.lease_s))
         self._observe_generation(int(reply["generation"]))
         if not standby:
@@ -426,13 +581,27 @@ class CoordinatorClient:
             try:
                 if _faults.should_drop("coord_heartbeat"):
                     continue  # simulated lost heartbeat: lease decays
+                # step-timing feed (ISSUE 14): per-step wall/dispatch
+                # means from the flight ring — pure host-side reads, so
+                # the straggler signal costs the hot loop nothing
+                t_send = time.time()
                 reply = _http_json(self.addr, "/heartbeat",
                                    {"member": self.member,
                                     "generation": self.generation,
-                                    "progress": self._polls},
+                                    "progress": self._polls,
+                                    "steps":
+                                        _tm.health.step_time_stats()},
                                    timeout=max(interval, 2.0))
+                t_recv = time.time()
                 self._misses = 0
                 self._observe_generation(int(reply["generation"]))
+                server_time = reply.get("server_time")
+                if server_time is not None:
+                    # clock-offset estimate via the RTT midpoint: the
+                    # common timebase fleetstat merge-trace aligns on
+                    _tm.health.set_clock_offset(
+                        float(server_time) - (t_send + t_recv) / 2.0,
+                        rtt_s=t_recv - t_send)
             except Exception:  # noqa: BLE001 — counted, surfaced at poll
                 self._misses += 1
                 if self._misses >= self._MISS_LIMIT:

@@ -234,6 +234,32 @@ def num_workers() -> int:
     return jax.process_count()
 
 
+def log_prefix() -> str:
+    """``"[rank/size@g<generation>] "`` when jax.distributed spans >1
+    process, else ``""`` — the identity prefix Speedometer and the
+    telemetry LoggingReporter stamp on their lines so interleaved logs
+    from the elastic launcher stay attributable.  Reads the distributed
+    client state directly (never initializes a backend)."""
+    ident = _log_identity()
+    return "[%d/%d@g%d] " % ident if ident else ""
+
+
+def _log_identity():
+    """(rank, size, generation) of a live multi-process world, or None
+    (single-process / uninitialized).  Split out so tests can fake a
+    world without bringing up jax.distributed."""
+    try:
+        from jax._src import distributed as _jd
+
+        st = _jd.global_state
+        if st.client is None or not st.num_processes \
+                or int(st.num_processes) <= 1:
+            return None
+        return (int(st.process_id), int(st.num_processes), generation())
+    except Exception:  # noqa: BLE001 — logging must never require dist
+        return None
+
+
 def is_multi_host() -> bool:
     """True when jax.distributed spans >1 process (without initializing
     it: env says multi-worker, or a live backend says so)."""

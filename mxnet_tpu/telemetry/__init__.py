@@ -16,7 +16,10 @@ Quickstart::
     print(mx.telemetry.generate_text())         # Prometheus exposition
 
 Env knobs: ``MXTPU_TELEMETRY=1`` enables recording at import;
-``MXTPU_TELEMETRY_HTTP_PORT=<port>`` additionally serves ``/metrics``.
+``MXTPU_TELEMETRY_HTTP_PORT=<port>`` additionally serves ``/metrics``
+(``0`` binds an ephemeral port; a taken port auto-increments to the
+next free one so multi-worker-per-host runs sharing the env value never
+collide — :func:`http_address` reports what was actually bound).
 Disabled (the default) every record call is a single flag check — safe
 to leave instrumentation on hot paths.
 """
@@ -44,4 +47,14 @@ _http_server = None
 _port = _os.environ.get("MXTPU_TELEMETRY_HTTP_PORT")
 if _port:
     enable()
-    _http_server = start_http_server(int(_port))
+    _http_server = start_http_server(int(_port), max_tries=16)
+
+
+def http_address():
+    """``host:port`` of the import-time ``/metrics`` server
+    (``MXTPU_TELEMETRY_HTTP_PORT``), or None when none is running —
+    what the coordinator join advertises for fleet federation."""
+    if _http_server is None:
+        return None
+    host, port = _http_server.server_address[:2]
+    return "%s:%d" % (host, port)

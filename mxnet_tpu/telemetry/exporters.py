@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from typing import Optional
@@ -111,11 +112,16 @@ def dump_json(filename: str, registry: Optional[Registry] = None) -> str:
 
 
 def start_http_server(port: int = 0, addr: str = "127.0.0.1",
-                      registry: Optional[Registry] = None):
+                      registry: Optional[Registry] = None,
+                      max_tries: int = 1):
     """Serve ``/metrics`` (Prometheus text) and ``/metrics.json`` on a
     daemon thread.  ``port=0`` binds an ephemeral port — read it back
-    from the returned server's ``server_address``.  Call ``.shutdown()``
-    to stop."""
+    from the returned server's ``server_address``.  ``max_tries`` > 1
+    auto-increments past ports already bound (multi-worker-per-host
+    runs sharing one ``MXTPU_TELEMETRY_HTTP_PORT`` value must not fight
+    over the socket — each worker lands on the next free port and
+    advertises the bound one through its coordinator join).  Call
+    ``.shutdown()`` to stop."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry or get_registry()
@@ -135,10 +141,18 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1",
                 # cardinality) of a full exposition render
                 from . import health as _health
 
+                ident = _health.host_identity()
                 payload = {
                     "status": "ok",
                     "families": len(reg.collect()),
                     "flight_ring_len": len(_health.flight_ring()),
+                    # fleet topology self-assembly (ISSUE-14): a scraper
+                    # probing health endpoints alone learns who this
+                    # process is and where its membership authority lives
+                    "rank": ident["rank"],
+                    "generation": ident["generation"],
+                    "coordinator_addr": os.environ.get(
+                        "MXTPU_COORD_ADDR", "").strip() or None,
                 }
                 # cluster-health gauges ride along when their families
                 # exist (ISSUE-13): the dead-worker count the PS /
@@ -169,7 +183,16 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1",
         def log_message(self, *args):  # scrapers are chatty; stay quiet
             pass
 
-    srv = ThreadingHTTPServer((addr, port), _Handler)
+    last_exc = None
+    for i in range(max(int(max_tries), 1)):
+        try:
+            srv = ThreadingHTTPServer((addr, port + i if port else 0),
+                                      _Handler)
+            break
+        except OSError as exc:
+            last_exc = exc
+    else:
+        raise last_exc
     srv.daemon_threads = True
     thread = threading.Thread(target=srv.serve_forever, daemon=True,
                               name="mxtpu-telemetry-http")
@@ -177,10 +200,23 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1",
     return srv
 
 
+def _dist_log_prefix() -> str:
+    """``rank/size@generation`` log prefix in multi-host runs (import is
+    lazy: parallel.dist imports this package at module load)."""
+    try:
+        from ..parallel import dist as _dist
+
+        return _dist.log_prefix()
+    except Exception:  # noqa: BLE001 — logging must never require dist
+        return ""
+
+
 class LoggingReporter:
     """Periodically log a compact snapshot (counters + gauges + histogram
     count/mean) — the "tail the training log" consumption mode, Speedometer
-    generalized to every registered metric."""
+    generalized to every registered metric.  Lines carry the
+    ``[rank/size@generation]`` prefix in multi-host runs so interleaved
+    elastic-launcher logs stay attributable."""
 
     def __init__(self, interval: float = 60.0, logger=None,
                  registry: Optional[Registry] = None, level=logging.INFO):
@@ -206,7 +242,8 @@ class LoggingReporter:
                     parts.append(f"{tag}={s:.6g}" if isinstance(s, float)
                                  else f"{tag}={s}")
         if parts:
-            self.logger.log(self.level, "telemetry: %s", "  ".join(parts))
+            self.logger.log(self.level, "%stelemetry: %s",
+                            _dist_log_prefix(), "  ".join(parts))
 
     def start(self):
         if self._thread is not None:

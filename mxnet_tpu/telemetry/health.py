@@ -60,6 +60,7 @@ __all__ = [
     "donation_saved",
     "flight_enabled", "record_step", "flight_ring", "dump_flight_record",
     "auto_dump",
+    "host_identity", "set_clock_offset", "clock_offset", "step_time_stats",
 ]
 
 _logger = logging.getLogger("mxnet_tpu.telemetry")
@@ -355,6 +356,90 @@ def sentinel_check(site: str = "boundary"):
 
 
 # ---------------------------------------------------------------------------
+# fleet identity + cross-host clock correlation (telemetry/fleet.py)
+# ---------------------------------------------------------------------------
+def host_identity() -> dict:
+    """Who this process is in the fleet: host / pid / rank / generation.
+
+    Env view on purpose (``MXTPU_RANK`` / ``MXTPU_DIST_GENERATION``, the
+    same contract parallel/dist.py reads) — stamping a flight dump or a
+    health probe must never initialize jax backends."""
+    import socket
+
+    def _int_env(name, alt=None):
+        try:
+            return int(os.environ.get(name, os.environ.get(alt, "0")
+                                      if alt else "0") or 0)
+        except ValueError:
+            return 0
+
+    return {"host": socket.gethostname(), "pid": os.getpid(),
+            "rank": _int_env("MXTPU_RANK", "DMLC_RANK"),
+            "generation": _int_env("MXTPU_DIST_GENERATION")}
+
+
+_clock = {"offset_s": 0.0, "rtt_s": None, "at": None, "source": "none"}
+_clock_lock = threading.Lock()
+
+
+def set_clock_offset(offset_s: float, rtt_s=None, source="coordinator"):
+    """Record this host's clock-offset estimate vs the coordinator.
+
+    ``offset_s`` is (coordinator clock - local clock): the coordinator
+    client derives it from each heartbeat's RTT midpoint (reply carries
+    the server's wall time; offset = server_time - (send+recv)/2).  The
+    estimate rides every flight dump so ``tools/fleetstat.py
+    merge-trace`` can put per-host lanes on one timebase."""
+    with _clock_lock:
+        _clock["offset_s"] = float(offset_s)
+        _clock["rtt_s"] = None if rtt_s is None else float(rtt_s)
+        _clock["at"] = time.time()
+        _clock["source"] = str(source)
+
+
+def clock_offset() -> dict:
+    """Latest clock-offset estimate ({offset_s, rtt_s, at, source})."""
+    with _clock_lock:
+        return dict(_clock)
+
+
+def step_time_stats(window: int = 32) -> dict:
+    """Per-step timing summary from the newest ``window`` flight-ring
+    records — the straggler-detection feed the coordinator heartbeat
+    reports.  Pure host-side ring reads (the records were stamped by
+    the fit loops without syncing the device), so attaching this to
+    every heartbeat preserves the zero-per-batch-host-sync property.
+
+    Returns ``{count}`` plus, when the ring has them, ``step_wall_s``
+    (mean wall seconds per step: explicit ``wall_s`` fields, falling
+    back to deltas of the records' wall stamps), ``dispatch_s`` (mean
+    dispatch latency) and ``last_step_t``."""
+    recs = flight_ring()[-max(int(window), 2):]
+    walls, disps = [], []
+    prev_t = None
+    for r in recs:
+        w = r.get("wall_s")
+        t = r.get("t")
+        if w is None and prev_t is not None and t is not None:
+            w = t - prev_t
+        if t is not None:
+            prev_t = t
+        if w is not None and 0 <= w:
+            walls.append(float(w))
+        d = r.get("dispatch_s")
+        if d is not None:
+            disps.append(float(d))
+    out = {"count": len(recs)}
+    if walls:
+        out["step_wall_s"] = sum(walls) / len(walls)
+    if disps:
+        out["dispatch_s"] = sum(disps) / len(disps)
+    if recs and recs[-1].get("t") is not None:
+        out["last_step_t"] = recs[-1]["t"]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------
 _OFF = ("0", "off", "false", "no")
@@ -389,16 +474,35 @@ _ring_lock = threading.Lock()
 _step_seq = 0
 
 
+def _fault_slow_s() -> float:
+    """MXTPU_FAULT_SLOW_S — seconds the ``slow_step`` fault site parks
+    each step (default 0.05): the injected-straggler knob."""
+    try:
+        return max(float(os.environ.get("MXTPU_FAULT_SLOW_S", "0.05")), 0.0)
+    except ValueError:
+        return 0.05
+
+
 def record_step(**fields):
     """Append one per-step record to the ring (host-only, no sync).
 
     Callers pass whatever is cheap at the dispatch site — step/epoch
     ids, pipeline depth, dispatch latency, program signature; a global
     sequence number, wall-clock stamp, and the sentinel backlog are
-    added here."""
+    added here.
+
+    Fault site ``slow_step`` (docs/fault_tolerance.md): a ``drop``
+    parks the host ``MXTPU_FAULT_SLOW_S`` here before stamping, so the
+    ring's step walls — and everything downstream of them: heartbeat
+    step stats, the coordinator's skew computation — see a genuinely
+    slow host.  The straggler-detection tests and bench ride this."""
     global _ring, _step_seq
     if not flight_enabled():
         return None
+    from .. import faults as _faults
+
+    if _faults.active() and _faults.should_drop("slow_step"):
+        time.sleep(_fault_slow_s())
     rec = dict(fields)
     with _ring_lock:
         _step_seq += 1
@@ -419,22 +523,39 @@ def flight_ring():
         return list(_ring)
 
 
+def _default_dump_name() -> str:
+    """Rank/generation-aware dump filename: N workers per host (or per
+    generation) must never overwrite each other's black boxes."""
+    ident = host_identity()
+    return ("mxtpu_flight_record_r%d_g%d_%d.json"
+            % (ident["rank"], ident["generation"], ident["pid"]))
+
+
 def dump_flight_record(path=None, trigger: str = "manual") -> str:
     """Write the flight record as ONE JSON: the step-record ring, the
     registry snapshot, the compiled-program cache contents, the ranked
-    memory report, and the sentinel state.  Returns the path written."""
+    memory report, the sentinel state, and this host's fleet identity
+    (host/rank/generation + the coordinator clock-offset estimate, so
+    ``tools/fleetstat.py merge-trace`` can lane and align it).
+    Returns the path written."""
     from .. import executor as _executor
 
     if path is None:
-        path = _auto_dump_path() or f"mxtpu_flight_record_{os.getpid()}.json"
+        path = _auto_dump_path() or _default_dump_name()
     if os.path.isdir(path):
-        path = os.path.join(path, f"mxtpu_flight_record_{os.getpid()}.json")
+        path = os.path.join(path, _default_dump_name())
     with _executor._program_cache_lock:
         cache_keys = [repr(k)[:200] for k in _executor._program_cache]
     payload = {
-        "version": 1,
+        "version": 2,
         "time": time.time(),
         "trigger": trigger,
+        "identity": {
+            **host_identity(),
+            "clock": clock_offset(),
+            "coordinator": os.environ.get("MXTPU_COORD_ADDR",
+                                          "").strip() or None,
+        },
         "ring": flight_ring(),
         "registry": json_snapshot(),
         "program_cache": {
@@ -501,10 +622,9 @@ def auto_dump(trigger: str):
         if path is None and trigger != "signal":
             return None
         if path is None:
-            path = f"mxtpu_flight_record_{os.getpid()}.json"
+            path = _default_dump_name()
         if os.path.isdir(path):
-            path = os.path.join(path,
-                                f"mxtpu_flight_record_{os.getpid()}.json")
+            path = os.path.join(path, _default_dump_name())
         if trigger != "exception":
             # live-run triggers (SIGUSR1, injected faults) recur: each
             # dump gets a step-id suffix and the set rotates under the

@@ -807,6 +807,7 @@ class FusedTrainer:
             # fused metric update are pure async dispatches, so this is
             # the only place the steady-state loop blocks
             window = _engine.AsyncWindow()
+            prev_tick = None  # per-epoch: wall_s must not span eval/reset
             for nbatch, batch in enumerate(train_data):
                 if epoch == start_epoch and nbatch <= resume_nbatch:
                     # mid-epoch resume: the checkpoint's cursor already
@@ -823,11 +824,18 @@ class FusedTrainer:
                 eval_metric.update(batch.label, [NDArray(o) for o in outs])
                 window.push(list(outs))
                 if flight:
+                    # step-timing feed (ISSUE 14): wall_s = batch-to-
+                    # batch host wall, reported by the coordinator
+                    # heartbeat for straggler detection (host-side only)
+                    now = _time.perf_counter()
                     _tm.health.record_step(
                         loop="fused", step=self._step, epoch=epoch,
                         nbatch=nbatch, depth=len(window),
-                        dispatch_s=_time.perf_counter() - t0,
+                        dispatch_s=now - t0,
+                        wall_s=(now - prev_tick if prev_tick is not None
+                                else now - t0),
                         program=f"fused_step[{self.symbol.name or 'graph'}]")
+                    prev_tick = now
                 if coord is not None and coord.step_poll():
                     # membership changed: boundary checkpoint, then the
                     # named exit — the next generation resumes on the

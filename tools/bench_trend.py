@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Bench regression sentinel (ROADMAP item 3's honesty gate, automated).
+
+Rounds r03–r05 silently embedded committed artifacts after backend-init
+timeouts and the bench trajectory read stale numbers as live ones for
+three PRs.  This tool makes the trajectory itself a tested artifact:
+
+- loads every committed ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` round,
+  separating LIVE captures from artifact fallbacks (an embedded
+  ``last_measured`` or an ``error`` field);
+- prints a per-metric trend table over the live rounds (fallback rounds
+  shown, but excluded from the series — stale numbers must not anchor a
+  comparison);
+- exits nonzero when (a) any metric moved the WRONG way by more than
+  ``BENCH_TREND_TOL`` (default 0.15) between the two most recent live
+  rounds that carry it, (b) the newest committed round is an artifact
+  fallback, or (c) ``--current-fallback`` says the round being captured
+  RIGHT NOW fell back (bench.py's ``_fail`` path passes this, so a
+  non-live round is loud in its own log, not a footnote N PRs later).
+
+Direction is inferred from the metric name: ``*_ms`` / ``*_us`` /
+``*_seconds`` / latency / overhead-style metrics regress UP, throughput/
+MFU-style metrics regress DOWN.
+
+    python tools/bench_trend.py [--dir REPO] [--tol 0.15]
+    python tools/bench_trend.py --current-fallback "backend init timed out"
+
+Exit codes: 0 trajectory clean, 1 regression or fallback, 2 usage error.
+Stdlib-only (no mxnet_tpu/jax import): safe in any CI stage.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# derived / configuration values that are not perf metrics
+EXCLUDE_KEYS = {
+    "vs_baseline", "init_attempts", "batch", "steps_per_call",
+    "fallback_streak", "dist_generations", "n_devices", "bench_trend_rc",
+    "eval_forward_vs_p100_infer_baseline",
+}
+_LOWER_IS_BETTER = ("_ms", "_us", "_seconds", "latency", "_p50", "_p99",
+                    "overhead", "stall", "_bytes_per_replica")
+
+
+def lower_is_better(name: str) -> bool:
+    n = name.lower()
+    return any(tok in n for tok in _LOWER_IS_BETTER)
+
+
+def _is_fallback(parsed: dict) -> bool:
+    return bool(parsed.get("error")) or "last_measured" in parsed
+
+
+def _flatten(parsed: dict) -> dict:
+    """Numeric metrics of one live round; the headline ``value`` is
+    renamed to the round's ``metric`` so every series has a real name."""
+    out = {}
+    headline = parsed.get("metric")
+    for key, val in parsed.items():
+        if key in EXCLUDE_KEYS or isinstance(val, bool) \
+                or not isinstance(val, (int, float)):
+            continue
+        out[headline if key == "value" and headline else key] = float(val)
+    return out
+
+
+def load_rounds(dirpath: str, pattern: str) -> list:
+    """Committed rounds matching ``pattern`` (e.g. BENCH_r[0-9]*.json),
+    sorted by round number: [{n, file, fallback, reason, metrics}].
+    Rounds with no ``parsed`` payload at all (the early MULTICHIP
+    artifacts record only rc/device counts) are not part of the
+    trajectory."""
+    rounds = []
+    for path in glob.glob(os.path.join(glob.escape(dirpath), pattern)):
+        m = re.search(r"_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue  # unreadable round: not part of the trajectory
+        if "parsed" not in doc:
+            continue
+        parsed = doc.get("parsed") or {}
+        fell = _is_fallback(parsed)
+        rounds.append({
+            "n": int(m.group(1)),
+            "file": os.path.basename(path),
+            "fallback": fell,
+            "reason": str(parsed.get("error") or "")[:160],
+            "metrics": {} if fell else _flatten(parsed),
+        })
+    rounds.sort(key=lambda r: r["n"])
+    return rounds
+
+
+def analyze(rounds: list, tol: float):
+    """(series, regressions): series maps metric -> [(round, value)]
+    over live rounds; regressions are last-vs-previous moves worse than
+    ``tol`` in the metric's bad direction."""
+    series = {}
+    for r in rounds:
+        for name, val in r["metrics"].items():
+            series.setdefault(name, []).append((r["n"], val))
+    regressions = []
+    for name, pts in sorted(series.items()):
+        if len(pts) < 2:
+            continue
+        (prev_n, prev_v), (last_n, last_v) = pts[-2], pts[-1]
+        if prev_v == 0:
+            continue
+        change = (last_v - prev_v) / abs(prev_v)
+        lower = lower_is_better(name)
+        if (change > tol) if lower else (change < -tol):
+            regressions.append({
+                "metric": name, "prev_round": prev_n, "prev": prev_v,
+                "last_round": last_n, "last": last_v,
+                "change_pct": round(change * 100.0, 1),
+                "direction": "lower-is-better" if lower
+                             else "higher-is-better"})
+    return series, regressions
+
+
+def _fmt(v: float) -> str:
+    return "%g" % (round(v, 4) if abs(v) < 100 else round(v, 1))
+
+
+def render_table(rounds: list, series: dict) -> str:
+    lines = []
+    live = [r["n"] for r in rounds if not r["fallback"]]
+    fell = [r["n"] for r in rounds if r["fallback"]]
+    lines.append("rounds: live %s%s" % (
+        live or "(none)",
+        ("  fallback %s" % fell) if fell else ""))
+    for r in rounds:
+        if r["fallback"]:
+            lines.append("  r%02d %s: ARTIFACT FALLBACK (%s)"
+                         % (r["n"], r["file"], r["reason"] or "?"))
+    width = max([len(n) for n in series] or [6]) + 2
+    header = "%-*s %s" % (width, "metric",
+                          " ".join("%12s" % ("r%02d" % n) for n in live))
+    lines.append(header)
+    for name in sorted(series):
+        by_round = dict(series[name])
+        lines.append("%-*s %s" % (
+            width, name,
+            " ".join("%12s" % (_fmt(by_round[n]) if n in by_round else "-")
+                     for n in live)))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="bench_trend.py",
+        description="trend table + regression gate over committed "
+                    "BENCH_r*/MULTICHIP_r* rounds")
+    ap.add_argument("--dir",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="directory holding the committed rounds "
+                         "(default: repo root)")
+    ap.add_argument("--tol", type=float,
+                    default=float(os.environ.get("BENCH_TREND_TOL",
+                                                 "0.15") or 0.15),
+                    help="relative worsening tolerated between the two "
+                         "newest live rounds (default $BENCH_TREND_TOL "
+                         "or 0.15)")
+    ap.add_argument("--current-fallback", default=None, metavar="REASON",
+                    help="the round being captured right now fell back "
+                         "to a committed artifact: fail loudly with this "
+                         "reason (bench.py's _fail path sets it)")
+    args = ap.parse_args(argv)
+
+    families = [("BENCH", load_rounds(args.dir, "BENCH_r[0-9]*.json")),
+                ("MULTICHIP",
+                 load_rounds(args.dir, "MULTICHIP_r[0-9]*.json"))]
+    if not any(rounds for _, rounds in families):
+        print("bench_trend: no BENCH_r*/MULTICHIP_r* rounds under %s"
+              % args.dir, file=sys.stderr)
+        return 2
+
+    failed = False
+    if args.current_fallback:
+        failed = True
+        print("FAIL: the round being captured NOW is an artifact "
+              "fallback: %s" % args.current_fallback)
+    for family, rounds in families:
+        if not rounds:
+            continue
+        series, regressions = analyze(rounds, args.tol)
+        print("== %s ==" % family)
+        print(render_table(rounds, series))
+        if rounds[-1]["fallback"]:
+            failed = True
+            print("FAIL: newest committed %s round (r%02d) is an "
+                  "artifact fallback (%s) — fix the harness/backend "
+                  "before trusting the trajectory"
+                  % (family, rounds[-1]["n"],
+                     rounds[-1]["reason"] or "?"))
+        for reg in regressions:
+            failed = True
+            print("FAIL: %s regressed %+.1f%% (%s): r%02d %s -> "
+                  "r%02d %s (tol %.0f%%)" % (
+                      reg["metric"], reg["change_pct"], reg["direction"],
+                      reg["prev_round"], _fmt(reg["prev"]),
+                      reg["last_round"], _fmt(reg["last"]),
+                      args.tol * 100.0))
+    if not failed:
+        print("ok: no regression beyond %.0f%% and the newest round is "
+              "a live capture" % (args.tol * 100.0))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

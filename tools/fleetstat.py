@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Fleet operator CLI (docs/multihost.md): one view of an N-host world.
+
+Status mode talks to the coordinator's ``GET /fleet`` endpoint
+(parallel/coordinator.py federates every member's ``/metrics.json`` and
+the heartbeat step timings behind it):
+
+    python tools/fleetstat.py --coord 10.0.0.1:8476        # one-shot
+    python tools/fleetstat.py --watch 5                    # refresh loop
+    python tools/fleetstat.py --json                       # raw /fleet
+
+``merge-trace`` folds per-host flight-record dumps (telemetry
+``dump_flight_record``: ring + identity + clock offset) into ONE
+chrome-trace JSON with a lane per host, every lane shifted onto the
+coordinator timebase by its dump's RTT-midpoint clock-offset estimate —
+a cross-host stall is one picture instead of N files:
+
+    python tools/fleetstat.py merge-trace dumps/*.json -o fleet_trace.json
+
+Stdlib-only on purpose: this runs on an operator workstation or a bare
+pod VM without the mxnet_tpu (or jax) install.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+
+def fetch_fleet(addr, timeout=10.0):
+    with urllib.request.urlopen("http://%s/fleet" % addr,
+                                timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _ms(seconds):
+    return "-" if seconds is None else "%.1f" % (float(seconds) * 1e3)
+
+
+def render(fleet):
+    """Human one-screen rendering of the /fleet JSON."""
+    lines = []
+    lines.append(
+        "generation %s   hosts_alive %s   step_skew %.2fx   "
+        "scrape every %.1fs" % (
+            fleet.get("generation"), fleet.get("hosts_alive"),
+            float(fleet.get("step_skew_ratio") or 0.0),
+            float(fleet.get("scrape_interval_s") or 0.0)))
+    strag = fleet.get("straggler")
+    if strag:
+        lines.append(
+            "STRAGGLER: %s (host %s) at %.2fx the fleet median "
+            "(%.1fms vs %.1fms)" % (
+                strag.get("member"), strag.get("host"),
+                float(strag.get("ratio") or 0.0),
+                float(strag.get("step_wall_s") or 0.0) * 1e3,
+                float(strag.get("fleet_median_s") or 0.0) * 1e3))
+    lines.append("%-28s %-14s %4s %9s %9s %8s %8s %7s" % (
+        "member", "host", "rank", "lease_age", "progress",
+        "step_ms", "disp_ms", "scrape"))
+    hosts = fleet.get("hosts") or {}
+    for mid in sorted(hosts):
+        m = hosts[mid]
+        steps = m.get("steps") or {}
+        mark = " <- straggler" if strag and strag.get("member") == mid \
+            else ""
+        lines.append("%-28s %-14s %4s %9s %9s %8s %8s %7s%s" % (
+            mid[:28], str(m.get("host", "?"))[:14], m.get("rank"),
+            "%.1fs" % float(m.get("lease_age_s") or 0.0),
+            m.get("progress", 0), _ms(steps.get("step_wall_s")),
+            _ms(steps.get("dispatch_s")),
+            "ok" if m.get("scrape_ok") else
+            ("err" if m.get("telemetry") else "-"), mark))
+    dead = fleet.get("dead") or []
+    if dead:
+        lines.append("dead: " + ", ".join(
+            "%s (g%s)" % (d.get("member"), d.get("generation"))
+            for d in dead[-8:]))
+    lines.append("%d merged metric families (GET /fleet for the full "
+                 "host-labeled catalog)" % len(fleet.get("metrics") or {}))
+    return "\n".join(lines)
+
+
+def merge_trace(paths, out_path):
+    """Merge flight-record dumps into one chrome trace with per-host
+    lanes on a common timebase.
+
+    Each dump's ``identity`` names its lane (host/rank/generation) and
+    carries ``clock.offset_s`` = (coordinator clock - local clock): a
+    record stamped at local time ``t`` lands at coordinator time
+    ``t + offset_s``, so lanes from hosts with skewed clocks still line
+    up.  Ring records become complete ("X") events — the record's ``t``
+    is stamped at step END, so each slice spans ``[t - wall, t]``.
+    Returns ``(out_path, n_events)``."""
+    events = []
+    lanes = []
+    t_min = None
+    for i, path in enumerate(sorted(paths)):
+        with open(path) as f:
+            dump = json.load(f)
+        ident = dump.get("identity") or {}
+        host = str(ident.get("host", "host%d" % i))
+        rank = ident.get("rank", i)
+        gen = ident.get("generation", 0)
+        offset = float((ident.get("clock") or {}).get("offset_s") or 0.0)
+        pid = i  # one lane per dump; the label carries host/rank/gen
+        lanes.append((pid, "%s rank%s g%s" % (host, rank, gen)))
+        for rec in dump.get("ring") or ():
+            t = rec.get("t")
+            if t is None:
+                continue
+            dur_s = float(rec.get("wall_s") or rec.get("dispatch_s") or 0.0)
+            end_us = (float(t) + offset) * 1e6
+            start_us = end_us - dur_s * 1e6
+            t_min = start_us if t_min is None else min(t_min, start_us)
+            events.append({
+                "ph": "X", "pid": pid, "tid": 0,
+                "ts": start_us, "dur": max(dur_s * 1e6, 1.0),
+                "name": "step %s" % rec.get("step", rec.get("seq", "?")),
+                "cat": str(rec.get("loop", "step")),
+                "args": {k: v for k, v in rec.items()
+                         if isinstance(v, (int, float, str))
+                         and k not in ("t",)},
+            })
+    t_min = t_min or 0.0
+    for e in events:
+        e["ts"] = round(e["ts"] - t_min, 3)
+    meta = [{"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+             "args": {"name": label}} for pid, label in lanes]
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": meta + events,
+                   "displayTimeUnit": "ms"}, f, indent=1)
+    return out_path, len(events)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "merge-trace":
+        ap = argparse.ArgumentParser(
+            prog="fleetstat.py merge-trace",
+            description="merge per-host flight dumps into one chrome trace")
+        ap.add_argument("dumps", nargs="+", help="flight-record JSONs")
+        ap.add_argument("-o", "--out", default="fleet_trace.json")
+        args = ap.parse_args(argv[1:])
+        out, n = merge_trace(args.dumps, args.out)
+        print("wrote %s (%d events, %d lanes) — open in chrome://tracing"
+              % (out, n, len(args.dumps)))
+        return 0
+
+    ap = argparse.ArgumentParser(
+        prog="fleetstat.py",
+        description="fleet status from the coordinator's GET /fleet")
+    ap.add_argument("--coord",
+                    default=os.environ.get("MXTPU_COORD_ADDR",
+                                           "127.0.0.1:8476"),
+                    help="coordinator host:port (default: "
+                         "$MXTPU_COORD_ADDR or 127.0.0.1:8476)")
+    ap.add_argument("--watch", nargs="?", const=5.0, type=float,
+                    default=None, metavar="SEC",
+                    help="refresh every SEC seconds (default 5)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the raw /fleet JSON")
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            fleet = fetch_fleet(args.coord)
+        except OSError as exc:
+            print("fleetstat: coordinator %s unreachable: %s"
+                  % (args.coord, exc), file=sys.stderr)
+            if args.watch is None:
+                return 1
+            time.sleep(args.watch)
+            continue
+        print(json.dumps(fleet, indent=1) if args.as_json
+              else render(fleet), flush=True)
+        if args.watch is None:
+            return 0
+        time.sleep(args.watch)
+        print("---- %s" % time.strftime("%H:%M:%S"), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
