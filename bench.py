@@ -1143,6 +1143,190 @@ def _serve_micro():
             tm.disable()
 
 
+def _router_micro():
+    """Serving-fleet micro-bench (round 19, ISSUE 15).  Two parts:
+
+    (1) a Poisson soak through the replica router
+    (serving/router.py) against a 2-replica in-process fleet sharing
+    one decoder — fleet-wide served tokens/s, p50/p99 TTFT through the
+    router, and the retry counter (0 on a healthy fleet);
+
+    (2) paged-vs-contiguous co-batching at EQUAL slot count: a mixed
+    long/short workload where the long requests share an 80-token
+    system prefix.  The contiguous backend prefills every long prompt
+    at its full bucket; the paged backend (MXTPU_KV_BLOCK-style pages
+    + prefix cache) computes the shared prefix once and prefills only
+    the tails — the acceptance ratio
+    ``paged_vs_contiguous_tokens_per_sec`` (>= 1.2 on this rig).
+    """
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models, telemetry as tm
+    from mxnet_tpu.models.decode import KVDecoder
+    from mxnet_tpu.serving import (ReplicaRouter, SlotScheduler,
+                                   serve_decoder, start_router)
+
+    was_enabled = tm.enabled()
+    tm.enable()
+    out = {}
+    servers, scheds = [], []
+    rsrv = router = None
+    try:
+        L_, H_, D_, T_, V_ = 2, 4, 128, 128, 512
+        net = models.transformer.transformer_lm(
+            num_layers=L_, num_heads=H_, d_model=D_, seq_len=T_,
+            vocab_size=V_)
+        ex = net.simple_bind(ctx=mx.cpu(), grad_req="null",
+                             data=(1, T_), softmax_label=(1, T_))
+        rs = np.random.RandomState(19)
+        params = {}
+        for name, arr in ex.arg_dict.items():
+            if name in ("data", "softmax_label"):
+                continue
+            arr[:] = rs.normal(0, 0.08, arr.shape).astype(np.float32)
+            params[name] = arr
+        dec = KVDecoder(params, num_layers=L_, num_heads=H_, max_len=T_)
+
+        # ---- (1) routed Poisson soak over a 2-replica fleet ----------
+        for _ in range(2):
+            s, sch = serve_decoder(dec, port=0, num_slots=4,
+                                   queue_size=64,
+                                   default_deadline_ms=120000)
+            servers.append(s)
+            scheds.append(sch)
+        addrs = ["127.0.0.1:%d" % s.server_address[1] for s in servers]
+        router = ReplicaRouter(replicas=addrs, scrape_s=0.2, retries=2)
+        rsrv = start_router(router, port=0)
+        rport = rsrv.server_address[1]
+
+        def post(body):
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/generate" % rport,
+                data=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=300) as r:
+                return r.status, _json.loads(r.read())
+
+        # warm every replica's programs (each bucket mixed traffic hits)
+        for sch in scheds:
+            for plen in (5, 12, 30):
+                sch.generate(rs.randint(0, V_, plen), max_new_tokens=2,
+                             timeout=300)
+        retries0 = tm.get_registry().get("router_retries_total").total()
+        n_req, max_new = 24, 12
+        results, errors = [], []
+
+        def client(i):
+            try:
+                prompt = rs.randint(0, V_, int(rs.randint(4, 32)))
+                results.append(post({"prompt": prompt.tolist(),
+                                     "max_tokens": max_new}))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        tic = time.perf_counter()
+        threads = []
+        for i in range(n_req):
+            time.sleep(float(rs.exponential(0.01)))  # Poisson arrivals
+            t = threading.Thread(target=client, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(300)
+        dt = time.perf_counter() - tic
+        if errors:
+            raise errors[0]
+        toks = sum(o["n_tokens"] for _, o in results)
+        ttfts = sorted(o["ttft_ms"] for _, o in results
+                       if o.get("ttft_ms") is not None)
+        pct = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)]
+        out["serve_fleet_tokens_per_sec"] = round(toks / dt, 1)
+        out["serve_fleet_ttft_p50_ms"] = round(pct(0.50), 1)
+        out["serve_fleet_ttft_p99_ms"] = round(pct(0.99), 1)
+        out["serve_fleet_ok"] = sum(1 for st, o in results
+                                    if st == 200 and o["outcome"] == "ok")
+        out["serve_fleet_requests"] = n_req
+        out["serve_fleet_replicas"] = len(addrs)
+        out["router_retry_total"] = int(
+            tm.get_registry().get("router_retries_total").total()
+            - retries0)
+
+        # ---- (2) paged vs contiguous co-batching, equal slot count ---
+        prefix = rs.randint(0, V_, 80)       # the shared system prompt
+
+        def mixed_workload(seed):
+            w = []
+            r2 = np.random.RandomState(seed)
+            for i in range(20):
+                if i % 4 == 3:               # short, prefix-free
+                    w.append(r2.randint(0, V_, int(r2.randint(4, 16))))
+                else:                        # long, shared prefix
+                    w.append(np.concatenate(
+                        [prefix,
+                         r2.randint(0, V_, int(r2.randint(4, 16)))]))
+            return w
+
+        def soak(sched, seed):
+            # warm the buckets THIS traffic hits with a disjoint prefix
+            # (the measured run still pays its one shared-prefix fill)
+            warm = np.concatenate(
+                [rs.randint(0, V_, 80), rs.randint(0, V_, 8)])
+            sched.generate(warm, max_new_tokens=2, timeout=300)
+            sched.generate(rs.randint(0, V_, 6), max_new_tokens=2,
+                           timeout=300)
+            sched.generate(rs.randint(0, V_, 12), max_new_tokens=2,
+                           timeout=300)
+            reqs = []
+            r3 = np.random.RandomState(seed + 1)
+            tic = time.perf_counter()
+            for p in mixed_workload(seed):
+                time.sleep(float(r3.exponential(0.002)))
+                reqs.append(sched.submit(p, max_new_tokens=8))
+            for r in reqs:
+                r.wait(300)
+            dt = time.perf_counter() - tic
+            assert all(r.outcome == "ok" for r in reqs), \
+                [r.outcome for r in reqs]
+            return sum(len(r.tokens) for r in reqs) / dt
+
+        cont = SlotScheduler(dec, num_slots=4, queue_size=64,
+                             default_deadline_ms=120000, paged=False)
+        try:
+            cont_tps = soak(cont, 77)
+        finally:
+            cont.close()
+        paged = SlotScheduler(dec, num_slots=4, queue_size=64,
+                              default_deadline_ms=120000, paged=True,
+                              kv_block=16)
+        try:
+            paged_tps = soak(paged, 77)
+            pstats = paged.paged_stats()
+        finally:
+            paged.close()
+        out["serve_paged_tokens_per_sec"] = round(paged_tps, 1)
+        out["serve_contiguous_tokens_per_sec"] = round(cont_tps, 1)
+        out["paged_vs_contiguous_tokens_per_sec"] = round(
+            paged_tps / cont_tps, 3)
+        out["serve_prefix_pages"] = pstats["prefix_pages"]
+        return out
+    finally:
+        if rsrv is not None:
+            rsrv.shutdown()
+        if router is not None:
+            router.stop()
+        for s in servers:
+            s.shutdown()
+        for sch in scheds:
+            sch.close()
+        if not was_enabled:
+            tm.disable()
+
+
 def _sparse_micro():
     """Row-sparse embedding-update micro-bench (round 13): the fused
     sparse bucket (touched-rows-only jitted update, kvstore_fused +
@@ -1860,6 +2044,14 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
             # occupancy (ISSUE 6)
             if os.environ.get("BENCH_SERVE", "1") == "1":
                 for k_, v_ in _serve_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # serving fleet: Poisson soak through the replica router +
+            # paged-vs-contiguous co-batching at equal slots (ISSUE 15)
+            if os.environ.get("BENCH_ROUTER", "1") == "1":
+                for k_, v_ in _router_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

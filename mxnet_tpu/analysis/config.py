@@ -30,6 +30,12 @@ ENTRY_POINTS = (
     # host-side (HTTP + ring reads), never touching the device
     "mxnet_tpu.parallel.coordinator.CoordinatorClient._heartbeat_loop",
     "mxnet_tpu.telemetry.fleet.FleetScraper.scrape_once",
+    # serving fleet (ISSUE 15): the router's replica-health scrape loop
+    # and the paged-KV allocator tick (page allocation, block tables,
+    # prefix index) are pure host-side bookkeeping — the device only
+    # ever sees the jitted step/prefill dispatches
+    "mxnet_tpu.serving.router.ReplicaRouter.scrape_once",
+    "mxnet_tpu.serving.paged_kv.PagedSlots.step",
 )
 
 # Sanctioned sync boundaries: the analyzer does not descend into these.

@@ -43,7 +43,10 @@ beat so the lease decays and the coordinator declares the host dead),
 ``crash_after:n`` is the SIGKILL-shaped mid-training death the elastic
 chaos tests use), ``slow_step`` (flight-recorder step record — a drop
 parks the host ``MXTPU_FAULT_SLOW_S`` per step, the injected-straggler
-the fleet skew detector must name).  Any other site string is legal —
+the fleet skew detector must name), ``replica_kill`` (fired per
+serving engine tick — ``crash_after:n`` is the SIGKILL-shaped
+mid-request replica death the serving router's re-route/502 paths must
+survive, tests/test_serving_fleet.py).  Any other site string is legal —
 call sites define the namespace; unknown sites in a plan simply never
 fire.
 

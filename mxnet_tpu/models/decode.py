@@ -271,7 +271,10 @@ class KVDecoder:
     # independent request slot whose cache window [start, cursor] the
     # CALLER tracks as host int arrays — no step reads device state, so
     # the scheduler's bookkeeping costs zero syncs, exactly like the
-    # shared-pos API's host counter.
+    # shared-pos API's host counter.  serving/paged_kv.py builds the
+    # paged twin of these programs (block-table gather over a shared
+    # page pool, same layer math via _block_qkv/_ln/_fc) — bitwise
+    # equal to this path on aligned prompts, test-pinned.
 
     def _forward_slots(self, kc, vc, tokens, start, cursor):
         """One decode position for EVERY slot at once, each row at its

@@ -143,7 +143,7 @@ class CoordinatorService:
                         self.generation, why)
 
     def join(self, member, host="?", pid=0, rank=-1, generation=None,
-             standby=False, telemetry_addr=None):
+             standby=False, telemetry_addr=None, role="train"):
         """Register a member.  A normal join enters the CURRENT
         generation (bring-up: the launcher started this world).  A
         ``standby`` join is a rejoin announcement: the host is back but
@@ -151,10 +151,15 @@ class CoordinatorService:
         the generation is bumped so running members leave their step
         loops at the boundary, and the launcher relaunches everyone.
         ``telemetry_addr`` (``host:port`` of the member's /metrics
-        server) opts the member into the fleet federation scrape."""
+        server) opts the member into the fleet federation scrape.
+        ``role`` distinguishes training hosts (``"train"``) from
+        serving replicas (``"serve"`` — ISSUE 15): the serving router
+        folds ``role="serve"`` members into its replica registry, so a
+        replica's lease IS its registration."""
         with self._lock:
             info = {"host": host, "pid": int(pid), "rank": int(rank),
                     "beat": time.monotonic(),
+                    "role": str(role or "train"),
                     "telemetry": (str(telemetry_addr)
                                   if telemetry_addr else None),
                     "generation": self.generation if generation is None
@@ -366,6 +371,7 @@ class CoordinatorService:
                 "members": {
                     mid: {"host": m["host"], "pid": m["pid"],
                           "rank": m["rank"],
+                          "role": m.get("role", "train"),
                           "joined_generation": m["generation"],
                           "progress": m.get("progress", 0),
                           "telemetry": m.get("telemetry"),
@@ -423,7 +429,8 @@ class CoordinatorService:
                             rank=int(msg.get("rank", -1)),
                             generation=msg.get("generation"),
                             standby=bool(msg.get("standby", False)),
-                            telemetry_addr=msg.get("telemetry")))
+                            telemetry_addr=msg.get("telemetry"),
+                            role=str(msg.get("role", "train"))))
                     elif path == "/heartbeat":
                         self._reply(svc.heartbeat(
                             member, generation=msg.get("generation"),
@@ -518,11 +525,12 @@ class CoordinatorClient:
     _MISS_LIMIT = 5  # consecutive heartbeat failures = coordinator lost
 
     def __init__(self, addr, member=None, rank=None, generation=None,
-                 standby=False, telemetry_addr=None):
+                 standby=False, telemetry_addr=None, role="train"):
         from . import dist as _dist
 
         self.addr = str(addr)
         self.rank = _dist._rank_or_env() if rank is None else int(rank)
+        self.role = str(role or "train")
         self.member = member or f"rank{self.rank}:{socket.gethostname()}" \
                                 f":{os.getpid()}"
         self.generation = (_dist.generation() if generation is None
@@ -546,6 +554,7 @@ class CoordinatorClient:
                                     "pid": os.getpid(), "rank": self.rank,
                                     "generation": self.generation,
                                     "standby": bool(standby),
+                                    "role": self.role,
                                     "telemetry": self.telemetry_addr})
         self.lease_s = float(reply.get("lease_s", self.lease_s))
         self._observe_generation(int(reply["generation"]))

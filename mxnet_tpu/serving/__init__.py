@@ -5,26 +5,42 @@ The first subsystem that SERVES traffic instead of training it — the
 exercising the predict-API surface of the source paper (the C-predict
 ABI / `predict.py`) as a long-running server process.
 
-Three pieces:
+Five pieces:
 
 - :mod:`.scheduler` — slot-pool continuous batching over a
   `KVDecoder`: one jitted decode step per tick across all occupied
   slots, mid-flight slot reuse, bounded admission queue, deadlines.
+- :mod:`.paged_kv` — paged KV cache: block-table indirection over a
+  shared device page pool (``MXTPU_KV_BLOCK``) with prompt-prefix
+  reuse (``MXTPU_PREFIX_CACHE``), so long and short requests co-batch
+  without padding waste and shared system prompts are computed once.
 - :mod:`.server` — stdlib HTTP front-end (``POST /generate`` with 429
-  backpressure, plus the ops ``/metrics`` and ``/healthz``); see
+  backpressure, plus the ops ``/metrics``, ``/healthz`` and the
+  ``/admin/drain|undrain`` rolling-restart hooks); see
   ``tools/serve.py`` for the process entrypoint.
+- :mod:`.router` — the serving-fleet front (``tools/serve.py
+  --router``): least-loaded balancing over N replicas
+  (``MXTPU_SERVE_REPLICAS`` or coordinator self-registration), bounded
+  idempotent retries, draining rolling upgrades.
 - :mod:`.quantize` — post-training int8 weight quantization
   (per-channel symmetric, int8 storage, dequantize-in-compute) for
   `Predictor` and `KVDecoder` — the TVM-style (arXiv:1802.04799)
   quantized-inference lowering, done through XLA fusion.
 
-Env knobs (docs/how_to/env_var.md round 10): ``MXTPU_SERVE_SLOTS``,
-``MXTPU_SERVE_QUEUE``, ``MXTPU_SERVE_DEADLINE_MS``,
-``MXTPU_PREDICT_INT8``.  Metric families: docs/telemetry.md (serving
-section).
+Env knobs (docs/how_to/env_var.md rounds 10 + 19):
+``MXTPU_SERVE_SLOTS``, ``MXTPU_SERVE_QUEUE``,
+``MXTPU_SERVE_DEADLINE_MS``, ``MXTPU_PREDICT_INT8``,
+``MXTPU_SERVE_REPLICAS``, ``MXTPU_ROUTER_SCRAPE_S``,
+``MXTPU_ROUTER_RETRIES``, ``MXTPU_KV_BLOCK``, ``MXTPU_PREFIX_CACHE``.
+Metric families: docs/telemetry.md (serving + serving-fleet sections).
 """
 from . import quantize  # noqa: F401
+from .paged_kv import PagedSlots, PoolExhausted  # noqa: F401
 from .quantize import QuantizedTensor, quantize_params  # noqa: F401
+from .router import (  # noqa: F401
+    NoReplicaAvailable, ReplicaDied, ReplicaRouter,
+    RouterRetriesExhausted, register_replica, start_router,
+)
 from .scheduler import (  # noqa: F401
     AdmissionQueueFull, Request, SlotScheduler,
 )

@@ -1,0 +1,490 @@
+"""Paged KV cache: block-table indirection + prompt-prefix reuse.
+
+The PR-6 slot pool reserves one CONTIGUOUS ``(L, H, max_len, dh)`` cache
+row per slot — a 4-token health-check request holds the same device
+memory as a max_len chat, and two requests sharing a system prompt each
+recompute and store identical K/V.  This module replaces the per-slot
+row with vLLM-style paging: ONE shared device pool of fixed-size pages
+(``MXTPU_KV_BLOCK`` tokens per page), per-slot *block tables* mapping
+each slot's logical cache positions onto pool pages, gathered inside
+the jitted decode programs, so
+
+- long and short requests co-batch without padding waste (a slot holds
+  exactly ``ceil(tokens/block)`` pages, not ``max_len/block``);
+- identical prompt prefixes map to the SAME immutable pages: full
+  prompt blocks are chain-hashed into a prefix index, admission reuses
+  the longest cached chain and prefills only the tail (the shared
+  system prompt is computed ONCE — ``serve_prefix_hits_total``);
+- copy-on-write at the divergence point is structural: sharing is
+  block-aligned and a request's first write lands at its prompt length,
+  so the partially-filled divergence block is always per-fork private —
+  mutating one fork can never corrupt the shared prefix (pinned by
+  tests/test_serving_fleet.py).
+
+Page allocation, refcounts, block tables and the prefix index are pure
+HOST-side bookkeeping (``PagedSlots.step`` is a declared
+``analysis/config.py:ENTRY_POINTS`` steady-state loop — lint proves it
+never touches the device); the device work stays the serving invariant:
+one jitted step over all slots per tick, one bucketed prefill per
+admission, zero traces on a warm server
+(``executor_compile_total{kind=decode_step_paged|decode_prefill_paged}``).
+
+Parity: the gathered table reconstructs exactly the contiguous layout
+(absolute positions, ``start=0``), the layer math is shared with
+``models/decode.py``, and masked-out table entries contribute exact
+zeros — paged and contiguous decode are BITWISE equal on aligned
+prompts (tests pin it).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from collections import OrderedDict
+from functools import partial
+
+import numpy as np
+
+from .. import telemetry as _tm
+from ..base import MXNetError
+
+__all__ = ["PagedSlots", "PoolExhausted", "kv_block", "prefix_cache_on"]
+
+# --- paged serving metric families (docs/telemetry.md) ----------------------
+_TM_PREFIX_HITS = _tm.counter(
+    "serve_prefix_hits_total",
+    "prompt blocks served from the prefix cache instead of being "
+    "prefilled (each hit skips one MXTPU_KV_BLOCK-token block of "
+    "prefill compute)")
+_TM_PAGES = _tm.gauge(
+    "serve_kv_pages",
+    "KV-cache page pool occupancy: total usable pages, currently free "
+    "pages, and pages pinned by the prompt-prefix cache",
+    labels=("state",))
+
+
+class PoolExhausted(MXNetError):
+    """No free KV page and nothing evictable — the pool is fully pinned
+    by live requests (size the pool, or shed load upstream)."""
+
+
+def kv_block() -> int:
+    """``MXTPU_KV_BLOCK`` — tokens per KV page; 0/unset keeps the PR-6
+    contiguous slot cache."""
+    try:
+        return max(int(os.environ.get("MXTPU_KV_BLOCK", "0") or 0), 0)
+    except ValueError:
+        return 0
+
+
+def prefix_cache_on() -> bool:
+    """``MXTPU_PREFIX_CACHE`` — prompt-prefix page reuse (default on
+    whenever paging is on)."""
+    return os.environ.get("MXTPU_PREFIX_CACHE", "1").lower() \
+        not in ("0", "false", "off")
+
+
+class _PagedPrograms:
+    """The jitted decode programs over the page pool.
+
+    Pool layout ``(P, L, H, block, dh)`` — page-major so one gather by
+    page id reconstructs a slot's table.  The layer math is the
+    decoder's own (``_block_qkv`` + shared ``_ln``/``_fc``), run over
+    the gathered table in the contiguous layout, so a paged step is
+    bitwise the contiguous step whenever the table contents match.
+    """
+
+    def __init__(self, decoder, block, max_blocks, num_pages):
+        import jax
+
+        from ..models.decode import _count_compiles
+
+        self.dec = decoder
+        self.block = int(block)
+        self.max_blocks = int(max_blocks)
+        self.num_pages = int(num_pages)
+        self._step_jit = jax.jit(_count_compiles(
+            self._forward_step, "decode_step_paged"))
+        self._prefill_cache = {}
+
+    def init_pool(self):
+        import jax.numpy as jnp
+
+        d = self.dec
+        shape = (self.num_pages, d.L, d.H, self.block, d.dh)
+        return (jnp.zeros(shape, d._cache_dtype),
+                jnp.zeros(shape, d._cache_dtype))
+
+    # ------------------------------------------------------------ gathers
+    def _gather(self, pool, bt):
+        """(P, L, H, blk, dh)[bt (B, M)] -> contiguous (L, B, H, S, dh)."""
+        d = self.dec
+        t = pool[bt]                                 # (B, M, L, H, blk, dh)
+        t = t.transpose(2, 0, 3, 1, 4, 5)            # (L, B, H, M, blk, dh)
+        return t.reshape(d.L, bt.shape[0], d.H,
+                         self.max_blocks * self.block, d.dh)
+
+    # ---------------------------------------------------------------- step
+    def _forward_step(self, pool_k, pool_v, bt, tokens, cursor):
+        """One decode position for every slot: row ``b`` writes its new
+        K/V at absolute cache position ``cursor[b]`` (page
+        ``bt[b, cursor//block]``, offset ``cursor%block``) and attends
+        over ``[0, cursor[b]]``.  Free rows ride along with
+        ``bt[b]=0``/``cursor=0`` — their writes land in the scratch
+        page the allocator never hands out."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.decode import NEG_INF, _fc, _ln
+
+        d = self.dec
+        p = d.p
+        B = tokens.shape[0]
+        H, dh, D = d.H, d.dh, d.d_model
+        S = self.max_blocks * self.block
+
+        tok = jnp.take(p["tok_embed_weight"], tokens.astype(jnp.int32),
+                       axis=0)                               # (B, D)
+        pos_ids = jnp.clip(cursor, 0, d.max_len - 1)
+        posv = jnp.take(p["pos_embed"][0], pos_ids, axis=0)  # (B, D)
+        h = (tok + posv)[:, None]                            # (B, 1, D)
+        s_idx = jnp.arange(S)
+        valid = s_idx[None, :] <= cursor[:, None]            # (B, S)
+        rows = jnp.arange(B)
+        pages = jnp.take_along_axis(
+            bt, (cursor // self.block)[:, None], axis=1)[:, 0]   # (B,)
+        offs = cursor % self.block
+        kc = self._gather(pool_k, bt)
+        vc = self._gather(pool_v, bt)
+        for i in range(d.L):
+            name = f"layer{i}"
+            h2 = _ln(h, p[f"{name}_ln1_gamma"], p[f"{name}_ln1_beta"])
+            q, k, v = d._block_qkv(i, h2)
+            sh = lambda a: a.reshape(B, 1, H, dh).transpose(0, 2, 1, 3)
+            qh, kh, vh = sh(q), sh(k), sh(v)                 # (B, H, 1, dh)
+            kc = kc.at[i, rows, :, cursor].set(kh[:, :, 0])
+            vc = vc.at[i, rows, :, cursor].set(vh[:, :, 0])
+            pool_k = pool_k.at[pages, i, :, offs].set(kh[:, :, 0])
+            pool_v = pool_v.at[pages, i, :, offs].set(vh[:, :, 0])
+            scores = jnp.einsum("bhnd,bhsd->bhns", qh, kc[i]) \
+                / jnp.sqrt(jnp.asarray(dh, h.dtype))
+            scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+            att = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bhns,bhsd->bhnd", att, vc[i])
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(B, 1, D)
+            proj = _fc(ctx, p[f"{name}_proj_weight"],
+                       p[f"{name}_proj_bias"])
+            h = h + proj
+            h2 = _ln(h, p[f"{name}_ln2_gamma"], p[f"{name}_ln2_beta"])
+            f = _fc(h2, p[f"{name}_ffn_in_weight"],
+                    p[f"{name}_ffn_in_bias"])
+            f = jax.nn.gelu(f)
+            f = _fc(f, p[f"{name}_ffn_out_weight"],
+                    p[f"{name}_ffn_out_bias"])
+            h = h + f
+        h = _ln(h, p["final_ln_gamma"], p["final_ln_beta"])
+        logits = _fc(h, p["lm_head_weight"], p["lm_head_bias"])
+        return (pool_k, pool_v), logits[:, 0]                # (B, V)
+
+    # ------------------------------------------------------------- prefill
+    def _forward_prefill(self, pool_k, pool_v, bt_row, tokens, hist, t):
+        """Tail prefill behind a (possibly reused) history: ``tokens``
+        (1, T) RIGHT-padded, the ``t`` real tokens sit at absolute
+        positions ``hist .. hist+t-1``.  K/V of real tokens scatter
+        into their pages (and the gathered table, for intra-prefill
+        attention); pad tokens target out-of-bounds indices, which the
+        scatter drops.  ``hist``/``t`` ride as traced scalars, so the
+        program count is one per padded bucket length."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.decode import NEG_INF, _fc, _ln
+
+        d = self.dec
+        p = d.p
+        T = tokens.shape[1]
+        H, dh, D = d.H, d.dh, d.d_model
+        S = self.max_blocks * self.block
+
+        j = jnp.arange(T)
+        real = j < t                                         # (T,)
+        qpos = hist + j                                      # absolute
+        tok = jnp.take(p["tok_embed_weight"], tokens.astype(jnp.int32),
+                       axis=0)                               # (1, T, D)
+        posv = jnp.take(p["pos_embed"][0],
+                        jnp.clip(qpos, 0, d.max_len - 1), axis=0)[None]
+        h = tok + posv
+        # write targets: pad tokens go out of bounds -> dropped writes
+        wpos = jnp.where(real, qpos, S)                      # table scatter
+        pages = jnp.where(
+            real,
+            bt_row[jnp.clip(qpos // self.block, 0, self.max_blocks - 1)],
+            self.num_pages)                                  # pool scatter
+        offs = qpos % self.block
+        s_idx = jnp.arange(S)
+        valid = s_idx[None, :] <= qpos[:, None]              # (T, S)
+        kc = self._gather(pool_k, bt_row[None])              # (L, 1, H, S, dh)
+        vc = self._gather(pool_v, bt_row[None])
+        for i in range(d.L):
+            name = f"layer{i}"
+            h2 = _ln(h, p[f"{name}_ln1_gamma"], p[f"{name}_ln1_beta"])
+            q, k, v = d._block_qkv(i, h2)
+            sh = lambda a: a.reshape(1, T, H, dh).transpose(0, 2, 1, 3)
+            qh, kh, vh = sh(q), sh(k), sh(v)                 # (1, H, T, dh)
+            k_t = kh[0].transpose(1, 0, 2)                   # (T, H, dh)
+            v_t = vh[0].transpose(1, 0, 2)
+            kc = kc.at[i, 0, :, wpos].set(k_t)
+            vc = vc.at[i, 0, :, wpos].set(v_t)
+            pool_k = pool_k.at[pages, i, :, offs].set(k_t)
+            pool_v = pool_v.at[pages, i, :, offs].set(v_t)
+            scores = jnp.einsum("bhnd,bhsd->bhns", qh, kc[i]) \
+                / jnp.sqrt(jnp.asarray(dh, h.dtype))
+            scores = jnp.where(valid[None, None], scores, NEG_INF)
+            att = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bhns,bhsd->bhnd", att, vc[i])
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(1, T, D)
+            proj = _fc(ctx, p[f"{name}_proj_weight"],
+                       p[f"{name}_proj_bias"])
+            h = h + proj
+            h2 = _ln(h, p[f"{name}_ln2_gamma"], p[f"{name}_ln2_beta"])
+            f = _fc(h2, p[f"{name}_ffn_in_weight"],
+                    p[f"{name}_ffn_in_bias"])
+            f = jax.nn.gelu(f)
+            f = _fc(f, p[f"{name}_ffn_out_weight"],
+                    p[f"{name}_ffn_out_bias"])
+            h = h + f
+        h = _ln(h, p["final_ln_gamma"], p["final_ln_beta"])
+        logits = _fc(h, p["lm_head_weight"], p["lm_head_bias"])
+        return (pool_k, pool_v), logits                      # (1, T, V)
+
+    def prefill(self, bucket):
+        if bucket not in self._prefill_cache:
+            import jax
+
+            from ..models.decode import _count_compiles
+
+            self._prefill_cache[bucket] = jax.jit(_count_compiles(
+                self._forward_prefill, "decode_prefill_paged"))
+        return self._prefill_cache[bucket]
+
+
+class PagedSlots:
+    """Paged scheduler backend: the device pool + pure-host page
+    bookkeeping (block tables, refcounts, prefix index).
+
+    The pool holds ``num_pages`` usable pages plus page 0, a scratch
+    page free rows write into (never allocated).  Default sizing —
+    ``num_slots * max_len/block`` — matches the contiguous footprint,
+    so prefix sharing turns straight into headroom.  Refcounts: one per
+    slot whose table references the page, plus one while the prefix
+    index pins it; a page drops to the free list at refcount 0.  The
+    prefix index evicts LRU pages nothing else references when the
+    free list runs dry; a request that still cannot get a page at
+    admission fails that admission, and one starving mid-decode is
+    delivered truncated (reported by :meth:`step`, finished ``ok`` by
+    the scheduler like the contiguous cache-window end).
+    """
+
+    paged = True
+
+    def __init__(self, decoder, num_slots, block=None, num_pages=None,
+                 prefix_cache=None, prefill_buckets=None):
+        if decoder.mesh is not None:
+            raise MXNetError(
+                "paged KV is not supported together with a tensor-"
+                "parallel mesh yet (serve the paged fleet data-parallel)")
+        self.decoder = decoder
+        self.num_slots = int(num_slots)
+        self.block = int(block if block is not None else (kv_block() or 16))
+        if self.block < 1:
+            raise MXNetError(f"KV block must be >= 1, got {self.block}")
+        if decoder.max_len % self.block:
+            raise MXNetError(
+                f"MXTPU_KV_BLOCK {self.block} must divide the decoder's "
+                f"max_len {decoder.max_len}")
+        self.max_blocks = decoder.max_len // self.block
+        self.num_pages = int(
+            num_pages if num_pages is not None
+            else self.num_slots * self.max_blocks)
+        if self.num_pages < self.max_blocks:
+            raise MXNetError(
+                f"pool of {self.num_pages} pages cannot hold one "
+                f"max_len request ({self.max_blocks} pages)")
+        self.prefix_on = (prefix_cache_on() if prefix_cache is None
+                          else bool(prefix_cache))
+        self.prefill_buckets = tuple(prefill_buckets or ())
+        self.programs = _PagedPrograms(
+            decoder, self.block, self.max_blocks, self.num_pages + 1)
+        self.pool = self.programs.init_pool()
+        self.bt = np.zeros((self.num_slots, self.max_blocks), np.int32)
+        self.cursor = np.zeros(self.num_slots, np.int32)
+        self._free = list(range(self.num_pages, 0, -1))   # pop() -> page 1 last
+        self._ref = np.zeros(self.num_pages + 1, np.int64)
+        self._prefix = OrderedDict()      # chain hash -> page (LRU first)
+        self._page_hash = {}              # page -> chain hash
+        self._slot_pages = [[] for _ in range(self.num_slots)]
+        self._set_gauges()
+
+    # --------------------------------------------------------- bookkeeping
+    def _set_gauges(self):
+        _TM_PAGES.set(self.num_pages, state="total")
+        _TM_PAGES.set(len(self._free), state="free")
+        _TM_PAGES.set(len(self._prefix), state="prefix")
+
+    def stats(self):
+        """The ``/healthz`` ``paged`` payload."""
+        return {"block": self.block,
+                "pages_total": self.num_pages,
+                "pages_free": len(self._free),
+                "prefix_pages": len(self._prefix)}
+
+    def _alloc(self, n):
+        """``n`` pages off the free list, evicting LRU prefix-only pages
+        when it runs dry; all-or-nothing (rolls back on exhaustion)."""
+        got = []
+        while len(got) < n:
+            if self._free:
+                got.append(self._free.pop())
+                continue
+            evicted = None
+            for hh, pg in self._prefix.items():     # LRU order
+                if self._ref[pg] == 1:              # only the index holds it
+                    evicted = (hh, pg)
+                    break
+            if evicted is None:
+                self._free.extend(got)
+                raise PoolExhausted(
+                    f"KV page pool exhausted: {self.num_pages} pages all "
+                    f"pinned by live requests (needed {n})")
+            hh, pg = evicted
+            del self._prefix[hh]
+            del self._page_hash[pg]
+            self._ref[pg] = 0
+            got.append(pg)
+        for pg in got:
+            self._ref[pg] = 1           # owned by the requesting slot
+        return got
+
+    def _block_hashes(self, prompt, n_blocks):
+        """Chain hashes of the prompt's full blocks: ``d_i = H(d_{i-1}
+        || tokens_i)`` — a block's hash commits to its whole prefix, so
+        one dict hit per block reconstructs the longest shared chain."""
+        prev = b"mxtpu-prefix"
+        out = []
+        for i in range(n_blocks):
+            prev = hashlib.blake2b(
+                prev + prompt[i * self.block:(i + 1) * self.block]
+                .tobytes(), digest_size=16).digest()
+            out.append(prev)
+        return out
+
+    @property
+    def max_prompt(self):
+        return self.decoder.max_len
+
+    # ------------------------------------------------------------ admission
+    def admit(self, slot, prompt):
+        """Prefix lookup + page allocation + ONE bucketed tail prefill
+        writing straight into the pool; returns the next-token logits
+        row of the last prompt token."""
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt, np.int64)
+        p_len = int(prompt.size)
+        blk = self.block
+        n_full = p_len // blk
+        hashes = self._block_hashes(prompt, n_full) if self.prefix_on \
+            else []
+        shared = []
+        # reuse the longest cached chain, capped so >=1 tail token is
+        # always prefilled (its logits seed the first sampled token) and
+        # the cursor page stays fork-private
+        for i in range((p_len - 1) // blk):
+            pg = self._prefix.get(hashes[i]) if i < len(hashes) else None
+            if pg is None:
+                break
+            shared.append(pg)
+            self._prefix.move_to_end(hashes[i])
+        n_shared = len(shared)
+        hist = n_shared * blk
+        tail = prompt[hist:]
+        t = int(tail.size)
+        owned = self._alloc((p_len + blk - 1) // blk - n_shared)
+        for pg in shared:
+            self._ref[pg] += 1
+        row = shared + owned
+        self.bt[slot, :len(row)] = row
+        self.bt[slot, len(row):] = 0
+        self._slot_pages[slot] = list(row)
+        if n_shared:
+            _TM_PREFIX_HITS.inc(n_shared)
+        bucket = next(b for b in self.prefill_buckets if b >= t)
+        padded = np.zeros((1, bucket), np.int64)
+        padded[0, :t] = tail
+        (pk, pv), logits = self.programs.prefill(bucket)(
+            self.pool[0], self.pool[1], jnp.asarray(self.bt[slot]),
+            jnp.asarray(padded), jnp.int32(hist), jnp.int32(t))
+        self.pool = (pk, pv)
+        self.cursor[slot] = p_len
+        # promote this prompt's full blocks: they are never written
+        # again (writes happen at cursor >= p_len), so they are safe to
+        # share with every later identical prefix
+        if self.prefix_on:
+            for i in range(n_full):
+                if hashes[i] not in self._prefix:
+                    pg = row[i]
+                    self._prefix[hashes[i]] = pg
+                    self._page_hash[pg] = hashes[i]
+                    self._ref[pg] += 1
+        self._set_gauges()
+        return logits[0, t - 1]
+
+    # ----------------------------------------------------------------- tick
+    def step(self, tokens, occupied):
+        """One jitted step over the pool (the paged allocator tick —
+        declared in analysis/config.py:ENTRY_POINTS).  Rows crossing a
+        block boundary get their next page here; a row the pool cannot
+        feed is reported in ``starved`` for the scheduler to deliver
+        truncated (its garbage write lands in the scratch page)."""
+        import jax.numpy as jnp
+
+        starved = []
+        for b in np.flatnonzero(occupied):
+            b = int(b)
+            c = int(self.cursor[b])
+            if c >= self.decoder.max_len:
+                raise MXNetError(
+                    f"slot cursor at max_len {self.decoder.max_len}: "
+                    "finish or evict the request before ticking it")
+            idx = c // self.block
+            if c % self.block == 0 and len(self._slot_pages[b]) <= idx:
+                try:
+                    pg = self._alloc(1)[0]
+                except PoolExhausted:
+                    starved.append(b)
+                    continue
+                self.bt[b, idx] = pg
+                self._slot_pages[b].append(pg)
+        (pk, pv), logits = self.programs._step_jit(
+            self.pool[0], self.pool[1], jnp.asarray(self.bt),
+            jnp.asarray(np.asarray(tokens), jnp.int32),
+            jnp.asarray(self.cursor))
+        self.pool = (pk, pv)
+        adv = occupied.copy()
+        adv[starved] = False
+        self.cursor[adv] += 1
+        if starved:
+            self._set_gauges()
+        return logits, starved
+
+    def exhausted(self, slot):
+        return self.cursor[slot] >= self.decoder.max_len
+
+    def release(self, slot):
+        for pg in self._slot_pages[slot]:
+            self._ref[pg] -= 1
+            if self._ref[pg] == 0:
+                self._free.append(pg)
+        self._slot_pages[slot] = []
+        self.bt[slot] = 0
+        self.cursor[slot] = 0
+        self._set_gauges()

@@ -23,6 +23,13 @@ This is the int8 analog of the bf16 predict dtype
 (``MXTPU_PREDICT_DTYPE``): same dequantize-in-compute philosophy, half
 the storage of bf16 again, scales carrying the dynamic range the int8
 grid lacks.
+
+Backend-agnostic by construction: the ``_DequantView`` param dict
+dequantizes on read inside whatever program traces it, so the int8
+path composes unchanged with the contiguous slot pool AND the paged
+KV backend (`serving/paged_kv.py`) — behind the fleet router every
+replica can serve int8 paged (test-pinned in
+tests/test_serving_fleet.py).
 """
 from __future__ import annotations
 

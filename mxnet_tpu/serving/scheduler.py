@@ -39,6 +39,7 @@ import numpy as np
 
 from .. import telemetry as _tm
 from ..base import MXNetError
+from . import paged_kv as _paged_kv
 
 __all__ = ["Request", "SlotScheduler", "AdmissionQueueFull"]
 
@@ -145,6 +146,65 @@ class Request:
         return self._event.is_set()
 
 
+class _ContiguousSlots:
+    """The PR-6 contiguous slot pool behind the backend interface the
+    scheduler drives: one ``(L, slots, H, max_len, dh)`` cache pair,
+    left-padded bucketed prefill + ``adopt_row`` admission, per-slot
+    ``[start, cursor]`` windows.  The paged twin is
+    :class:`~mxnet_tpu.serving.paged_kv.PagedSlots`."""
+
+    paged = False
+
+    def __init__(self, decoder, num_slots, prefill_buckets):
+        self.decoder = decoder
+        self.num_slots = num_slots
+        self.prefill_buckets = prefill_buckets
+        self.cache = decoder.init_slot_state(num_slots)
+        self.start = np.zeros(num_slots, np.int32)
+        self.cursor = np.zeros(num_slots, np.int32)
+
+    def stats(self):
+        return None
+
+    def admit(self, slot, prompt):
+        """Bucketed left-padded prefill + one traced-slot cache write;
+        returns the next-token logits row of the last prompt token."""
+        plen = int(prompt.size)
+        bucket = next(b for b in self.prefill_buckets if b >= plen)
+        padded = np.zeros((1, bucket), np.int64)
+        padded[0, bucket - plen:] = prompt
+        row, logits = self.decoder.prefill_padded(padded, [plen])
+        self.cache = self.decoder.adopt_row(self.cache, row, slot)
+        self.start[slot] = bucket - plen
+        self.cursor[slot] = bucket
+        return logits[0, -1]
+
+    def step(self, tokens, occupied):
+        """ONE jitted decode step over the whole pool; advances the
+        occupied rows' windows.  Never starves (each slot owns its full
+        max_len row) — the empty second return keeps the interface."""
+        tokens = np.asarray(tokens).copy()
+        start = self.start.copy()
+        cursor = self.cursor.copy()
+        free = ~occupied
+        # free rows ride along; pin their write to position 0 —
+        # adopt_row overwrites the whole row on admission
+        tokens[free] = 0
+        start[free] = 0
+        cursor[free] = 0
+        self.cache, logits = self.decoder.step_slots(
+            self.cache, tokens, start, cursor)
+        self.cursor[occupied] += 1
+        return logits, []
+
+    def exhausted(self, slot):
+        return self.cursor[slot] >= self.decoder.max_len
+
+    def release(self, slot):
+        self.start[slot] = 0
+        self.cursor[slot] = 0
+
+
 class SlotScheduler:
     """Continuous batching over one :class:`~mxnet_tpu.models.decode.
     KVDecoder`.
@@ -154,11 +214,17 @@ class SlotScheduler:
     ``max_len``).  A request's prompt is left-padded to the smallest
     bucket that fits, so the number of prefill programs is
     O(log max_len) and a warm server admits without tracing.
+
+    ``paged``/``kv_block``/``num_pages``/``prefix_cache`` select the
+    paged KV backend (`serving/paged_kv.py`): block-table indirection
+    over a shared page pool with prompt-prefix reuse.  Default follows
+    ``MXTPU_KV_BLOCK`` (0/unset = contiguous).
     """
 
     def __init__(self, decoder, num_slots=None, queue_size=None,
                  default_deadline_ms=None, prefill_buckets=None,
-                 idle_wait=0.05):
+                 idle_wait=0.05, paged=None, kv_block=None,
+                 num_pages=None, prefix_cache=None):
         self.decoder = decoder
         # `is not None` (not truthiness): an explicit 0 must reach the
         # guards below, not silently become the env/default value
@@ -189,9 +255,17 @@ class SlotScheduler:
                 f"prefill bucket {self.prefill_buckets[-1]} exceeds the "
                 f"decoder's max_len {decoder.max_len}")
 
-        self.cache = decoder.init_slot_state(self.num_slots)
-        self.start = np.zeros(self.num_slots, np.int32)
-        self.cursor = np.zeros(self.num_slots, np.int32)
+        blk = kv_block if kv_block is not None else _paged_kv.kv_block()
+        if paged is None:
+            paged = blk > 0
+        if paged:
+            self.backend = _paged_kv.PagedSlots(
+                decoder, self.num_slots, block=(blk or None),
+                num_pages=num_pages, prefix_cache=prefix_cache,
+                prefill_buckets=self.prefill_buckets)
+        else:
+            self.backend = _ContiguousSlots(
+                decoder, self.num_slots, self.prefill_buckets)
         self.slots = [None] * self.num_slots
         self._next_tok = np.zeros(self.num_slots, np.int64)
         self._slot_used = [False] * self.num_slots
@@ -281,6 +355,16 @@ class SlotScheduler:
         with self._cond:
             return (self._draining and not self._queue
                     and all(r is None for r in self.slots))
+
+    @property
+    def paged(self):
+        return self.backend.paged
+
+    def paged_stats(self):
+        """Page-pool occupancy for ``/healthz`` (None when running the
+        contiguous backend): {block, pages_total, pages_free,
+        prefix_pages}."""
+        return self.backend.stats()
 
     @property
     def occupied(self):
@@ -375,27 +459,20 @@ class SlotScheduler:
                     return
                 req = self._queue.popleft()
                 _TM_QUEUE.set(len(self._queue))
-            plen = int(req.prompt.size)
-            bucket = next(b for b in self.prefill_buckets if b >= plen)
-            padded = np.zeros((1, bucket), np.int64)
-            padded[0, bucket - plen:] = req.prompt
             try:
                 # the whole admission for THIS request — prefill, first
-                # sample, cache adoption — fails only this request; the
+                # sample, cache write — fails only this request; the
                 # slot stays free and the engine moves on
                 from .. import faults as _faults
 
                 _faults.maybe_fail("serve_admit")
-                row, logits = self.decoder.prefill_padded(padded, [plen])
-                first = self._sample(
-                    req, np.asarray(logits[0, -1], np.float32))
-                self.cache = self.decoder.adopt_row(self.cache, row, free)
+                logits = self.backend.admit(free, req.prompt)
+                first = self._sample(req, np.asarray(logits, np.float32))
             except Exception as exc:  # noqa: BLE001
+                self.backend.release(free)
                 req.error = exc
                 self._terminal(req, "error")
                 continue
-            self.start[free] = bucket - plen
-            self.cursor[free] = bucket
             self._next_tok[free] = first
             if self._slot_used[free]:
                 _TM_REUSE.inc()
@@ -411,25 +488,26 @@ class SlotScheduler:
 
     def _tick(self):
         """ONE jitted decode step over the whole pool + host sampling."""
+        from .. import faults as _faults
+
+        # SIGKILL-shaped chaos: MXTPU_FAULT_PLAN="replica_kill:
+        # crash_after:n" dies mid-decode — the death the router's
+        # re-route/502 paths must survive (tests/test_serving_fleet.py)
+        _faults.fire("replica_kill")
         t0 = time.perf_counter()
         occupied = [i for i, r in enumerate(self.slots) if r is not None]
-        tokens = self._next_tok.copy()
-        start = self.start.copy()
-        cursor = self.cursor.copy()
-        for i in range(self.num_slots):
-            if self.slots[i] is None:
-                # free rows ride along; pin their write to position 0 —
-                # adopt_row overwrites the whole row on admission
-                tokens[i] = 0
-                start[i] = 0
-                cursor[i] = 0
-        self.cache, logits = self.decoder.step_slots(
-            self.cache, tokens, start, cursor)
+        occ_mask = np.array([r is not None for r in self.slots])
+        logits, starved = self.backend.step(self._next_tok, occ_mask)
         logits = np.asarray(logits, np.float32)   # the ONE host sync/tick
         now = time.monotonic()
         for i in occupied:
+            if i in starved:
+                # page pool exhausted mid-generation: deliver what was
+                # generated so far (the paged analog of the contiguous
+                # cache-window truncation — documented in serving.md)
+                self._finish_slot(i, "ok")
+                continue
             req = self.slots[i]
-            self.cursor[i] += 1
             nxt = self._sample(req, logits[i])
             req.tokens.append(nxt)
             self._next_tok[i] = nxt
@@ -448,7 +526,7 @@ class SlotScheduler:
             self._finish_slot(slot, "ok")
         elif len(req.tokens) >= req.max_new_tokens:
             self._finish_slot(slot, "ok")
-        elif self.cursor[slot] >= self.decoder.max_len:
+        elif self.backend.exhausted(slot):
             # cache window exhausted: the checkpoint's positional table
             # ends here — deliver what fits (documented truncation)
             self._finish_slot(slot, "ok")
@@ -456,8 +534,7 @@ class SlotScheduler:
     def _finish_slot(self, slot, outcome):
         req = self.slots[slot]
         self.slots[slot] = None
-        self.start[slot] = 0
-        self.cursor[slot] = 0
+        self.backend.release(slot)
         self._next_tok[slot] = 0
         self.stats["completed"] += 1
         _TM_OCCUPANCY.set(self.occupied)

@@ -21,14 +21,20 @@ Endpoints:
     serving families (docs/telemetry.md) plus everything else the
     process emits.
 ``GET /healthz``
-    ``{"status", "slots", "occupied", "queue_depth", "ticks"}`` —
-    liveness + the two saturation signals an orchestrator scales on.
-    ``status`` is ``"draining"`` after ``/admin/drain`` (and
-    ``"drained"`` once nothing is in flight — safe to restart).
-``POST /admin/drain``
+    ``{"status", "draining", "slots", "occupied", "queue_depth",
+    "queue_size", "ticks"}`` — liveness + the saturation and drain
+    signals an orchestrator (and the serving router,
+    ``serving/router.py``) scales and balances on.  ``status`` is
+    ``"draining"`` after ``/admin/drain`` (and ``"drained"`` once
+    nothing is in flight — safe to restart).  With the paged KV
+    backend a ``paged`` object carries ``{block, pages_total,
+    pages_free, prefix_pages}``.
+``POST /admin/drain`` / ``POST /admin/undrain``
     Rolling-restart support (docs/fault_tolerance.md): stop admitting
     (new ``/generate`` calls get 503 + Retry-After), finish queued and
-    in-flight requests, report drain progress.  Idempotent.
+    in-flight requests, report drain progress; ``undrain`` re-opens
+    admission (a cancelled drain, or the post-restart re-open).
+    Idempotent.
 """
 from __future__ import annotations
 
@@ -142,13 +148,19 @@ def start_server(scheduler: SlotScheduler, port: int = 0,
                 status = "ok"
                 if scheduler.draining:
                     status = "drained" if scheduler.drained else "draining"
-                self._reply(200, {
+                payload = {
                     "status": status,
+                    "draining": scheduler.draining,
                     "slots": scheduler.num_slots,
                     "occupied": scheduler.occupied,
                     "queue_depth": scheduler.queue_depth,
+                    "queue_size": scheduler.queue_size,
                     "ticks": scheduler.stats["ticks"],
-                })
+                }
+                paged = scheduler.paged_stats()
+                if paged is not None:
+                    payload["paged"] = paged
+                self._reply(200, payload)
             else:
                 self._reply(404, {"error": f"no such path {path!r}"})
 
@@ -162,6 +174,13 @@ def start_server(scheduler: SlotScheduler, port: int = 0,
                     "occupied": scheduler.occupied,
                     "queue_depth": scheduler.queue_depth,
                 })
+                return
+            if path == "/admin/undrain":
+                # a drain that was cancelled (or the post-restart
+                # re-open of the rolling-upgrade runbook)
+                scheduler.undrain()
+                self._reply(200, {"status": "ok",
+                                  "occupied": scheduler.occupied})
                 return
             if path != "/generate":
                 self._reply(404, {"error": f"no such path {path!r}"})

@@ -41,6 +41,7 @@ from . import registry as _reg
 
 __all__ = [
     "FleetScraper", "merge_snapshots", "fleet_scrape_s", "straggler_ratio",
+    "fetch_json", "post_json",
     "STRAGGLER_MIN_STEPS", "STRAGGLER_SUSTAIN",
 ]
 
@@ -94,9 +95,11 @@ def straggler_ratio() -> float:
         return 2.0
 
 
-def _fetch_json(addr: str, path: str, timeout: float):
+def fetch_json(addr: str, path: str, timeout: float):
     """One bounded GET against a member endpoint — a dead member must
-    cost at most ``timeout``, never hang the sweep."""
+    cost at most ``timeout``, never hang the sweep.  Shared by the
+    coordinator federation scrape and the serving router
+    (serving/router.py)."""
     import http.client
 
     host, port = str(addr).rsplit(":", 1)
@@ -110,6 +113,29 @@ def _fetch_json(addr: str, path: str, timeout: float):
         return json.loads(data)
     finally:
         conn.close()
+
+
+def post_json(addr: str, path: str, payload: dict, timeout: float):
+    """One bounded JSON POST against a member endpoint (the router's
+    /admin/drain fan-out rides this)."""
+    import http.client
+
+    host, port = str(addr).rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        body = json.dumps(payload or {}).encode()
+        conn.request("POST", path, body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            raise OSError(f"{addr}{path}: HTTP {resp.status}")
+        return json.loads(data)
+    finally:
+        conn.close()
+
+
+_fetch_json = fetch_json  # internal alias (pre-ISSUE-15 name)
 
 
 class FleetScraper:

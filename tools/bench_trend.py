@@ -42,7 +42,10 @@ EXCLUDE_KEYS = {
     "eval_forward_vs_p100_infer_baseline",
 }
 _LOWER_IS_BETTER = ("_ms", "_us", "_seconds", "latency", "_p50", "_p99",
-                    "overhead", "stall", "_bytes_per_replica")
+                    "overhead", "stall", "_bytes_per_replica",
+                    # serving-fleet metrics (round 19): router re-routes
+                    # and shed requests are failures — they regress UP
+                    "retry", "retries", "unavailable")
 
 
 def lower_is_better(name: str) -> bool:

@@ -34,6 +34,40 @@ def fetch_fleet(addr, timeout=10.0):
         return json.loads(resp.read())
 
 
+def render_router(fleet):
+    """Human rendering of a serving ROUTER's /fleet JSON
+    (serving/router.py): one row per replica with the drain state an
+    operator watches during a rolling upgrade, and the paged-KV
+    occupancy when the replica runs the paged backend."""
+    lines = []
+    reps = fleet.get("replicas") or {}
+    lines.append("serving fleet: %d replica(s), %d healthy, scrape "
+                 "every %.1fs" % (len(reps), fleet.get("healthy") or 0,
+                                  float(fleet.get("scrape_interval_s")
+                                        or 0.0)))
+    lines.append("%-22s %-6s %-9s %5s %5s %6s %6s %-18s" % (
+        "replica", "state", "draining", "slots", "occ", "queue",
+        "ticks", "paged pages (free/total, prefix)"))
+    for addr in sorted(reps):
+        r = reps[addr]
+        hz = r.get("health") or {}
+        paged = hz.get("paged")
+        lines.append("%-22s %-6s %-9s %5s %5s %6s %6s %-18s%s" % (
+            addr[:22],
+            "up" if r.get("ok") else "DEAD",
+            str(hz.get("status", "?")) if r.get("draining") else "no",
+            hz.get("slots", "-"), hz.get("occupied", "-"),
+            hz.get("queue_depth", "-"), hz.get("ticks", "-"),
+            ("%s/%s, %s prefix" % (paged.get("pages_free"),
+                                   paged.get("pages_total"),
+                                   paged.get("prefix_pages")))
+            if paged else "-",
+            "" if r.get("ok") else "  <- " + str(r.get("error"))[:40]))
+    lines.append("%d merged metric families (GET /fleet on the router "
+                 "for the full catalog)" % len(fleet.get("metrics") or {}))
+    return "\n".join(lines)
+
+
 def _ms(seconds):
     return "-" if seconds is None else "%.1f" % (float(seconds) * 1e3)
 
@@ -56,8 +90,8 @@ def render(fleet):
                 float(strag.get("ratio") or 0.0),
                 float(strag.get("step_wall_s") or 0.0) * 1e3,
                 float(strag.get("fleet_median_s") or 0.0) * 1e3))
-    lines.append("%-28s %-14s %4s %9s %9s %8s %8s %7s" % (
-        "member", "host", "rank", "lease_age", "progress",
+    lines.append("%-28s %-14s %4s %-6s %9s %9s %8s %8s %7s" % (
+        "member", "host", "rank", "role", "lease_age", "progress",
         "step_ms", "disp_ms", "scrape"))
     hosts = fleet.get("hosts") or {}
     for mid in sorted(hosts):
@@ -65,8 +99,9 @@ def render(fleet):
         steps = m.get("steps") or {}
         mark = " <- straggler" if strag and strag.get("member") == mid \
             else ""
-        lines.append("%-28s %-14s %4s %9s %9s %8s %8s %7s%s" % (
+        lines.append("%-28s %-14s %4s %-6s %9s %9s %8s %8s %7s%s" % (
             mid[:28], str(m.get("host", "?"))[:14], m.get("rank"),
+            str(m.get("role", "train"))[:6],
             "%.1fs" % float(m.get("lease_age_s") or 0.0),
             m.get("progress", 0), _ms(steps.get("step_wall_s")),
             _ms(steps.get("dispatch_s")),
@@ -150,30 +185,38 @@ def main(argv=None):
 
     ap = argparse.ArgumentParser(
         prog="fleetstat.py",
-        description="fleet status from the coordinator's GET /fleet")
+        description="fleet status from the coordinator's (or serving "
+                    "router's) GET /fleet")
     ap.add_argument("--coord",
                     default=os.environ.get("MXTPU_COORD_ADDR",
                                            "127.0.0.1:8476"),
                     help="coordinator host:port (default: "
                          "$MXTPU_COORD_ADDR or 127.0.0.1:8476)")
+    ap.add_argument("--router", default=None, metavar="ADDR",
+                    help="serving router host:port: render the replica "
+                         "table (drain state + paged-KV occupancy) "
+                         "instead of the coordinator view")
     ap.add_argument("--watch", nargs="?", const=5.0, type=float,
                     default=None, metavar="SEC",
                     help="refresh every SEC seconds (default 5)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print the raw /fleet JSON")
     args = ap.parse_args(argv)
+    target = args.router or args.coord
     while True:
         try:
-            fleet = fetch_fleet(args.coord)
+            fleet = fetch_fleet(target)
         except OSError as exc:
-            print("fleetstat: coordinator %s unreachable: %s"
-                  % (args.coord, exc), file=sys.stderr)
+            print("fleetstat: %s %s unreachable: %s"
+                  % ("router" if args.router else "coordinator",
+                     target, exc), file=sys.stderr)
             if args.watch is None:
                 return 1
             time.sleep(args.watch)
             continue
         print(json.dumps(fleet, indent=1) if args.as_json
-              else render(fleet), flush=True)
+              else (render_router(fleet) if args.router
+                    else render(fleet)), flush=True)
         if args.watch is None:
             return 0
         time.sleep(args.watch)
