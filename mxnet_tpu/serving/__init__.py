@@ -38,7 +38,7 @@ from . import quantize  # noqa: F401
 from .paged_kv import PagedSlots, PoolExhausted  # noqa: F401
 from .quantize import QuantizedTensor, quantize_params  # noqa: F401
 from .router import (  # noqa: F401
-    NoReplicaAvailable, ReplicaDied, ReplicaRouter,
+    NoReplicaAvailable, ReplicaDied, ReplicaRouter, ReplicaTimeout,
     RouterRetriesExhausted, register_replica, start_router,
 )
 from .scheduler import (  # noqa: F401

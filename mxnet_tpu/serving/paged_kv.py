@@ -408,9 +408,19 @@ class PagedSlots:
         hist = n_shared * blk
         tail = prompt[hist:]
         t = int(tail.size)
-        owned = self._alloc((p_len + blk - 1) // blk - n_shared)
+        # pin the matched chain BEFORE allocating: _alloc evicts ref==1
+        # prefix pages, which would otherwise include this request's own
+        # shared chain under pool pressure — the evicted page would come
+        # back as an owned tail page and the prefill would overwrite the
+        # shared prefix
         for pg in shared:
             self._ref[pg] += 1
+        try:
+            owned = self._alloc((p_len + blk - 1) // blk - n_shared)
+        except PoolExhausted:
+            for pg in shared:
+                self._ref[pg] -= 1
+            raise
         row = shared + owned
         self.bt[slot, :len(row)] = row
         self.bt[slot, len(row):] = 0

@@ -27,9 +27,13 @@ of them into a fleet (ROADMAP item 2).  A stdlib-HTTP router process
   :class:`RouterRetriesExhausted`.  A connection that breaks AFTER the
   request was accepted is NOT idempotent (tokens may have been
   generated and delivered nowhere) — it returns the named
-  :class:`ReplicaDied` as an HTTP 502 naming the replica.
-- **backpressure** — 503 + ``Retry-After`` only when EVERY replica is
-  draining or full; a single sick replica never surfaces to clients.
+  :class:`ReplicaDied` as an HTTP 502 naming the replica; a replica
+  that merely exceeds ``generate_timeout_s`` returns the named
+  :class:`ReplicaTimeout` as an HTTP 504 and is NOT marked dead.
+- **backpressure** — 503 + ``Retry-After`` whenever EVERY replica is
+  draining or full — including when every re-route attempt was shed
+  with a live 429/503; a single sick replica never surfaces to
+  clients.
 - **rolling upgrade** — ``POST /admin/drain`` fans out (or targets one
   replica); :meth:`ReplicaRouter.rolling_upgrade` drains one replica,
   waits ``drained``, restarts it, un-drains, then moves to the next —
@@ -55,7 +59,7 @@ from ..telemetry import fleet as _fleet
 
 __all__ = ["ReplicaRouter", "start_router", "register_replica",
            "RouterRetriesExhausted", "NoReplicaAvailable", "ReplicaDied",
-           "router_scrape_s", "router_retries"]
+           "ReplicaTimeout", "router_scrape_s", "router_retries"]
 
 _logger = logging.getLogger("mxnet_tpu.serving.router")
 
@@ -65,7 +69,8 @@ _TM_ROUTED = _tm.counter(
     "requests routed by terminal outcome: relayed (a replica answered — "
     "whatever its status), unavailable (every replica draining/full, "
     "HTTP 503), exhausted (MXTPU_ROUTER_RETRIES re-routes all failed, "
-    "502), dead (replica died mid-request, 502)",
+    "502), dead (replica died mid-request, 502), timeout (replica "
+    "exceeded generate_timeout_s, 504 — not marked dead)",
     labels=("outcome",))
 _TM_RETRIES = _tm.counter(
     "router_retries_total",
@@ -97,6 +102,13 @@ class ReplicaDied(MXNetError):
     """The connection broke AFTER a replica accepted the request —
     generation may have happened, so the router must NOT silently
     retry; the client decides (HTTP 502 naming the replica)."""
+
+
+class ReplicaTimeout(MXNetError):
+    """The replica accepted the request but did not answer within
+    ``generate_timeout_s`` — slow, not provably dead: the router
+    neither retries (generation may still be running) nor marks the
+    replica dead (HTTP 504 naming the replica)."""
 
 
 def router_scrape_s() -> float:
@@ -295,13 +307,14 @@ class ReplicaRouter:
         """Forward one /generate body to the least-loaded replica,
         re-routing idempotent failures; returns ``(status, payload
         bytes, replica addr)``.  Raises :class:`NoReplicaAvailable`
-        (503), :class:`RouterRetriesExhausted` (502) or
-        :class:`ReplicaDied` (502)."""
+        (503), :class:`RouterRetriesExhausted` (502),
+        :class:`ReplicaDied` (502) or :class:`ReplicaTimeout` (504)."""
         import http.client
 
         t0 = time.perf_counter()
         tried = set()
         last_error = None
+        shed_only = True      # every failure so far was a live 429/503
         try:
             for _ in range(self.retries + 1):
                 addr = self.pick(exclude=tried)
@@ -330,7 +343,19 @@ class ReplicaRouter:
                             _TM_RETRIES.inc(reason="connect")
                             tried.add(addr)
                             last_error = exc
+                            shed_only = False
                             continue
+                        if isinstance(exc, (TimeoutError,
+                                            socket.timeout)):
+                            # accepted but slow: past generate_timeout_s
+                            # the replica is NOT provably dead — surface
+                            # the named 504 and keep it routable
+                            _TM_ROUTED.inc(outcome="timeout")
+                            raise ReplicaTimeout(
+                                f"replica {addr} did not answer within "
+                                f"{self.generate_timeout_s}s: {exc!r} "
+                                "(generation may still be running; "
+                                "resubmit if safe)") from exc
                         # the request was accepted and the replica died
                         # under it: prefill/decode may have run — NOT
                         # idempotent, surface the named 502
@@ -358,16 +383,21 @@ class ReplicaRouter:
                     continue
                 _TM_ROUTED.inc(outcome="relayed")
                 return status, data, addr
-            if tried:
+            if tried and not shed_only:
                 _TM_ROUTED.inc(outcome="exhausted")
                 raise RouterRetriesExhausted(
                     f"no replica accepted the request after trying "
                     f"{sorted(tried)} (MXTPU_ROUTER_RETRIES="
                     f"{self.retries}); last error: {last_error!r}")
+            # nothing routable, or every attempt was a live 429/503
+            # admission shed — the fleet is saturated/draining, not
+            # broken: keep the backpressure contract (503 + Retry-After)
             _TM_ROUTED.inc(outcome="unavailable")
             raise NoReplicaAvailable(
                 "every replica is draining, full, or unreachable — "
-                "retry after backoff")
+                "retry after backoff"
+                + (f" (tried {sorted(tried)}: all answered 429/503)"
+                   if tried else ""))
         finally:
             _TM_PROXY_SEC.observe(time.perf_counter() - t0)
 
@@ -569,6 +599,12 @@ def start_router(router: ReplicaRouter, port: int = 0,
                 self._reply(502, {
                     "error": str(exc),
                     "router_error": type(exc).__name__,
+                })
+                return
+            except ReplicaTimeout as exc:
+                self._reply(504, {
+                    "error": str(exc),
+                    "router_error": "ReplicaTimeout",
                 })
                 return
             self._reply(status, data,

@@ -22,10 +22,11 @@ import mxnet_tpu as mx
 from mxnet_tpu import models, telemetry as tm
 from mxnet_tpu.models.decode import KVDecoder
 from mxnet_tpu.serving import (NoReplicaAvailable, ReplicaDied,
-                               ReplicaRouter, RouterRetriesExhausted,
-                               SlotScheduler, register_replica,
-                               serve_decoder, start_router)
-from mxnet_tpu.serving.paged_kv import PagedSlots
+                               ReplicaRouter, ReplicaTimeout,
+                               RouterRetriesExhausted, SlotScheduler,
+                               register_replica, serve_decoder,
+                               start_router)
+from mxnet_tpu.serving.paged_kv import PagedSlots, PoolExhausted
 from mxnet_tpu.serving.scheduler import _ContiguousSlots
 
 L, H, D, T, V = 2, 2, 32, 32, 17
@@ -210,6 +211,83 @@ def test_router_exhaustion_is_named(decoder):
     # nothing routable at all -> the named unavailable error
     with pytest.raises(NoReplicaAvailable):
         router.route_generate(b'{"prompt": [1]}')
+
+
+def _stub_replica(post_handler):
+    """A bare HTTP server whose POST /generate is ``post_handler``;
+    returns (server, "host:port")."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0") or 0)
+            self.rfile.read(n)
+            post_handler(self)
+
+        def log_message(self, *args):
+            pass
+
+    class _S(ThreadingHTTPServer):
+        daemon_threads = True
+
+    srv = _S(("127.0.0.1", 0), _H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, "127.0.0.1:%d" % srv.server_address[1]
+
+
+def _routable(router, addr):
+    router._replicas[addr].update(
+        ok=True, health={"slots": 2, "occupied": 0, "queue_depth": 0,
+                         "queue_size": 4})
+
+
+def test_router_all_shed_keeps_backpressure_503():
+    """When EVERY attempted replica answers a live 429/503 admission
+    shed, the fleet is saturated, not broken: the router keeps the
+    documented backpressure contract (NoReplicaAvailable -> 503 +
+    Retry-After), not RouterRetriesExhausted's 502."""
+    def shed(h):
+        h.send_response(429)
+        h.send_header("Content-Length", "0")
+        h.end_headers()
+
+    srvs, addrs = zip(*(_stub_replica(shed) for _ in range(2)))
+    try:
+        router = ReplicaRouter(replicas=list(addrs), scrape_s=30,
+                               retries=2)
+        for a in addrs:
+            _routable(router, a)
+        with pytest.raises(NoReplicaAvailable, match="429/503"):
+            router.route_generate(b'{"prompt": [1]}')
+        # a shed reply is not a death: both replicas stay routable
+        assert all(r["ok"] for r in router.replicas().values())
+    finally:
+        for s in srvs:
+            s.shutdown()
+
+
+def test_router_slow_replica_is_timeout_not_dead():
+    """A replica that merely exceeds generate_timeout_s raises the
+    named ReplicaTimeout (504) and is NOT marked dead — a slow, healthy
+    replica must not be reported as died mid-request nor dropped from
+    routing."""
+    def slow(h):
+        time.sleep(3.0)
+        h.send_response(200)
+        h.send_header("Content-Length", "0")
+        h.end_headers()
+
+    srv, addr = _stub_replica(slow)
+    try:
+        router = ReplicaRouter(replicas=[addr], scrape_s=30, retries=1,
+                               generate_timeout_s=0.3)
+        _routable(router, addr)
+        with pytest.raises(ReplicaTimeout, match="did not answer"):
+            router.route_generate(b'{"prompt": [1]}')
+        assert router.replicas()[addr]["ok"], \
+            "slow replica was wrongly marked dead"
+    finally:
+        srv.shutdown()
 
 
 def test_rolling_upgrade_under_live_traffic(decoder, metrics):
@@ -587,6 +665,50 @@ def test_paged_pool_exhaustion_truncates(decoder):
         assert sched.paged_stats()["pages_free"] == 4
     finally:
         sched.close()
+
+
+def test_prefix_chain_pinned_against_own_eviction(decoder, metrics):
+    """Admit-order regression pin: the shared chain must be pinned
+    BEFORE tail allocation.  Unpinned, _alloc's LRU eviction reclaims
+    this request's own ref==1 prefix page and hands it back as an owned
+    tail page — one physical page mapped to two logical blocks, the
+    tail prefill overwriting the shared prefix it is reusing.  Pinned,
+    a pool that cannot feed the tail fails CLEANLY: PoolExhausted with
+    refcounts and the prefix index intact, and the same admission
+    succeeds uncorrupted once pages free up."""
+    hits = metrics.get("serve_prefix_hits_total")
+    buckets = (8, 16, 32)
+    cont = _ContiguousSlots(decoder, 1, buckets)
+    pg = PagedSlots(decoder, 3, block=8, num_pages=4,
+                    prefix_cache=True, prefill_buckets=buckets)
+    rs = np.random.RandomState(11)
+    block_a = rs.randint(0, V, 8).astype(np.int64)
+    pg.admit(0, block_a)                 # seed + promote chain A
+    pg.release(0)
+    pga = next(iter(pg._prefix.values()))
+    pg.admit(1, rs.randint(0, V, 4))     # a live slot: 2 pages free
+    # slot 0 matches chain A and needs 3 tail pages with 2 free; the
+    # ONLY eviction candidate is chain A itself — unpinned, it would be
+    # evicted into the owned tail (the page aliased to two blocks)
+    long = np.concatenate([block_a, rs.randint(0, V, 24)])
+    with pytest.raises(PoolExhausted):
+        pg.admit(0, long)
+    assert int(pg._ref[pga]) == 1          # the pin rolled back
+    assert pga in pg._prefix.values()      # chain A survived
+    assert pg._slot_pages[0] == []
+    assert pg.stats()["pages_free"] == 2
+    # release the contending slot: the SAME admission now succeeds,
+    # reusing the intact chain behind a duplicate-free page row
+    pg.release(1)
+    h0 = hits.total()
+    lp = np.asarray(pg.admit(0, long), np.float32)
+    assert hits.total() - h0 >= 1, "chain A was not reused"
+    row = pg._slot_pages[0]
+    assert row[0] == pga and len(set(row)) == len(row) == 4
+    lc = np.asarray(cont.admit(0, long), np.float32)
+    scale = max(1.0, float(np.abs(lc).max()))
+    assert np.abs(lc - lp).max() < 1e-3 * scale, \
+        "tail prefill corrupted the shared prefix"
 
 
 def test_paged_composes_with_int8(lm_params):
