@@ -1327,6 +1327,153 @@ def _router_micro():
             tm.disable()
 
 
+def _trace_micro():
+    """Request-tracing overhead micro-bench (round 20, ISSUE 16).
+
+    The SAME routed Poisson workload as ``_router_micro``'s soak — a
+    2-replica in-process fleet behind the replica router — run three
+    ways: tracing OFF, tracing ON at sample rate 1.0, and sampled at
+    25%.  Span recording is pure host-side dict/ring writes (never a
+    device sync — tools/lint.py proves the tick-path callers), so the
+    acceptance gate is ``trace_overhead_pct`` <= 2 on this rig.  The
+    on-run's SLO plane numbers ride along (every routed request feeds
+    the router's burn-rate windows).
+    """
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models, telemetry as tm
+    from mxnet_tpu.models.decode import KVDecoder
+    from mxnet_tpu.serving import (ReplicaRouter, serve_decoder,
+                                   start_router)
+    from mxnet_tpu.telemetry import tracing
+
+    was_enabled = tm.enabled()
+    was_tracing = tracing.trace_on()
+    sample0 = os.environ.get("MXTPU_TRACE_SAMPLE")
+    tm.enable()
+    out = {}
+    servers, scheds = [], []
+    rsrv = router = None
+    try:
+        L_, H_, D_, T_, V_ = 2, 4, 128, 128, 512
+        net = models.transformer.transformer_lm(
+            num_layers=L_, num_heads=H_, d_model=D_, seq_len=T_,
+            vocab_size=V_)
+        ex = net.simple_bind(ctx=mx.cpu(), grad_req="null",
+                             data=(1, T_), softmax_label=(1, T_))
+        rs = np.random.RandomState(20)
+        params = {}
+        for name, arr in ex.arg_dict.items():
+            if name in ("data", "softmax_label"):
+                continue
+            arr[:] = rs.normal(0, 0.08, arr.shape).astype(np.float32)
+            params[name] = arr
+        dec = KVDecoder(params, num_layers=L_, num_heads=H_, max_len=T_)
+
+        for _ in range(2):
+            s, sch = serve_decoder(dec, port=0, num_slots=4,
+                                   queue_size=64,
+                                   default_deadline_ms=120000)
+            servers.append(s)
+            scheds.append(sch)
+        addrs = ["127.0.0.1:%d" % s.server_address[1] for s in servers]
+        router = ReplicaRouter(replicas=addrs, scrape_s=0.2, retries=2)
+        rsrv = start_router(router, port=0)
+        rport = rsrv.server_address[1]
+
+        def post(body):
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/generate" % rport,
+                data=_json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=300) as r:
+                return r.status, _json.loads(r.read())
+
+        for sch in scheds:      # warm every bucket the traffic hits
+            for plen in (5, 12, 30):
+                sch.generate(rs.randint(0, V_, plen), max_new_tokens=2,
+                             timeout=300)
+        n_req, max_new = 24, 12
+
+        def soak(seed):
+            rs2 = np.random.RandomState(seed)
+            prompts = [rs2.randint(0, V_, int(rs2.randint(4, 32)))
+                       for _ in range(n_req)]
+            results, errors = [], []
+
+            def client(p):
+                try:
+                    results.append(post({"prompt": p.tolist(),
+                                         "max_tokens": max_new}))
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+            tic = time.perf_counter()
+            threads = []
+            for p in prompts:
+                time.sleep(float(rs2.exponential(0.01)))
+                t = threading.Thread(target=client, args=(p,))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(300)
+            dt = time.perf_counter() - tic
+            if errors:
+                raise errors[0]
+            return sum(o["n_tokens"] for _, o in results) / dt
+
+        # identical workload (same seed) three ways: A/B the span path.
+        # One unmeasured soak settles threads/caches, then each arm of
+        # the off/on comparison takes its best of two runs — the soak
+        # is Poisson-arrival threaded HTTP, whose run-to-run scheduling
+        # jitter would otherwise swamp a <=2% span-recording overhead.
+        tracing.enable_tracing(False)
+        soak(100)
+        off_tps = max(soak(101) for _ in range(2))
+        tracing.clear_spans()
+        os.environ["MXTPU_TRACE_SAMPLE"] = "1"
+        tracing.enable_tracing(True)
+        on_tps = max(soak(101) for _ in range(2))
+        n_spans = len(tracing.spans())
+        tracing.clear_spans()
+        os.environ["MXTPU_TRACE_SAMPLE"] = "0.25"
+        sampled_tps = soak(101)
+        out["serve_trace_off_tokens_per_sec"] = round(off_tps, 1)
+        out["serve_trace_on_tokens_per_sec"] = round(on_tps, 1)
+        out["serve_trace_sampled_tokens_per_sec"] = round(sampled_tps, 1)
+        out["trace_overhead_pct"] = round(
+            (off_tps - on_tps) / off_tps * 100.0, 2)
+        out["serve_trace_spans"] = n_spans
+        slo = router.slo.snapshot()
+        out["slo_burn_rate_availability_60s"] = \
+            slo["windows"]["60s"]["burn_rate"]["availability"]
+        out["slo_violations_availability"] = \
+            slo["violations_total"]["availability"]
+        return out
+    finally:
+        tracing.enable_tracing(was_tracing)
+        tracing.clear_spans()
+        if sample0 is None:
+            os.environ.pop("MXTPU_TRACE_SAMPLE", None)
+        else:
+            os.environ["MXTPU_TRACE_SAMPLE"] = sample0
+        if rsrv is not None:
+            rsrv.shutdown()
+        if router is not None:
+            router.stop()
+        for s in servers:
+            s.shutdown()
+        for sch in scheds:
+            sch.close()
+        if not was_enabled:
+            tm.disable()
+
+
 def _sparse_micro():
     """Row-sparse embedding-update micro-bench (round 13): the fused
     sparse bucket (touched-rows-only jitted update, kvstore_fused +
@@ -2052,6 +2199,15 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
             # paged-vs-contiguous co-batching at equal slots (ISSUE 15)
             if os.environ.get("BENCH_ROUTER", "1") == "1":
                 for k_, v_ in _router_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # request tracing + SLO plane: the routed soak with span
+            # recording off/on/sampled — trace_overhead_pct is the
+            # host-side cost of the per-request lens (ISSUE 16)
+            if os.environ.get("BENCH_TRACE", "1") == "1":
+                for k_, v_ in _trace_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

@@ -36,6 +36,11 @@ ENTRY_POINTS = (
     # ever sees the jitted step/prefill dispatches
     "mxnet_tpu.serving.router.ReplicaRouter.scrape_once",
     "mxnet_tpu.serving.paged_kv.PagedSlots.step",
+    # tracing + SLO plane (ISSUE 16): the per-request router relay and
+    # the span-buffer flush behind GET /spans.json are steady-state
+    # host paths — spans are pure dict/ring writes, never a device sync
+    "mxnet_tpu.serving.router.ReplicaRouter.route_generate",
+    "mxnet_tpu.telemetry.tracing.spans_payload",
 )
 
 # Sanctioned sync boundaries: the analyzer does not descend into these.

@@ -46,7 +46,12 @@ parks the host ``MXTPU_FAULT_SLOW_S`` per step, the injected-straggler
 the fleet skew detector must name), ``replica_kill`` (fired per
 serving engine tick — ``crash_after:n`` is the SIGKILL-shaped
 mid-request replica death the serving router's re-route/502 paths must
-survive, tests/test_serving_fleet.py).  Any other site string is legal —
+survive, tests/test_serving_fleet.py), ``serve_slow`` (fired per
+serving engine tick — a ``drop`` parks the engine thread
+``MXTPU_FAULT_SLOW_S`` per tick, so queue wait and TTFT genuinely
+inflate: the injected latency the SLO plane's burn-rate and exemplar
+paths are tested against, tests/test_tracing.py).  Any other site
+string is legal —
 call sites define the namespace; unknown sites in a plan simply never
 fire.
 

@@ -27,12 +27,21 @@ Five pieces:
   `Predictor` and `KVDecoder` — the TVM-style (arXiv:1802.04799)
   quantized-inference lowering, done through XLA fusion.
 
-Env knobs (docs/how_to/env_var.md rounds 10 + 19):
+Requests are traceable end to end (docs/tracing.md): the router mints
+a W3C ``traceparent`` per ``POST /generate``, the scheduler and paged
+KV record host-side spans for every stage of a sampled request, and
+the router keeps multi-window SLO burn rates at ``GET /slo``
+(``MXTPU_TRACE``, ``MXTPU_SLO_TTFT_MS``, ``MXTPU_SLO_AVAIL``).
+
+Env knobs (docs/how_to/env_var.md rounds 10 + 19 + 20):
 ``MXTPU_SERVE_SLOTS``, ``MXTPU_SERVE_QUEUE``,
 ``MXTPU_SERVE_DEADLINE_MS``, ``MXTPU_PREDICT_INT8``,
 ``MXTPU_SERVE_REPLICAS``, ``MXTPU_ROUTER_SCRAPE_S``,
-``MXTPU_ROUTER_RETRIES``, ``MXTPU_KV_BLOCK``, ``MXTPU_PREFIX_CACHE``.
-Metric families: docs/telemetry.md (serving + serving-fleet sections).
+``MXTPU_ROUTER_RETRIES``, ``MXTPU_KV_BLOCK``, ``MXTPU_PREFIX_CACHE``,
+``MXTPU_TRACE``, ``MXTPU_TRACE_SAMPLE``, ``MXTPU_SPAN_RING``,
+``MXTPU_SLO_TTFT_MS``, ``MXTPU_SLO_AVAIL``.
+Metric families: docs/telemetry.md (serving + serving-fleet +
+tracing/SLO sections).
 """
 from . import quantize  # noqa: F401
 from .paged_kv import PagedSlots, PoolExhausted  # noqa: F401

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 from collections import OrderedDict
 from functools import partial
 
@@ -46,6 +47,7 @@ import numpy as np
 
 from .. import telemetry as _tm
 from ..base import MXNetError
+from ..telemetry import tracing as _tracing
 
 __all__ = ["PagedSlots", "PoolExhausted", "kv_block", "prefix_cache_on"]
 
@@ -322,6 +324,9 @@ class PagedSlots:
         self._prefix = OrderedDict()      # chain hash -> page (LRU first)
         self._page_hash = {}              # page -> chain hash
         self._slot_pages = [[] for _ in range(self.num_slots)]
+        # trace id of the admission currently allocating, so _alloc can
+        # attribute its prefix evictions; None for step-time evictions
+        self._trace_ctx = None
         self._set_gauges()
 
     # --------------------------------------------------------- bookkeeping
@@ -360,6 +365,9 @@ class PagedSlots:
             del self._page_hash[pg]
             self._ref[pg] = 0
             got.append(pg)
+            if _tracing.trace_on():
+                _tracing.record_span(
+                    "kv_evict", "replica", self._trace_ctx, 0.0, page=pg)
         for pg in got:
             self._ref[pg] = 1           # owned by the requesting slot
         return got
@@ -382,12 +390,16 @@ class PagedSlots:
         return self.decoder.max_len
 
     # ------------------------------------------------------------ admission
-    def admit(self, slot, prompt):
+    def admit(self, slot, prompt, trace=None):
         """Prefix lookup + page allocation + ONE bucketed tail prefill
         writing straight into the pool; returns the next-token logits
-        row of the last prompt token."""
+        row of the last prompt token.  ``trace``: the admitting
+        request's trace id — kv_admit/kv_prefix_hit spans land under
+        it, and prefix pages evicted to make room are attributed to it
+        (ISSUE 16)."""
         import jax.numpy as jnp
 
+        t_kv0 = time.perf_counter()
         prompt = np.asarray(prompt, np.int64)
         p_len = int(prompt.size)
         blk = self.block
@@ -415,12 +427,15 @@ class PagedSlots:
         # shared prefix
         for pg in shared:
             self._ref[pg] += 1
+        self._trace_ctx = trace
         try:
             owned = self._alloc((p_len + blk - 1) // blk - n_shared)
         except PoolExhausted:
             for pg in shared:
                 self._ref[pg] -= 1
             raise
+        finally:
+            self._trace_ctx = None
         row = shared + owned
         self.bt[slot, :len(row)] = row
         self.bt[slot, len(row):] = 0
@@ -446,6 +461,16 @@ class PagedSlots:
                     self._page_hash[pg] = hashes[i]
                     self._ref[pg] += 1
         self._set_gauges()
+        if trace is not None and _tracing.trace_on():
+            if n_shared:
+                _tracing.record_span(
+                    "kv_prefix_hit", "replica", trace, 0.0,
+                    blocks=n_shared, tokens=hist)
+            _tracing.record_span(
+                "kv_admit", "replica", trace,
+                time.perf_counter() - t_kv0, slot=slot,
+                pages_shared=n_shared, pages_owned=len(owned),
+                bucket=bucket)
         return logits[0, t - 1]
 
     # ----------------------------------------------------------------- tick

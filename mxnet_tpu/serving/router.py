@@ -39,6 +39,15 @@ of them into a fleet (ROADMAP item 2).  A stdlib-HTTP router process
   waits ``drained``, restarts it, un-drains, then moves to the next —
   the fleet upgrades under live traffic (runbook: docs/serving.md).
 
+- **tracing + SLO** (ISSUE 16) — the router is where traces are born
+  and where the SLO plane lives: ``POST /generate`` adopts the
+  client's ``traceparent`` (or mints one — ``telemetry/tracing.py``),
+  forwards the SAME trace id on every re-route attempt under a fresh
+  parent span id, answers with ``X-MXTPU-Trace``, and feeds every
+  terminal outcome into :class:`~mxnet_tpu.telemetry.tracing.SloPlane`
+  — multi-window burn rates at ``GET /slo``, span buffer at
+  ``GET /spans.json``, per-trace join via ``fleetstat.py trace <id>``.
+
 ``GET /fleet`` serves the router's federation view — per-replica health
 rows plus the replicas' ``/metrics.json`` merged host-labeled through
 :func:`telemetry.fleet.merge_snapshots` — rendered by
@@ -56,6 +65,7 @@ import time
 from .. import telemetry as _tm
 from ..base import MXNetError
 from ..telemetry import fleet as _fleet
+from ..telemetry import tracing as _tracing
 
 __all__ = ["ReplicaRouter", "start_router", "register_replica",
            "RouterRetriesExhausted", "NoReplicaAvailable", "ReplicaDied",
@@ -180,6 +190,9 @@ class ReplicaRouter:
         self.retries = router_retries() if retries is None \
             else int(retries)
         self.generate_timeout_s = float(generate_timeout_s)
+        # the SLO plane lives at the router: it sees every request's
+        # terminal outcome, replicas only see their own (GET /slo)
+        self.slo = _tracing.SloPlane()
         self._lock = threading.Lock()
         self._replicas = {}
         for addr in static:
@@ -249,6 +262,9 @@ class ReplicaRouter:
                 if addr in self._replicas:
                     self._replicas[addr].update(row)
         self._set_gauges()
+        # refresh slo_burn_rate{objective,window} each sweep, so the
+        # gauges decay with the trailing windows without /slo polling
+        self.slo.snapshot()
         return self.replicas()
 
     def _set_gauges(self):
@@ -303,32 +319,62 @@ class ReplicaRouter:
                 row.update(ok=False, error=repr(exc), health=None,
                            at=time.time())
 
-    def route_generate(self, body: bytes):
+    def route_generate(self, body: bytes, traceparent=None):
         """Forward one /generate body to the least-loaded replica,
         re-routing idempotent failures; returns ``(status, payload
         bytes, replica addr)``.  Raises :class:`NoReplicaAvailable`
         (503), :class:`RouterRetriesExhausted` (502),
-        :class:`ReplicaDied` (502) or :class:`ReplicaTimeout` (504)."""
+        :class:`ReplicaDied` (502) or :class:`ReplicaTimeout` (504).
+
+        ``traceparent``: the client's W3C header — absent or malformed
+        degrades to a freshly minted trace, never an error.  Every
+        (re-)route attempt forwards the SAME trace id under a fresh
+        parent span id (the replica's spans parent the router's
+        attempt span exactly), and the terminal outcome feeds the SLO
+        plane: availability = relayed without a 5xx/transport failure,
+        TTFT read from the replica's reply."""
         import http.client
 
+        ctx = _tracing.parse_traceparent(traceparent) or \
+            _tracing.parse_traceparent(_tracing.mint_traceparent())
+        trace, sampled = ctx["trace"], ctx["sampled"]
+        traced = sampled and _tracing.trace_on()
+        route_sid = _tracing.mint_span_id()
         t0 = time.perf_counter()
         tried = set()
         last_error = None
+        attempts = 0
+        slo_ok = False        # flips only on a relayed non-5xx
+        slo_ttft = None
         shed_only = True      # every failure so far was a live 429/503
+
+        def _span_attempt(t_att, att_sid, addr, status):
+            if traced:
+                _tracing.record_span(
+                    "attempt", "router", trace,
+                    time.perf_counter() - t_att, parent=route_sid,
+                    span=att_sid, replica=addr, status=status,
+                    attempt=attempts)
+
         try:
             for _ in range(self.retries + 1):
                 addr = self.pick(exclude=tried)
                 if addr is None:
                     break
+                attempts += 1
                 host, port = addr.rsplit(":", 1)
                 conn = http.client.HTTPConnection(
                     host, int(port), timeout=self.generate_timeout_s)
                 accepted = False
+                att_sid = _tracing.mint_span_id()
+                t_att = time.perf_counter()
                 try:
                     try:
                         conn.request(
                             "POST", "/generate", body,
-                            {"Content-Type": "application/json"})
+                            {"Content-Type": "application/json",
+                             "traceparent": _tracing.child_traceparent(
+                                 trace, sampled, att_sid)})
                         accepted = True
                         resp = conn.getresponse()
                         data = resp.read()
@@ -341,6 +387,8 @@ class ReplicaRouter:
                             # saw the request — idempotent, re-route
                             self._mark_dead(addr, exc)
                             _TM_RETRIES.inc(reason="connect")
+                            _span_attempt(t_att, att_sid, addr,
+                                          "connect_error")
                             tried.add(addr)
                             last_error = exc
                             shed_only = False
@@ -351,6 +399,7 @@ class ReplicaRouter:
                             # the replica is NOT provably dead — surface
                             # the named 504 and keep it routable
                             _TM_ROUTED.inc(outcome="timeout")
+                            _span_attempt(t_att, att_sid, addr, "timeout")
                             raise ReplicaTimeout(
                                 f"replica {addr} did not answer within "
                                 f"{self.generate_timeout_s}s: {exc!r} "
@@ -361,12 +410,14 @@ class ReplicaRouter:
                         # idempotent, surface the named 502
                         self._mark_dead(addr, exc)
                         _TM_ROUTED.inc(outcome="dead")
+                        _span_attempt(t_att, att_sid, addr, "died")
                         raise ReplicaDied(
                             f"replica {addr} died mid-request: {exc!r} "
                             "(generation may have started; resubmit if "
                             "safe)") from exc
                 finally:
                     conn.close()
+                _span_attempt(t_att, att_sid, addr, status)
                 if status in (429, 503):
                     # the replica's own admission shed the request —
                     # provably no work started, re-route
@@ -382,6 +433,16 @@ class ReplicaRouter:
                         f"replica {addr}: HTTP {status}")
                     continue
                 _TM_ROUTED.inc(outcome="relayed")
+                slo_ok = status < 500
+                if status == 200:
+                    # the replica's reply carries its TTFT — the SLO
+                    # plane's latency objective reads it off the relay
+                    try:
+                        ttft_ms = json.loads(data).get("ttft_ms")
+                        if ttft_ms is not None:
+                            slo_ttft = float(ttft_ms) / 1e3
+                    except (ValueError, AttributeError):
+                        pass
                 return status, data, addr
             if tried and not shed_only:
                 _TM_ROUTED.inc(outcome="exhausted")
@@ -399,7 +460,35 @@ class ReplicaRouter:
                 + (f" (tried {sorted(tried)}: all answered 429/503)"
                    if tried else ""))
         finally:
-            _TM_PROXY_SEC.observe(time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            _TM_PROXY_SEC.observe(dur)
+            # EVERY terminal outcome feeds the SLO plane — the raise
+            # paths above unwind through here with slo_ok still False
+            self.slo.record(slo_ok, ttft_s=slo_ttft, trace=trace)
+            if traced:
+                _tracing.record_span(
+                    "route", "router", trace, dur, parent=ctx["parent"],
+                    span=route_sid, attempts=attempts, ok=slo_ok)
+
+    def retry_after_s(self) -> int:
+        """Retry-After guidance for the router's own 503, derived from
+        the cached fleet state instead of a constant: deeper aggregate
+        queues push clients further out (``1 + queue_depth/slots`` over
+        the routable replicas, clamped to 30 s); a fleet with NOTHING
+        routable — every replica draining or dead — answers 10 s, the
+        drain/restart timescale of the rolling-upgrade runbook."""
+        with self._lock:
+            rows = list(self._replicas.values())
+        qd = slots = 0
+        for r in rows:
+            if not r["ok"] or r["draining"]:
+                continue
+            hz = r["health"] or {}
+            qd += int(hz.get("queue_depth") or 0)
+            slots += int(hz.get("slots") or 0)
+        if slots < 1:
+            return 10
+        return min(1 + qd // slots, 30)
 
     # -------------------------------------------------------------- admin
     def _admin(self, addr, action):
@@ -567,6 +656,10 @@ def start_router(router: ReplicaRouter, port: int = 0,
                 })
             elif path == "/fleet":
                 self._reply(200, router.fleet())
+            elif path == "/slo":
+                self._reply(200, router.slo.snapshot())
+            elif path == "/spans.json":
+                self._reply(200, _tracing.spans_payload())
             else:
                 self._reply(404, {"error": f"no such path {path!r}"})
 
@@ -589,26 +682,40 @@ def start_router(router: ReplicaRouter, port: int = 0,
                 return
             length = int(self.headers.get("Content-Length", "0") or 0)
             body = self.rfile.read(length)
+            # adopt the client's traceparent or mint one HERE, so the
+            # error replies below can still name the trace id
+            tp = self.headers.get("traceparent")
+            if _tracing.parse_traceparent(tp) is None:
+                tp = _tracing.mint_traceparent()
+            trace_id = _tracing.parse_traceparent(tp)["trace"]
+            trace_hdr = ("X-MXTPU-Trace", trace_id)
             try:
-                status, data, addr_ = router.route_generate(body)
+                status, data, addr_ = router.route_generate(
+                    body, traceparent=tp)
             except NoReplicaAvailable as exc:
-                self._reply(503, {"error": str(exc)},
-                            headers=(("Retry-After", "2"),))
+                # Retry-After derived from fleet queue depth + drain
+                # state (retry_after_s), not a constant
+                self._reply(503, {"error": str(exc), "trace": trace_id},
+                            headers=(("Retry-After",
+                                      str(router.retry_after_s())),
+                                     trace_hdr))
                 return
             except (RouterRetriesExhausted, ReplicaDied) as exc:
                 self._reply(502, {
                     "error": str(exc),
                     "router_error": type(exc).__name__,
-                })
+                    "trace": trace_id,
+                }, headers=(trace_hdr,))
                 return
             except ReplicaTimeout as exc:
                 self._reply(504, {
                     "error": str(exc),
                     "router_error": "ReplicaTimeout",
-                })
+                    "trace": trace_id,
+                }, headers=(trace_hdr,))
                 return
             self._reply(status, data,
-                        headers=(("X-MXTPU-Replica", addr_),))
+                        headers=(("X-MXTPU-Replica", addr_), trace_hdr))
 
         def log_message(self, *args):  # health probes are chatty
             pass

@@ -39,6 +39,7 @@ import numpy as np
 
 from .. import telemetry as _tm
 from ..base import MXNetError
+from ..telemetry import tracing as _tracing
 from . import paged_kv as _paged_kv
 
 __all__ = ["Request", "SlotScheduler", "AdmissionQueueFull"]
@@ -57,8 +58,15 @@ _TM_OCCUPANCY = _tm.gauge(
     "serve_slot_occupancy", "decode slots currently running a request")
 _TM_TTFT = _tm.histogram(
     "serve_ttft_seconds",
-    "time-to-first-token: request arrival to its first sampled token "
-    "(queue wait + prefill)")
+    "time-to-first-token: request ARRIVAL (HTTP receipt, before "
+    "parse/queue — the server passes its receipt stamp into Request) "
+    "to the first sampled token: queue wait + admission prefill")
+_TM_QWAIT = _tm.histogram(
+    "serve_queue_wait_seconds",
+    "time a request spent in the bounded admission queue before a "
+    "slot freed up — the queueing component of serve_ttft_seconds, "
+    "reported separately so saturation (queue wait) and compute "
+    "(prefill) are tellable apart at the replica")
 _TM_REQ_SEC = _tm.histogram(
     "serve_request_seconds", "request latency: arrival to terminal outcome")
 _TM_REUSE = _tm.counter(
@@ -94,7 +102,8 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt, max_new_tokens=16, temperature=0.0,
-                 top_k=None, eos_id=None, deadline_ms=None, seed=0):
+                 top_k=None, eos_id=None, deadline_ms=None, seed=0,
+                 arrival=None, trace=None, parent=None, sampled=False):
         prompt = np.asarray(prompt)
         if prompt.ndim != 1 or prompt.size == 0:
             raise MXNetError(
@@ -125,9 +134,20 @@ class Request:
         self.temperature = temperature
         self.top_k = top_k
         self.eos_id = eos_id
-        self.arrival = time.monotonic()
+        # TTFT origin (ISSUE 16): the server stamps monotonic receipt
+        # time BEFORE reading/parsing the body and passes it here, so
+        # serve_ttft_seconds includes the full queue wait
+        self.arrival = (time.monotonic() if arrival is None
+                        else float(arrival))
         self.deadline = (self.arrival + deadline_ms / 1000.0
                          if deadline_ms else None)
+        # trace context (telemetry/tracing.py): the W3C traceparent the
+        # router minted; spans are recorded only when `sampled` rode in
+        # on the flags byte AND tracing is on in this process
+        self.trace = trace
+        self.parent = parent
+        self.sampled = bool(sampled)
+        self.queue_wait = None
         self.tokens = []
         self.outcome = None   # ok | timeout | error | shutdown
         self.error = None
@@ -166,9 +186,12 @@ class _ContiguousSlots:
     def stats(self):
         return None
 
-    def admit(self, slot, prompt):
+    def admit(self, slot, prompt, trace=None):
         """Bucketed left-padded prefill + one traced-slot cache write;
-        returns the next-token logits row of the last prompt token."""
+        returns the next-token logits row of the last prompt token.
+        ``trace`` is accepted for backend-interface parity with the
+        paged pool (which records kv_admit/kv_evict spans); the
+        contiguous pool has no per-admit KV events to attribute."""
         plen = int(prompt.size)
         bucket = next(b for b in self.prefill_buckets if b >= plen)
         padded = np.zeros((1, bucket), np.int64)
@@ -459,6 +482,14 @@ class SlotScheduler:
                     return
                 req = self._queue.popleft()
                 _TM_QUEUE.set(len(self._queue))
+            req.queue_wait = time.monotonic() - req.arrival
+            _TM_QWAIT.observe(req.queue_wait)
+            traced = req.sampled and _tracing.trace_on()
+            if traced:
+                _tracing.record_span(
+                    "queue_wait", "replica", req.trace, req.queue_wait,
+                    parent=req.parent, request=req.id)
+            t_admit0 = time.perf_counter()
             try:
                 # the whole admission for THIS request — prefill, first
                 # sample, cache write — fails only this request; the
@@ -466,7 +497,11 @@ class SlotScheduler:
                 from .. import faults as _faults
 
                 _faults.maybe_fail("serve_admit")
-                logits = self.backend.admit(free, req.prompt)
+                t_pf0 = time.perf_counter()
+                logits = self.backend.admit(
+                    free, req.prompt,
+                    trace=(req.trace if traced else None))
+                pf_dur = time.perf_counter() - t_pf0
                 first = self._sample(req, np.asarray(logits, np.float32))
             except Exception as exc:  # noqa: BLE001
                 self.backend.release(free)
@@ -484,6 +519,18 @@ class SlotScheduler:
             _TM_TOKENS.inc()
             self.stats["admitted"] += 1
             _TM_OCCUPANCY.set(self.occupied)
+            if traced:
+                plen = int(req.prompt.size)
+                bucket = next(b for b in self.prefill_buckets
+                              if b >= plen)
+                _tracing.record_span(
+                    "prefill", "replica", req.trace, pf_dur,
+                    parent=req.parent, bucket=bucket, prompt_len=plen,
+                    request=req.id)
+                _tracing.record_span(
+                    "admit", "replica", req.trace,
+                    time.perf_counter() - t_admit0, parent=req.parent,
+                    slot=free, request=req.id)
             self._maybe_finish(free, time.monotonic())
 
     def _tick(self):
@@ -494,8 +541,22 @@ class SlotScheduler:
         # crash_after:n" dies mid-decode — the death the router's
         # re-route/502 paths must survive (tests/test_serving_fleet.py)
         _faults.fire("replica_kill")
+        # injected slow replica (MXTPU_FAULT_PLAN="serve_slow:drop:1"):
+        # park the engine thread so queue wait and TTFT genuinely
+        # inflate — the SLO plane's violation paths ride this in tests
+        if _faults.active() and _faults.should_drop("serve_slow"):
+            time.sleep(_tm.health._fault_slow_s())
         t0 = time.perf_counter()
         occupied = [i for i, r in enumerate(self.slots) if r is not None]
+        # sampled decode-tick spans (ISSUE 16): every TICK_EVERY-th tick
+        # records one span per sampled live request — pure host dict
+        # writes after the tick, so the zero-host-sync invariant holds;
+        # requests are captured NOW because _finish_slot clears slots
+        tick_reqs = ()
+        if _tracing.trace_on() \
+                and self.stats["ticks"] % _tracing.TICK_EVERY == 0:
+            tick_reqs = [(i, self.slots[i]) for i in occupied
+                         if self.slots[i].sampled]
         occ_mask = np.array([r is not None for r in self.slots])
         logits, starved = self.backend.step(self._next_tok, occ_mask)
         logits = np.asarray(logits, np.float32)   # the ONE host sync/tick
@@ -515,7 +576,13 @@ class SlotScheduler:
             self._maybe_finish(i, now)
         self.stats["ticks"] += 1
         self.stats["slot_ticks"] += len(occupied)
-        _TM_TICK.observe(time.perf_counter() - t0)
+        tick_dur = time.perf_counter() - t0
+        _TM_TICK.observe(tick_dur)
+        for i, req in tick_reqs:
+            _tracing.record_span(
+                "decode_tick", "replica", req.trace, tick_dur,
+                parent=req.parent, slot=i, tick=self.stats["ticks"] - 1,
+                tokens=len(req.tokens), request=req.id)
 
     def _maybe_finish(self, slot, now):
         req = self.slots[slot]
@@ -545,7 +612,19 @@ class SlotScheduler:
             return
         req.outcome = outcome
         _TM_REQS.inc(outcome=outcome)
-        _TM_REQ_SEC.observe(time.monotonic() - req.arrival)
+        wall = time.monotonic() - req.arrival
+        _TM_REQ_SEC.observe(wall)
+        if req.sampled and _tracing.trace_on():
+            # the terminal span covers the whole request (arrival →
+            # outcome) and mirrors into the PR-5 flight ring so
+            # post-mortem dumps carry the trace id
+            _tracing.record_span(
+                "request", "replica", req.trace, wall,
+                parent=req.parent, outcome=outcome,
+                tokens=len(req.tokens), request=req.id)
+            _tm.record_step(
+                loop="serve", trace=req.trace, outcome=outcome,
+                wall_s=wall, ttft_s=req.ttft)
         req._event.set()
 
     @staticmethod

@@ -12,14 +12,23 @@ Endpoints:
     body: ``{"prompt": [token ids], "max_tokens": 16, "temperature": 0,
     "top_k": null, "eos_id": null, "deadline_ms": null, "seed": 0}``.
     200: ``{"tokens": [...], "outcome": "ok", "ttft_ms": ..,
-    "latency_ms": ..}``.  429 when the bounded admission queue is full
-    (body carries ``Retry-After`` guidance), 504 when the deadline
-    expires (partial ``tokens`` included), 400 on malformed input,
-    500 on an engine error.
+    "queue_wait_ms": .., "latency_ms": ..}``.  429 when the bounded
+    admission queue is full (body carries ``Retry-After`` guidance),
+    504 when the deadline expires (partial ``tokens`` included), 400 on
+    malformed input, 500 on an engine error.  A ``traceparent`` request
+    header (the router forwards one per attempt — docs/tracing.md)
+    threads the trace through the scheduler; the reply echoes the
+    trace id.  TTFT is measured from REQUEST RECEIPT — the handler
+    stamps the arrival before reading the body, so queue wait and
+    parse time are inside it, not silently dropped.
 ``GET /metrics`` / ``/metrics.json``
     Prometheus text / JSON snapshot of the process registry — the
     serving families (docs/telemetry.md) plus everything else the
     process emits.
+``GET /spans.json``
+    This process's bounded span buffer + host identity + clock offset —
+    what ``tools/fleetstat.py trace <id>`` joins across the fleet
+    (docs/tracing.md).
 ``GET /healthz``
     ``{"status", "draining", "slots", "occupied", "queue_depth",
     "queue_size", "ticks"}`` — liveness + the saturation and drain
@@ -41,9 +50,11 @@ from __future__ import annotations
 import json
 import math
 import threading
+import time
 
 from .. import telemetry as _tm
 from ..base import MXNetError
+from ..telemetry import tracing as _tracing
 from .scheduler import (AdmissionQueueFull, SchedulerDraining,
                         SlotScheduler)
 
@@ -104,14 +115,19 @@ def _parse_generate(body):
 
 
 def _request_json(req):
-    return {
+    out = {
         "id": req.id,
         "tokens": [int(t) for t in req.tokens],
         "n_tokens": len(req.tokens),
         "outcome": req.outcome,
         "ttft_ms": round(req.ttft * 1000.0, 3) if req.ttft is not None
         else None,
+        "queue_wait_ms": round(req.queue_wait * 1000.0, 3)
+        if req.queue_wait is not None else None,
     }
+    if req.trace is not None:
+        out["trace"] = req.trace
+    return out
 
 
 def start_server(scheduler: SlotScheduler, port: int = 0,
@@ -144,6 +160,8 @@ def start_server(scheduler: SlotScheduler, port: int = 0,
                             "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/metrics.json":
                 self._reply(200, _tm.json_snapshot(reg))
+            elif path == "/spans.json":
+                self._reply(200, _tracing.spans_payload())
             elif path == "/healthz":
                 status = "ok"
                 if scheduler.draining:
@@ -185,6 +203,12 @@ def start_server(scheduler: SlotScheduler, port: int = 0,
             if path != "/generate":
                 self._reply(404, {"error": f"no such path {path!r}"})
                 return
+            # TTFT origin (ISSUE 16): stamp receipt BEFORE the body is
+            # read or parsed — serve_ttft_seconds must cover queue wait
+            # and parse time, not start when a slot frees up
+            t_arrival = time.monotonic()
+            ctx = _tracing.parse_traceparent(
+                self.headers.get("traceparent"))
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -195,6 +219,10 @@ def start_server(scheduler: SlotScheduler, port: int = 0,
             except (ValueError, UnicodeDecodeError) as exc:
                 self._reply(400, {"error": f"malformed JSON: {exc}"})
                 return
+            kwargs["arrival"] = t_arrival
+            if ctx is not None:
+                kwargs.update(trace=ctx["trace"], parent=ctx["parent"],
+                              sampled=ctx["sampled"])
             try:
                 req = scheduler.submit(prompt, **kwargs)
             except SchedulerDraining as exc:

@@ -42,6 +42,11 @@ from .health import (  # noqa: F401
     flight_ring, sentinel_check, sentinel_record, memory_report,
     format_memory_report,
 )
+from . import tracing  # noqa: F401
+from .tracing import (  # noqa: F401
+    SloPlane, record_span, spans_payload, trace_on, enable_tracing,
+    mint_traceparent, parse_traceparent,
+)
 
 _http_server = None
 _port = _os.environ.get("MXTPU_TELEMETRY_HTTP_PORT")

@@ -135,6 +135,16 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1",
             elif path == "/metrics.json":
                 body = json.dumps(json_snapshot(reg)).encode("utf-8")
                 ctype = "application/json"
+            elif path == "/spans.json":
+                # the bounded trace-span buffer + identity/clock offset
+                # (telemetry/tracing.py) — every metrics endpoint in the
+                # fleet serves it, so fleetstat.py trace can join spans
+                # from training hosts too, not just the serving fleet
+                from . import tracing as _tracing
+
+                body = json.dumps(_tracing.spans_payload(),
+                                  default=str).encode("utf-8")
+                ctype = "application/json"
             elif path == "/healthz":
                 # liveness probe, distinct from the scrape endpoint:
                 # answers "is the process serving" without the cost (or

@@ -135,6 +135,18 @@ def post_json(addr: str, path: str, payload: dict, timeout: float):
         conn.close()
 
 
+def fetch_spans(addr: str, trace=None, timeout: float = 5.0):
+    """One member's ``GET /spans.json`` payload (identity + clock
+    offset + span buffer — telemetry/tracing.py), optionally filtered
+    to one trace id client-side.  ``fleetstat.py trace`` sweeps this
+    over the router and every replica."""
+    payload = fetch_json(addr, "/spans.json", timeout=timeout)
+    if trace is not None:
+        payload["spans"] = [s for s in payload.get("spans") or []
+                            if s.get("trace") == trace]
+    return payload
+
+
 _fetch_json = fetch_json  # internal alias (pre-ISSUE-15 name)
 
 

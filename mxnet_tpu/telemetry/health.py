@@ -567,6 +567,12 @@ def dump_flight_record(path=None, trigger: str = "manual") -> str:
         "sentinel": {"mode": sentinel_mode() or "off",
                      "pending": len(_pending)},
     }
+    # the span buffer rides every dump (lazy import: tracing needs this
+    # module's identity/clock helpers) — a post-mortem keeps the last
+    # requests' traces, not just aggregate rings
+    from . import tracing as _tracing
+
+    payload["spans"] = _tracing.spans()
     with open(path, "w") as f:
         json.dump(payload, f, indent=1, default=str)
     _TM_FLIGHT_DUMP.inc(trigger=trigger)

@@ -299,22 +299,27 @@ def histogram(name, help="", labels=(), buckets=DEFAULT_BUCKETS) -> Histogram:
 # ---------------------------------------------------------------------------
 @contextmanager
 def span(name: str, category: str = "host", device: str = "host",
-         sync=None, histogram_name: Optional[str] = None, **labels):
+         sync=None, histogram_name: Optional[str] = None, trace=None,
+         **labels):
     """Time a region.
 
     When the profiler is running, emits a chrome-trace event named
     ``name`` under ``category`` (profiler parity — same sink and timebase
     as op spans).  When telemetry is enabled, observes the duration into
     histogram ``histogram_name`` (default: sanitized ``<name>_seconds``)
-    with ``labels``.  ``sync`` is an optional zero-arg callable run before
+    with ``labels``.  ``trace`` additionally lands the region in the
+    distributed-tracing span buffer under that trace id when request
+    tracing is on (``telemetry/tracing.py`` — the ``GET /spans.json``
+    lens).  ``sync`` is an optional zero-arg callable run before
     closing (e.g. ``block_until_ready``) so async dispatch doesn't
-    under-report.  When both sinks are off the region runs untimed.
+    under-report.  When every sink is off the region runs untimed.
     """
     from .. import profiler as _prof
+    from . import tracing as _tracing
 
     prof_on = _prof.is_running()
-    tm_on = _state.enabled
-    if not (prof_on or tm_on):
+    trace_on = trace is not None and _tracing.trace_on()
+    if not (prof_on or _state.enabled or trace_on):
         yield
         return
     us0 = _prof.now_us() if prof_on else 0.0
@@ -330,6 +335,8 @@ def span(name: str, category: str = "host", device: str = "host",
         dt = time.perf_counter() - t0
         if prof_on:
             _prof.record(name, device, us0, _prof.now_us(), category)
+        if trace_on:
+            _tracing.record_span(name, category, trace, dt, **labels)
         if _state.enabled:  # re-check: may have flipped inside the region
             hname = histogram_name or sanitize_name(name) + "_seconds"
             histogram(hname, f"wall time of {name} (seconds)",

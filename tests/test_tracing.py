@@ -1,0 +1,495 @@
+"""Tracing + SLO plane tests (ISSUE 16): W3C traceparent grammar, the
+bounded span ring, end-to-end propagation through a router retry (one
+trace id across router and replica lanes, joined by fleetstat), TTFT
+measured from request receipt (>= queue wait + prefill on a saturated
+queue), queue-depth-derived Retry-After, SLO burn-rate math + the /slo
+endpoint, the serve_slow fault site, and — the deployability bar —
+bit-identical scheduler outputs with tracing off vs on.
+"""
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, models, telemetry as tm
+from mxnet_tpu.models.decode import KVDecoder
+from mxnet_tpu.serving import (NoReplicaAvailable, ReplicaRouter,
+                               SlotScheduler, serve_decoder,
+                               start_router)
+from mxnet_tpu.telemetry import tracing
+
+L, H, D, T, V = 2, 2, 32, 32, 17
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    net = models.transformer.transformer_lm(
+        num_layers=L, num_heads=H, d_model=D, seq_len=T, vocab_size=V)
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="null",
+                         data=(1, T), softmax_label=(1, T))
+    rs = np.random.RandomState(0)
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        arr[:] = rs.normal(0, 0.08, arr.shape).astype(np.float32)
+        params[name] = arr
+    return params
+
+
+@pytest.fixture(scope="module")
+def decoder(lm_params):
+    return KVDecoder(lm_params, num_layers=L, num_heads=H, max_len=T)
+
+
+@pytest.fixture()
+def metrics():
+    was = tm.enabled()
+    tm.enable()
+    yield tm.get_registry()
+    if not was:
+        tm.disable()
+
+
+@pytest.fixture()
+def traced(monkeypatch):
+    """Tracing on, everything sampled, every tick recorded; restores."""
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1")
+    monkeypatch.setattr(tracing, "TICK_EVERY", 1)
+    was = tracing.trace_on()
+    tracing.enable_tracing(True)
+    tracing.clear_spans()
+    yield
+    tracing.enable_tracing(was)
+    tracing.clear_spans()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_" + name, os.path.join(REPO, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _post(port, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# traceparent grammar
+# ---------------------------------------------------------------------------
+def test_traceparent_mint_parse_roundtrip():
+    tp = tracing.mint_traceparent(sampled=True)
+    ctx = tracing.parse_traceparent(tp)
+    assert len(ctx["trace"]) == 32 and len(ctx["parent"]) == 16
+    assert ctx["sampled"] is True
+    assert tracing.parse_traceparent(
+        tracing.mint_traceparent(sampled=False))["sampled"] is False
+    # child: same trace, fresh parent span id
+    child = tracing.child_traceparent(ctx["trace"], True)
+    cctx = tracing.parse_traceparent(child)
+    assert cctx["trace"] == ctx["trace"]
+    assert cctx["parent"] != ctx["parent"]
+    # the router records its attempt span under the SAME id it forwards
+    sid = tracing.mint_span_id()
+    reused = tracing.parse_traceparent(
+        tracing.child_traceparent(ctx["trace"], False, sid))
+    assert reused["parent"] == sid and reused["sampled"] is False
+
+
+def test_traceparent_malformed_degrades_to_none():
+    bad = [None, "", "garbage", "01-" + "a" * 32 + "-" + "b" * 16 + "-01",
+           "00-" + "a" * 31 + "-" + "b" * 16 + "-01",
+           "00-" + "a" * 32 + "-" + "b" * 15 + "-01",
+           "00-" + "g" * 32 + "-" + "b" * 16 + "-01", 42]
+    for header in bad:
+        assert tracing.parse_traceparent(header) is None
+    # case-insensitive per the W3C grammar
+    up = ("00-" + "A" * 32 + "-" + "B" * 16 + "-01").upper()
+    assert tracing.parse_traceparent(up)["trace"] == "a" * 32
+
+
+def test_span_ring_is_bounded(monkeypatch, metrics):
+    monkeypatch.setenv("MXTPU_SPAN_RING", "16")
+    tracing.clear_spans()
+    try:
+        for i in range(40):
+            tracing.record_span("s%d" % i, "replica", "t" * 32, 0.001)
+        got = tracing.spans()
+        assert len(got) == 16                       # oldest fell off
+        assert got[-1]["name"] == "s39"
+        assert len({s["sid"] for s in got}) == 16   # sids unique
+        # per-trace filter
+        tracing.record_span("x", "router", "u" * 32, 0.0)
+        assert [s["name"] for s in tracing.spans("u" * 32)] == ["x"]
+    finally:
+        tracing.clear_spans()
+
+
+# ---------------------------------------------------------------------------
+# SLO plane math
+# ---------------------------------------------------------------------------
+def test_slo_plane_burn_math_and_exemplars(metrics):
+    plane = tracing.SloPlane(ttft_ms=100, avail=0.9)   # budget = 0.1
+    for _ in range(8):
+        plane.record(True, ttft_s=0.01)
+    plane.record(False)                                 # availability bad
+    plane.record(True, ttft_s=0.2, trace="e" * 32)      # ttft bad
+    snap = plane.snapshot()
+    w = snap["windows"]["60s"]
+    assert w["requests"] == 10
+    assert w["bad_availability"] == 1 and w["bad_ttft"] == 1
+    # bad fraction / budget: 1/10 / 0.1 = 1.0 exactly at the objective
+    assert w["burn_rate"]["availability"] == pytest.approx(1.0)
+    # ttft denominator is requests WITH a ttft observation (9 of 10);
+    # the snapshot rounds burn rates to 4 decimals
+    assert w["burn_rate"]["ttft"] == pytest.approx((1 / 9) / 0.1,
+                                                   abs=1e-3)
+    assert snap["violations_total"] == {"availability": 1, "ttft": 1}
+    # the slowest TTFT carries its exemplar trace id
+    assert snap["exemplars"][0]["trace"] == "e" * 32
+    assert snap["exemplars"][0]["ttft_ms"] == pytest.approx(200.0)
+    assert snap["error_budget"] == pytest.approx(0.1)
+
+
+def test_slo_endpoint_and_metric_families(metrics):
+    router = ReplicaRouter(replicas=["127.0.0.1:9"], scrape_s=30)
+    rsrv = start_router(router, port=0)
+    try:
+        router.slo.record(True, ttft_s=0.001, trace="a" * 32)
+        router.slo.record(False, trace="b" * 32)
+        slo = _get(rsrv.server_address[1], "/slo")
+        assert slo["objectives"]["availability"] == router.slo.avail
+        assert slo["windows"]["5s"]["requests"] == 2
+        assert slo["violations_total"]["availability"] == 1
+        # snapshot() refreshed the gauges: families live in the registry
+        text = tm.generate_text(tm.get_registry())
+        assert "slo_burn_rate" in text
+        assert "slo_violations_total" in text
+        tracing.record_span("x", "router", "c" * 32, 0.0)
+        assert "trace_spans_total" in tm.generate_text(tm.get_registry())
+        tracing.clear_spans()
+    finally:
+        rsrv.shutdown()
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# e2e propagation: router retry -> replica -> finished
+# ---------------------------------------------------------------------------
+def _stub_shed_replica():
+    """An HTTP replica that looks healthy (/healthz) but sheds every
+    POST /generate with a 503 — the first routing choice that forces a
+    traced re-route."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps({"status": "ok", "slots": 2, "occupied": 0,
+                               "queue_depth": 0, "queue_size": 16,
+                               "ticks": 0}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0") or 0)
+            self.rfile.read(n)
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *args):
+            pass
+
+    class _S(ThreadingHTTPServer):
+        daemon_threads = True
+
+    srv = _S(("127.0.0.1", 0), _H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, "127.0.0.1:%d" % srv.server_address[1]
+
+
+def test_e2e_trace_through_retry_and_fleetstat(decoder, metrics, traced,
+                                               tmp_path, capsys):
+    """One request bounces off a shedding replica, finishes on a real
+    one, and the whole story — route, both attempts, queue wait,
+    prefill, admit, decode ticks, terminal — lands under ONE trace id
+    with router and replica lanes, joinable by `fleetstat.py trace`."""
+    stub, stub_addr = _stub_shed_replica()
+    server, sched = serve_decoder(decoder, port=0, num_slots=2,
+                                  queue_size=16)
+    real_addr = "127.0.0.1:%d" % server.server_address[1]
+    # the stub is listed FIRST: equal load ties keep dict order, so the
+    # first attempt sheds and the retry carries the same trace onward
+    router = ReplicaRouter(replicas=[stub_addr, real_addr], scrape_s=0.1)
+    rsrv = start_router(router, port=0)
+    rport = rsrv.server_address[1]
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            rows = router.replicas()
+            if all(r["ok"] for r in rows.values()):
+                break
+            time.sleep(0.05)
+        st, out, hdr = _post(rport, {"prompt": [1, 2, 3], "max_tokens": 4})
+        assert st == 200
+        tid = hdr["X-MXTPU-Trace"]
+        assert len(tid) == 32
+        assert out["trace"] == tid             # reply body names it too
+        assert "queue_wait_ms" in out
+        assert hdr["X-MXTPU-Replica"] == real_addr
+
+        spans = tracing.spans(trace=tid)
+        names = [s["name"] for s in spans]
+        for need in ("route", "attempt", "queue_wait", "prefill",
+                     "admit", "decode_tick", "request"):
+            assert need in names, f"missing span {need!r} in {names}"
+        # the shed attempt and the successful one, same trace
+        attempts = [s for s in spans if s["name"] == "attempt"]
+        assert sorted(str(a["status"]) for a in attempts) == ["200", "503"]
+        assert {s["svc"] for s in spans} == {"router", "replica"}
+        # parentage: attempts hang off the route span; the replica's
+        # spans hang off the span id the router forwarded (= the
+        # successful attempt's own sid)
+        route = next(s for s in spans if s["name"] == "route")
+        assert all(a["parent"] == route["sid"] for a in attempts)
+        ok_att = next(a for a in attempts if str(a["status"]) == "200")
+        qw = next(s for s in spans if s["name"] == "queue_wait")
+        assert qw["parent"] == ok_att["sid"]
+
+        # fleetstat joins router + replica buffers into one timeline
+        fs = _load_tool("fleetstat")
+        outj = str(tmp_path / "trace.json")
+        rc = fs.main(["trace", tid, "--router", "127.0.0.1:%d" % rport,
+                      "-o", outj])
+        assert rc == 0
+        listing = capsys.readouterr().out
+        shown = [ln.split()[3] for ln in listing.splitlines()[2:]
+                 if ln.strip() and "wrote" not in ln]
+        assert len(shown) >= 5                 # >=5 named spans rendered
+        # corrected start order: the queue wait starts before prefill,
+        # prefill before the first decode tick (the terminal "request"
+        # span starts at ARRIVAL, so it sorts near the queue wait)
+        assert shown.index("queue_wait") < shown.index("prefill") \
+            < shown.index("decode_tick")
+        assert "request" in shown
+        with open(outj) as f:
+            doc = json.load(f)
+        evs = doc["traceEvents"]
+        lanes = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert any("router" in ln for ln in lanes)
+        assert any("replica" in ln for ln in lanes)
+        assert sum(1 for e in evs if e["ph"] == "X") == len(spans)
+    finally:
+        rsrv.shutdown()
+        router.stop()
+        stub.shutdown()
+        server.shutdown()
+        sched.close()
+
+
+def test_tracing_off_records_nothing_and_spans_json(decoder, metrics):
+    """With MXTPU_TRACE off the fleet still mints/propagates trace ids
+    (log correlation is free) but the span buffer stays empty, and
+    /spans.json says so."""
+    tracing.enable_tracing(False)
+    tracing.clear_spans()
+    server, sched = serve_decoder(decoder, port=0, num_slots=2,
+                                  queue_size=16)
+    addr = "127.0.0.1:%d" % server.server_address[1]
+    router = ReplicaRouter(replicas=[addr], scrape_s=0.1)
+    rsrv = start_router(router, port=0)
+    try:
+        st, out, hdr = _post(rsrv.server_address[1],
+                             {"prompt": [1, 2], "max_tokens": 3})
+        assert st == 200 and len(hdr["X-MXTPU-Trace"]) == 32
+        payload = _get(rsrv.server_address[1], "/spans.json")
+        assert payload["trace_on"] is False
+        assert payload["spans"] == []
+        assert "offset_s" in payload["clock"]
+    finally:
+        rsrv.shutdown()
+        router.stop()
+        server.shutdown()
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# TTFT from request receipt (satellite a)
+# ---------------------------------------------------------------------------
+def test_ttft_includes_queue_wait_on_saturated_queue(decoder, metrics,
+                                                     traced):
+    """One slot, several requests: the queued request's TTFT must be
+    measured from submission (receipt), so ttft >= queue_wait +
+    prefill — queue time can never be hidden from the SLO."""
+    sched = SlotScheduler(decoder, num_slots=1, queue_size=8)
+    try:
+        reqs = [sched.submit([1, 2, 3, 4], max_new_tokens=8, temperature=0,
+                             trace="%032x" % i, sampled=True)
+                for i in range(3)]
+        for r in reqs:
+            r.wait(120)
+            assert r.outcome == "ok"
+        last = reqs[-1]
+        assert last.queue_wait > 0          # it genuinely queued
+        assert last.ttft >= last.queue_wait
+        pf = next(s for s in tracing.spans(trace=last.trace)
+                  if s["name"] == "prefill")
+        assert last.ttft >= last.queue_wait + pf["dur_s"] - 5e-3
+        # the metric families observed both components
+        text = tm.generate_text(tm.get_registry())
+        assert "serve_queue_wait_seconds" in text
+        assert "serve_ttft_seconds" in text
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# Retry-After from fleet queue depth (satellite b)
+# ---------------------------------------------------------------------------
+def test_retry_after_tracks_fleet_queue_depth():
+    router = ReplicaRouter(replicas=["h1:1", "h2:1"], scrape_s=30)
+
+    def _load(qd, draining=False, ok=True):
+        for a in router._replicas:
+            router._replicas[a].update(
+                ok=ok, draining=draining,
+                health={"slots": 2, "occupied": 0, "queue_depth": qd,
+                        "queue_size": 64})
+
+    _load(0)
+    shallow = router.retry_after_s()
+    _load(16)
+    deep = router.retry_after_s()
+    _load(80)
+    deeper = router.retry_after_s()
+    assert shallow < deep < deeper           # deeper queue pushes out
+    assert shallow == 1 and deep == 1 + 32 // 4
+    _load(10 ** 6)
+    assert router.retry_after_s() == 30      # clamped
+    _load(0, draining=True)
+    assert router.retry_after_s() == 10      # nothing routable: drain
+    _load(0, ok=False)
+    assert router.retry_after_s() == 10      # ...or restart timescale
+
+
+def test_router_503_carries_derived_retry_after(metrics):
+    """The HTTP 503 reply's Retry-After is retry_after_s(), not a
+    constant — an empty/unroutable fleet answers the 10 s drain
+    timescale, and the reply still names the trace."""
+    router = ReplicaRouter(replicas=["127.0.0.1:9"], scrape_s=30)
+    rsrv = start_router(router, port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(rsrv.server_address[1], {"prompt": [1]})
+        err = ei.value
+        assert err.code == 503
+        assert err.headers["Retry-After"] == str(router.retry_after_s())
+        assert int(err.headers["Retry-After"]) == 10
+        assert len(err.headers["X-MXTPU-Trace"]) == 32
+        body = json.loads(err.read())
+        assert body["trace"] == err.headers["X-MXTPU-Trace"]
+    finally:
+        rsrv.shutdown()
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# serve_slow fault site: injectable TTFT pressure
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_serve_slow_fault_parks_the_engine(decoder, metrics, monkeypatch):
+    """MXTPU_FAULT_PLAN=serve_slow:drop:1 parks the engine thread every
+    tick, so decode genuinely slows — the injected-straggler knob the
+    SLO/burn-rate demos ride."""
+    sched = SlotScheduler(decoder, num_slots=1, queue_size=4)
+    try:
+        # warm the prefill/step programs so the baseline is decode, not
+        # compile time
+        sched.submit([1, 2, 3], max_new_tokens=6, temperature=0).wait(120)
+        t0 = time.monotonic()
+        sched.submit([1, 2, 3], max_new_tokens=6, temperature=0).wait(120)
+        fast = time.monotonic() - t0
+        monkeypatch.setenv("MXTPU_FAULT_PLAN", "serve_slow:drop:1")
+        monkeypatch.setenv("MXTPU_FAULT_SLOW_S", "0.05")
+        faults.reset()
+        t0 = time.monotonic()
+        req = sched.submit([1, 2, 3], max_new_tokens=6, temperature=0)
+        req.wait(120)
+        slow = time.monotonic() - t0
+        assert req.outcome == "ok"
+        assert slow > fast + 0.15            # >=5 parked decode ticks
+    finally:
+        monkeypatch.delenv("MXTPU_FAULT_PLAN", raising=False)
+        faults.reset()
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# tracing-off bit-identity (satellite c)
+# ---------------------------------------------------------------------------
+def test_tracing_is_bit_identical_on_scheduler_outputs(decoder, metrics,
+                                                       monkeypatch):
+    """Tracing must be pure observation: the same prompts and seeds
+    produce byte-identical token streams with tracing off vs on."""
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11], [12]]
+
+    def run():
+        sched = SlotScheduler(decoder, num_slots=2, queue_size=16)
+        try:
+            reqs = [sched.submit(p, max_new_tokens=6,
+                                 temperature=(0 if i % 2 else 0.7),
+                                 seed=i, trace="%032x" % i, sampled=True)
+                    for i, p in enumerate(prompts)]
+            return [list(r.wait(120).tokens) for r in reqs]
+        finally:
+            sched.close()
+
+    tracing.enable_tracing(False)
+    tracing.clear_spans()
+    base = run()
+    assert not tracing.spans()
+    monkeypatch.setattr(tracing, "TICK_EVERY", 1)
+    tracing.enable_tracing(True)
+    try:
+        on = run()
+        assert tracing.spans()               # it really recorded
+    finally:
+        tracing.enable_tracing(False)
+        tracing.clear_spans()
+    assert on == base
+
+
+# ---------------------------------------------------------------------------
+# bench_trend direction tokens (satellite f)
+# ---------------------------------------------------------------------------
+def test_bench_trend_directions_for_trace_metrics():
+    bt = _load_tool("bench_trend")
+    assert bt.lower_is_better("slo_burn_rate_availability_60s")
+    assert bt.lower_is_better("slo_violations_availability")
+    assert bt.lower_is_better("trace_overhead_pct")
+    assert not bt.lower_is_better("serve_trace_on_tokens_per_sec")
+    assert not bt.lower_is_better("serve_trace_off_tokens_per_sec")

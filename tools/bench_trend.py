@@ -45,7 +45,11 @@ _LOWER_IS_BETTER = ("_ms", "_us", "_seconds", "latency", "_p50", "_p99",
                     "overhead", "stall", "_bytes_per_replica",
                     # serving-fleet metrics (round 19): router re-routes
                     # and shed requests are failures — they regress UP
-                    "retry", "retries", "unavailable")
+                    "retry", "retries", "unavailable",
+                    # tracing + SLO metrics (round 20): budget burn,
+                    # objective violations, and tracing overhead all
+                    # regress UP
+                    "burn_rate", "violations")
 
 
 def lower_is_better(name: str) -> bool:

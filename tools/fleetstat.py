@@ -17,6 +17,19 @@ a cross-host stall is one picture instead of N files:
 
     python tools/fleetstat.py merge-trace dumps/*.json -o fleet_trace.json
 
+``trace`` is the per-REQUEST twin (docs/tracing.md): it sweeps
+``GET /spans.json`` over a serving router and every replica the router
+knows, keeps one trace id's spans, dedupes shared buffers, corrects
+each process's clock by its payload's offset estimate, prints the span
+listing in start order, and (with ``-o``) writes a chrome trace with a
+lane per (host, pid, service):
+
+    python tools/fleetstat.py trace 4bf92f3577b34da6a3ce929d0e0e4736 \\
+        --router 10.0.0.9:8700 -o trace.json
+
+``--slo`` renders the router's ``GET /slo`` burn-rate table (multi-
+window error-budget burn + slowest-TTFT exemplar trace ids).
+
 Stdlib-only on purpose: this runs on an operator workstation or a bare
 pod VM without the mxnet_tpu (or jax) install.
 """
@@ -28,10 +41,14 @@ import time
 import urllib.request
 
 
-def fetch_fleet(addr, timeout=10.0):
-    with urllib.request.urlopen("http://%s/fleet" % addr,
+def fetch_json(addr, path, timeout=10.0):
+    with urllib.request.urlopen("http://%s%s" % (addr, path),
                                 timeout=timeout) as resp:
         return json.loads(resp.read())
+
+
+def fetch_fleet(addr, timeout=10.0):
+    return fetch_json(addr, "/fleet", timeout=timeout)
 
 
 def render_router(fleet):
@@ -169,8 +186,164 @@ def merge_trace(paths, out_path):
     return out_path, len(events)
 
 
+def gather_spans(router_addr, trace_id, timeout=10.0):
+    """One trace's spans from the whole serving fleet: the router's
+    ``/spans.json`` plus every replica's (addresses learned from the
+    router's ``/healthz`` registry), deduped by span id — an in-process
+    test fleet shares ONE buffer, so the same span can arrive from
+    every endpoint — with each payload's ``clock.offset_s`` applied to
+    its spans' end stamps (``_t_corr``).  Sorted by corrected START."""
+    payloads = []
+    try:
+        payloads.append(fetch_json(router_addr, "/spans.json", timeout))
+    except OSError as exc:
+        print("fleetstat: router %s /spans.json unreachable: %s"
+              % (router_addr, exc), file=sys.stderr)
+    replicas = ()
+    try:
+        hz = fetch_json(router_addr, "/healthz", timeout)
+        replicas = sorted(hz.get("replicas") or {})
+    except OSError:
+        pass
+    for addr in replicas:
+        try:
+            payloads.append(fetch_json(addr, "/spans.json", timeout))
+        except OSError:
+            continue
+    seen = set()
+    out = []
+    for p in payloads:
+        offset = float((p.get("clock") or {}).get("offset_s") or 0.0)
+        for s in p.get("spans") or ():
+            if s.get("trace") != trace_id or s.get("sid") in seen:
+                continue
+            seen.add(s.get("sid"))
+            s = dict(s)
+            s["_t_corr"] = float(s.get("t") or 0.0) + offset
+            s["_lane"] = (str(p.get("host", "?")), p.get("pid", 0),
+                          str(s.get("svc", "?")))
+            out.append(s)
+    out.sort(key=lambda s: s["_t_corr"] - float(s.get("dur_s") or 0.0))
+    return out
+
+
+def render_spans(trace_id, spans):
+    """Span listing in corrected start order, offsets relative to the
+    trace's first span."""
+    t0 = min(s["_t_corr"] - float(s.get("dur_s") or 0.0) for s in spans)
+    lanes = sorted({s["_lane"] for s in spans})
+    lines = ["trace %s: %d span(s) across %d lane(s)"
+             % (trace_id, len(spans), len(lanes))]
+    lines.append("%10s %10s  %-8s %-14s %s" % (
+        "start", "dur", "svc", "span", "attrs"))
+    for s in spans:
+        dur_s = float(s.get("dur_s") or 0.0)
+        attrs = " ".join(
+            "%s=%s" % (k, v) for k, v in sorted(s.items())
+            if not k.startswith("_")
+            and k not in ("t", "dur_s", "name", "svc", "trace", "sid",
+                          "parent"))
+        lines.append("%8.2fms %8.2fms  %-8s %-14s %s" % (
+            (s["_t_corr"] - dur_s - t0) * 1e3, dur_s * 1e3,
+            s["_lane"][2], str(s.get("name", "?")), attrs))
+    return "\n".join(lines)
+
+
+def write_trace(spans, out_path):
+    """Chrome trace over the corrected timebase: one lane per (host,
+    pid, service); each span drawn ``[t - dur, t]`` (same convention as
+    :func:`merge_trace`).  Returns ``(path, n_events, n_lanes)``."""
+    lanes = {}
+    events = []
+    t_min = None
+    for s in spans:
+        pid = lanes.setdefault(s["_lane"], len(lanes))
+        dur_s = float(s.get("dur_s") or 0.0)
+        end_us = s["_t_corr"] * 1e6
+        start_us = end_us - dur_s * 1e6
+        t_min = start_us if t_min is None else min(t_min, start_us)
+        events.append({
+            "ph": "X", "pid": pid, "tid": 0,
+            "ts": start_us, "dur": max(dur_s * 1e6, 1.0),
+            "name": str(s.get("name", "?")),
+            "cat": str(s.get("svc", "span")),
+            "args": {k: v for k, v in s.items()
+                     if isinstance(v, (int, float, str))
+                     and not k.startswith("_") and k != "t"},
+        })
+    t_min = t_min or 0.0
+    for e in events:
+        e["ts"] = round(e["ts"] - t_min, 3)
+    meta = [{"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+             "args": {"name": "%s pid%s %s" % lane}}
+            for lane, pid in lanes.items()]
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": meta + events,
+                   "displayTimeUnit": "ms"}, f, indent=1)
+    return out_path, len(events), len(lanes)
+
+
+def render_slo(slo):
+    """Human rendering of the router's GET /slo burn-rate payload."""
+    obj = slo.get("objectives") or {}
+    lines = ["SLO: ttft <= %sms, availability >= %s (error budget %s)"
+             % (obj.get("ttft_ms"), obj.get("availability"),
+                slo.get("error_budget"))]
+    lines.append("%-8s %9s %10s %11s %9s %10s" % (
+        "window", "requests", "bad_avail", "burn_avail", "bad_ttft",
+        "burn_ttft"))
+    windows = slo.get("windows") or {}
+    for label in sorted(windows, key=lambda w: float(w.rstrip("s"))):
+        w = windows[label]
+        burn = w.get("burn_rate") or {}
+        lines.append("%-8s %9s %10s %11s %9s %10s" % (
+            label, w.get("requests"), w.get("bad_availability"),
+            burn.get("availability"), w.get("bad_ttft"),
+            burn.get("ttft")))
+    viol = slo.get("violations_total") or {}
+    lines.append("violations since start: availability=%s ttft=%s"
+                 % (viol.get("availability"), viol.get("ttft")))
+    exemplars = slo.get("exemplars") or []
+    if exemplars:
+        lines.append("slowest-TTFT exemplar traces (fleetstat.py trace "
+                     "<id> --router ...):")
+        for e in exemplars:
+            lines.append("  %s  %8.2fms" % (e.get("trace"),
+                                            float(e.get("ttft_ms") or 0)))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "trace":
+        ap = argparse.ArgumentParser(
+            prog="fleetstat.py trace",
+            description="join one request's spans from the router and "
+                        "every replica into a clock-corrected listing "
+                        "and (with -o) a chrome trace")
+        ap.add_argument("trace_id", help="32-hex trace id (from "
+                        "X-MXTPU-Trace, /slo exemplars, or the reply "
+                        "body)")
+        ap.add_argument("--router", required=True, metavar="ADDR",
+                        help="serving router host:port")
+        ap.add_argument("-o", "--out", default=None,
+                        help="also write a chrome-trace JSON here")
+        ap.add_argument("--timeout", type=float, default=10.0)
+        args = ap.parse_args(argv[1:])
+        spans = gather_spans(args.router, args.trace_id,
+                             timeout=args.timeout)
+        if not spans:
+            print("fleetstat: no spans for trace %s (is MXTPU_TRACE=1 "
+                  "on the fleet, and was the request sampled?)"
+                  % args.trace_id, file=sys.stderr)
+            return 1
+        print(render_spans(args.trace_id, spans))
+        if args.out:
+            out, n, nl = write_trace(spans, args.out)
+            print("wrote %s (%d events, %d lanes) — open in "
+                  "chrome://tracing" % (out, n, nl))
+        return 0
+
     if argv and argv[0] == "merge-trace":
         ap = argparse.ArgumentParser(
             prog="fleetstat.py merge-trace",
@@ -200,9 +373,27 @@ def main(argv=None):
                     default=None, metavar="SEC",
                     help="refresh every SEC seconds (default 5)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="print the raw /fleet JSON")
+                    help="print the raw /fleet (or /slo) JSON")
+    ap.add_argument("--slo", action="store_true",
+                    help="render the router's GET /slo burn-rate table "
+                         "instead of the fleet view")
+    ap.add_argument("target", nargs="?", default=None, metavar="ADDR",
+                    help="bare host:port shorthand — treated as --router "
+                         "ADDR (e.g. `fleetstat.py localhost:9100 --slo`)")
     args = ap.parse_args(argv)
+    if args.target is not None and args.router is None:
+        args.router = args.target
     target = args.router or args.coord
+    if args.slo:
+        try:
+            slo = fetch_json(target, "/slo")
+        except OSError as exc:
+            print("fleetstat: router %s /slo unreachable: %s"
+                  % (target, exc), file=sys.stderr)
+            return 1
+        print(json.dumps(slo, indent=1) if args.as_json
+              else render_slo(slo))
+        return 0
     while True:
         try:
             fleet = fetch_fleet(target)

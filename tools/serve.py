@@ -33,10 +33,19 @@ SIGTERM drains gracefully: the scheduler stops admitting, queued and
 in-flight requests finish, then the process exits 0 — so a plain
 ``kill`` IS the restart step of the rolling-upgrade runbook.
 
+Request tracing + SLO plane (docs/tracing.md): ``--trace`` (or
+``MXTPU_TRACE=1``) turns on span recording — the router mints/forwards
+W3C ``traceparent`` per request, every process serves its span buffer
+at ``GET /spans.json``, the router serves burn rates at ``GET /slo``,
+and ``tools/fleetstat.py trace <id> --router host:port`` joins one
+request's spans into a clock-corrected chrome trace.
+
 Knobs (flags override env): MXTPU_SERVE_SLOTS, MXTPU_SERVE_QUEUE,
 MXTPU_SERVE_DEADLINE_MS, MXTPU_PREDICT_INT8, MXTPU_KV_BLOCK,
 MXTPU_PREFIX_CACHE, MXTPU_SERVE_REPLICAS, MXTPU_ROUTER_SCRAPE_S,
-MXTPU_ROUTER_RETRIES (docs/how_to/env_var.md rounds 10 + 19).
+MXTPU_ROUTER_RETRIES, MXTPU_TRACE, MXTPU_TRACE_SAMPLE,
+MXTPU_SLO_TTFT_MS, MXTPU_SLO_AVAIL (docs/how_to/env_var.md rounds
+10 + 19 + 20).
 """
 import argparse
 import os
@@ -100,6 +109,10 @@ def _parse_args(argv=None):
     ap.add_argument("--retries", type=int, default=None,
                     help="router idempotent re-routes per request "
                          "(MXTPU_ROUTER_RETRIES, 2)")
+    ap.add_argument("--trace", action="store_true",
+                    help="record request spans (MXTPU_TRACE=1): "
+                         "/spans.json per process, /slo + traceparent "
+                         "minting on the router — docs/tracing.md")
     ap.add_argument("--port", type=int, default=9200)
     ap.add_argument("--addr", default="127.0.0.1")
     return ap.parse_args(argv)
@@ -164,6 +177,8 @@ def _main_replica(args):
     from mxnet_tpu.serving import serve_decoder
 
     telemetry.enable()  # a server without metrics is not operable
+    if args.trace:
+        telemetry.tracing.enable_tracing()
     stop = _arm_sigterm()
     decoder = build_decoder(args)
     server, scheduler = serve_decoder(
@@ -236,6 +251,8 @@ def _spawn_fleet(args):
         flags += ["--deadline-ms", str(args.deadline_ms)]
     if args.kv_block is not None:
         flags += ["--kv-block", str(args.kv_block)]
+    if args.trace:
+        flags.append("--trace")   # one flag traces the whole fleet
     procs, addrs = [], []
     for _ in range(args.fleet):
         procs.append(subprocess.Popen(
@@ -274,6 +291,8 @@ def _main_router(args):
     from mxnet_tpu.serving import ReplicaRouter, start_router
 
     telemetry.enable()
+    if args.trace:
+        telemetry.tracing.enable_tracing()
     stop = _arm_sigterm()
     procs = []
     replicas = [a.strip() for a in (args.replicas or "").split(",")
@@ -288,7 +307,9 @@ def _main_router(args):
     n = len(router.replicas())
     print(f"routing on http://{host}:{port} over {n} replica(s) "
           f"(scrape every {router.scrape_s}s, retries {router.retries}"
-          f"{', coordinator ' + args.coord if args.coord else ''})",
+          f"{', coordinator ' + args.coord if args.coord else ''}"
+          f"{', tracing on' if args.trace else ''}) — "
+          f"GET /slo for burn rates, /spans.json for the span buffer",
           flush=True)
     try:
         while not stop.wait(0.5):
