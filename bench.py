@@ -199,8 +199,15 @@ def _init_backend(timeout_s, retry_timeout_s, notes):
     can parse.  An init that *raises* is genuinely retried once.  Every
     attempt lands in ``notes`` (emitted as ``init_notes`` in the bench
     JSON), so a slow-but-successful init is visible instead of silent.
+
+    Round 21 adds PHASE attribution: init walks three phases — ``import``
+    (the jax import itself), ``device enumeration`` (``jax.devices()``,
+    where the plugin handshake lives), ``first compile`` (a 1-element
+    jitted add, the first XLA client round-trip) — and the watchdog
+    stamps the in-flight phase into every timeout note, so a hung
+    artifact says WHICH phase wedged instead of just "init timed out".
     """
-    state = {"done": False}
+    state = {"done": False, "phase": "import"}
     deadline = {"at": time.monotonic() + timeout_s, "extended": False}
 
     def watchdog():
@@ -211,13 +218,15 @@ def _init_backend(timeout_s, retry_timeout_s, notes):
                     deadline["extended"] = True
                     deadline["at"] = now + retry_timeout_s
                     notes.append(
-                        "backend init exceeded the %ds window; watchdog "
-                        "extended once for a %ds retry window"
-                        % (timeout_s, retry_timeout_s))
+                        "backend init exceeded the %ds window during "
+                        "phase '%s'; watchdog extended once for a %ds "
+                        "retry window"
+                        % (timeout_s, state["phase"], retry_timeout_s))
                 else:
                     _fail("backend init timed out after retry "
-                          "(%ds + %ds windows): %s"
-                          % (timeout_s, retry_timeout_s, "; ".join(notes)))
+                          "(%ds + %ds windows) during phase '%s': %s"
+                          % (timeout_s, retry_timeout_s, state["phase"],
+                             "; ".join(notes)))
                     os._exit(2)
             time.sleep(1.0)
 
@@ -227,18 +236,24 @@ def _init_backend(timeout_s, retry_timeout_s, notes):
     try:
         import jax
 
+        state["phase"] = "device enumeration"
         try:
             attempts += 1
             devices = jax.devices()
         except Exception as exc:  # noqa: BLE001 — plugin flake: retry once
-            notes.append("first init attempt raised %r; retrying once"
+            notes.append("device enumeration raised %r; retrying once"
                          % (exc,))
             time.sleep(2.0)
             attempts += 1
             devices = jax.devices()
+        state["phase"] = "first compile"
+        import jax.numpy as jnp
+
+        jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.zeros((1,))))
         init_s = time.monotonic() - tic
         if init_s > min(timeout_s, 60):
-            notes.append("backend init took %.1fs" % init_s)
+            notes.append("backend init took %.1fs (last phase: %s)"
+                         % (init_s, state["phase"]))
         return devices, attempts
     finally:
         state["done"] = True  # disarm even when init raises
@@ -1474,6 +1489,116 @@ def _trace_micro():
             tm.disable()
 
 
+def _autotune_micro():
+    """Autotune micro-bench (round 21, ISSUE 18).  Four numbers:
+
+    - ``paged_attn_{gather,kernel}_us_per_step``: one full decode step
+      (all layers) over the paged pool through the PR-15 gather
+      materialization vs the tuned paged-attention schedule the
+      autotuner picks for this rig — plus the ratio as
+      ``paged_attn_kernel_speedup`` (higher is better; the acceptance
+      gate is >= 1.2x);
+    - ``autotune_search_ms``: wall cost of the bounded first search
+      (``MXTPU_AUTOTUNE_TRIALS`` candidates, warmup + best-of-k each);
+    - ``autotune_cache_hit``: a SECOND in-process run against the file
+      the first search persisted — 1 iff it reused the winner with
+      zero new trials (the whole point of the on-disk cache);
+    - ``epilogue_tuned_vs_default_us``: the residual epilogue's tuned
+      ``block_rows`` vs the static default, same jitted kernel timing
+      ``tune()`` used (negative = the tuned block is faster).
+
+    Runs against a private temp ``MXTPU_SCHEDULE_CACHE`` in search mode
+    and restores the caller's autotune state on the way out.
+    """
+    import functools
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import autotune as at, telemetry as tm
+    from mxnet_tpu.autotune import search as at_search
+    from mxnet_tpu.ops import paged_attention as pa
+    from mxnet_tpu.ops import residual_epilogue as repi
+
+    was_enabled = tm.enabled()
+    tm.enable()
+    cache0 = os.environ.get("MXTPU_SCHEDULE_CACHE")
+    tmpd = tempfile.mkdtemp(prefix="mxtpu_autotune_bench_")
+    os.environ["MXTPU_SCHEDULE_CACHE"] = \
+        "search:" + os.path.join(tmpd, "schedules.json")
+    at.reset()
+    out = {}
+    try:
+        # serving-shaped decode step: B slots, M pages/slot (a 512-token
+        # context window), half-full ragged cursors (make_bench_fn's
+        # honest steady-state mix) — the regime where the gather path
+        # materializes every page and a liveness-bounded walk does not
+        B, H, M, block, dh, L = 4, 8, 32, 16, 64, 2
+        dtype = jnp.float32
+        platform = jax.default_backend()
+        sig = pa.keysig(B, H, M, block, dh, dtype)
+        default = pa.default_schedule(platform, block, dh, dtype)
+        cands = pa.candidate_schedules(platform, block, dh, M, dtype)
+        bench = functools.partial(pa.make_bench_fn, B=B, H=H, M=M,
+                                  block=block, dh=dh, L=L, dtype=dtype)
+        tic = time.perf_counter()
+        winner = at.ensure("paged_attention", sig, default, cands, bench,
+                           warmup=1, best_of=3)
+        out["autotune_search_ms"] = round(
+            (time.perf_counter() - tic) * 1e3, 1)
+        gather_us = at.measure(bench({"impl": "gather"}),
+                               warmup=1, best_of=5)
+        kernel_us = at.measure(bench(winner), warmup=1, best_of=5)
+        out["paged_attn_gather_us_per_step"] = round(gather_us, 1)
+        out["paged_attn_kernel_us_per_step"] = round(kernel_us, 1)
+        out["paged_attn_kernel_impl"] = winner.get("impl", "gather")
+        out["paged_attn_kernel_speedup"] = round(gather_us / kernel_us, 2)
+        # second in-process run: forget the memo (NOT the file), re-ensure
+        trials0 = at_search._TM_TRIALS.total()
+        hits0 = at_search._TM_CACHE.value(result="hit")
+        at.reset()
+        again = at.ensure("paged_attention", sig, default, cands, bench,
+                          warmup=1, best_of=3)
+        hit = (again == winner
+               and at_search._TM_TRIALS.total() == trials0
+               and at_search._TM_CACHE.value(result="hit") > hits0)
+        out["autotune_cache_hit"] = int(hit)
+        # epilogue knob: ResNet-tail shape, interpret timing on a
+        # CPU rig (exactly what tune() itself measures)
+        rows, channels = 2048, 256
+        interp = jax.default_backend() != "tpu"
+        tuned = repi.tune(rows, channels, interpret=interp)
+        rs = np.random.RandomState(0)
+        x2 = jnp.asarray(rs.normal(size=(rows, channels)).astype(np.float32))
+        s2 = jnp.asarray(rs.normal(size=(rows, channels)).astype(np.float32))
+        sc = jnp.asarray(rs.normal(size=(channels,)).astype(np.float32))
+        b_ = jnp.asarray(rs.normal(size=(channels,)).astype(np.float32))
+
+        def _epi_us(br):
+            fn = jax.jit(functools.partial(
+                repi._pallas_fwd, interpret=interp, block_rows=br))
+            return at.measure(lambda: fn(x2, s2, sc, b_),
+                              warmup=1, best_of=3)
+
+        default_us = _epi_us(repi._default_block_rows(rows))
+        tuned_us = _epi_us(int(tuned["block_rows"]))
+        out["epilogue_tuned_block_rows"] = int(tuned["block_rows"])
+        out["epilogue_tuned_vs_default_us"] = round(
+            tuned_us - default_us, 1)
+        return out
+    finally:
+        if cache0 is None:
+            os.environ.pop("MXTPU_SCHEDULE_CACHE", None)
+        else:
+            os.environ["MXTPU_SCHEDULE_CACHE"] = cache0
+        at.reset()
+        shutil.rmtree(tmpd, ignore_errors=True)
+        if not was_enabled:
+            tm.disable()
+
+
 def _sparse_micro():
     """Row-sparse embedding-update micro-bench (round 13): the fused
     sparse bucket (touched-rows-only jitted update, kvstore_fused +
@@ -2208,6 +2333,15 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
             # host-side cost of the per-request lens (ISSUE 16)
             if os.environ.get("BENCH_TRACE", "1") == "1":
                 for k_, v_ in _trace_micro().items():
+                    extras[k_] = v_
+        except Exception as exc:  # noqa: BLE001
+            extras.setdefault("extras_error", repr(exc))
+        try:
+            # schedule autotuner: paged-attention kernel vs gather per
+            # decode step, search cost, persisted-cache reuse, and the
+            # epilogue's tuned block_rows vs its default (ISSUE 18)
+            if os.environ.get("BENCH_AUTOTUNE", "1") == "1":
+                for k_, v_ in _autotune_micro().items():
                     extras[k_] = v_
         except Exception as exc:  # noqa: BLE001
             extras.setdefault("extras_error", repr(exc))

@@ -64,6 +64,15 @@ BOUNDARIES = {
         "opt-in debugging Monitor: interval-gated stat rendering syncs "
         "by contract (PR-5 keeps the per-batch tic() sync-free; "
         "production loops install no monitor)",
+    # autotuner (ISSUE 18): schedule search is a bind/admit-time
+    # activity ONLY — PagedSlots construction and explicit tune() call
+    # sites.  measure() blocks on each candidate by design; the
+    # steady-state loops see tuned schedules exclusively through the
+    # pure autotune.cache.schedule_for lookup, which never syncs.
+    "mxnet_tpu.autotune.search.measure":
+        "the autotuner's candidate timer: warmup + best-of-k "
+        "block_until_ready at bind/admit-time search sites — never "
+        "reachable from a steady-state tick",
 }
 
 # Device->host sync primitives, matched as method names on any receiver.

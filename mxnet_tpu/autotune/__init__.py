@@ -1,0 +1,25 @@
+"""Schedule autotuner (ISSUE 18): TVM-style search over Pallas
+candidate configs per (kernel, shape signature, dtype, device kind),
+winners persisted in the on-disk ``MXTPU_SCHEDULE_CACHE``.
+
+Two halves with a hard purity line between them:
+
+- :mod:`.cache` — the PURE lookup plane.  ``schedule_for`` is callable
+  from traced code (no telemetry, no clock, no device);
+  ``fingerprint`` is what ``executor._compiled_programs`` composes into
+  the program-cache key so a new winner invalidates programs that
+  traced the old one.
+- :mod:`.search` — the measuring plane.  ``ensure`` runs the bounded
+  search (``MXTPU_AUTOTUNE_TRIALS``) at bind/admit call sites only and
+  owns the ``autotune_*`` telemetry families.
+
+Consumers: the paged-attention kernel (``ops/paged_attention.py``,
+tuned at ``PagedSlots`` construction) and the residual epilogue's
+``block_rows`` (``ops/residual_epilogue.py``).  ``docs/autotune.md``
+is the runbook, including how to make another kernel tunable.
+"""
+from .cache import (  # noqa: F401
+    SCHEMA_VERSION, cache_spec, device_kind, fingerprint, load_file,
+    prime, record, reset, schedule_for,
+)
+from .search import ensure, measure, trials_budget  # noqa: F401

@@ -193,7 +193,14 @@ def _compiled_programs(symbol: Symbol, platform: Optional[str],
     structure and converge on a single compiled entry.  Different pass
     selections need no extra key axis for the same reason: the
     rewritten structure IS the selection's fingerprint.
+
+    The autotuner's schedule-cache fingerprint (mode + path + epoch) IS
+    a key axis: tuned kernels (the residual epilogue's block_rows) bake
+    their schedule in at trace time, so a program compiled before a
+    search landed would silently keep the stale tiling — composing the
+    fingerprint makes the next bind rebuild against the new winner.
     """
+    from . import autotune as _autotune
     from . import passes as _passes
 
     symbol = _passes.apply_graph_passes(symbol)
@@ -202,7 +209,7 @@ def _compiled_programs(symbol: Symbol, platform: Optional[str],
     key = None
     if capacity > 0:
         key = (symbol.structural_signature(), platform, channels_last,
-               shard_sig)
+               shard_sig, _autotune.fingerprint())
         with _program_cache_lock:
             entry = _program_cache.get(key)
             if entry is not None:

@@ -33,6 +33,18 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _snap(x, dtype=np.int32):
+    """Device copy of host-side slot state (tokens/start/cursor/block
+    tables) that can never alias the caller's buffer.  The CPU PJRT
+    backend zero-copy-aliases suitably aligned numpy arrays on
+    ``jnp.asarray``, so the steady-state idiom of mutating the host
+    array in place right after an async dispatch (``cursor[b] += 1``,
+    ``bt[b, idx] = page``) races with the still-executing program and
+    flips its inputs mid-flight — the source of the long-standing
+    serving bitwise-parity flake."""
+    return jnp.asarray(np.array(x, dtype, copy=True))
+
+
 def _count_compiles(fn, kind):
     """Wrap a to-be-jitted callable so each trace (= each XLA compile)
     lands in ``executor_compile_total{kind=decode_*}`` — the serving
@@ -426,9 +438,7 @@ class KVDecoder:
                 f"slot cursor at max_len {self.max_len}: finish or evict "
                 "the request before ticking it")
         (kc, vc), logits = self._slot_step_jit(
-            kc, vc, jnp.asarray(np.asarray(tokens), jnp.int32),
-            jnp.asarray(np.asarray(start), jnp.int32),
-            jnp.asarray(cursor, jnp.int32))
+            kc, vc, _snap(tokens), _snap(start), _snap(cursor))
         return (kc, vc), logits
 
     def adopt_row(self, cache, row_cache, slot):

@@ -19,6 +19,12 @@ Three lowerings behind one ``custom_vjp`` function:
   shapes the kernel does not tile.  Same math, so tier-1 (CPU) runs
   identically whichever path a platform picks.
 
+The row-block size is the autotuner's first tuned knob (ISSUE 18):
+``_block_rows_for`` consults ``mxnet_tpu.autotune.schedule_for`` (the
+pure lookup plane — safe at trace time) and :func:`tune` is the
+bind-time search call site that installs a per-(rows, C, dtype) winner
+in the ``MXTPU_SCHEDULE_CACHE``.
+
 The backward is lax (elementwise selects + two per-channel reductions
 — XLA fuses these fine; the win of the hand kernel is the forward,
 which sits between two convolutions in the hot path).  The custom VJP
@@ -48,8 +54,13 @@ from ..base import parse_attr, parse_bool
 from .registry import register
 
 # row-block of the (rows, C) view each grid step processes; rows are
-# N*H*W of an NHWC activation, so real batches divide 256 comfortably
+# N*H*W of an NHWC activation, so real batches divide 256 comfortably.
+# The DEFAULT — the autotuner's first tuned knob (ISSUE 18) can
+# override it per (rows, C, dtype) through the schedule cache.
 _BLOCK_ROWS = 256
+# the search space tune() measures: default included (a search can
+# never lose to not searching), 512 gives headroom above the default
+_CANDIDATE_BLOCK_ROWS = (512, 256, 128, 64, 32, 16, 8)
 
 
 def supports(rows: int, channels: int) -> bool:
@@ -59,16 +70,36 @@ def supports(rows: int, channels: int) -> bool:
     512 / 1024 / 2048, rows = N*H*W) all qualify."""
     if channels % 128 != 0:
         return False
-    return rows % _block_rows_for(rows) == 0 and rows >= 8
+    return rows % _default_block_rows(rows) == 0 and rows >= 8
 
 
-def _block_rows_for(rows: int) -> int:
+def _default_block_rows(rows: int) -> int:
     if rows % _BLOCK_ROWS == 0:
         return _BLOCK_ROWS
     for b in (128, 64, 32, 16, 8):
         if rows % b == 0:
             return b
     return rows  # not tileable; supports() returns False upstream
+
+
+def _keysig(rows: int, channels: int, dtype) -> str:
+    return "r%dc%d_%s" % (rows, channels, jnp.dtype(dtype).name)
+
+
+def _block_rows_for(rows: int, channels: int, dtype) -> int:
+    """The row block the kernel tiles with: the tuned winner for this
+    (rows, C, dtype) when the schedule cache holds one, the static
+    default otherwise.  ``schedule_for`` is the autotuner's PURE plane
+    — safe here even though this runs at trace time inside the jitted
+    graph."""
+    from .. import autotune as _autotune
+
+    default = _default_block_rows(rows)
+    sched = _autotune.schedule_for(
+        "residual_epilogue", _keysig(rows, channels, dtype),
+        {"block_rows": default})
+    br = int(sched.get("block_rows", default))
+    return br if (br > 0 and rows % br == 0) else default
 
 
 def _epilogue_kernel(x_ref, s_ref, sc_ref, b_ref, o_ref):
@@ -79,9 +110,10 @@ def _epilogue_kernel(x_ref, s_ref, sc_ref, b_ref, o_ref):
     o_ref[...] = jnp.maximum((x + s) * sc + b, 0.0).astype(o_ref.dtype)
 
 
-def _pallas_fwd(x2, s2, scale, bias, interpret):
+def _pallas_fwd(x2, s2, scale, bias, interpret, block_rows=None):
     rows, c = x2.shape
-    br = _block_rows_for(rows)
+    br = (int(block_rows) if block_rows
+          else _block_rows_for(rows, c, x2.dtype))
     sc2 = scale.reshape(1, c)
     b2 = bias.reshape(1, c)
     return pl.pallas_call(
@@ -175,6 +207,45 @@ def residual_epilogue(x, s, scale=None, bias=None, channel_axis=-1,
         use_pallas = False  # shape gate even when forced (ragged shapes)
     return _epilogue(x, s, scale, bias, channel_axis, use_pallas,
                      bool(interpret))
+
+
+def tune(rows, channels, dtype=jnp.float32, interpret=None):
+    """Search ``block_rows`` for the ``(rows, C)`` epilogue view and
+    install the winner in the schedule cache (a bind-time call site —
+    benches and tests call this; the traced kernel only ever does the
+    pure ``schedule_for`` lookup).  On a host without a TPU the kernel
+    is measured in interpret mode — tuning the parity tool honestly
+    rather than pretending to time hardware it does not have.  Returns
+    the winning schedule dict (``{"block_rows": N}``)."""
+    from .. import autotune as _autotune
+
+    rows, channels = int(rows), int(channels)
+    if not supports(rows, channels):
+        return {"block_rows": _default_block_rows(rows)}
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    name = jnp.dtype(dtype).name
+    rs = np.random.RandomState(0)
+    x2 = jnp.asarray(rs.normal(size=(rows, channels)).astype(name))
+    s2 = jnp.asarray(rs.normal(size=(rows, channels)).astype(name))
+    scale = jnp.asarray(rs.normal(size=(channels,)).astype(np.float32))
+    bias = jnp.asarray(rs.normal(size=(channels,)).astype(np.float32))
+
+    def bench(cand):
+        br = int(cand["block_rows"])
+        if br <= 0 or rows % br:
+            raise ValueError("block_rows %d does not tile %d rows"
+                             % (br, rows))
+        fn = jax.jit(functools.partial(
+            _pallas_fwd, interpret=bool(interpret), block_rows=br))
+        return lambda: fn(x2, s2, scale, bias)
+
+    return _autotune.ensure(
+        "residual_epilogue", _keysig(rows, channels, dtype),
+        {"block_rows": _default_block_rows(rows)},
+        [{"block_rows": b} for b in _CANDIDATE_BLOCK_ROWS
+         if b <= rows and rows % b == 0],
+        bench, warmup=1, best_of=3)
 
 
 # ---------------------------------------------------------------------------

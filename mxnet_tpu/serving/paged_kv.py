@@ -49,7 +49,8 @@ from .. import telemetry as _tm
 from ..base import MXNetError
 from ..telemetry import tracing as _tracing
 
-__all__ = ["PagedSlots", "PoolExhausted", "kv_block", "prefix_cache_on"]
+__all__ = ["PagedSlots", "PoolExhausted", "kv_block", "prefix_cache_on",
+           "paged_kernel_mode"]
 
 # --- paged serving metric families (docs/telemetry.md) ----------------------
 _TM_PREFIX_HITS = _tm.counter(
@@ -85,6 +86,24 @@ def prefix_cache_on() -> bool:
         not in ("0", "false", "off")
 
 
+def paged_kernel_mode() -> str:
+    """``MXTPU_PAGED_KERNEL`` — the step-attention lowering (ISSUE 18).
+
+    ``auto`` (default, also ``1``): consult the autotuner — with a
+    schedule cache, the tuned winner; without one, the Pallas kernel on
+    a TPU whose shape qualifies and the PR-15 gather path everywhere
+    else.  ``0``/``off``/``gather``: pin the gather path (bit-identical
+    to PR 15).  ``pallas`` / ``interpret`` / ``pagewalk``: force one
+    lowering of ``ops/paged_attention.py`` (``interpret`` is the
+    CPU-parity hook; ``pagewalk`` the lax live-page walk)."""
+    raw = os.environ.get("MXTPU_PAGED_KERNEL", "auto").strip().lower()
+    if raw in ("", "1", "auto"):
+        return "auto"
+    if raw in ("0", "off", "false", "gather"):
+        return "gather"
+    return raw
+
+
 class _PagedPrograms:
     """The jitted decode programs over the page pool.
 
@@ -95,7 +114,8 @@ class _PagedPrograms:
     bitwise the contiguous step whenever the table contents match.
     """
 
-    def __init__(self, decoder, block, max_blocks, num_pages):
+    def __init__(self, decoder, block, max_blocks, num_pages,
+                 schedule=None):
         import jax
 
         from ..models.decode import _count_compiles
@@ -104,6 +124,12 @@ class _PagedPrograms:
         self.block = int(block)
         self.max_blocks = int(max_blocks)
         self.num_pages = int(num_pages)
+        # step-attention schedule (ops/paged_attention.py, picked by
+        # mxnet_tpu.autotune at PagedSlots construction).  None/"gather"
+        # keeps the PR-15 materialized-table math verbatim; prefill
+        # always gathers (one admission-time cost, not the per-tick one)
+        self.schedule = schedule if (
+            schedule and schedule.get("impl") != "gather") else None
         self._step_jit = jax.jit(_count_compiles(
             self._forward_step, "decode_step_paged"))
         self._prefill_cache = {}
@@ -155,23 +181,37 @@ class _PagedPrograms:
         pages = jnp.take_along_axis(
             bt, (cursor // self.block)[:, None], axis=1)[:, 0]   # (B,)
         offs = cursor % self.block
-        kc = self._gather(pool_k, bt)
-        vc = self._gather(pool_v, bt)
+        sched = self.schedule
+        if sched is None:
+            kc = self._gather(pool_k, bt)
+            vc = self._gather(pool_v, bt)
+        else:
+            from ..ops import paged_attention as _pa
         for i in range(d.L):
             name = f"layer{i}"
             h2 = _ln(h, p[f"{name}_ln1_gamma"], p[f"{name}_ln1_beta"])
             q, k, v = d._block_qkv(i, h2)
             sh = lambda a: a.reshape(B, 1, H, dh).transpose(0, 2, 1, 3)
             qh, kh, vh = sh(q), sh(k), sh(v)                 # (B, H, 1, dh)
-            kc = kc.at[i, rows, :, cursor].set(kh[:, :, 0])
-            vc = vc.at[i, rows, :, cursor].set(vh[:, :, 0])
+            if sched is None:
+                kc = kc.at[i, rows, :, cursor].set(kh[:, :, 0])
+                vc = vc.at[i, rows, :, cursor].set(vh[:, :, 0])
             pool_k = pool_k.at[pages, i, :, offs].set(kh[:, :, 0])
             pool_v = pool_v.at[pages, i, :, offs].set(vh[:, :, 0])
-            scores = jnp.einsum("bhnd,bhsd->bhns", qh, kc[i]) \
-                / jnp.sqrt(jnp.asarray(dh, h.dtype))
-            scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
-            att = jax.nn.softmax(scores, axis=-1)
-            ctx = jnp.einsum("bhns,bhsd->bhnd", att, vc[i])
+            if sched is None:
+                scores = jnp.einsum("bhnd,bhsd->bhns", qh, kc[i]) \
+                    / jnp.sqrt(jnp.asarray(dh, h.dtype))
+                scores = jnp.where(
+                    valid[:, None, None, :], scores, NEG_INF)
+                att = jax.nn.softmax(scores, axis=-1)
+                ctx = jnp.einsum("bhns,bhsd->bhnd", att, vc[i])
+            else:
+                # the kernel walks the block table over the pool the
+                # writes above just updated — same values the gathered
+                # table would hold, no materialization
+                ctx = _pa.paged_attention(
+                    qh, pool_k, pool_v, bt, cursor, i,
+                    block=self.block, schedule=sched)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(B, 1, D)
             proj = _fc(ctx, p[f"{name}_proj_weight"],
                        p[f"{name}_proj_bias"])
@@ -289,7 +329,7 @@ class PagedSlots:
     paged = True
 
     def __init__(self, decoder, num_slots, block=None, num_pages=None,
-                 prefix_cache=None, prefill_buckets=None):
+                 prefix_cache=None, prefill_buckets=None, kernel=None):
         if decoder.mesh is not None:
             raise MXNetError(
                 "paged KV is not supported together with a tensor-"
@@ -314,8 +354,12 @@ class PagedSlots:
         self.prefix_on = (prefix_cache_on() if prefix_cache is None
                           else bool(prefix_cache))
         self.prefill_buckets = tuple(prefill_buckets or ())
+        self.kernel_mode = (paged_kernel_mode() if kernel is None
+                            else str(kernel).strip().lower())
+        self.schedule = self._resolve_schedule()
         self.programs = _PagedPrograms(
-            decoder, self.block, self.max_blocks, self.num_pages + 1)
+            decoder, self.block, self.max_blocks, self.num_pages + 1,
+            schedule=self.schedule)
         self.pool = self.programs.init_pool()
         self.bt = np.zeros((self.num_slots, self.max_blocks), np.int32)
         self.cursor = np.zeros(self.num_slots, np.int32)
@@ -329,6 +373,45 @@ class PagedSlots:
         self._trace_ctx = None
         self._set_gauges()
 
+    # ------------------------------------------------------------- schedule
+    def _resolve_schedule(self):
+        """The step-attention schedule for this pool's shape signature
+        — decided ONCE, here at bind time, never per tick (the search's
+        device syncs are the declared ``autotune.search.measure``
+        boundary).  ``None`` means the PR-15 gather step verbatim."""
+        import jax
+
+        from .. import autotune as _autotune
+        from ..ops import paged_attention as _pa
+
+        mode = self.kernel_mode
+        if mode == "gather":
+            return None
+        d = self.decoder
+        B, M, blk = self.num_slots, self.max_blocks, self.block
+        dtype = d._cache_dtype
+        if mode in ("pallas", "interpret"):
+            if not _pa.supports(blk, d.dh, dtype):
+                return None         # shape gate even when forced
+            return {"impl": "pallas", "grid": "bh", "live_only": True,
+                    "interpret": mode == "interpret"}
+        if mode == "pagewalk":
+            return {"impl": "pagewalk", "chunk": 1}
+        if mode != "auto":
+            raise MXNetError(
+                f"unknown MXTPU_PAGED_KERNEL mode {mode!r} (want auto, "
+                "gather/0, pallas, interpret or pagewalk)")
+        platform = jax.default_backend()
+        default = _pa.default_schedule(platform, blk, d.dh, dtype)
+        sched = _autotune.ensure(
+            "paged_attention",
+            _pa.keysig(B, d.H, M, blk, d.dh, dtype),
+            default,
+            _pa.candidate_schedules(platform, blk, d.dh, M, dtype),
+            lambda c: _pa.make_bench_fn(c, B=B, H=d.H, M=M, block=blk,
+                                        dh=d.dh, L=d.L, dtype=dtype))
+        return None if sched.get("impl") == "gather" else dict(sched)
+
     # --------------------------------------------------------- bookkeeping
     def _set_gauges(self):
         _TM_PAGES.set(self.num_pages, state="total")
@@ -340,7 +423,8 @@ class PagedSlots:
         return {"block": self.block,
                 "pages_total": self.num_pages,
                 "pages_free": len(self._free),
-                "prefix_pages": len(self._prefix)}
+                "prefix_pages": len(self._prefix),
+                "kernel": (self.schedule or {"impl": "gather"})["impl"]}
 
     def _alloc(self, n):
         """``n`` pages off the free list, evicting LRU prefix-only pages
@@ -399,6 +483,8 @@ class PagedSlots:
         (ISSUE 16)."""
         import jax.numpy as jnp
 
+        from ..models.decode import _snap
+
         t_kv0 = time.perf_counter()
         prompt = np.asarray(prompt, np.int64)
         p_len = int(prompt.size)
@@ -445,8 +531,10 @@ class PagedSlots:
         bucket = next(b for b in self.prefill_buckets if b >= t)
         padded = np.zeros((1, bucket), np.int64)
         padded[0, :t] = tail
+        # _snap: self.bt is mutated in place by later admits/steps while
+        # this dispatch may still be executing — never alias it
         (pk, pv), logits = self.programs.prefill(bucket)(
-            self.pool[0], self.pool[1], jnp.asarray(self.bt[slot]),
+            self.pool[0], self.pool[1], _snap(self.bt[slot]),
             jnp.asarray(padded), jnp.int32(hist), jnp.int32(t))
         self.pool = (pk, pv)
         self.cursor[slot] = p_len
@@ -480,7 +568,7 @@ class PagedSlots:
         block boundary get their next page here; a row the pool cannot
         feed is reported in ``starved`` for the scheduler to deliver
         truncated (its garbage write lands in the scratch page)."""
-        import jax.numpy as jnp
+        from ..models.decode import _snap
 
         starved = []
         for b in np.flatnonzero(occupied):
@@ -499,10 +587,11 @@ class PagedSlots:
                     continue
                 self.bt[b, idx] = pg
                 self._slot_pages[b].append(pg)
+        # _snap: bt/cursor are mutated in place right below and on the
+        # next tick — aliasing them into the async dispatch races
         (pk, pv), logits = self.programs._step_jit(
-            self.pool[0], self.pool[1], jnp.asarray(self.bt),
-            jnp.asarray(np.asarray(tokens), jnp.int32),
-            jnp.asarray(self.cursor))
+            self.pool[0], self.pool[1], _snap(self.bt),
+            _snap(tokens), _snap(self.cursor))
         self.pool = (pk, pv)
         adv = occupied.copy()
         adv[starved] = False

@@ -241,13 +241,16 @@ class SlotScheduler:
     ``paged``/``kv_block``/``num_pages``/``prefix_cache`` select the
     paged KV backend (`serving/paged_kv.py`): block-table indirection
     over a shared page pool with prompt-prefix reuse.  Default follows
-    ``MXTPU_KV_BLOCK`` (0/unset = contiguous).
+    ``MXTPU_KV_BLOCK`` (0/unset = contiguous).  ``paged_kernel``
+    overrides ``MXTPU_PAGED_KERNEL`` — the paged step's attention
+    lowering (gather / Pallas page-walk kernel / lax pagewalk; ISSUE
+    18), resolved once at construction through ``mxnet_tpu.autotune``.
     """
 
     def __init__(self, decoder, num_slots=None, queue_size=None,
                  default_deadline_ms=None, prefill_buckets=None,
                  idle_wait=0.05, paged=None, kv_block=None,
-                 num_pages=None, prefix_cache=None):
+                 num_pages=None, prefix_cache=None, paged_kernel=None):
         self.decoder = decoder
         # `is not None` (not truthiness): an explicit 0 must reach the
         # guards below, not silently become the env/default value
@@ -285,7 +288,8 @@ class SlotScheduler:
             self.backend = _paged_kv.PagedSlots(
                 decoder, self.num_slots, block=(blk or None),
                 num_pages=num_pages, prefix_cache=prefix_cache,
-                prefill_buckets=self.prefill_buckets)
+                prefill_buckets=self.prefill_buckets,
+                kernel=paged_kernel)
         else:
             self.backend = _ContiguousSlots(
                 decoder, self.num_slots, self.prefill_buckets)

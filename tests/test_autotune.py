@@ -1,0 +1,468 @@
+"""Autotuner tests (ISSUE 18): the schedule cache's roundtrip /
+corruption / readonly / segregation contracts, the bounded search, the
+paged-attention kernel's interpret-mode parity against the PR-15
+gather path (prefill + ragged steps + fork-private divergence), the
+shape-gate fallback, and zero steady-state recompiles with tuning on.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autotune as at, models, telemetry as tm
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models.decode import KVDecoder
+from mxnet_tpu.ops import paged_attention as pa
+from mxnet_tpu.ops import residual_epilogue as repi
+from mxnet_tpu.serving.paged_kv import PagedSlots
+from mxnet_tpu.serving.scheduler import SlotScheduler
+
+L, H, D, T, V = 2, 2, 32, 32, 17
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    net = models.transformer.transformer_lm(
+        num_layers=L, num_heads=H, d_model=D, seq_len=T, vocab_size=V)
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="null",
+                         data=(1, T), softmax_label=(1, T))
+    rs = np.random.RandomState(0)
+    params = {}
+    for name, arr in ex.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        arr[:] = rs.normal(0, 0.08, arr.shape).astype(np.float32)
+        params[name] = arr
+    return params
+
+
+@pytest.fixture(scope="module")
+def decoder(lm_params):
+    return KVDecoder(lm_params, num_layers=L, num_heads=H, max_len=T)
+
+
+@pytest.fixture()
+def metrics():
+    was = tm.enabled()
+    tm.enable()
+    yield tm.get_registry()
+    if not was:
+        tm.disable()
+
+
+@pytest.fixture()
+def no_cache(monkeypatch):
+    """Autotuning off and in-memory winners forgotten — the default
+    regime every non-cache test should run in."""
+    monkeypatch.delenv("MXTPU_SCHEDULE_CACHE", raising=False)
+    monkeypatch.delenv("MXTPU_PAGED_KERNEL", raising=False)
+    at.reset()
+    yield
+    at.reset()
+
+
+@pytest.fixture()
+def sched_cache(tmp_path, monkeypatch):
+    """A private search-mode schedule cache; state reset both sides."""
+    path = str(tmp_path / "schedules.json")
+    monkeypatch.setenv("MXTPU_SCHEDULE_CACHE", "search:" + path)
+    monkeypatch.delenv("MXTPU_PAGED_KERNEL", raising=False)
+    monkeypatch.delenv("MXTPU_AUTOTUNE_TRIALS", raising=False)
+    at.reset()
+    yield path
+    at.reset()
+
+
+def _const_bench(calls=None):
+    """A bench_fn whose thunks do trivial device work; optionally
+    records which candidates were measured."""
+    def bench(cand):
+        if calls is not None:
+            calls.append(cand)
+        return lambda: 0.0
+    return bench
+
+
+# ---------------------------------------------------------------------------
+# cache plane
+# ---------------------------------------------------------------------------
+def test_cache_roundtrip_persists_and_reloads(sched_cache):
+    won = at.ensure("k", "sig", {"impl": "a"},
+                    [{"impl": "a"}, {"impl": "b"}], _const_bench(),
+                    warmup=0, best_of=1)
+    assert won["impl"] in ("a", "b")
+    doc = json.load(open(sched_cache))
+    assert doc["version"] == at.SCHEMA_VERSION
+    ent = doc["entries"][at.device_kind()]["k|sig"]
+    assert ent["schedule"] == won
+    assert ent["trials"] == 2
+    assert ent["best_us"] >= 0
+    # a fresh process-state must reload the winner from disk with zero
+    # new trials: reset the memo, prime through the bind path, look up
+    at.reset()
+    assert at.schedule_for("k", "sig", "DEFAULT") == "DEFAULT", \
+        "unprimed lookup must stay a pure default read"
+    at.fingerprint()                       # the executor-bind priming hook
+    assert at.schedule_for("k", "sig", "DEFAULT") == won
+    calls = []
+    again = at.ensure("k", "sig", {"impl": "a"},
+                      [{"impl": "a"}, {"impl": "b"}], _const_bench(calls),
+                      warmup=0, best_of=1)
+    assert again == won and calls == [], \
+        "a persisted winner must be reused without re-measuring"
+
+
+def test_corrupt_and_mismatched_files_fall_back(tmp_path, monkeypatch):
+    good = {"version": at.SCHEMA_VERSION,
+            "entries": {"cpu": {"k|s": {"schedule": {"impl": "x"}}}}}
+    for name, text in [
+        ("garbage.json", "{not json"),
+        ("wrong_version.json", json.dumps(dict(good, version=999))),
+        ("wrong_shape.json", json.dumps([1, 2, 3])),
+    ]:
+        p = tmp_path / name
+        p.write_text(text)
+        assert at.load_file(str(p)) == {}, name
+    assert at.load_file(str(tmp_path / "missing.json")) == {}
+    # end to end: a corrupt cache degrades to defaults, and a search
+    # REPLACES it with a valid document instead of crashing
+    p = tmp_path / "corrupt.json"
+    p.write_text("{not json")
+    monkeypatch.setenv("MXTPU_SCHEDULE_CACHE", "search:%s" % p)
+    at.reset()
+    at.fingerprint()
+    assert at.schedule_for("k", "s", "DEFAULT") == "DEFAULT"
+    at.ensure("k", "s", {"impl": "a"}, [{"impl": "a"}], _const_bench(),
+              warmup=0, best_of=1)
+    assert json.load(open(p))["version"] == at.SCHEMA_VERSION
+    at.reset()
+
+
+def test_readonly_never_writes(tmp_path, monkeypatch):
+    path = tmp_path / "ro.json"
+    monkeypatch.setenv("MXTPU_SCHEDULE_CACHE", "readonly:%s" % path)
+    at.reset()
+    calls = []
+    got = at.ensure("k", "sig", {"impl": "default"},
+                    [{"impl": "default"}, {"impl": "other"}],
+                    _const_bench(calls), warmup=0, best_of=1)
+    assert got == {"impl": "default"}
+    assert calls == [], "readonly mode must never measure"
+    assert not path.exists(), "readonly mode must never create the file"
+    # an explicit record(persist=True) also refuses to touch disk
+    at.record("k", "sig", {"impl": "other"}, 1.0, 1)
+    assert not path.exists()
+    # ...but a pre-existing file IS honored, byte-for-byte untouched
+    doc = {"version": at.SCHEMA_VERSION,
+           "entries": {at.device_kind(): {
+               "k|sig": {"schedule": {"impl": "pinned"},
+                         "best_us": 1.0, "trials": 1}}}}
+    path.write_text(json.dumps(doc))
+    before = path.read_bytes()
+    at.reset()
+    got = at.ensure("k", "sig", {"impl": "default"},
+                    [{"impl": "default"}], _const_bench(calls),
+                    warmup=0, best_of=1)
+    assert got == {"impl": "pinned"} and calls == []
+    assert path.read_bytes() == before
+    at.reset()
+
+
+def test_device_kind_segregation(tmp_path, monkeypatch):
+    kind = at.device_kind()
+    other = "TPU_v4" if kind != "TPU_v4" else "TPU_v5e"
+    path = tmp_path / "mixed.json"
+    path.write_text(json.dumps({
+        "version": at.SCHEMA_VERSION,
+        "entries": {
+            kind: {"k|sig": {"schedule": {"impl": "mine"}}},
+            other: {"k|sig": {"schedule": {"impl": "theirs"}},
+                    "k2|sig": {"schedule": {"impl": "theirs"}}},
+        }}))
+    monkeypatch.setenv("MXTPU_SCHEDULE_CACHE", "search:%s" % path)
+    at.reset()
+    at.fingerprint()
+    assert at.schedule_for("k", "sig", None) == {"impl": "mine"}
+    assert at.schedule_for("k2", "sig", "DEFAULT") == "DEFAULT", \
+        "another device kind's winners must not load here"
+    # recording here must not clobber the other kind's entries
+    at.ensure("k3", "sig", {"impl": "a"}, [{"impl": "a"}], _const_bench(),
+              warmup=0, best_of=1)
+    entries = json.load(open(path))["entries"]
+    assert entries[other]["k|sig"]["schedule"] == {"impl": "theirs"}
+    assert "k3|sig" in entries[kind]
+    at.reset()
+
+
+def test_trial_budget_and_telemetry(sched_cache, monkeypatch, metrics):
+    monkeypatch.setenv("MXTPU_AUTOTUNE_TRIALS", "2")
+    assert at.trials_budget() == 2
+    trials = metrics.get("autotune_trials_total")
+    cachec = metrics.get("autotune_cache_total")
+    t0, h0, m0 = (trials.total(), cachec.value(result="hit"),
+                  cachec.value(result="miss"))
+    calls = []
+    cands = [{"impl": "c%d" % i} for i in range(5)]
+    won = at.ensure("budgeted", "sig", cands[0], cands,
+                    _const_bench(calls), warmup=0, best_of=1)
+    assert len(calls) == 2, "budget must cap measured candidates"
+    assert won in cands[:2]
+    assert trials.total() - t0 == 2
+    assert cachec.value(result="miss") - m0 == 1
+    # second call: the recorded winner hits, zero new trials
+    calls.clear()
+    again = at.ensure("budgeted", "sig", cands[0], cands,
+                      _const_bench(calls), warmup=0, best_of=1)
+    assert again == won and calls == []
+    assert trials.total() - t0 == 2
+    assert cachec.value(result="hit") - h0 == 1
+    # budget 0: cached winners still honored, new searches disabled
+    monkeypatch.setenv("MXTPU_AUTOTUNE_TRIALS", "0")
+    assert at.ensure("budgeted", "sig", cands[0], cands,
+                     _const_bench(calls), warmup=0, best_of=1) == won
+    got = at.ensure("never_searched", "sig", {"impl": "d"}, cands,
+                    _const_bench(calls), warmup=0, best_of=1)
+    assert got == {"impl": "d"} and calls == []
+
+
+def test_fingerprint_epoch_invalidates_on_record(sched_cache):
+    fp0 = at.fingerprint()
+    at.record("k", "sig", {"impl": "a"}, 1.0, 1)
+    fp1 = at.fingerprint()
+    assert fp0 != fp1, \
+        "a new winner must change the executor program-cache key"
+    assert fp0[:2] == fp1[:2]              # same mode + path, new epoch
+
+
+# ---------------------------------------------------------------------------
+# paged-attention op parity
+# ---------------------------------------------------------------------------
+def _op_case(B=3, Hh=2, M=4, block=8, dh=32, Ll=2, seed=3):
+    rs = np.random.RandomState(seed)
+    P = B * M + 1
+    import jax.numpy as jnp
+    pool_k = jnp.asarray(rs.normal(size=(P, Ll, Hh, block, dh))
+                         .astype(np.float32))
+    pool_v = jnp.asarray(rs.normal(size=(P, Ll, Hh, block, dh))
+                         .astype(np.float32))
+    q = jnp.asarray(rs.normal(size=(B, Hh, 1, dh)).astype(np.float32))
+    bt = jnp.asarray(rs.permutation(np.arange(1, P))[:B * M]
+                     .reshape(B, M).astype(np.int32))
+    # ragged cursors: a nearly-empty, a mid, a nearly-full slot
+    cursor = jnp.asarray(
+        np.linspace(1, M * block - 1, B).astype(np.int32))
+    return q, pool_k, pool_v, bt, cursor
+
+
+def _run_op(sched, args, layer, block):
+    """One jitted attention call — jitted because that is how serving
+    invokes it (the bitwise contract is between compiled programs;
+    eager dispatch fuses differently and drifts in the last bit)."""
+    import jax
+
+    f = jax.jit(lambda *a: pa.paged_attention(
+        *a, layer, block=block, schedule=sched))
+    return np.asarray(f(*args))
+
+
+@pytest.mark.parametrize("grid", ["bh", "flat"])
+@pytest.mark.parametrize("live_only", [True, False])
+def test_pallas_interpret_bitwise_vs_gather(no_cache, grid, live_only):
+    """The kernel is BITWISE against the PR-15 gather math on aligned
+    shapes, for both grid layouts, with and without live-page DMA
+    gating, on ragged block tables."""
+    args = _op_case()
+    sched = {"impl": "pallas", "grid": grid, "live_only": live_only,
+             "interpret": True}
+    for layer in range(L):
+        ref = _run_op(None, args, layer, 8)
+        out = _run_op(sched, args, layer, 8)
+        assert np.array_equal(ref, out), (grid, live_only, layer)
+
+
+def test_pagewalk_allclose_vs_gather(no_cache):
+    """The lax pagewalk reassociates the reductions (loop-carried
+    accumulation) — allclose, deliberately NOT bitwise, which is why
+    only the autotuner or an explicit mode ever installs it."""
+    args = _op_case()
+    ref = _run_op(None, args, 0, 8)
+    for chunk in (1, 2, 4):
+        out = _run_op({"impl": "pagewalk", "chunk": chunk}, args, 0, 8)
+        np.testing.assert_allclose(ref, out, rtol=2e-5, atol=2e-6)
+
+
+def test_shape_gate_falls_back_bit_identical(no_cache):
+    """A shape the kernel cannot tile (block % 8 != 0) silently takes
+    the gather path even when the pallas schedule is forced — same
+    array, bit for bit."""
+    args = _op_case(block=4, dh=12)
+    assert not pa.supports(4, 12, np.float32)
+    ref = _run_op(None, args, 0, 4)
+    out = _run_op({"impl": "pallas", "grid": "bh", "interpret": True},
+                  args, 0, 4)
+    assert np.array_equal(ref, out)
+
+
+def test_candidate_schedules_and_keysig(no_cache):
+    cands = pa.candidate_schedules("cpu", 8, 32, 4, np.float32)
+    assert {"impl": "gather"} in cands
+    assert all(c["impl"] != "pallas" for c in cands), \
+        "compiled-pallas candidates are TPU-only"
+    assert {"impl": "pagewalk", "chunk": 3} not in cands  # 3 !| M=4
+    tpu = pa.candidate_schedules("tpu", 8, 32, 4, np.float32)
+    assert any(c["impl"] == "pallas" for c in tpu)
+    assert pa.default_schedule("cpu", 8, 32, np.float32) == \
+        {"impl": "gather"}
+    assert pa.default_schedule("tpu", 8, 32, np.float32)["impl"] == \
+        "pallas"
+    assert pa.keysig(2, 4, 8, 16, 64, np.float32) == \
+        "b2h4m8k16d64_float32"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end serving parity
+# ---------------------------------------------------------------------------
+def _drive(pg, seed=5):
+    """Prefill + ragged steps + a mid-flight fork admission against the
+    shared prefix block + dual-slot steps — the full paged life cycle,
+    returning every logits array along the way."""
+    rs = np.random.RandomState(seed)
+    shared = rs.randint(0, V, 8).astype(np.int64)     # one full block
+    fa = np.concatenate([shared, rs.randint(0, V, 8)])
+    fb = np.concatenate([shared, rs.randint(0, V, 3)])  # ragged tail
+    outs = [np.asarray(pg.admit(0, fa), np.float32)]
+    occ = np.array([True, False, False])
+    tok = np.array([int(outs[-1].argmax()), 0, 0])
+    for _ in range(4):
+        lg, _ = pg.step(tok, occ)
+        outs.append(np.asarray(lg, np.float32))
+        tok = np.array([int(outs[-1][0].argmax()), 0, 0])
+    outs.append(np.asarray(pg.admit(1, fb), np.float32))
+    occ = np.array([True, True, False])
+    tok = np.array([tok[0], int(outs[-1].argmax()), 0])
+    for _ in range(4):
+        lg, _ = pg.step(tok, occ)
+        outs.append(np.asarray(lg, np.float32))
+        tok = np.array([int(outs[-1][0].argmax()),
+                        int(outs[-1][1].argmax()), 0])
+    return outs
+
+
+def test_paged_slots_interpret_kernel_bitwise_end_to_end(decoder,
+                                                         no_cache):
+    """The interpret-mode kernel drives the REAL serving backend —
+    prefill, ragged decode steps, a fork admitting mid-flight behind
+    the shared prefix block — bitwise against the gather backend at
+    every emission."""
+    buckets = (8, 16, 32)
+    ref = _drive(PagedSlots(decoder, 3, block=8, prefill_buckets=buckets,
+                            kernel="gather"))
+    pg = PagedSlots(decoder, 3, block=8, prefill_buckets=buckets,
+                    kernel="interpret")
+    assert pg.schedule == {"impl": "pallas", "grid": "bh",
+                           "live_only": True, "interpret": True}
+    assert pg.stats()["kernel"] == "pallas"
+    outs = _drive(pg)
+    for i, (a, b) in enumerate(zip(ref, outs)):
+        assert np.array_equal(a, b), \
+            "interpret kernel diverged bitwise at emission %d" % i
+
+
+def test_paged_slots_pagewalk_and_auto(decoder, no_cache):
+    """Pagewalk through the same life cycle stays allclose (its
+    documented tier); auto with the cache off resolves to gather on a
+    CPU host — bit-identical to MXTPU_PAGED_KERNEL=0."""
+    buckets = (8, 16, 32)
+    ref = _drive(PagedSlots(decoder, 3, block=8, prefill_buckets=buckets,
+                            kernel="gather"))
+    pw = PagedSlots(decoder, 3, block=8, prefill_buckets=buckets,
+                    kernel="pagewalk")
+    assert pw.stats()["kernel"] == "pagewalk"
+    for a, b in zip(ref, _drive(pw)):
+        scale = max(1.0, float(np.abs(a).max()))
+        assert np.abs(a - b).max() < 1e-3 * scale
+    auto = PagedSlots(decoder, 3, block=8, prefill_buckets=buckets)
+    assert auto.schedule is None and auto.stats()["kernel"] == "gather"
+    for a, b in zip(ref, _drive(auto)):
+        assert np.array_equal(a, b)
+
+
+def test_paged_kernel_mode_env(decoder, no_cache, monkeypatch):
+    monkeypatch.setenv("MXTPU_PAGED_KERNEL", "0")
+    pg = PagedSlots(decoder, 2, block=8, prefill_buckets=(8, 16, 32))
+    assert pg.schedule is None
+    monkeypatch.setenv("MXTPU_PAGED_KERNEL", "bogus")
+    with pytest.raises(MXNetError):
+        PagedSlots(decoder, 2, block=8, prefill_buckets=(8, 16, 32))
+
+
+def test_zero_recompiles_after_warmup_with_tuning_on(decoder, metrics,
+                                                     sched_cache,
+                                                     monkeypatch):
+    """Tuning on (auto kernel, search-mode cache): the admit-time
+    search picks a schedule ONCE, and warm serving traffic does zero
+    traces per tick — the tuned program is as steady as the gather
+    one."""
+    monkeypatch.setenv("MXTPU_AUTOTUNE_TRIALS", "3")
+    compiles = metrics.get("executor_compile_total")
+    trials = metrics.get("autotune_trials_total")
+    sched = SlotScheduler(decoder, num_slots=2, queue_size=16,
+                          paged=True, kv_block=8)
+    try:
+        rs = np.random.RandomState(6)
+        for plen in (3, 12, 20):           # warm every bucket + search
+            sched.generate(rs.randint(0, V, plen), max_new_tokens=2,
+                           timeout=120)
+        assert os.path.exists(sched_cache), \
+            "the admit-time search should have persisted a winner"
+        c0, t0 = compiles.total(), trials.total()
+        reqs = [sched.submit(rs.randint(0, V, ln), max_new_tokens=4)
+                for ln in (3, 7, 5, 9, 4, 18)]
+        for r in reqs:
+            r.wait(120)
+        assert all(r.outcome == "ok" for r in reqs), \
+            [(r.outcome, r.error) for r in reqs]
+        assert compiles.total() - c0 == 0, \
+            "warm tuned serving traffic recompiled"
+        assert trials.total() - t0 == 0, \
+            "steady-state traffic must never re-search"
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# residual epilogue knob
+# ---------------------------------------------------------------------------
+def test_epilogue_tune_installs_winner_and_stays_bitwise(sched_cache):
+    """tune() records a block_rows winner; the kernel's tiling is
+    elementwise so EVERY block size is bitwise-identical — the knob
+    can only change speed, never values."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    rows, channels = 64, 128
+    won = repi.tune(rows, channels)
+    assert won["block_rows"] > 0 and rows % won["block_rows"] == 0
+    assert repi._block_rows_for(rows, channels, jnp.float32) == \
+        won["block_rows"]
+    ent = json.load(open(sched_cache))["entries"][at.device_kind()]
+    assert "residual_epilogue|r64c128_float32" in ent
+    rs = np.random.RandomState(1)
+    x2 = jnp.asarray(rs.normal(size=(rows, channels)).astype(np.float32))
+    s2 = jnp.asarray(rs.normal(size=(rows, channels)).astype(np.float32))
+    sc = jnp.asarray(rs.normal(size=(channels,)).astype(np.float32))
+    b = jnp.asarray(rs.normal(size=(channels,)).astype(np.float32))
+    outs = [np.asarray(jax.jit(functools.partial(
+        repi._pallas_fwd, interpret=True, block_rows=br))(x2, s2, sc, b))
+        for br in (8, 16, 32, 64)]
+    for o in outs[1:]:
+        assert np.array_equal(outs[0], o)
+
+
+def test_epilogue_unsupported_shape_keeps_default(no_cache):
+    assert repi.tune(60, 100) == \
+        {"block_rows": repi._default_block_rows(60)}

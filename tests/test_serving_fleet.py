@@ -793,3 +793,15 @@ def test_bench_trend_directions_for_serve_metrics():
     assert not bt.lower_is_better("serve_fleet_tokens_per_sec")
     assert not bt.lower_is_better("paged_vs_contiguous_tokens_per_sec")
     assert not bt.lower_is_better("serve_paged_tokens_per_sec")
+
+
+def test_bench_trend_directions_for_autotune_metrics():
+    """Round-21 direction table: search wall cost and per-step kernel
+    microseconds regress UP; the kernel speedup ratio regresses DOWN."""
+    bt = _load_tool("bench_trend")
+    assert bt.lower_is_better("autotune_search_ms")
+    assert bt.lower_is_better("paged_attn_kernel_us_per_step")
+    assert bt.lower_is_better("paged_attn_gather_us_per_step")
+    assert bt.lower_is_better("epilogue_tuned_vs_default_us")
+    assert not bt.lower_is_better("paged_attn_kernel_speedup")
+    assert not bt.lower_is_better("autotune_cache_hit")

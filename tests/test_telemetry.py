@@ -406,6 +406,7 @@ def test_metric_catalog_matches_registered_families():
         "import mxnet_tpu.serving\n"
         "import mxnet_tpu.parallel.dist\n"
         "import mxnet_tpu.parallel.coordinator\n"
+        "import mxnet_tpu.autotune\n"
         "for f in mxnet_tpu.telemetry.get_registry().collect():\n"
         "    print(f.name)\n")
     res = subprocess.run([sys.executable, "-c", code], capture_output=True,

@@ -49,7 +49,13 @@ _LOWER_IS_BETTER = ("_ms", "_us", "_seconds", "latency", "_p50", "_p99",
                     # tracing + SLO metrics (round 20): budget burn,
                     # objective violations, and tracing overhead all
                     # regress UP
-                    "burn_rate", "violations")
+                    "burn_rate", "violations",
+                    # autotune metrics (round 21): search wall cost and
+                    # per-step kernel microseconds regress UP (already
+                    # implied by _ms/_us, pinned explicitly so a rename
+                    # cannot silently flip them; *_speedup stays
+                    # higher-is-better by omission)
+                    "search_ms", "us_per_step")
 
 
 def lower_is_better(name: str) -> bool:
