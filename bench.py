@@ -33,15 +33,10 @@ BASELINE_IMG_S = 181.53  # P100 ResNet-50 train b32 (docs/how_to/perf.md:132-139
 # training step ~= 3x forward (fwd + 2x in bwd).
 TRAIN_FLOPS_PER_IMG = 3 * 4.089e9
 
-# peak dense bf16 FLOP/s per chip, by device_kind substring (public specs)
-_PEAK_TFLOPS = [
-    ("v6", 918.0),     # Trillium
-    ("v5p", 459.0),
-    ("v5", 197.0),     # v5e / "TPU v5 lite"
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
+# The device-kind -> peak FLOP/s table lives in the telemetry perf
+# plane (mxnet_tpu/telemetry/perf.py:PEAK_TFLOPS, round 22) — ONE
+# table, so bench MFU and the live program_mfu gauge can never
+# disagree.  _peak_flops below delegates to it.
 
 
 def _emit(payload):
@@ -175,11 +170,12 @@ def _fail(msg, metric="resnet50_train_imgs_per_sec_per_chip"):
 
 
 def _peak_flops(device_kind):
-    kind = (device_kind or "").lower()
-    for key, tflops in _PEAK_TFLOPS:
-        if key in kind:
-            return tflops * 1e12
-    return None
+    """Peak FLOP/s for a device kind — the telemetry perf plane's
+    shared table (None on a miss; callers record a
+    ``peak_flops_unknown`` note instead of guessing)."""
+    from mxnet_tpu.telemetry import perf as _perf
+
+    return _perf.peak_flops(device_kind)
 
 
 def _init_backend(timeout_s, retry_timeout_s, notes):
@@ -312,8 +308,10 @@ def _dispatch_micro():
 
     from mxnet_tpu import sym, telemetry as tm
     from mxnet_tpu.context import default_accelerator_context
+    from mxnet_tpu.telemetry import perf as _perf
 
     was_enabled = tm.enabled()
+    perf_was = _perf.enabled()
     tm.enable()
     try:
         ctx = default_accelerator_context()
@@ -337,9 +335,14 @@ def _dispatch_micro():
         ex = sweep()                      # re-bind the same 3 structures
         recompiles = compile_ctr.total() - before
 
+        # arm the perf plane only AFTER the recompile sweep: the
+        # one-time cost capture re-traces the program for lower(), and
+        # that bookkeeping trace must not read as a cache miss above
+        _perf.enable()
         ex.forward(is_train=True)
-        ex.backward()
+        ex.backward()                     # warm + one-time cost capture
         jax.block_until_ready(ex.outputs[0]._read())
+        _perf.reset(costs=False)          # keep cost rows, drop warmup wall
         n = 100
         tic = time.perf_counter()
         for _ in range(n):
@@ -347,11 +350,29 @@ def _dispatch_micro():
             ex.backward()
         jax.block_until_ready(ex.outputs[0]._read())
         dt = time.perf_counter() - tic
-        return {"dispatch_us_per_step": round(dt / n * 1e6, 1),
-                "recompiles": int(recompiles)}
+        out = {"dispatch_us_per_step": round(dt / n * 1e6, 1),
+               "recompiles": int(recompiles)}
+        # agreement check (round 22): bench-side MFU (plane cost row
+        # FLOPs over the loop's own wall) vs the plane's program_mfu
+        # (same FLOPs over the wall its dispatch sites accumulated) —
+        # the two denominators measure the same loop, so the values
+        # must track each other
+        prof = _perf.profile_payload(topn=0)
+        row = next((p for p in prof["programs"]
+                    if p["program"] == getattr(ex, "_program_label", None)),
+                   None)
+        if row and row.get("flops") and prof.get("peak_flops") and dt > 0:
+            out["dispatch_bench_mfu"] = round(
+                row["flops"] * n / (dt * prof["peak_flops"]), 6)
+            if row.get("mfu") is not None:
+                out["dispatch_program_mfu"] = round(row["mfu"], 6)
+        return out
     finally:
         if not was_enabled:
             tm.disable()
+        if not perf_was:
+            _perf.disable()
+            _perf.reset()
 
 
 def _kv_update_micro():
@@ -1965,6 +1986,14 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
     from mxnet_tpu.trainer import FusedTrainer
 
     batch = int(os.environ.get("BENCH_BATCH", "32"))
+    # BENCH_EXPLAIN (round 22): arm the perf-attribution plane for the
+    # whole bench so a profile document (ranked programs, cost rows,
+    # MFU) can be written next to the headline number
+    explain = os.environ.get("BENCH_EXPLAIN", "").strip()
+    if explain:
+        from mxnet_tpu.telemetry import perf as _perf
+
+        _perf.enable()
     net = models.get_symbol("resnet-50", num_classes=1000)
     dtype = jnp.bfloat16 if os.environ.get("BENCH_DTYPE", "bf16") == "bf16" else jnp.float32
 
@@ -2046,10 +2075,28 @@ def _bench(dev, kind, init_notes=(), init_attempts=1):
         "model_tflops_per_sec": round(img_s * TRAIN_FLOPS_PER_IMG / 1e12, 2),
         "steps_per_call": spc,
     }
+    if peak is None:
+        # an unknown device kind must leave a note, not a bare null MFU
+        payload["peak_flops_unknown"] = (
+            "device_kind %r has no telemetry/perf.py:PEAK_TFLOPS entry"
+            % kind)
     payload["init_attempts"] = int(init_attempts)
     if init_notes:
         # a slow/retried backend init is a datapoint, not a silent event
         payload["init_notes"] = list(init_notes)
+    if explain:
+        # write the perf plane's full profile document (tools/explain.py
+        # renders it); BENCH_EXPLAIN=1 picks a default path
+        from mxnet_tpu.telemetry import perf as _perf
+
+        out_path = explain if explain.lower() not in ("1", "true") \
+            else "BENCH_EXPLAIN.json"
+        try:
+            with open(out_path, "w") as f:
+                json.dump(_perf.profile_payload(topn=0), f, indent=1)
+            payload["explain_path"] = out_path
+        except OSError as exc:
+            payload["explain_error"] = repr(exc)
 
     if os.environ.get("BENCH_EXTRAS", "1") == "1":
         # secondary datapoint (inference b32; P100 baseline 713.17 img/s)

@@ -41,6 +41,13 @@ ENTRY_POINTS = (
     # host paths — spans are pure dict/ring writes, never a device sync
     "mxnet_tpu.serving.router.ReplicaRouter.route_generate",
     "mxnet_tpu.telemetry.tracing.spans_payload",
+    # perf-attribution plane (ISSUE 20): the per-scrape gauge fold and
+    # the /profile payload walk the host-side ledgers the hot loops fed
+    # with perf_counter stamps — pure dict arithmetic, never a device
+    # touch; the ledger writers (record_dispatch/record_step_buckets)
+    # are covered through the fit/tick entry points above
+    "mxnet_tpu.telemetry.perf.publish_gauges",
+    "mxnet_tpu.telemetry.perf.profile_payload",
 )
 
 # Sanctioned sync boundaries: the analyzer does not descend into these.
@@ -73,6 +80,14 @@ BOUNDARIES = {
         "the autotuner's candidate timer: warmup + best-of-k "
         "block_until_ready at bind/admit-time search sites — never "
         "reachable from a steady-state tick",
+    # perf-attribution plane (ISSUE 20): the cost capture re-lowers the
+    # already-compiled program once per program lifetime (first
+    # dispatch, guarded by per-program flags and the MXTPU_PERF_ATTR
+    # arm) — compile() is a cache lookup; never a per-batch activity
+    "mxnet_tpu.telemetry.perf.attach_cost_analysis":
+        "one-time per-program compile-cache probe for the analytical "
+        "cost row at first dispatch — flag-guarded at every call site, "
+        "never per batch, no device sync (lower/compile only)",
 }
 
 # Device->host sync primitives, matched as method names on any receiver.

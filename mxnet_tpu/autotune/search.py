@@ -119,4 +119,36 @@ def ensure(kernel: str, keysig: str, default, candidates, bench_fn,
         return default
     _TM_BEST.set(best_us, kernel=kernel)
     _cache.record(kernel, keysig, best_sched, best_us, trials)
+    if _tm.perf.enabled():
+        _log_winner_roofline(kernel, best_us, trials)
     return best_sched
+
+
+def _log_winner_roofline(kernel: str, best_us: float, trials: int):
+    """Achieved-vs-roofline context for a search winner (perf plane,
+    docs/perf_attr.md): when a cost row exists for a program whose
+    label mentions the kernel, compare the winner's achieved wall to
+    the analytical roofline floor — max(flops/peak_flops,
+    bytes/peak_bw) — else just name the peaks the consumer's live MFU
+    will be measured against.  Logging only; never raises."""
+    import logging
+
+    try:
+        kind = _tm.perf.device_kind()
+        pf = _tm.perf.peak_flops(kind)
+        pb = _tm.perf.peak_bytes_per_sec(kind)
+        row = next((r for r in _tm.perf.cost_table()
+                    if kernel in r["program"]), None)
+        msg = ("autotune: %s winner %.1fus over %d trials on %s"
+               % (kernel, best_us, trials, kind))
+        if row and pf and pb and (row["flops"] or row["bytes_accessed"]):
+            floor_s = max((row["flops"] or 0.0) / pf,
+                          (row["bytes_accessed"] or 0.0) / pb)
+            msg += (" (roofline floor %.1fus, achieved %.0f%% of it)"
+                    % (floor_s * 1e6,
+                       100.0 * floor_s * 1e6 / best_us if best_us else 0.0))
+        elif pf:
+            msg += " (peak %.0f TFLOP/s, no cost row yet)" % (pf / 1e12)
+        logging.getLogger("mxnet_tpu.autotune").info(msg)
+    except Exception:  # noqa: BLE001 — reporting must never break a search
+        pass

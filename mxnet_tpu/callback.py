@@ -131,6 +131,10 @@ class Speedometer:
         speed = nbatches * self.batch_size / elapsed
         _TM_SPEED.set(speed)
         _TM_SPEED_SAMPLES.inc(nbatches * self.batch_size)
+        # perf plane armed: " mfu=0.42 top=dispatch" rides the log line
+        # (pure host reads of the attribution ledgers — the same
+        # no-added-syncs contract as the update_stamp() guard below)
+        perf_sfx = _tm.perf.speedometer_suffix()
         metric = param.eval_metric
         if metric is not None:
             # "values needed" boundary guard: get_name_value() is the
@@ -148,8 +152,9 @@ class Speedometer:
                     "\tTrain-%s=%f" % nv
                     for nv in metric.get_name_value())
                 logging.info(
-                    "%sEpoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
-                    _log_prefix(), param.epoch, param.nbatch, speed, parts)
+                    "%sEpoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s%s",
+                    _log_prefix(), param.epoch, param.nbatch, speed,
+                    perf_sfx, parts)
                 if self.auto_reset:
                     # reset only the local window: the epoch-end Train-*
                     # log (base_module.fit -> get_global_name_value) must
@@ -161,11 +166,13 @@ class Speedometer:
                                     else None)
             else:
                 logging.info(
-                    "%sEpoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                    _log_prefix(), param.epoch, param.nbatch, speed)
+                    "%sEpoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                    _log_prefix(), param.epoch, param.nbatch, speed,
+                    perf_sfx)
         else:
-            logging.info("%sIter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                         _log_prefix(), param.epoch, param.nbatch, speed)
+            logging.info("%sIter[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         _log_prefix(), param.epoch, param.nbatch, speed,
+                         perf_sfx)
         self._mark = (now, param.nbatch)
 
 

@@ -1018,6 +1018,15 @@ class Executor:
         # non-CPU backends
         self._program_label = self._record_bind_memory()
         self._mem_analyzed = False
+        # perf-attribution plane (telemetry/perf.py, MXTPU_PERF_ATTR):
+        # one analytical cost row per compiled program at first
+        # dispatch, fwd and fwdbwd each captured once (the fwdbwd row
+        # wins the shared label once training runs); the train
+        # forward's host wall is carried into backward's dispatch
+        # record so the fused program owns the whole fwd+bwd wall
+        self._cost_fwd_done = False
+        self._cost_fwdbwd_done = False
+        self._pending_fwd_wall = 0.0
 
     def _build_rs_specs(self, symbol, rs_holders):
         """Static ``(name, n_ids, row_dim, dtype)`` probe specs for the
@@ -1121,6 +1130,8 @@ class Executor:
     def forward(self, is_train=False, **kwargs):
         """Parity: Executor.forward (python/mxnet/executor.py:84 ->
         GraphExecutor::Forward)."""
+        perf_on = _tm.perf.enabled()
+        tp0 = time.perf_counter() if perf_on else 0.0
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError(f"unknown input {k}")
@@ -1139,6 +1150,10 @@ class Executor:
             # lazy: defer compute so backward() can run the fused fwd+bwd
             self._pending = (args, aux, key)
             self._outputs_cache = None
+            outs = self.outputs  # materializes via _jit_fwd (train mode)
+            self._pending_fwd_wall = \
+                (time.perf_counter() - tp0) if perf_on else 0.0
+            return outs
         else:
             from . import profiler as _prof
 
@@ -1163,8 +1178,16 @@ class Executor:
                     _tm.health.attach_compiled_analysis(
                         self._program_label, self._jit_fwd,
                         args, aux, key, False)
+                if perf_on and not self._cost_fwd_done:
+                    self._cost_fwd_done = True
+                    _tm.perf.attach_cost_analysis(
+                        self._program_label, self._jit_fwd,
+                        args, aux, key, False)
             if t0 is not None:
                 _TM_FWD_SEC.observe(time.perf_counter() - t0)
+            if perf_on:
+                _tm.perf.record_dispatch(self._program_label,
+                                         time.perf_counter() - tp0)
             if self._monitor_callback is not None:
                 self._run_monitor(args, aux, key)
         return self.outputs
@@ -1176,7 +1199,8 @@ class Executor:
             raise MXNetError("backward() requires forward(is_train=True) first")
         from . import profiler as _prof
 
-        t0 = time.perf_counter() if _tm.enabled() else None
+        perf_on = _tm.perf.enabled()
+        t0 = time.perf_counter() if (_tm.enabled() or perf_on) else None
         with _prof.span(f"forward_backward[{self._symbol.name or 'graph'}]",
                         device=str(self._ctx),
                         sync=lambda: jax.block_until_ready(
@@ -1185,6 +1209,14 @@ class Executor:
             self._backward_impl(out_grads)
         if t0 is not None:
             _TM_BWD_SEC.observe(time.perf_counter() - t0)
+            if perf_on:
+                # the fused program owns the train forward's host wall
+                # too — so the per-program ledger matches the wall a
+                # caller timing fwd+bwd (bench _dispatch_micro) sees
+                _tm.perf.record_dispatch(
+                    self._program_label,
+                    time.perf_counter() - t0 + self._pending_fwd_wall)
+                self._pending_fwd_wall = 0.0
 
     def _backward_impl(self, out_grads):
         args, aux, key = self._pending
@@ -1247,6 +1279,17 @@ class Executor:
         except Exception as e:  # noqa: BLE001 — OOM gets a report
             _tm.health.reraise_if_oom(e, site="executor.backward")
             raise
+        if not self._cost_fwdbwd_done and _tm.perf.enabled():
+            # one-time analytical cost row for the fused fwd+bwd
+            # program — same label as the memory row; overwrites the
+            # eval-forward row once training runs (the fwdbwd program
+            # is the one the fit loops attribute wall to)
+            self._cost_fwdbwd_done = True
+            _tm.perf.attach_cost_analysis(
+                self._program_label, self._jit_fwdbwd,
+                args, aux, key, head, grad_ins, loss_scale,
+                gnames=self._gnames, add_names=self._add_names,
+                rs_specs=self._rs_specs)
         self._outputs_cache = [NDArray(o) for o in outs]
         self._write_aux(new_aux)
         for k, g in grads.items():

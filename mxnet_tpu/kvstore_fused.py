@@ -411,6 +411,7 @@ class FusedUpdateEngine:
         self._load: Dict = {}       # merge-device -> assigned bucket bytes
         self._local_programs: Dict = {}  # fallback when the LRU is off
         self._push_count = 0        # the sentinel's step id for this store
+        self._cost_done: set = set()  # perf plane: buckets with cost rows
 
     @property
     def num_buckets(self) -> int:
@@ -514,6 +515,8 @@ class FusedUpdateEngine:
         self._plan_keys = tuple(keys)
         self._key_index = idx
         self._ndev = ndev
+        # perf plane: cost rows re-attach once per (re)plan
+        self._cost_done = set()
         if _tm.enabled():
             _TM_BUCKET_COUNT.set(len(buckets), store=self._kv.type)
 
@@ -684,6 +687,15 @@ class FusedUpdateEngine:
                 scale_raw = jax.device_put(scale_raw, b.target)
             args = args + (scale_raw,)
         res = fn(*args)
+        if bi not in self._cost_done and _tm.perf.enabled():
+            # perf plane: one analytical cost row per bucket program,
+            # same label as the plan-time memory row (once per plan)
+            self._cost_done.add(bi)
+            _tm.perf.attach_cost_analysis(
+                f"kv_bucket{bi}[{np.dtype(b.dtype).name}x{len(b.keys)}"
+                + (f"/shard{b.shard_n}" if b.shard_n > 1 else "")
+                + ("/mp" if b.mp else "") + "]",
+                fn, *args)
         new_w, new_s = res[0], res[1]
         flag = res[-1] if scaling else None
         if sentinel:
@@ -831,6 +843,14 @@ class FusedUpdateEngine:
                     sc, NamedSharding(b.shard_mesh, P()))
             args = args + (sc,)
         res = fn(*args)
+        if bi not in self._cost_done and _tm.perf.enabled():
+            # perf plane: cost row under the plan-time memory row's label
+            self._cost_done.add(bi)
+            _tm.perf.attach_cost_analysis(
+                f"kv_bucket{bi}[{np.dtype(b.dtype).name}x{len(b.keys)}"
+                + (f"/shard{b.shard_n}" if b.shard_n > 1 else "")
+                + ("/mp" if b.mp else "") + "]",
+                fn, *args)
         new_w, new_s = res[0], res[1]
         flag = res[-1] if scaling else None
         if sentinel:

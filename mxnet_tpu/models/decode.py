@@ -167,6 +167,10 @@ class KVDecoder:
         self._padded_prefill_cache = {}
         self._slot_step_jit = jax.jit(
             _count_compiles(self._forward_slots, "decode_step"))
+        # perf plane (telemetry/perf.py): one analytical cost row per
+        # compiled decode program, captured at first dispatch
+        self._cost_step_done = False
+        self._cost_prefill_done = set()
         self._adopt_jit = jax.jit(_count_compiles(
             lambda kc, vc, kr, vr, slot: (
                 jax.lax.dynamic_update_slice(kc, kr, (0, slot, 0, 0, 0)),
@@ -421,6 +425,15 @@ class KVDecoder:
         start = (T - lengths).astype(np.int32)
         (kc, vc), logits = self._padded_prefill_cache[T](
             kc, vc, tokens, jnp.asarray(start))
+        if T not in self._cost_prefill_done:
+            from .. import telemetry as _tm
+
+            if _tm.perf.enabled():
+                self._cost_prefill_done.add(T)
+                _tm.perf.attach_cost_analysis(
+                    f"decode_prefill[b{T}]",
+                    self._padded_prefill_cache[T],
+                    kc, vc, tokens, jnp.asarray(start))
         return (kc, vc), logits
 
     def step_slots(self, cache, tokens, start, cursor):
@@ -439,6 +452,14 @@ class KVDecoder:
                 "the request before ticking it")
         (kc, vc), logits = self._slot_step_jit(
             kc, vc, _snap(tokens), _snap(start), _snap(cursor))
+        if not self._cost_step_done:
+            from .. import telemetry as _tm
+
+            if _tm.perf.enabled():
+                self._cost_step_done = True
+                _tm.perf.attach_cost_analysis(
+                    "decode_step_slots", self._slot_step_jit,
+                    kc, vc, _snap(tokens), _snap(start), _snap(cursor))
         return (kc, vc), logits
 
     def adopt_row(self, cache, row_cache, slot):

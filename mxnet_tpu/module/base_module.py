@@ -260,6 +260,8 @@ class BaseModule:
         # MXTPU_COORD_ADDR; step_poll is a pure host-side flag check
         coord = _coordinator.client_from_env()
         flight = _tm.health.flight_enabled()
+        perf_on = _tm.perf.enabled()
+        rec = flight or perf_on
         program = None
         if flight:
             try:
@@ -287,24 +289,38 @@ class BaseModule:
                 if monitor is not None:
                     monitor.tic()
                 step_id += 1
-                t0 = time.perf_counter() if flight else 0.0
+                t0 = time.perf_counter() if rec else 0.0
                 self.forward_backward(data_batch)
                 self.update()
                 self.update_metric(eval_metric, data_batch.label)
+                tp = time.perf_counter() if perf_on else 0.0
                 window.push(self._output_handles())
-                if flight:
+                if rec:
                     # step-timing feed (ISSUE 14): wall_s is the full
                     # batch-to-batch host wall — what the coordinator
                     # heartbeat reports for straggler detection.  Pure
                     # perf_counter reads, no device sync.
                     now = time.perf_counter()
-                    _tm.health.record_step(
-                        loop="module", step=step_id, epoch=epoch,
-                        nbatch=nbatch, depth=len(window),
-                        dispatch_s=now - t0,
-                        wall_s=(now - prev_tick if prev_tick is not None
-                                else now - t0),
-                        program=program)
+                    if flight:
+                        _tm.health.record_step(
+                            loop="module", step=step_id, epoch=epoch,
+                            nbatch=nbatch, depth=len(window),
+                            dispatch_s=now - t0,
+                            wall_s=(now - prev_tick
+                                    if prev_tick is not None else now - t0),
+                            program=program)
+                    if perf_on:
+                        # step decomposition (docs/perf_attr.md): the
+                        # buckets partition the batch-to-batch wall by
+                        # construction — same stamps the flight feed
+                        # takes, zero device syncs
+                        _tm.perf.record_step_buckets(
+                            wall_s=(now - prev_tick
+                                    if prev_tick is not None else now - t0),
+                            data_wait=(max(t0 - prev_tick, 0.0)
+                                       if prev_tick is not None else 0.0),
+                            dispatch=tp - t0,
+                            window_stall=now - tp)
                     prev_tick = now
                 if coord is not None and coord.step_poll():
                     # the cluster generation moved (a host died or a
@@ -343,7 +359,11 @@ class BaseModule:
                         cb(params)
             # epoch boundary: the checkpoint/eval callbacks below need the
             # device caught up, and the epoch log reads the metric values
+            td0 = time.perf_counter() if perf_on else 0.0
             window.drain()
+            if perf_on:
+                _tm.perf.record_bucket("boundary_sync",
+                                       time.perf_counter() - td0)
             # global view: correct even when a Speedometer(auto_reset=True)
             # batch callback reset the metric's local window mid-epoch
             for name, val in eval_metric.get_global_name_value():

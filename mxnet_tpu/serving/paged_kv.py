@@ -371,6 +371,10 @@ class PagedSlots:
         # trace id of the admission currently allocating, so _alloc can
         # attribute its prefix evictions; None for step-time evictions
         self._trace_ctx = None
+        # perf plane (telemetry/perf.py): one analytical cost row per
+        # compiled paged program, captured at first dispatch
+        self._cost_step_done = False
+        self._cost_prefill_done = set()
         self._set_gauges()
 
     # ------------------------------------------------------------- schedule
@@ -536,6 +540,13 @@ class PagedSlots:
         (pk, pv), logits = self.programs.prefill(bucket)(
             self.pool[0], self.pool[1], _snap(self.bt[slot]),
             jnp.asarray(padded), jnp.int32(hist), jnp.int32(t))
+        if bucket not in self._cost_prefill_done and _tm.perf.enabled():
+            self._cost_prefill_done.add(bucket)
+            _tm.perf.attach_cost_analysis(
+                f"decode_prefill_paged[b{bucket}]",
+                self.programs.prefill(bucket),
+                pk, pv, _snap(self.bt[slot]), jnp.asarray(padded),
+                jnp.int32(hist), jnp.int32(t))
         self.pool = (pk, pv)
         self.cursor[slot] = p_len
         # promote this prompt's full blocks: they are never written
@@ -592,6 +603,12 @@ class PagedSlots:
         (pk, pv), logits = self.programs._step_jit(
             self.pool[0], self.pool[1], _snap(self.bt),
             _snap(tokens), _snap(self.cursor))
+        if not self._cost_step_done and _tm.perf.enabled():
+            self._cost_step_done = True
+            _tm.perf.attach_cost_analysis(
+                "decode_step_paged", self.programs._step_jit,
+                pk, pv, _snap(self.bt), _snap(tokens),
+                _snap(self.cursor))
         self.pool = (pk, pv)
         adv = occupied.copy()
         adv[starved] = False

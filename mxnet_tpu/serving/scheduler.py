@@ -564,6 +564,7 @@ class SlotScheduler:
         occ_mask = np.array([r is not None for r in self.slots])
         logits, starved = self.backend.step(self._next_tok, occ_mask)
         logits = np.asarray(logits, np.float32)   # the ONE host sync/tick
+        t_fetch = time.perf_counter()
         now = time.monotonic()
         for i in occupied:
             if i in starved:
@@ -582,6 +583,18 @@ class SlotScheduler:
         self.stats["slot_ticks"] += len(occupied)
         tick_dur = time.perf_counter() - t0
         _TM_TICK.observe(tick_dur)
+        if _tm.perf.enabled() and occupied:
+            # perf-attribution plane (docs/perf_attr.md): the tick wall
+            # splits into the decode dispatch (step + the one logits
+            # fetch above) and the host sampling loop — perf_counter
+            # stamps the tick already takes, no extra device sync
+            _tm.perf.record_dispatch(
+                "decode_step_paged"
+                if getattr(self.backend, "paged", False)
+                else "decode_step_slots", t_fetch - t0)
+            _tm.perf.record_step_buckets(
+                wall_s=tick_dur, dispatch=t_fetch - t0,
+                sample=tick_dur - (t_fetch - t0))
         for i, req in tick_reqs:
             _tracing.record_span(
                 "decode_tick", "replica", req.trace, tick_dur,

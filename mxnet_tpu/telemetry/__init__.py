@@ -47,6 +47,8 @@ from .tracing import (  # noqa: F401
     SloPlane, record_span, spans_payload, trace_on, enable_tracing,
     mint_traceparent, parse_traceparent,
 )
+from . import perf  # noqa: F401
+from .perf import profile_payload  # noqa: F401
 
 _http_server = None
 _port = _os.environ.get("MXTPU_TELEMETRY_HTTP_PORT")

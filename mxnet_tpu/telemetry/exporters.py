@@ -129,11 +129,28 @@ def start_http_server(port: int = 0, addr: str = "127.0.0.1",
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):
             path = self.path.split("?", 1)[0]
+            if path in ("/", "/metrics", "/metrics.json"):
+                # fold the perf-attribution ledgers into program_mfu/
+                # program_roofline right before the render — derived
+                # gauges are computed per scrape, not per batch (lazy
+                # import: perf pulls health which pulls this module)
+                from . import perf as _perf
+
+                _perf.publish_gauges()
             if path in ("/", "/metrics"):
                 body = generate_text(reg).encode("utf-8")
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/metrics.json":
                 body = json.dumps(json_snapshot(reg)).encode("utf-8")
+                ctype = "application/json"
+            elif path == "/profile":
+                # the perf-attribution plane's ranked-programs document
+                # (docs/perf_attr.md): cost rows x runtime ledger x peak
+                # table, rendered by tools/explain.py
+                from . import perf as _perf
+
+                body = json.dumps(_perf.profile_payload(),
+                                  default=str).encode("utf-8")
                 ctype = "application/json"
             elif path == "/spans.json":
                 # the bounded trace-span buffer + identity/clock offset

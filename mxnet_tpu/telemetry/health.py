@@ -573,6 +573,11 @@ def dump_flight_record(path=None, trigger: str = "manual") -> str:
     from . import tracing as _tracing
 
     payload["spans"] = _tracing.spans()
+    # the perf-attribution ledgers ride too (lazy import, same reason):
+    # untruncated (topn<=0) so a post-mortem never reads a cut table
+    from . import perf as _perf
+
+    payload["perf"] = _perf.profile_payload(topn=0)
     with open(path, "w") as f:
         json.dump(payload, f, indent=1, default=str)
     _TM_FLIGHT_DUMP.inc(trigger=trigger)

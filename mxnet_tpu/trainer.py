@@ -173,6 +173,7 @@ class FusedTrainer:
         self._sent_names: tuple = ()
         self._mem_recorded = False
         self._donated_bytes = None
+        self._cost_recorded = False
 
     # ------------------------------------------------------------------ setup
     def init(self, **input_shapes):
@@ -298,6 +299,7 @@ class FusedTrainer:
         sent_names = self._sent_names
         self._mem_recorded = False
         self._donated_bytes = None
+        self._cost_recorded = False
 
         def train_step(params, cparams, aux, opt_state, batch, key, step, lr):
             # the per-step RNG fold happens INSIDE the compiled step (step
@@ -496,7 +498,8 @@ class FusedTrainer:
 
         lr = np.float32(self.current_lr())  # single source of lr truth
         self._step += 1
-        t0 = _time.perf_counter() if _tm.enabled() else None
+        perf_on = _tm.perf.enabled()
+        t0 = _time.perf_counter() if (_tm.enabled() or perf_on) else None
         sb = self._shard_batch(batch)
         self._record_step_memory(sb)
         try:
@@ -507,6 +510,16 @@ class FusedTrainer:
         except Exception as e:  # noqa: BLE001 — OOM gets a report
             _tm.health.reraise_if_oom(e, site="trainer.step")
             raise
+        if perf_on and not self._cost_recorded:
+            # one-time analytical cost row for the fused step program
+            # (telemetry/perf.py) — compile() is a cache lookup here,
+            # the dispatch above already built the executable
+            self._cost_recorded = True
+            _tm.perf.attach_cost_analysis(
+                f"fused_step[{self.symbol.name or 'graph'}]",
+                self._step_fn, self.params, self._cparams, self.aux,
+                self.opt_state, sb, _random.current_key(),
+                np.int32(self._step), lr)
         if self._sentinel:
             (self.params, self._cparams, self.aux, self.opt_state,
              outs, sent) = res
@@ -521,6 +534,10 @@ class FusedTrainer:
             _TM_SAMPLES.inc(next(iter(sb.values())).shape[0], loop="fused")
             _tm.health.donation_saved(self._donated_bytes or 0,
                                       site="trainer_step")
+            if perf_on:
+                _tm.perf.record_dispatch(
+                    f"fused_step[{self.symbol.name or 'graph'}]",
+                    _time.perf_counter() - t0)
         return outs
 
     def _tree_nbytes(self, *trees):
@@ -799,6 +816,8 @@ class FusedTrainer:
         # MXTPU_COORD_ADDR; step_poll is a pure host-side flag check
         coord = _coordinator.client_from_env()
         flight = _tm.health.flight_enabled()
+        perf_on = _tm.perf.enabled()
+        rec = flight or perf_on
         for epoch in range(start_epoch, num_epoch):
             tic = _time.time()
             eval_metric.reset()
@@ -819,22 +838,39 @@ class FusedTrainer:
                 if not self.params:
                     self.init(**{k: tuple(v.shape)
                                  for k, v in feed.items()})
-                t0 = _time.perf_counter() if flight else 0.0
+                t0 = _time.perf_counter() if rec else 0.0
                 outs = self.step(**feed)
                 eval_metric.update(batch.label, [NDArray(o) for o in outs])
+                tp = _time.perf_counter() if perf_on else 0.0
                 window.push(list(outs))
-                if flight:
+                if rec:
                     # step-timing feed (ISSUE 14): wall_s = batch-to-
                     # batch host wall, reported by the coordinator
                     # heartbeat for straggler detection (host-side only)
                     now = _time.perf_counter()
-                    _tm.health.record_step(
-                        loop="fused", step=self._step, epoch=epoch,
-                        nbatch=nbatch, depth=len(window),
-                        dispatch_s=now - t0,
-                        wall_s=(now - prev_tick if prev_tick is not None
-                                else now - t0),
-                        program=f"fused_step[{self.symbol.name or 'graph'}]")
+                    if flight:
+                        _tm.health.record_step(
+                            loop="fused", step=self._step, epoch=epoch,
+                            nbatch=nbatch, depth=len(window),
+                            dispatch_s=now - t0,
+                            wall_s=(now - prev_tick
+                                    if prev_tick is not None else now - t0),
+                            program=f"fused_step"
+                                    f"[{self.symbol.name or 'graph'}]")
+                    if perf_on:
+                        # step decomposition (docs/perf_attr.md): the
+                        # three buckets partition this step's wall by
+                        # construction — data_wait is the iterator +
+                        # inter-step host work, dispatch the async
+                        # enqueues, window_stall the bounded-window
+                        # backpressure inside push()
+                        _tm.perf.record_step_buckets(
+                            wall_s=(now - prev_tick
+                                    if prev_tick is not None else now - t0),
+                            data_wait=(max(t0 - prev_tick, 0.0)
+                                       if prev_tick is not None else 0.0),
+                            dispatch=tp - t0,
+                            window_stall=now - tp)
                     prev_tick = now
                 if coord is not None and coord.step_poll():
                     # membership changed: boundary checkpoint, then the
@@ -868,7 +904,11 @@ class FusedTrainer:
                                            locals=None)
                     for cb in _as_list(batch_end_callback):
                         cb(params)
+            td0 = _time.perf_counter() if perf_on else 0.0
             window.drain()
+            if perf_on:
+                _tm.perf.record_bucket("boundary_sync",
+                                       _time.perf_counter() - td0)
             for name, val in eval_metric.get_global_name_value():
                 log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             log.info("Epoch[%d] Time cost=%.3f", epoch,

@@ -1,0 +1,503 @@
+"""Per-program performance attribution plane (ISSUE 20).
+
+Covers the acceptance criteria: guarded cost capture (backends without
+``cost_analysis`` yield an "unknown" row, never a raise), non-null CPU
+MFU for the fwd+bwd program, step buckets summing to the step wall on
+a live fit loop, the ``GET /profile`` shape, explain.py render + diff,
+zero per-batch host syncs with the plane ARMED, the Speedometer's
+sync-free ``mfu=`` suffix, and the bench-trend direction pins for the
+new metric names.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.telemetry import perf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _load_tool(name):
+    """Import a tools/ script by path (tools/ is not a package)."""
+    spec = importlib.util.spec_from_file_location(
+        "perf_test_" + name, os.path.join(TOOLS, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def plane():
+    """Armed plane + live registry, fully reset around each test."""
+    tm.enable()
+    tm.reset()
+    perf.reset()
+    perf.enable()
+    yield perf
+    perf.disable()
+    perf.reset()
+    tm.reset()
+    tm.disable()
+
+
+def _mlp():
+    data = sym.Variable("data")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=16, name="fc1"),
+                       act_type="relu")
+    return sym.SoftmaxOutput(sym.FullyConnected(h, num_hidden=10,
+                                                name="fc2"), name="softmax")
+
+
+def _fit(n_batches=6, num_epoch=1):
+    rs = np.random.RandomState(7)
+    x = rs.randn(16 * n_batches, 8).astype(np.float32)
+    y = rs.randint(0, 10, (16 * n_batches,)).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),),
+            num_epoch=num_epoch)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# peak table + derivation
+# ---------------------------------------------------------------------------
+
+def test_peak_table_matching_rules():
+    # v5p must win over the v5 substring; cpu is a nominal reference
+    assert perf.peak_flops("TPU v5p") == 459.0e12
+    assert perf.peak_flops("TPU v5 lite") == 197.0e12
+    assert perf.peak_flops("cpu") == 0.1e12
+    assert perf.peak_flops("quantum9000") is None
+    assert perf.peak_bytes_per_sec("TPU v4") == 1228.0e9
+    # machine balance = peak flops / peak bytes; None off-table
+    assert perf.machine_balance("cpu") == pytest.approx(2.0)
+    assert perf.machine_balance("quantum9000") is None
+
+
+def test_bench_peak_table_is_the_shared_one():
+    """Satellite: bench.py must report against the SAME peaks the live
+    plane derives MFU from — the table lives in perf.py only."""
+    spec = importlib.util.spec_from_file_location(
+        "perf_test_bench", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert not hasattr(bench, "_PEAK_TFLOPS")
+    assert bench._peak_flops("TPU v5p") == perf.peak_flops("TPU v5p")
+    assert bench._peak_flops("nope") is None
+
+
+# ---------------------------------------------------------------------------
+# cost capture (guarded)
+# ---------------------------------------------------------------------------
+
+class _FakeCompiled:
+    def __init__(self, cost):
+        self._cost = cost
+
+    def cost_analysis(self):
+        if isinstance(self._cost, Exception):
+            raise self._cost
+        return self._cost
+
+
+class _FakeLowered:
+    def __init__(self, cost):
+        self._cost = cost
+
+    def compile(self):
+        return _FakeCompiled(self._cost)
+
+
+class _FakeJitted:
+    def __init__(self, cost):
+        self._cost = cost
+
+    def lower(self, *a, **k):
+        return _FakeLowered(self._cost)
+
+
+def test_attach_cost_analysis_backend_without_support(plane):
+    """A backend whose executable has no usable cost_analysis must
+    yield an 'unknown' row and never raise."""
+    class NoCost:
+        def lower(self, *a, **k):
+            raise AttributeError("no lower on this backend")
+
+    assert plane.attach_cost_analysis("progA", NoCost()) is False
+    assert plane.attach_cost_analysis(
+        "progB", _FakeJitted(RuntimeError("unimplemented"))) is False
+    rows = {r["program"]: r for r in plane.cost_table()}
+    assert rows["progA"]["source"] == "unknown"
+    assert rows["progA"]["flops"] is None
+    assert rows["progB"]["source"] == "unknown"
+
+
+def test_attach_cost_analysis_real_row_and_list_shape(plane):
+    # newer jax returns a dict; older returned [dict] — both accepted
+    assert plane.attach_cost_analysis(
+        "progC", _FakeJitted({"flops": 1200.0, "bytes accessed": 600.0}))
+    assert plane.attach_cost_analysis(
+        "progD", _FakeJitted([{"flops": 7.0, "bytes accessed": 14.0}]))
+    rows = {r["program"]: r for r in plane.cost_table()}
+    assert rows["progC"] == {
+        "program": "progC", "flops": 1200.0, "bytes_accessed": 600.0,
+        "peak_memory": None, "source": "cost_analysis"}
+    assert rows["progD"]["flops"] == 7.0
+
+
+def test_attach_disarmed_records_nothing():
+    perf.disable()
+    try:
+        assert perf.attach_cost_analysis(
+            "progE", _FakeJitted({"flops": 1.0})) is False
+        assert perf.cost_table() == []
+    finally:
+        perf.reset()
+
+
+def test_cpu_executor_gets_real_cost_row_and_mfu(plane):
+    """Acceptance: on CPU the fwd+bwd program's MFU is non-null — the
+    capture must NOT skip the cpu backend (the memory plane does)."""
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(8, 8))
+    for _ in range(3):
+        ex.forward(is_train=True)
+        ex.backward()
+    payload = plane.profile_payload()
+    assert payload["device_kind"] == "cpu"
+    row = payload["programs"][0]
+    assert row["cost_source"] == "cost_analysis"
+    assert row["flops"] and row["flops"] > 0
+    assert row["mfu"] is not None and row["mfu"] > 0
+    assert row["roofline"] in ("compute_bound", "memory_bound")
+    assert row["dispatches"] == 3
+
+
+# ---------------------------------------------------------------------------
+# runtime ledger + step decomposition
+# ---------------------------------------------------------------------------
+
+def test_fit_buckets_sum_to_step_wall(plane):
+    """Acceptance: in-step buckets partition each step's wall, so their
+    ledger sums match the accumulated step wall within 10% on a live
+    CPU fit loop (exact by construction up to float rounding)."""
+    _fit(n_batches=6, num_epoch=2)
+    payload = plane.profile_payload()
+    steps = payload["steps"]
+    assert steps["count"] == 12
+    in_sum = sum(b["seconds"] for b in payload["buckets"].values()
+                 if b["in_step"])
+    assert steps["wall_s"] > 0
+    assert abs(in_sum - steps["wall_s"]) <= 0.10 * steps["wall_s"]
+    assert {"data_wait", "dispatch", "window_stall"} <= \
+        set(payload["buckets"])
+    # the epoch drain is outside the identity but on the ledger
+    assert payload["buckets"]["boundary_sync"]["in_step"] is False
+    # and the fwd+bwd program carried per-dispatch wall + a cost row
+    prog = payload["programs"][0]
+    assert prog["dispatches"] >= 12
+    assert prog["mfu"] is not None
+
+
+def test_fit_publishes_metric_families(plane):
+    _fit(n_batches=4)
+    plane.publish_gauges()
+    reg = tm.get_registry()
+    assert reg.get("program_wall_seconds").total() > 0
+    assert reg.get("step_time_seconds").total() > 0
+    assert reg.get("program_mfu") is not None
+    assert reg.get("program_mfu").samples()
+    assert reg.get("program_cost").samples()
+
+
+def test_disarmed_records_nothing():
+    perf.reset()
+    perf.disable()
+    perf.record_dispatch("p", 0.5)
+    perf.record_step_buckets(1.0, dispatch=1.0)
+    perf.record_bucket("boundary_sync", 0.1)
+    assert perf.runtime_table() == []
+    assert perf.bucket_table() == {}
+    assert perf.speedometer_suffix() == ""
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /profile, flight dump, Speedometer
+# ---------------------------------------------------------------------------
+
+def test_profile_endpoint_shape(plane):
+    plane.record_cost("p1", flops=100.0, bytes_accessed=50.0,
+                      source="cost_analysis")
+    plane.record_dispatch("p1", 0.25)
+    plane.record_step_buckets(0.3, data_wait=0.05, dispatch=0.25)
+    srv = tm.start_http_server(0)
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/profile", timeout=10).read()
+        doc = json.loads(body)
+        assert doc["version"] == 1 and doc["armed"] is True
+        assert doc["device_kind"] == "cpu"
+        assert doc["programs_total"] == 1
+        p = doc["programs"][0]
+        assert p["program"] == "p1" and p["dispatches"] == 1
+        assert p["mfu"] == pytest.approx(100.0 / (0.25 * 0.1e12))
+        assert p["roofline"] == "compute_bound"  # 2.0 intensity on cpu
+        assert doc["buckets"]["dispatch"]["seconds"] == pytest.approx(0.25)
+        assert doc["steps"] == {"count": 1, "wall_s": pytest.approx(0.3)}
+        # the scrape also derived the gauges for /metrics.json
+        jbody = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics.json", timeout=10).read()
+        fams = json.loads(jbody)["metrics"]
+        assert fams["program_mfu"]["samples"]
+        assert fams["program_roofline"]["samples"]
+    finally:
+        srv.shutdown()
+
+
+def test_profile_topn_truncates_but_counts_all(plane, monkeypatch):
+    for i in range(5):
+        plane.record_dispatch("prog%d" % i, 0.1 * (i + 1))
+    monkeypatch.setenv("MXTPU_PROFILE_TOPN", "2")
+    doc = plane.profile_payload()
+    assert doc["programs_total"] == 5 and len(doc["programs"]) == 2
+    assert doc["programs"][0]["program"] == "prog4"  # ranked by wall
+    assert len(plane.profile_payload(topn=0)["programs"]) == 5
+
+
+def test_flight_dump_embeds_untruncated_profile(plane, tmp_path):
+    from mxnet_tpu.telemetry import health
+
+    for i in range(3):
+        plane.record_dispatch("prog%d" % i, 0.1)
+    path = health.dump_flight_record(str(tmp_path / "dump.json"),
+                                     trigger="test")
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["perf"]["programs_total"] == 3
+    assert len(dump["perf"]["programs"]) == 3
+
+
+def test_speedometer_suffix_rides_log_line_with_zero_syncs(
+        plane, caplog, monkeypatch):
+    """Satellite: the armed Speedometer line carries mfu + dominant
+    bucket from pure ledger reads — zero device syncs added."""
+    import logging
+
+    from mxnet_tpu.callback import Speedometer
+
+    plane.record_cost("p1", flops=1e9, bytes_accessed=1e9,
+                      source="cost_analysis")
+    plane.record_dispatch("p1", 0.1)
+    plane.record_step_buckets(0.12, data_wait=0.02, dispatch=0.1)
+
+    counts = {"n": 0}
+    orig_asnumpy = nd.NDArray.asnumpy
+    orig_wait = nd.NDArray.wait_to_read
+
+    def counted_asnumpy(self):
+        counts["n"] += 1
+        return orig_asnumpy(self)
+
+    def counted_wait(self):
+        counts["n"] += 1
+        return orig_wait(self)
+
+    monkeypatch.setattr(nd.NDArray, "asnumpy", counted_asnumpy)
+    monkeypatch.setattr(nd.NDArray, "wait_to_read", counted_wait)
+
+    class P:
+        epoch, nbatch, eval_metric = 0, 2, None
+
+    speedo = Speedometer(batch_size=16, frequent=2)
+    with caplog.at_level(logging.INFO):
+        speedo(type("P0", (), {"epoch": 0, "nbatch": 0,
+                               "eval_metric": None})())
+        speedo(P())
+    line = "\n".join(r.getMessage() for r in caplog.records)
+    assert "mfu=0.10" in line and "top=dispatch" in line
+    assert counts["n"] == 0  # the suffix added no host syncs
+
+    # disarmed: the suffix vanishes, the line survives
+    perf.disable()
+    caplog.clear()
+    with caplog.at_level(logging.INFO):
+        speedo(type("P1", (), {"epoch": 0, "nbatch": 4,
+                               "eval_metric": None})())
+    line = "\n".join(r.getMessage() for r in caplog.records)
+    assert "Speed:" in line and "mfu=" not in line
+
+
+def test_perf_armed_fit_keeps_zero_per_batch_syncs(plane, monkeypatch):
+    """Acceptance: arming the plane must not add per-batch host syncs —
+    sync counts stay flat as the batch count quadruples."""
+    from mxnet_tpu import engine
+
+    counts = {"n": 0}
+    orig_asnumpy = nd.NDArray.asnumpy
+    orig_wait = engine.wait_for_var
+
+    def counted_asnumpy(self):
+        counts["n"] += 1
+        return orig_asnumpy(self)
+
+    def counted_wait(arr):
+        counts["n"] += 1
+        return orig_wait(arr)
+
+    def run(nbatch):
+        counts["n"] = 0
+        rs = np.random.RandomState(3)
+        x = rs.randn(16 * nbatch, 8).astype(np.float32)
+        y = rs.randint(0, 10, (16 * nbatch,)).astype(np.float32)
+        it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.fit(it, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.1),), num_epoch=1)
+        return counts["n"]
+
+    monkeypatch.setattr(nd.NDArray, "asnumpy", counted_asnumpy)
+    monkeypatch.setattr(engine, "wait_for_var", counted_wait)
+    small = run(4)
+    large = run(16)
+    assert large == small, (small, large)
+
+
+# ---------------------------------------------------------------------------
+# explain.py
+# ---------------------------------------------------------------------------
+
+def _synthetic_profile(wall=0.5, mfu_flops=2.5e10, steps=10,
+                       dispatch=0.45, data_wait=0.04, stall=0.01):
+    total = dispatch + data_wait + stall
+    return {
+        "version": 1, "armed": True, "device_kind": "cpu",
+        "peak_flops": 0.1e12, "peak_bytes_per_sec": 50.0e9,
+        "machine_balance": 2.0,
+        "programs": [{
+            "program": "fused_step[net]", "wall_s": wall,
+            "dispatches": steps, "flops": mfu_flops / steps,
+            "bytes_accessed": 1e9, "peak_memory": 1 << 20,
+            "cost_source": "cost_analysis",
+            "mfu": mfu_flops / (wall * 0.1e12),
+            "intensity": mfu_flops / steps / 1e9,
+            "roofline_ratio": 1.2, "roofline": "compute_bound"}],
+        "programs_total": 1,
+        "buckets": {
+            "dispatch": {"seconds": dispatch, "count": steps,
+                         "in_step": True},
+            "data_wait": {"seconds": data_wait, "count": steps,
+                          "in_step": True},
+            "window_stall": {"seconds": stall, "count": steps,
+                             "in_step": True},
+            "boundary_sync": {"seconds": 0.002, "count": 1,
+                              "in_step": False}},
+        "steps": {"count": steps, "wall_s": total},
+    }
+
+
+def _run_explain(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "explain.py"), *argv],
+        capture_output=True, text=True, timeout=60)
+
+
+def test_explain_renders_profile_and_flight_dump(tmp_path):
+    prof = tmp_path / "prof.json"
+    prof.write_text(json.dumps(_synthetic_profile()))
+    r = _run_explain(str(prof))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "fused_step[net]" in r.stdout
+    assert "compute_bound" in r.stdout
+    assert "sanity:" in r.stdout and "DIVERGED" not in r.stdout
+    assert "boundary_sync" in r.stdout and "(outside steps)" in r.stdout
+
+    # a flight dump carries the same document under "perf"
+    dump = tmp_path / "dump.json"
+    dump.write_text(json.dumps({"reason": "oom",
+                                "perf": _synthetic_profile()}))
+    r = _run_explain(str(dump))
+    assert r.returncode == 0 and "fused_step[net]" in r.stdout
+
+
+def test_explain_sanity_line_flags_divergence(tmp_path):
+    prof = _synthetic_profile()
+    prof["steps"]["wall_s"] *= 1.5  # a stamp went missing
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(prof))
+    r = _run_explain(str(p))
+    assert r.returncode == 0
+    assert "DIVERGED" in r.stdout
+
+
+def test_explain_diff_directions(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_synthetic_profile(wall=0.5, dispatch=0.45)))
+    b.write_text(json.dumps(_synthetic_profile(wall=0.6, dispatch=0.55,
+                                               mfu_flops=2.0e10)))
+    r = _run_explain("diff", str(a), str(b))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "fused_step[net]" in r.stdout
+    assert "+20.0%" in r.stdout          # wall moved up
+    assert "ms/step" in r.stdout         # per-step bucket normalization
+    assert "step wall:" in r.stdout
+
+
+def test_explain_rejects_non_profile_json(tmp_path):
+    p = tmp_path / "junk.json"
+    p.write_text(json.dumps({"hello": 1}))
+    r = _run_explain(str(p))
+    assert r.returncode == 1
+    assert "neither" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# bench_trend direction pins (satellite)
+# ---------------------------------------------------------------------------
+
+def test_bench_trend_directions_for_perf_metrics():
+    trend = _load_tool("bench_trend")
+    # higher-is-better: utilization + throughput regress DOWN
+    assert not trend.lower_is_better("mfu")
+    assert not trend.lower_is_better("dispatch_program_mfu")
+    assert not trend.lower_is_better("decode_tokens_per_sec")
+    # the override wins even when a lower-is-better token rides along
+    assert not trend.lower_is_better("mfu_stall_adjusted")
+    # lower-is-better: waiting regresses UP
+    assert trend.lower_is_better("data_wait_ms_per_step")
+    assert trend.lower_is_better("window_stall_seconds")
+
+
+# ---------------------------------------------------------------------------
+# bench agreement (acceptance: within 5% in _dispatch_micro)
+# ---------------------------------------------------------------------------
+
+def test_dispatch_micro_mfu_agreement():
+    spec = importlib.util.spec_from_file_location(
+        "perf_test_bench2", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    try:
+        out = bench._dispatch_micro()
+    finally:
+        perf.reset()
+        tm.reset()
+        tm.disable()
+    assert out["recompiles"] == 0  # the cost capture must not count
+    a = out["dispatch_bench_mfu"]
+    b = out["dispatch_program_mfu"]
+    assert a and b
+    assert abs(a - b) / max(a, b) <= 0.05, out

@@ -55,11 +55,22 @@ _LOWER_IS_BETTER = ("_ms", "_us", "_seconds", "latency", "_p50", "_p99",
                     # implied by _ms/_us, pinned explicitly so a rename
                     # cannot silently flip them; *_speedup stays
                     # higher-is-better by omission)
-                    "search_ms", "us_per_step")
+                    "search_ms", "us_per_step",
+                    # perf-attribution plane (round 22): stall was
+                    # already pinned above; time lost waiting on the
+                    # input pipeline regresses UP too
+                    "data_wait")
+# Explicit higher-is-better overrides, checked FIRST (round 22): mfu
+# and tokens_per_sec regress DOWN by name, so a lower-is-better token
+# sneaking into a future metric name (e.g. "mfu_stall_adjusted") can
+# never silently flip the headline utilization/throughput directions.
+_HIGHER_IS_BETTER = ("mfu", "tokens_per_sec")
 
 
 def lower_is_better(name: str) -> bool:
     n = name.lower()
+    if any(tok in n for tok in _HIGHER_IS_BETTER):
+        return False
     return any(tok in n for tok in _LOWER_IS_BETTER)
 
 

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Step-time explain CLI (docs/perf_attr.md): where does the wall GO?
+
+Renders the perf-attribution plane's ``/profile`` document — the ranked
+per-program table (device wall, MFU, roofline verdict, memory) and the
+step-time bucket decomposition with its sums-to-step-wall sanity line.
+The source can be a live process or a file:
+
+    python tools/explain.py localhost:9100          # GET /profile
+    python tools/explain.py profile.json            # saved payload
+    python tools/explain.py flight_dump.json        # dump's "perf" key
+
+``diff`` compares two captures (before/after a change) program by
+program and bucket by bucket, in each metric's regression direction:
+
+    python tools/explain.py diff before.json after.json
+
+Exit codes: 0 rendered, 1 source unreachable/unparseable, 2 usage.
+Stdlib-only on purpose (fleetstat.py's contract): runs on an operator
+workstation or a bare pod VM without the mxnet_tpu (or jax) install.
+"""
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def load_profile(source, timeout=10.0):
+    """The profile document from ``host:port`` (GET /profile), a saved
+    payload file, or a flight-record dump (whose ``perf`` key holds the
+    untruncated document)."""
+    if ":" in source and not source.endswith(".json"):
+        with urllib.request.urlopen("http://%s/profile" % source,
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read())
+    with open(source) as f:
+        doc = json.load(f)
+    if "programs" not in doc and isinstance(doc.get("perf"), dict):
+        doc = doc["perf"]  # flight dump: the plane rides under "perf"
+    if "programs" not in doc:
+        raise ValueError(
+            "%s is neither a /profile payload nor a flight dump with a "
+            "'perf' section" % source)
+    return doc
+
+
+def _fmt_flops(v):
+    if v is None:
+        return "-"
+    for unit, div in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if v >= div:
+            return "%.1f%s" % (v / div, unit)
+    return "%.0f" % v
+
+
+def _fmt_bytes(v):
+    if v is None:
+        return "-"
+    for unit, div in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if v >= div:
+            return "%.1f%s" % (v / div, unit)
+    return "%dB" % v
+
+
+def _fmt_mfu(v):
+    return "-" if v is None else "%.3f" % v
+
+
+def render(prof):
+    """One-screen rendering: header, ranked program table, bucket
+    decomposition + the sums-to-step-wall sanity line."""
+    lines = []
+    kind = prof.get("device_kind", "?")
+    peak = prof.get("peak_flops")
+    balance = prof.get("machine_balance")
+    lines.append(
+        "perf attribution on %s  peak %s  machine balance %s  %s" % (
+            kind,
+            ("%g TFLOP/s" % (peak / 1e12)) if peak else "UNKNOWN",
+            ("%.1f flops/byte" % balance) if balance else "?",
+            "armed" if prof.get("armed") else
+            "DISARMED (set MXTPU_PERF_ATTR=1)"))
+
+    programs = prof.get("programs") or []
+    total_wall = sum(p.get("wall_s") or 0.0 for p in programs)
+    lines.append("%-42s %9s %5s %7s %6s %-13s %8s %9s" % (
+        "program", "wall_ms", "share", "disp", "mfu", "roofline",
+        "flops", "peak_mem"))
+    for p in programs:
+        wall = p.get("wall_s") or 0.0
+        share = (wall / total_wall * 100.0) if total_wall > 0 else 0.0
+        lines.append("%-42s %9.1f %4.0f%% %7d %6s %-13s %8s %9s" % (
+            str(p.get("program", "?"))[:42], wall * 1e3, share,
+            p.get("dispatches") or 0, _fmt_mfu(p.get("mfu")),
+            str(p.get("roofline", "unknown")),
+            _fmt_flops(p.get("flops")),
+            _fmt_bytes(p.get("peak_memory"))))
+    if not programs:
+        lines.append("  (no programs attributed yet — has a dispatch "
+                     "run with the plane armed?)")
+    shown, known = len(programs), prof.get("programs_total")
+    if known is not None and known > shown:
+        lines.append("  ... %d more program(s) below the top-%d cut "
+                     "(MXTPU_PROFILE_TOPN)" % (known - shown, shown))
+
+    buckets = prof.get("buckets") or {}
+    steps = prof.get("steps") or {}
+    step_wall = float(steps.get("wall_s") or 0.0)
+    nsteps = int(steps.get("count") or 0)
+    lines.append("")
+    lines.append("step-time decomposition over %d step(s), %.1fms total:"
+                 % (nsteps, step_wall * 1e3))
+    in_sum = 0.0
+    for name in sorted(buckets,
+                       key=lambda n: -float(buckets[n].get("seconds", 0))):
+        b = buckets[name]
+        sec = float(b.get("seconds") or 0.0)
+        in_step = bool(b.get("in_step"))
+        if in_step:
+            in_sum += sec
+        share = (sec / step_wall * 100.0) \
+            if in_step and step_wall > 0 else None
+        lines.append("  %-16s %9.1fms %6s  x%d%s" % (
+            name, sec * 1e3,
+            ("%4.0f%%" % share) if share is not None else "",
+            int(b.get("count") or 0),
+            "" if in_step else "  (outside steps)"))
+    if step_wall > 0:
+        div = abs(in_sum - step_wall) / step_wall
+        lines.append(
+            "  sanity: in-step buckets sum to %.1fms of %.1fms step wall "
+            "(%.1f%% apart)%s" % (
+                in_sum * 1e3, step_wall * 1e3, div * 100.0,
+                "" if div <= 0.10 else
+                "  <- DIVERGED >10%: a stamp is missing a bucket"))
+    elif not buckets:
+        lines.append("  (no step buckets yet)")
+    return "\n".join(lines)
+
+
+def _index(prof):
+    return {p.get("program"): p for p in prof.get("programs") or []}
+
+
+def diff(prof_a, prof_b):
+    """A-vs-B rendering: per-program wall/MFU movement and the bucket
+    deltas, flagged in each metric's bad direction (wall up = worse,
+    MFU down = worse — the same conventions bench_trend.py pins)."""
+    lines = []
+    a_idx, b_idx = _index(prof_a), _index(prof_b)
+    lines.append("%-42s %10s %10s %8s %7s %7s" % (
+        "program", "wall_ms A", "wall_ms B", "Δwall%", "mfu A", "mfu B"))
+    for label in sorted(set(a_idx) | set(b_idx),
+                        key=lambda n: -(b_idx.get(n, a_idx.get(n, {}))
+                                        .get("wall_s") or 0.0)):
+        pa, pb = a_idx.get(label), b_idx.get(label)
+        wa = (pa or {}).get("wall_s")
+        wb = (pb or {}).get("wall_s")
+        if wa and wb:
+            dw = "%+.1f%%" % ((wb - wa) / wa * 100.0)
+        else:
+            dw = "new" if pa is None else ("gone" if pb is None else "-")
+        lines.append("%-42s %10s %10s %8s %7s %7s" % (
+            str(label)[:42],
+            "-" if wa is None else "%.1f" % (wa * 1e3),
+            "-" if wb is None else "%.1f" % (wb * 1e3),
+            dw, _fmt_mfu((pa or {}).get("mfu")),
+            _fmt_mfu((pb or {}).get("mfu"))))
+
+    ba = prof_a.get("buckets") or {}
+    bb = prof_b.get("buckets") or {}
+    sa = float((prof_a.get("steps") or {}).get("wall_s") or 0.0)
+    sb = float((prof_b.get("steps") or {}).get("wall_s") or 0.0)
+    na = int((prof_a.get("steps") or {}).get("count") or 0)
+    nb = int((prof_b.get("steps") or {}).get("count") or 0)
+    lines.append("")
+    lines.append("buckets (per-step ms so A and B compare across "
+                 "different step counts):")
+    lines.append("%-16s %12s %12s %8s" % ("bucket", "A ms/step",
+                                          "B ms/step", "Δ"))
+    for name in sorted(set(ba) | set(bb)):
+        va = (float(ba[name].get("seconds") or 0.0) / na * 1e3) \
+            if name in ba and na else None
+        vb = (float(bb[name].get("seconds") or 0.0) / nb * 1e3) \
+            if name in bb and nb else None
+        if va and vb:
+            d = "%+.1f%%" % ((vb - va) / va * 100.0)
+        else:
+            d = "-"
+        lines.append("%-16s %12s %12s %8s" % (
+            name, "-" if va is None else "%.2f" % va,
+            "-" if vb is None else "%.2f" % vb, d))
+    if na and nb and sa and sb:
+        lines.append("step wall: %.2f -> %.2f ms/step (%+.1f%%)" % (
+            sa / na * 1e3, sb / nb * 1e3,
+            (sb / nb - sa / na) / (sa / na) * 100.0))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "diff":
+        ap = argparse.ArgumentParser(
+            prog="explain.py diff",
+            description="compare two profile captures (file or "
+                        "host:port each)")
+        ap.add_argument("a", help="baseline capture")
+        ap.add_argument("b", help="candidate capture")
+        ap.add_argument("--timeout", type=float, default=10.0)
+        args = ap.parse_args(argv[1:])
+        try:
+            prof_a = load_profile(args.a, timeout=args.timeout)
+            prof_b = load_profile(args.b, timeout=args.timeout)
+        except (OSError, ValueError) as exc:
+            print("explain: %s" % exc, file=sys.stderr)
+            return 1
+        print(diff(prof_a, prof_b))
+        return 0
+
+    ap = argparse.ArgumentParser(
+        prog="explain.py",
+        description="render a perf-attribution profile (live GET "
+                    "/profile, saved payload, or flight dump)")
+    ap.add_argument("source", help="host:port, profile JSON, or "
+                    "flight-record dump")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the raw profile JSON")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    try:
+        prof = load_profile(args.source, timeout=args.timeout)
+    except (OSError, ValueError) as exc:
+        print("explain: %s" % exc, file=sys.stderr)
+        return 1
+    print(json.dumps(prof, indent=1) if args.as_json else render(prof))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
